@@ -1,0 +1,125 @@
+"""Checkpoint save/load with atomic per-tensor fragments.
+
+Analogue of the reference checkpoint stack (engine.py:2982 save_checkpoint,
+:2653 load_checkpoint, runtime/checkpoint_engine/, and the offline
+universal-checkpoint pipeline checkpoint/ds_to_universal.py:254).
+
+Design decision from SURVEY.md §7: the reference retrofits "universal
+checkpoints" by post-processing (tp,pp,dp)-sharded files into atomic per-param
+fragments. We make the *native* layout atomic-per-tensor from day 1: every leaf
+is stored as one full (unsharded) ``.npy`` fragment plus a JSON manifest. Any
+topology can load any checkpoint — elastic dp/tp/pp resize is just
+``jax.device_put`` onto the new sharding, no reshape tool required (the tool
+exists anyway for importing reference-style sharded checkpoints).
+
+Multi-host: sharded arrays are gathered via multihost allgather before process
+0 writes; loads read on every host and re-shard on device_put.
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+SENTINEL_NONE = "__none__"
+
+
+def _leaf_paths(tree):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path).strip("[]'\"").replace("']['", "/") \
+            .replace("'].", "/").replace("['", "").replace("']", "") \
+            .replace(".", "/").replace("[", "/").replace("]", "")
+        out.append((key, leaf))
+    return out, treedef
+
+
+def _fetch(leaf) -> np.ndarray:
+    """Gather a (possibly sharded, possibly multi-host) jax.Array to host.
+
+    Low-precision floats are upcast to fp32 fragments (lossless) — .npy has no
+    portable bf16 encoding, and fp32 fragments are what the universal
+    checkpoint format wants anyway (reference checkpoint/ds_to_universal.py)."""
+    if isinstance(leaf, (np.ndarray, np.generic, int, float)):
+        arr = np.asarray(leaf)
+    elif hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        arr = np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+    else:
+        arr = np.asarray(jax.device_get(leaf))
+    if arr.dtype.kind == "f" and arr.dtype.itemsize < 4 or arr.dtype.kind == "V":
+        arr = arr.astype(np.float32)
+    return arr
+
+
+def save_state(save_dir: str, tag: str, state: Dict[str, Any],
+               meta: Dict[str, Any], save_latest: bool = True) -> None:
+    ckpt_dir = os.path.join(save_dir, tag)
+    is_writer = jax.process_index() == 0
+    if is_writer:
+        os.makedirs(ckpt_dir, exist_ok=True)
+    manifest = {"tensors": {}, "meta": meta}
+    for name, subtree in state.items():
+        if subtree is None:
+            manifest["tensors"][name] = SENTINEL_NONE
+            continue
+        leaves, _ = _leaf_paths(subtree)
+        entries = {}
+        for key, leaf in leaves:
+            arr = _fetch(leaf)
+            fname = f"{name}__{key.replace('/', '__')}.npy" if key else f"{name}.npy"
+            if is_writer:
+                np.save(os.path.join(ckpt_dir, fname), arr)
+            entries[key] = {"file": fname, "shape": list(arr.shape),
+                            "dtype": str(arr.dtype)}
+        manifest["tensors"][name] = entries
+    if is_writer:
+        with open(os.path.join(ckpt_dir, "manifest.json"), "w") as fh:
+            json.dump(manifest, fh, indent=2, default=str)
+        if save_latest:
+            with open(os.path.join(save_dir, "latest"), "w") as fh:
+                fh.write(tag)
+
+
+def read_latest(load_dir: str) -> Optional[str]:
+    path = os.path.join(load_dir, "latest")
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        return fh.read().strip()
+
+
+def load_state(load_dir: str, tag: str, template: Dict[str, Any],
+               shardings: Dict[str, Any], mesh, zero_plan
+               ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Load into the structure of `template`, placing each leaf with the
+    sharding the corresponding template leaf currently has (elastic reshard)."""
+    ckpt_dir = os.path.join(load_dir, tag)
+    with open(os.path.join(ckpt_dir, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    state: Dict[str, Any] = {}
+    for name, subtree in template.items():
+        entry = manifest["tensors"].get(name, SENTINEL_NONE)
+        if entry == SENTINEL_NONE or subtree is None:
+            state[name] = subtree if entry == SENTINEL_NONE else subtree
+            continue
+        leaves, treedef = _leaf_paths(subtree)
+        new_leaves = []
+        for key, leaf in leaves:
+            info = entry.get(key)
+            if info is None:
+                raise KeyError(f"checkpoint missing tensor {name}/{key}")
+            arr = np.load(os.path.join(ckpt_dir, info["file"]))
+            if hasattr(leaf, "sharding"):
+                if hasattr(leaf, "dtype"):
+                    arr = arr.astype(leaf.dtype)
+                new_leaves.append(jax.device_put(arr, leaf.sharding))
+            else:
+                new_leaves.append(jax.numpy.asarray(arr) if hasattr(leaf, "dtype")
+                                  else arr)
+        state[name] = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return state, manifest["meta"]

@@ -1,0 +1,37 @@
+"""deepspeed_tpu.comm — backend-agnostic collectives over mesh axes.
+
+Mirrors the public surface of the reference's ``deepspeed.comm`` package
+(deepspeed/comm/comm.py) with XLA collectives in place of NCCL/oneCCL.
+"""
+
+from .comm import (  # noqa: F401
+    ReduceOp,
+    all_gather_into_tensor,
+    all_reduce,
+    all_to_all,
+    all_to_all_single,
+    allgather_fn,
+    axis_rank,
+    axis_size,
+    barrier,
+    broadcast,
+    configure,
+    get_comms_logger,
+    get_device_count,
+    get_local_rank,
+    get_rank,
+    get_world_size,
+    has_all_gather_into_tensor,
+    has_reduce_scatter_tensor,
+    inference_all_reduce,
+    init_distributed,
+    is_initialized,
+    log_summary,
+    permute,
+    recv_prev,
+    reduce_scatter_fn,
+    reduce_scatter_tensor,
+    send_next,
+    send_prev,
+    timed_op,
+)

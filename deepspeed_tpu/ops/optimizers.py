@@ -1,0 +1,244 @@
+"""Fused optimizers (functional).
+
+TPU-native replacements for the reference's optimizer kernels:
+  - FusedAdam  (csrc/adam/multi_tensor_adam.cu, ops/adam/fused_adam.py:195)
+  - FusedLamb  (csrc/lamb/fused_lamb_cuda_kernel.cu)
+  - FusedLion  (csrc/lion/multi_tensor_lion.cu)
+  - CPU Adam / Adagrad (csrc/adam/cpu_adam.cpp, csrc/adagrad/cpu_adagrad.cpp)
+
+On TPU the "fusion" the CUDA multi-tensor-apply kernels buy is done by XLA:
+each update below is elementwise math that XLA fuses into a handful of kernels
+per parameter, and under ZeRO sharding each device only updates its own shard.
+State and params are pytrees; master weights are fp32 regardless of the
+compute dtype (the engine casts down after the step).
+
+All updates are pure functions: (params, grads, state, step) -> (params, state).
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _tree_zeros_like(params, dtype=jnp.float32):
+    return jax.tree.map(lambda p: jnp.zeros(p.shape, dtype), params)
+
+
+@dataclass(frozen=True)
+class TpuOptimizer:
+    """Base: holds hyperparameters; subclasses define leaf-wise update math."""
+
+    lr: float = 1e-3
+    weight_decay: float = 0.0
+
+    def init_state(self, master_params) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def apply(self, master_params, grads, state, step, lr=None):
+        """step is 1-based. lr overrides self.lr (for schedules)."""
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class FusedAdam(TpuOptimizer):
+    """Adam/AdamW (adam_w_mode matches reference ops/adam/fused_adam.py:195)."""
+
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    adam_w_mode: bool = True
+    bias_correction: bool = True
+
+    def init_state(self, master_params):
+        return {
+            "exp_avg": _tree_zeros_like(master_params),
+            "exp_avg_sq": _tree_zeros_like(master_params),
+        }
+
+    def apply(self, master_params, grads, state, step, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        step = jnp.asarray(step, jnp.float32)
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** step
+            bc2 = 1.0 - b2 ** step
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+
+        def leaf(p, g, m, v):
+            g = g.astype(jnp.float32)
+            if self.weight_decay and not self.adam_w_mode:
+                g = g + self.weight_decay * p
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * g * g
+            update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+            if self.weight_decay and self.adam_w_mode:
+                update = update + self.weight_decay * p
+            return p - lr * update, m, v
+
+        out = jax.tree.map(leaf, master_params, grads, state["exp_avg"], state["exp_avg_sq"])
+        new_p = jax.tree.map(lambda t: t[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_m = jax.tree.map(lambda t: t[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_v = jax.tree.map(lambda t: t[2], out, is_leaf=lambda x: isinstance(x, tuple))
+        return new_p, {"exp_avg": new_m, "exp_avg_sq": new_v}
+
+
+@dataclass(frozen=True)
+class FusedLamb(TpuOptimizer):
+    """LAMB with per-layer trust ratio (reference csrc/lamb kernels)."""
+
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-6
+    max_coeff: float = 10.0
+    min_coeff: float = 0.01
+
+    def init_state(self, master_params):
+        return {
+            "exp_avg": _tree_zeros_like(master_params),
+            "exp_avg_sq": _tree_zeros_like(master_params),
+        }
+
+    def apply(self, master_params, grads, state, step, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        step = jnp.asarray(step, jnp.float32)
+        bc1 = 1.0 - b1 ** step
+        bc2 = 1.0 - b2 ** step
+
+        def leaf(p, g, m, v):
+            g = g.astype(jnp.float32)
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * g * g
+            update = (m / bc1) / (jnp.sqrt(v / bc2) + self.eps)
+            if self.weight_decay:
+                update = update + self.weight_decay * p
+            w_norm = jnp.linalg.norm(p.reshape(-1))
+            u_norm = jnp.linalg.norm(update.reshape(-1))
+            trust = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff), 1.0)
+            return p - lr * trust * update, m, v
+
+        out = jax.tree.map(leaf, master_params, grads, state["exp_avg"], state["exp_avg_sq"])
+        new_p = jax.tree.map(lambda t: t[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_m = jax.tree.map(lambda t: t[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_v = jax.tree.map(lambda t: t[2], out, is_leaf=lambda x: isinstance(x, tuple))
+        return new_p, {"exp_avg": new_m, "exp_avg_sq": new_v}
+
+
+@dataclass(frozen=True)
+class FusedLion(TpuOptimizer):
+    """Lion (reference csrc/lion/multi_tensor_lion.cu)."""
+
+    lr: float = 1e-4
+    betas: Tuple[float, float] = (0.9, 0.99)
+
+    def init_state(self, master_params):
+        return {"exp_avg": _tree_zeros_like(master_params)}
+
+    def apply(self, master_params, grads, state, step, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+
+        def leaf(p, g, m):
+            g = g.astype(jnp.float32)
+            update = jnp.sign(b1 * m + (1.0 - b1) * g)
+            if self.weight_decay:
+                update = update + self.weight_decay * p
+            m = b2 * m + (1.0 - b2) * g
+            return p - lr * update, m
+
+        out = jax.tree.map(leaf, master_params, grads, state["exp_avg"])
+        new_p = jax.tree.map(lambda t: t[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_m = jax.tree.map(lambda t: t[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        return new_p, {"exp_avg": new_m}
+
+
+@dataclass(frozen=True)
+class FusedAdagrad(TpuOptimizer):
+    """Adagrad (reference csrc/adagrad/cpu_adagrad.cpp)."""
+
+    lr: float = 1e-2
+    eps: float = 1e-10
+
+    def init_state(self, master_params):
+        return {"sum_sq": _tree_zeros_like(master_params)}
+
+    def apply(self, master_params, grads, state, step, lr=None):
+        lr = self.lr if lr is None else lr
+
+        def leaf(p, g, s):
+            g = g.astype(jnp.float32)
+            if self.weight_decay:
+                g = g + self.weight_decay * p
+            s = s + g * g
+            return p - lr * g / (jnp.sqrt(s) + self.eps), s
+
+        out = jax.tree.map(leaf, master_params, grads, state["sum_sq"])
+        new_p = jax.tree.map(lambda t: t[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_s = jax.tree.map(lambda t: t[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        return new_p, {"sum_sq": new_s}
+
+
+@dataclass(frozen=True)
+class SGD(TpuOptimizer):
+    lr: float = 1e-2
+    momentum: float = 0.0
+    nesterov: bool = False
+
+    def init_state(self, master_params):
+        if self.momentum == 0.0:
+            return {}
+        return {"momentum_buf": _tree_zeros_like(master_params)}
+
+    def apply(self, master_params, grads, state, step, lr=None):
+        lr = self.lr if lr is None else lr
+
+        def leaf(p, g, buf=None):
+            g = g.astype(jnp.float32)
+            if self.weight_decay:
+                g = g + self.weight_decay * p
+            if buf is None:
+                return p - lr * g, None
+            buf = self.momentum * buf + g
+            upd = g + self.momentum * buf if self.nesterov else buf
+            return p - lr * upd, buf
+
+        if self.momentum == 0.0:
+            out = jax.tree.map(lambda p, g: leaf(p, g), master_params, grads)
+            new_p = jax.tree.map(lambda t: t[0], out, is_leaf=lambda x: isinstance(x, tuple))
+            return new_p, {}
+        out = jax.tree.map(leaf, master_params, grads, state["momentum_buf"])
+        new_p = jax.tree.map(lambda t: t[0], out, is_leaf=lambda x: isinstance(x, tuple))
+        new_b = jax.tree.map(lambda t: t[1], out, is_leaf=lambda x: isinstance(x, tuple))
+        return new_p, {"momentum_buf": new_b}
+
+
+# Registry mirroring reference engine._configure_basic_optimizer name dispatch
+# (runtime/engine.py:1239): adam/adamw/lamb/lion/adagrad/sgd (1-bit variants in
+# runtime/fp16/onebit are layered on top of the comm path, added separately).
+OPTIMIZER_REGISTRY: Dict[str, Callable[..., TpuOptimizer]] = {
+    "adam": lambda **kw: FusedAdam(adam_w_mode=False, **kw),
+    "adamw": lambda **kw: FusedAdam(adam_w_mode=True, **kw),
+    "fusedadam": lambda **kw: FusedAdam(**kw),
+    "lamb": FusedLamb,
+    "fusedlamb": FusedLamb,
+    "lion": FusedLion,
+    "fusedlion": FusedLion,
+    "adagrad": FusedAdagrad,
+    "sgd": SGD,
+}
+
+
+def build_optimizer(name: str, params: Dict[str, Any]) -> TpuOptimizer:
+    key = name.lower().replace("_", "")
+    if key not in OPTIMIZER_REGISTRY:
+        raise ValueError(f"unknown optimizer '{name}'; known: {sorted(OPTIMIZER_REGISTRY)}")
+    kw = dict(params)
+    # accept torch-style names
+    if "betas" in kw:
+        kw["betas"] = tuple(kw["betas"])
+    kw.pop("torch_adam", None)
+    kw.pop("adam_w_mode", None) if key in ("adam", "adamw") else None
+    return OPTIMIZER_REGISTRY[key](**kw)

@@ -1,0 +1,130 @@
+"""Device-mesh topology.
+
+TPU-native replacement for the reference's process-group machinery:
+``deepspeed/utils/groups.py`` (initialize :51, expert/data groups :113, sequence
+accessors :420-460) and ``runtime/pipe/topology.py`` (ProcessTopology :12,
+PipelineParallelGrid :251). Instead of materializing torch.distributed process
+groups per parallel axis, we build ONE ``jax.sharding.Mesh`` with named axes and
+express every "group" as a mesh axis (or tuple of axes); XLA lowers collectives
+over an axis to ICI/DCN rings over exactly the devices the reference would have
+put in that group.
+
+Axis order (outermost -> innermost) is chosen for ICI locality: the innermost
+axes get the fastest links, so tensor parallelism ("model") is innermost,
+then sequence, then expert/data, with pipeline outermost (pipeline p2p is the
+least bandwidth-hungry).
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names (single source of truth).
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+EXPERT_AXIS = "expert"
+SEQ_AXIS = "seq"
+MODEL_AXIS = "model"
+
+AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+
+@dataclass(frozen=True)
+class TopologyConfig:
+    pipe: int = 1
+    model: int = 1  # tensor parallel
+    seq: int = 1  # Ulysses sequence parallel
+    expert: int = 1  # expert parallel (factors the data-parallel dimension)
+
+
+class MeshTopology:
+    """Owns the global device mesh and answers group-membership questions.
+
+    Reference parity:
+      - data-parallel group      -> ("data", "expert") axes combined
+        (experts are replicated data-parallel-wise across the expert axis for
+        dense params; expert params use "expert" as their placement axis, the
+        same way reference expert-data-parallel groups factor the DP world,
+        utils/groups.py:113)
+      - model(tensor)-parallel   -> "model" axis
+      - pipeline stage grid      -> "pipe" axis
+      - sequence-parallel group  -> "seq" axis (groups.py:420-460)
+    """
+
+    def __init__(self, topo: TopologyConfig, devices: Optional[Sequence] = None):
+        devices = list(devices if devices is not None else jax.devices())
+        n = len(devices)
+        mp = topo.pipe * topo.model * topo.seq * topo.expert
+        if n % mp != 0:
+            raise ValueError(
+                f"{n} devices not divisible by pipe*model*seq*expert={mp}")
+        data = n // mp
+        self.topo = topo
+        self.sizes: Dict[str, int] = {
+            PIPE_AXIS: topo.pipe,
+            DATA_AXIS: data,
+            EXPERT_AXIS: topo.expert,
+            SEQ_AXIS: topo.seq,
+            MODEL_AXIS: topo.model,
+        }
+        shape = tuple(self.sizes[a] for a in AXIS_ORDER)
+        mesh_devices = np.asarray(devices).reshape(shape)
+        self.mesh = Mesh(mesh_devices, AXIS_ORDER)
+
+    # ------------------------------------------------------------------
+    @property
+    def world_size(self) -> int:
+        return int(np.prod([s for s in self.sizes.values()]))
+
+    def axis_size(self, axis: str) -> int:
+        return self.sizes[axis]
+
+    @property
+    def dp_axes(self) -> Tuple[str, ...]:
+        """Axes a dense parameter's ZeRO shard spans (DP world = data*expert)."""
+        return (DATA_AXIS, EXPERT_AXIS) if self.sizes[EXPERT_AXIS] > 1 else (DATA_AXIS,)
+
+    @property
+    def dp_world_size(self) -> int:
+        return self.sizes[DATA_AXIS] * self.sizes[EXPERT_AXIS]
+
+    @property
+    def batch_axes(self) -> Tuple[str, ...]:
+        """Axes the global batch is sharded over (data-like axes)."""
+        return (DATA_AXIS, EXPERT_AXIS) if self.sizes[EXPERT_AXIS] > 1 else (DATA_AXIS,)
+
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self, extra_seq: bool = True) -> NamedSharding:
+        """[batch, seq, ...] sharding: batch over data axes, seq over seq axis."""
+        batch = self.batch_axes if len(self.batch_axes) > 1 else self.batch_axes[0]
+        if extra_seq and self.sizes[SEQ_AXIS] > 1:
+            return self.sharding(batch, SEQ_AXIS)
+        return self.sharding(batch)
+
+    def __repr__(self):
+        return f"MeshTopology({self.sizes})"
+
+
+def build_topology(config=None, devices=None, *, pipe=None, model=None, seq=None,
+                   expert=None) -> MeshTopology:
+    """Build from a DeepSpeedConfig (runtime.config) or explicit sizes."""
+    if config is not None:
+        c = config.cfg
+        topo = TopologyConfig(
+            pipe=pipe or c.pipeline.stages,
+            model=model or c.tensor_parallel_size,
+            seq=seq or c.sequence_parallel_size,
+            expert=expert or (c.moe.expert_parallel_size if c.moe.enabled else 1),
+        )
+    else:
+        topo = TopologyConfig(pipe=pipe or 1, model=model or 1, seq=seq or 1,
+                              expert=expert or 1)
+    return MeshTopology(topo, devices)

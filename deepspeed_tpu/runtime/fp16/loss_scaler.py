@@ -1,0 +1,72 @@
+"""Loss scaling for fp16 training, functional form.
+
+Analogue of reference ``runtime/fp16/loss_scaler.py`` (DynamicLossScaler :91,
+LossScaler static). Because the train step is one compiled XLA program, the
+overflow check is a global isfinite-reduce on the gradients and the skip-step
+is a ``jnp.where`` select rather than Python control flow — the same "global
+inf/nan check then maybe skip" the reference does eagerly (stage3.py:2018,
+fp16/loss_scaler.py update_scale), expressed functionally.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class LossScaleConfig:
+    static_scale: float = 0.0        # >0 => static
+    initial_scale_power: int = 16
+    scale_window: int = 1000
+    hysteresis: int = 2
+    min_scale: float = 1.0
+    scale_factor: float = 2.0
+
+
+def init_scale_state(cfg: LossScaleConfig) -> Dict[str, Any]:
+    scale = cfg.static_scale if cfg.static_scale > 0 else 2.0 ** cfg.initial_scale_power
+    return {
+        "loss_scale": jnp.asarray(scale, jnp.float32),
+        "good_steps": jnp.asarray(0, jnp.int32),
+        "hysteresis": jnp.asarray(cfg.hysteresis, jnp.int32),
+    }
+
+
+def grads_finite(grads) -> jnp.ndarray:
+    leaves = jax.tree.leaves(grads)
+    finite = jnp.asarray(True)
+    for g in leaves:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+    return finite
+
+
+def update_scale(state: Dict[str, Any], finite: jnp.ndarray,
+                 cfg: LossScaleConfig) -> Dict[str, Any]:
+    """Dynamic scale update (reference loss_scaler.py:137 update_scale)."""
+    if cfg.static_scale > 0:
+        return state
+    scale, good, hyst = state["loss_scale"], state["good_steps"], state["hysteresis"]
+    # overflow: consume hysteresis; once exhausted, halve the scale
+    new_hyst = jnp.where(finite, hyst, jnp.maximum(hyst - 1, 0))
+    drop = jnp.logical_and(~finite, new_hyst == 0)
+    scale_after_drop = jnp.maximum(scale / cfg.scale_factor, cfg.min_scale)
+    # growth: scale_window consecutive good steps doubles the scale
+    new_good = jnp.where(finite, good + 1, 0)
+    grow = new_good >= cfg.scale_window
+    scale_after_grow = jnp.where(grow, scale * cfg.scale_factor, scale)
+    new_scale = jnp.where(drop, scale_after_drop, scale_after_grow)
+    new_good = jnp.where(grow, 0, new_good)
+    new_hyst = jnp.where(drop, cfg.hysteresis, new_hyst)
+    return {"loss_scale": new_scale, "good_steps": new_good, "hysteresis": new_hyst}
+
+
+def from_fp16_config(fp16_cfg) -> LossScaleConfig:
+    return LossScaleConfig(
+        static_scale=fp16_cfg.loss_scale,
+        initial_scale_power=fp16_cfg.initial_scale_power,
+        scale_window=fp16_cfg.loss_scale_window,
+        hysteresis=fp16_cfg.hysteresis,
+        min_scale=fp16_cfg.min_loss_scale,
+    )

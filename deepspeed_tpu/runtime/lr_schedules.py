@@ -1,0 +1,151 @@
+"""Learning-rate schedules.
+
+Analogue of reference ``runtime/lr_schedules.py`` (LRRangeTest :258, OneCycle
+:361, WarmupLR :626, WarmupDecayLR :715, + WarmupCosineLR). Schedules here are
+pure functions ``step -> lr`` so they can live inside the jitted train step;
+a thin stateful wrapper provides the torch-scheduler-like ``step()/get_lr()``
+surface the reference exposes.
+"""
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+import jax.numpy as jnp
+
+LRFn = Callable[[Any], Any]  # step (traced or int) -> lr
+
+
+def constant_lr(lr: float) -> LRFn:
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+def warmup_lr(warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+              warmup_num_steps: int = 1000, warmup_type: str = "log") -> LRFn:
+    """Reference WarmupLR (lr_schedules.py:626): warm up then hold."""
+
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip(step / max(warmup_num_steps, 1), 0.0, 1.0)
+        if warmup_type == "log":
+            # log-space warmup as in reference (_get_gamma uses log curve)
+            frac = jnp.where(step >= warmup_num_steps, 1.0,
+                             jnp.log1p(step) / math.log(warmup_num_steps + 1))
+        return warmup_min_lr + (warmup_max_lr - warmup_min_lr) * frac
+
+    return fn
+
+
+def warmup_decay_lr(total_num_steps: int, warmup_min_lr: float = 0.0,
+                    warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                    warmup_type: str = "log") -> LRFn:
+    """Reference WarmupDecayLR (lr_schedules.py:715): warmup then linear decay."""
+    wu = warmup_lr(warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type)
+
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        decay = jnp.clip(
+            (total_num_steps - step) / max(total_num_steps - warmup_num_steps, 1),
+            0.0, 1.0)
+        return jnp.where(step < warmup_num_steps, wu(step), warmup_max_lr * decay)
+
+    return fn
+
+
+def warmup_cosine_lr(total_num_steps: int, warmup_min_ratio: float = 0.0,
+                     warmup_num_steps: int = 1000, cos_min_ratio: float = 0.0001,
+                     warmup_max_lr: float = 0.001, warmup_type: str = "linear") -> LRFn:
+    """Reference WarmupCosineLR: linear warmup then cosine decay."""
+
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        wu_frac = warmup_min_ratio + (1 - warmup_min_ratio) * jnp.clip(
+            step / max(warmup_num_steps, 1), 0.0, 1.0)
+        prog = jnp.clip((step - warmup_num_steps) /
+                        max(total_num_steps - warmup_num_steps, 1), 0.0, 1.0)
+        cos = cos_min_ratio + (1 - cos_min_ratio) * 0.5 * (1 + jnp.cos(math.pi * prog))
+        ratio = jnp.where(step < warmup_num_steps, wu_frac, cos)
+        return warmup_max_lr * ratio
+
+    return fn
+
+
+def one_cycle(cycle_min_lr: float, cycle_max_lr: float,
+              cycle_first_step_size: int = 2000,
+              cycle_second_step_size: Optional[int] = None,
+              decay_step_size: int = 0, decay_lr_rate: float = 0.0,
+              **_ignored) -> LRFn:
+    """Reference OneCycle (lr_schedules.py:361): triangular cycle + decay tail."""
+    second = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+    cycle_len = cycle_first_step_size + second
+
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        up = jnp.clip(step / cycle_first_step_size, 0.0, 1.0)
+        down = jnp.clip((step - cycle_first_step_size) / max(second, 1), 0.0, 1.0)
+        in_cycle = cycle_min_lr + (cycle_max_lr - cycle_min_lr) * jnp.where(
+            step < cycle_first_step_size, up, 1.0 - down)
+        if decay_step_size > 0:
+            decay_steps = jnp.maximum(step - cycle_len, 0.0) / decay_step_size
+            tail = cycle_min_lr / (1.0 + decay_lr_rate * decay_steps)
+        else:
+            tail = jnp.asarray(cycle_min_lr, jnp.float32)
+        return jnp.where(step < cycle_len, in_cycle, tail)
+
+    return fn
+
+
+def lr_range_test(lr_range_test_min_lr: float = 1e-3,
+                  lr_range_test_step_size: int = 2000,
+                  lr_range_test_step_rate: float = 1.0,
+                  lr_range_test_staircase: bool = False) -> LRFn:
+    """Reference LRRangeTest (lr_schedules.py:258): linearly growing probe LR."""
+
+    def fn(step):
+        step = jnp.asarray(step, jnp.float32)
+        interval = (jnp.floor(step / lr_range_test_step_size)
+                    if lr_range_test_staircase else step / lr_range_test_step_size)
+        return lr_range_test_min_lr * (1.0 + interval * lr_range_test_step_rate)
+
+    return fn
+
+
+SCHEDULE_REGISTRY: Dict[str, Callable[..., LRFn]] = {
+    "WarmupLR": warmup_lr,
+    "WarmupDecayLR": warmup_decay_lr,
+    "WarmupCosineLR": warmup_cosine_lr,
+    "OneCycle": one_cycle,
+    "LRRangeTest": lr_range_test,
+}
+
+
+def build_lr_schedule(sched_config, base_lr: float) -> LRFn:
+    """From SchedulerConfig (type/params) or None -> constant base_lr."""
+    if sched_config is None or sched_config.type is None:
+        return constant_lr(base_lr)
+    name = sched_config.type
+    if name not in SCHEDULE_REGISTRY:
+        raise ValueError(f"unknown scheduler '{name}'; known: {sorted(SCHEDULE_REGISTRY)}")
+    return SCHEDULE_REGISTRY[name](**sched_config.params)
+
+
+class LRScheduler:
+    """Stateful wrapper with the torch-like surface the reference returns."""
+
+    def __init__(self, fn: LRFn, start_step: int = 0):
+        self.fn = fn
+        self.last_step = start_step
+
+    def step(self, increment: int = 1):
+        self.last_step += increment
+
+    def get_lr(self):
+        return [float(self.fn(self.last_step))]
+
+    def get_last_lr(self):
+        return self.get_lr()
+
+    def state_dict(self):
+        return {"last_step": self.last_step}
+
+    def load_state_dict(self, sd):
+        self.last_step = sd["last_step"]

@@ -1,0 +1,52 @@
+"""Logging utilities.
+
+TPU-native analogue of the reference's ``deepspeed/utils/logging.py`` (logger +
+``log_dist(ranks=...)``). Process identity comes from ``jax.process_index`` rather
+than torch.distributed ranks.
+"""
+
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+LOG_LEVEL = os.environ.get("DSTPU_LOG_LEVEL", "INFO").upper()
+
+_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d] %(message)s"
+
+
+def _create_logger(name: str = "deepspeed_tpu", level: str = LOG_LEVEL) -> logging.Logger:
+    lg = logging.getLogger(name)
+    if not lg.handlers:
+        lg.setLevel(getattr(logging, level, logging.INFO))
+        lg.propagate = False
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pragma: no cover - before jax init
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log `message` only on the listed process indices (None/-1 => all)."""
+    my_rank = _process_index()
+    ranks = list(ranks) if ranks is not None else None
+    if ranks is None or my_rank in ranks or -1 in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str, _seen=set()) -> None:  # noqa: B006 - intentional cache
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

@@ -1,0 +1,112 @@
+"""Wall-clock + throughput timers.
+
+Analogue of reference ``deepspeed/utils/timer.py`` (SynchronizedWallClockTimer
+:43, ThroughputTimer :198). Device-event timing maps to blocking on the JAX
+array that ends the region (XLA programs are async-dispatched the same way CUDA
+streams are).
+"""
+
+import time
+from typing import Dict, List, Optional
+
+from .logging import log_dist
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self._start: Optional[float] = None
+        self.elapsed_total = 0.0
+        self.count = 0
+
+    def start(self):
+        self._start = time.perf_counter()
+
+    def stop(self, reset=False, record=True):
+        if self._start is None:
+            return
+        dt = time.perf_counter() - self._start
+        self._start = None
+        if record:
+            self.elapsed_total += dt
+            self.count += 1
+
+    def elapsed(self, reset=True) -> float:
+        value = self.elapsed_total
+        if reset:
+            self.reset()
+        return value
+
+    def mean(self) -> float:
+        return self.elapsed_total / max(self.count, 1)
+
+    def reset(self):
+        self.elapsed_total = 0.0
+        self.count = 0
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer registry (reference utils/timer.py:43)."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown=False, ranks=None):
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}")
+        if parts:
+            log_dist("time (ms) | " + " | ".join(parts), ranks=ranks or [0])
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
+        return {n: self.timers[n].mean() * 1000.0 / normalizer
+                for n in names if n in self.timers}
+
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+
+class ThroughputTimer:
+    """samples/sec + tokens/sec reporting (reference utils/timer.py:198)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2,
+                 steps_per_output: int = 50, monitor_memory: bool = False):
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self._start_time = None
+        self.started = False
+
+    def start(self):
+        self._start_time = time.perf_counter()
+        self.started = True
+
+    def stop(self, global_step=True, report_speed=False):
+        if not self.started:
+            return
+        self.started = False
+        self.global_step_count += 1
+        if self.global_step_count > self.start_step:
+            self.total_elapsed_time += time.perf_counter() - self._start_time
+
+    @property
+    def avg_samples_per_sec(self) -> float:
+        steps = self.global_step_count - self.start_step
+        if steps <= 0 or self.total_elapsed_time == 0:
+            return 0.0
+        return self.batch_size * steps / self.total_elapsed_time

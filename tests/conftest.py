@@ -1,0 +1,36 @@
+"""Test harness configuration.
+
+The reference spawns `world_size` torch processes per test
+(tests/unit/common.py:102 DistributedExec); on TPU/JAX we instead run every
+test single-process over a virtual 8-device CPU mesh
+(xla_force_host_platform_device_count), which exercises the same SPMD
+partitioning + collectives XLA emits on a real pod slice (SURVEY.md §4
+implication (a)).
+"""
+
+import os
+
+# Must be set before jax initializes its backends. The environment may pin
+# JAX_PLATFORMS to the real TPU ('axon'); tests always run on the virtual CPU
+# mesh, so override via jax.config (env var alone is overridden by the plugin).
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture(autouse=True)
+def _assert_8_devices():
+    assert jax.device_count() >= 8, "tests expect >=8 virtual devices"
+    yield

@@ -1,0 +1,139 @@
+"""End-to-end engine tests on the virtual 8-device mesh.
+
+Covers what the reference tests in tests/unit/runtime/test_ds_initialize.py +
+tests/unit/runtime/zero/test_zero.py (stages vs unsharded baseline)."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from simple_model import RandomDataset, SimpleModel, base_config, random_batches
+
+HIDDEN = 64
+
+
+def make_global_batch(batches, gas, global_micro):
+    """Stack micro-batches -> [gas, global_micro, ...]."""
+    sel = batches[:gas]
+    return jax.tree.map(lambda *xs: np.stack(xs), *sel)
+
+
+def train_losses(config, steps=5, seed=0, hidden=HIDDEN):
+    """Repeatedly fit one fixed global batch: loss must strictly decrease."""
+    model = SimpleModel(hidden_dim=hidden)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config, seed=seed)
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    batches = random_batches(engine.gas, gm, hidden)
+    gb = make_global_batch(batches, engine.gas, gm)
+    losses = [engine.train_batch(batch=gb) for _ in range(steps)]
+    return losses, engine
+
+
+def test_initialize_returns_tuple():
+    model = SimpleModel(hidden_dim=HIDDEN)
+    out = deepspeed_tpu.initialize(model=model, config=base_config())
+    assert len(out) == 4
+    engine = out[0]
+    assert engine.train_batch_size == 2 * 8  # micro=2 * dp=8 * gas=1
+
+
+def test_loss_decreases_dp():
+    losses, _ = train_losses(base_config(micro=4, stage=0), steps=8)
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stages_match_baseline(stage):
+    """All ZeRO stages must be numerically identical to plain DP (fp32)."""
+    ref_losses, _ = train_losses(base_config(micro=2, stage=0), steps=4)
+    losses, engine = train_losses(base_config(micro=2, stage=stage), steps=4)
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-5)
+    if stage >= 1:
+        # optimizer state must actually be sharded over the 8-device data axis
+        m = jax.tree.leaves(engine.opt_state["exp_avg"])[0]
+        assert not m.sharding.is_fully_replicated
+
+
+@pytest.mark.parametrize("stage", [2, 3])
+def test_zero_bf16(stage):
+    cfg = base_config(micro=2, stage=stage, dtype="bf16")
+    # tiny test params are all below the default persistence threshold; force
+    # real stage-3 param sharding
+    cfg["zero_optimization"]["stage3_param_persistence_threshold"] = 0
+    losses, engine = train_losses(cfg, steps=8)
+    assert losses[-1] < losses[0]
+    p = jax.tree.leaves(engine.params)[0]
+    assert p.dtype == jnp.bfloat16
+    assert jax.tree.leaves(engine.master_params)[0].dtype == jnp.float32
+    if stage == 3:
+        assert not p.sharding.is_fully_replicated
+
+
+def test_fp16_loss_scaling_runs():
+    losses, engine = train_losses(base_config(micro=2, stage=2, dtype="fp16"),
+                                  steps=8)
+    assert losses[-1] < losses[0]
+    assert engine.loss_scale > 0
+
+
+def test_gradient_accumulation_equivalence():
+    """micro=4/gas=1 must equal micro=2/gas=2 for the same 32 global rows."""
+    rows = random_batches(1, 32, HIDDEN)[0]
+
+    def run(micro, gas):
+        model = SimpleModel(hidden_dim=HIDDEN)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config=base_config(micro=micro, gas=gas), seed=0)
+        gb = jax.tree.map(lambda x: x.reshape((gas, 32 // gas) + x.shape[1:]),
+                          rows)
+        return [engine.train_batch(batch=gb) for _ in range(3)]
+
+    np.testing.assert_allclose(run(4, 1), run(2, 2), rtol=1e-5)
+
+
+def test_gradient_clipping():
+    losses, engine = train_losses(
+        base_config(micro=2, stage=1, gradient_clipping=0.1), steps=4)
+    assert losses[-1] <= losses[0] * 1.5
+
+
+def test_train_batch_from_dataloader():
+    model = SimpleModel(hidden_dim=HIDDEN)
+    ds = RandomDataset(256, HIDDEN)
+    engine, _, loader, _ = deepspeed_tpu.initialize(
+        model=model, config=base_config(micro=2, gas=2), training_data=ds)
+    loss0 = engine.train_batch()
+    loss1 = engine.train_batch()
+    assert np.isfinite(loss0) and np.isfinite(loss1)
+
+
+def test_forward_backward_step_compat():
+    """The torch-style forward/backward/step path trains too."""
+    model = SimpleModel(hidden_dim=HIDDEN)
+    cfg = base_config(micro=2, gas=2, stage=1)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    batch = random_batches(1, gm, HIDDEN)[0]
+    losses = []
+    for i in range(8):
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        if (i + 1) % engine.gas == 0:
+            engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_lr_scheduler_warmup():
+    cfg = base_config(micro=2)
+    cfg["scheduler"] = {"type": "WarmupLR",
+                        "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-3,
+                                   "warmup_num_steps": 10}}
+    losses, engine = train_losses(cfg, steps=3)
+    lr = engine.get_lr()[0]
+    assert 0 < lr < 1e-3

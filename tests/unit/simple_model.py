@@ -1,0 +1,98 @@
+"""Toy-model fixtures, mirroring the reference's tests/unit/simple_model.py
+(SimpleModel :18, random_dataloader :263, config helpers :279-297) in the
+functional model protocol the TPU engine consumes."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class SimpleModel:
+    """MLP regression model: stack of Linear+relu, MSE loss.
+
+    Matches the role of reference SimpleModel (hidden_dim params, simple loss)
+    for engine behavior tests.
+    """
+
+    def __init__(self, hidden_dim=64, nlayers=2, use_bias=True):
+        self.hidden_dim = hidden_dim
+        self.nlayers = nlayers
+        self.use_bias = use_bias
+
+    def init_params(self, rng):
+        params = {}
+        for i in range(self.nlayers):
+            rng, sub = jax.random.split(rng)
+            params[f"layer_{i}"] = {
+                "w": jax.random.normal(sub, (self.hidden_dim, self.hidden_dim),
+                                       jnp.float32) * 0.02,
+            }
+            if self.use_bias:
+                params[f"layer_{i}"]["b"] = jnp.zeros((self.hidden_dim,), jnp.float32)
+        return params
+
+    def apply(self, params, batch, train=True, rng=None):
+        x, y = batch["x"], batch["y"]
+        h = x
+        for i in range(self.nlayers):
+            p = params[f"layer_{i}"]
+            h = h.astype(p["w"].dtype) @ p["w"]
+            if self.use_bias:
+                h = h + p["b"]
+            if i < self.nlayers - 1:
+                h = jax.nn.relu(h)
+        loss = jnp.mean((h.astype(jnp.float32) - y.astype(jnp.float32)) ** 2)
+        return loss
+
+
+class SimpleTPModel(SimpleModel):
+    """SimpleModel with tensor-parallel column/row sharding on alternate layers."""
+
+    def param_partition_specs(self, topo):
+        specs = {}
+        for i in range(self.nlayers):
+            spec = {"w": P(None, "model") if i % 2 == 0 else P("model", None)}
+            if self.use_bias:
+                spec["b"] = P("model") if i % 2 == 0 else P()
+            specs[f"layer_{i}"] = spec
+        return specs
+
+
+def random_batches(num_batches, batch_size, hidden_dim, seed=42):
+    """List of {x,y} numpy batches (reference random_dataloader :263)."""
+    rng = np.random.default_rng(seed)
+    return [{
+        "x": rng.standard_normal((batch_size, hidden_dim)).astype(np.float32),
+        "y": rng.standard_normal((batch_size, hidden_dim)).astype(np.float32),
+    } for _ in range(num_batches)]
+
+
+class RandomDataset:
+    def __init__(self, n, hidden_dim, seed=0):
+        rng = np.random.default_rng(seed)
+        self.x = rng.standard_normal((n, hidden_dim)).astype(np.float32)
+        self.y = rng.standard_normal((n, hidden_dim)).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+def base_config(micro=2, gas=1, stage=0, dtype=None, opt="adamw", lr=1e-3,
+                **extra):
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 100,
+        "optimizer": {"type": opt, "params": {"lr": lr}},
+        "zero_optimization": {"stage": stage},
+    }
+    if dtype == "bf16":
+        cfg["bf16"] = {"enabled": True}
+    elif dtype == "fp16":
+        cfg["fp16"] = {"enabled": True}
+    cfg.update(extra)
+    return cfg
