@@ -1,0 +1,103 @@
+"""Benchmark: flagship-model training throughput on the available TPU chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: model FLOPs utilization (MFU) of a full ZeRO training step (fwd+bwd+
+optimizer) on the Llama-architecture flagship at the largest per-chip batch
+that fits. vs_baseline compares against the north-star target of 45% MFU
+(BASELINE.md: ZeRO-3 Llama-2-7B on v5e-64 at >=45% MFU; single-chip MFU is
+the per-chip factor of that target).
+"""
+
+import json
+import time
+
+import numpy as np
+
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e bf16 peak per chip
+    "TPU v5": 459e12,        # v5p
+    "TPU v4": 275e12,
+    "cpu": 1e12,             # nominal, for smoke runs
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for key, val in PEAK_FLOPS.items():
+        if key.lower() in str(kind).lower():
+            return val
+    return PEAK_FLOPS["cpu"]
+
+
+def main():
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+    n_dev = jax.device_count()
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = TransformerConfig(vocab_size=32000, hidden_size=1024,
+                                intermediate_size=2816, num_layers=24,
+                                num_heads=16, max_seq_len=2048)
+        micro, gas, steps, warmup = 8, 1, 20, 3
+    else:  # CPU smoke mode
+        cfg = TransformerConfig(vocab_size=256, hidden_size=128,
+                                intermediate_size=256, num_layers=2,
+                                num_heads=8, max_seq_len=128)
+        micro, gas, steps, warmup = 1, 1, 5, 2
+
+    model = TransformerLM(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2 if n_dev > 1 else 0},
+        "steps_per_print": 10**9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    seq = cfg.max_seq_len
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, cfg.vocab_size, (gas, gm, seq),
+                                       dtype=np.int64)}
+
+    for _ in range(warmup):
+        engine.train_batch(batch=batch)
+    jax.block_until_ready(engine.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        engine.train_batch(batch=batch)
+    jax.block_until_ready(engine.params)
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_step = gm * gas * seq
+    tokens_per_sec = tokens_per_step / dt
+    n_params = model.num_params(include_embed=False)
+    flops_per_token = model.flops_per_token(seq)
+    achieved = tokens_per_sec * flops_per_token / n_dev
+    peak = peak_flops(jax.devices()[0])
+    mfu = achieved / peak
+
+    result = {
+        "metric": "train_mfu_llama_flagship",
+        "value": round(mfu * 100, 2),
+        "unit": "% MFU",
+        "vs_baseline": round(mfu / 0.45, 3),
+        "detail": {
+            "tokens_per_sec_per_chip": round(tokens_per_sec / n_dev, 1),
+            "step_time_s": round(dt, 4),
+            "params_no_embed": n_params,
+            "devices": n_dev,
+            "device_kind": str(getattr(jax.devices()[0], "device_kind", "cpu")),
+            "seq_len": seq,
+            "global_batch_tokens": tokens_per_step,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""Model zoo (functional, mesh-aware implementations).
+
+Replaces the reference's model implementations
+(deepspeed/inference/v2/model_implementations/, model_implementations/,
+module_inject containers) with TPU-first functional models.
+"""
+
+from .transformer import (  # noqa: F401
+    TransformerConfig,
+    TransformerLM,
+    gpt2_small,
+    llama2_7b,
+    llama2_13b,
+    mistral_7b,
+    tiny_test,
+)

@@ -1,0 +1,338 @@
+"""Flash attention — Pallas TPU kernels.
+
+TPU-native replacement for the reference's fused attention kernels
+(csrc/transformer/ds_transformer_cuda.cpp softmax path, and the inference
+attention kernels in csrc/transformer/inference). Implements the
+memory-efficient online-softmax algorithm (never materializes the [S, S]
+score matrix) as three Mosaic kernels:
+
+  * forward:  grid (BH, Sq/bq, Skv/bk), running (m, l, acc) in VMEM scratch —
+    the kv grid axis is innermost and TPU grids execute sequentially, so the
+    scratch carries across kv steps.
+  * backward dq: same grid, accumulates dq over kv blocks.
+  * backward dk/dv: grid (BH, Skv/bk, Sq/bq), accumulates dk, dv over q blocks.
+
+Supports causal masking (bottom-right aligned for sq != skv, matching the
+usual decode convention; fully-masked blocks are skipped via pl.when) and
+grouped-query attention (kv-head indexing in the BlockSpec index map). f32
+accumulation on the MXU (preferred_element_type) with bf16 inputs.
+
+On non-TPU backends (the CPU test mesh) kernels run in interpret mode;
+parity is tested against the jnp reference in tests/unit/ops.
+"""
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_sc, m_sc, l_sc, *, scale, causal, bq, bk, n_kv, off):
+    i = pl.program_id(1)  # q block
+    j = pl.program_id(2)  # kv block
+
+    @pl.when(j == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    # causal: skip blocks entirely above the (bottom-right aligned) diagonal
+    run = True
+    if causal:
+        run = j * bk <= (i + 1) * bq - 1 + off
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)            # (bq, d)
+        k = k_ref[0].astype(jnp.float32)            # (bk, d)
+        v = v_ref[0].astype(jnp.float32)            # (bk, d)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = off + i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_sc[:, :1]                         # (bq, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                       # (bq, bk)
+        corr = jnp.exp(m_prev - m_new)               # (bq, 1)
+        l_new = l_sc[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_sc[:] = acc_sc[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(j == n_kv - 1)
+    def _finish():
+        l = l_sc[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_sc[:, :1] + jnp.log(l_safe)
+
+
+def _flash_fwd(q, k, v, scale, causal, bq, bk):
+    bh, sq, d = q.shape
+    bhk, skv, _ = k.shape
+    group = bh // bhk
+    n_q, n_kv = pl.cdiv(sq, bq), pl.cdiv(skv, bk)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, n_kv=n_kv, off=skv - sq)
+    out_shape = [
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j, g=group: (b // g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j, g=group: (b // g, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        out_shape=out_shape,
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_sc, *, scale, causal, bq, bk, n_kv, off):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_sc[:] = jnp.zeros_like(dq_sc)
+
+    run = True
+    if causal:
+        run = j * bk <= (i + 1) * bq - 1 + off
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]                             # (bq, 1)
+        delta = delta_ref[0]                         # (bq, 1)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = off + i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                         # (bq, bk)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_sc[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_kv - 1)
+    def _finish():
+        dq_ref[0] = dq_sc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_sc, dv_sc,
+                    *, scale, causal, bq, bk, n_q, off):
+    j = pl.program_id(1)  # kv block (outer)
+    i = pl.program_id(2)  # q block (inner)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_sc[:] = jnp.zeros_like(dk_sc)
+        dv_sc[:] = jnp.zeros_like(dv_sc)
+
+    run = True
+    if causal:
+        run = (i + 1) * bq - 1 + off >= j * bk
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = off + i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                         # (bq, bk)
+        dv_sc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                # (bq, bk)
+        dk_sc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(i == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, scale, causal, bq, bk):
+    q, k, v, o, lse = res
+    do = g
+    bh, sq, d = q.shape
+    bhk, skv, _ = k.shape
+    group = bh // bhk
+    n_q, n_kv = pl.cdiv(sq, bq), pl.cdiv(skv, bk)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                    keepdims=True)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, n_kv=n_kv, off=skv - sq),
+        grid=(bh, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j, g_=group: (b // g_, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j, g_=group: (b // g_, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv computed per *query* head, then reduced over the GQA group.
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, n_q=n_q, off=skv - sq),
+        grid=(bh, n_kv, n_q),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i, g_=group: (b // g_, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i, g_=group: (b // g_, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, skv, d), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    if group > 1:
+        dk = dk_h.reshape(bhk, group, skv, d).sum(axis=1).astype(k.dtype)
+        dv = dv_h.reshape(bhk, group, skv, d).sum(axis=1).astype(v.dtype)
+    else:
+        dk, dv = dk_h.astype(k.dtype), dv_h.astype(v.dtype)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, scale, causal, bq, bk):
+    o, _ = _flash_fwd(q, k, v, scale, causal, bq, bk)
+    return o
+
+
+def _flash_core_fwd(q, k, v, scale, causal, bq, bk):
+    o, lse = _flash_fwd(q, k, v, scale, causal, bq, bk)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_core_bwd(scale, causal, bq, bk, res, g):
+    return _flash_bwd(res, g, scale, causal, bq, bk)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
+                    block_q: int = 128, block_kv: int = 128):
+    """Flash attention over [batch, num_heads, seq, head_dim] inputs.
+
+    k/v may have fewer heads (GQA); num_heads % num_kv_heads == 0.
+    """
+    b, h, sq, d = q.shape
+    _, hk, skv, _ = k.shape
+    assert h % hk == 0, f"GQA requires h({h}) % hk({hk}) == 0"
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    bq = min(block_q, sq)
+    bk = min(block_kv, skv)
+    assert sq % bq == 0 and skv % bk == 0, \
+        f"seq lengths ({sq},{skv}) must be multiples of block sizes ({bq},{bk})"
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * hk, skv, d)
+    vf = v.reshape(b * hk, skv, d)
+    # fold batch into the head axis keeping kv-head grouping contiguous
+    o = _flash_core(qf, kf, vf, scale, causal, bq, bk)
+    return o.reshape(b, h, sq, d)
+
+
+def mha_reference(q, k, v, causal: bool = True, scale: Optional[float] = None):
+    """jnp reference implementation for parity tests (O(S^2) memory)."""
+    b, h, sq, d = q.shape
+    _, hk, skv, _ = k.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if h != hk:
+        rep = h // hk
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, skv), bool), k=skv - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if causal:
+        # fully-masked rows (sq > skv) produce zeros, matching the kernel
+        any_valid = jnp.any(mask, axis=-1)[None, None, :, None]
+        p = jnp.where(any_valid, p, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)

@@ -112,6 +112,9 @@ class DeepSpeedTpuEngine:
         self.scale_cfg: Optional[LossScaleConfig] = (
             from_fp16_config(self.config.fp16) if self.fp16_enabled else None)
 
+        if hasattr(self.model, "set_topology"):
+            self.model.set_topology(self.topology)
+
         # --- state init under sharding constraints (zero.Init equivalent:
         # params materialize directly into their shards, partition_parameters.py:723)
         self._init_state(seed)
@@ -311,12 +314,14 @@ class DeepSpeedTpuEngine:
         def prep(x):
             x = np.asarray(x)
             gm = self.micro_batch_size * self.ds_config.dp_world_size
-            if x.shape[0] == self.gas * gm:
+            if x.ndim >= 2 and x.shape[0] == self.gas and x.shape[1] == gm:
+                pass  # already [gas, global_micro, ...]
+            elif x.shape[0] == self.gas * gm:
                 x = x.reshape((self.gas, gm) + x.shape[1:])
-            elif x.shape[0] != self.gas or (x.ndim > 1 and x.shape[1] != gm):
-                if x.shape[0] != self.gas:
-                    raise ValueError(
-                        f"batch dim {x.shape[0]} != gas*global_micro {self.gas * gm}")
+            else:
+                raise ValueError(
+                    f"batch dim {x.shape[:2]} incompatible with "
+                    f"gas={self.gas}, global_micro={gm}")
             return jax.device_put(x, self._batch_sharding_fn(x))
 
         return jax.tree.map(prep, batch)

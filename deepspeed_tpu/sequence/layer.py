@@ -1,0 +1,117 @@
+"""Sequence parallelism (Ulysses) + sharded attention dispatch.
+
+TPU-native analogue of the reference's DeepSpeed-Ulysses
+(deepspeed/sequence/layer.py: _SeqAllToAll :15, DistributedAttention :37):
+activations are sequence-sharded between layers; around attention an
+all-to-all re-partitions [*, heads, S/sp, D] -> [*, heads/sp, S, D] so each
+device computes full-sequence attention for a subset of heads, then the
+reverse all-to-all restores sequence sharding.
+
+Because Pallas kernels are opaque to GSPMD, attention always runs inside a
+`jax.shard_map` region: data parallelism maps the batch dim, tensor
+parallelism maps the head dim over "model", and (when enabled) Ulysses adds
+the "seq" axis all-to-alls inside the region. XLA lowers the all-to-alls onto
+ICI (§2.4 of SURVEY.md).
+"""
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.topology import MODEL_AXIS, SEQ_AXIS, MeshTopology
+
+
+def seq_all_to_all(x, axis_name: str, scatter_dim: int, gather_dim: int):
+    """The Ulysses primitive (reference sequence/layer.py:15 _SeqAllToAll):
+    scatter `scatter_dim` across the axis, gather `gather_dim`."""
+    return lax.all_to_all(x, axis_name, split_axis=scatter_dim,
+                          concat_axis=gather_dim, tiled=True)
+
+
+def _inner_attention(q, k, v, causal, use_flash, block_q, block_kv, sp_size):
+    """Runs on local shards inside shard_map. q/k/v: [B_l, H_l, S_l, D]."""
+    from ..ops.flash_attention import flash_attention, mha_reference
+
+    if sp_size > 1:
+        # Ulysses: heads -> heads/sp, seq/sp -> seq
+        nh, nkv = q.shape[1], k.shape[1]
+        if nkv < sp_size:
+            rep = sp_size // nkv
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        q = seq_all_to_all(q, SEQ_AXIS, scatter_dim=1, gather_dim=2)
+        k = seq_all_to_all(k, SEQ_AXIS, scatter_dim=1, gather_dim=2)
+        v = seq_all_to_all(v, SEQ_AXIS, scatter_dim=1, gather_dim=2)
+
+    s = q.shape[2]
+    if use_flash and s % block_q == 0 and k.shape[2] % block_kv == 0 \
+            and s >= block_q:
+        o = flash_attention(q, k, v, causal=causal, block_q=block_q,
+                            block_kv=block_kv)
+    else:
+        o = mha_reference(q, k, v, causal=causal)
+
+    if sp_size > 1:
+        o = seq_all_to_all(o, SEQ_AXIS, scatter_dim=2, gather_dim=1)
+    return o
+
+
+def sharded_attention(q, k, v, topo: Optional[MeshTopology], causal: bool = True,
+                      use_flash: bool = True, block_q: int = 128,
+                      block_kv: int = 128):
+    """Attention over [B, H, S, D] with mesh-aware partitioning.
+
+    Without a topology (single device / replicated), calls the kernel
+    directly. With one, wraps in shard_map: batch over data axes, heads over
+    "model", sequence over "seq" (Ulysses all-to-alls inside).
+    """
+    if topo is None:
+        return _inner_attention(q, k, v, causal, use_flash, block_q, block_kv, 1)
+
+    sp = topo.axis_size(SEQ_AXIS)
+    dp_axes = topo.batch_axes
+    batch_spec = dp_axes if len(dp_axes) > 1 else dp_axes[0]
+    tp = topo.axis_size(MODEL_AXIS)
+    head_spec = MODEL_AXIS if tp > 1 else None
+    qkv_spec = P(batch_spec, head_spec, SEQ_AXIS if sp > 1 else None, None)
+
+    fn = partial(_inner_attention, causal=causal, use_flash=use_flash,
+                 block_q=block_q, block_kv=block_kv, sp_size=sp)
+    # check_vma=False: pallas_call outputs don't carry vma metadata
+    return jax.shard_map(fn, mesh=topo.mesh,
+                         in_specs=(qkv_spec, qkv_spec, qkv_spec),
+                         out_specs=qkv_spec, check_vma=False)(q, k, v)
+
+
+def ulysses_attention(q, k, v, causal: bool = True, use_flash: bool = True,
+                      block_q: int = 128, block_kv: int = 128,
+                      topo: Optional[MeshTopology] = None):
+    """Explicit-SP entry used by models with cfg.seq_parallel=True."""
+    return sharded_attention(q, k, v, topo, causal=causal, use_flash=use_flash,
+                             block_q=block_q, block_kv=block_kv)
+
+
+class DistributedAttention:
+    """Reference-parity wrapper (sequence/layer.py:37): wraps a local
+    attention callable with the Ulysses scatter/gather all-to-alls.
+
+    local_attn receives [B, H/sp, S, D] tensors and full sequence.
+    """
+
+    def __init__(self, local_attn: Callable, sequence_process_group=SEQ_AXIS,
+                 scatter_idx: int = 1, gather_idx: int = 2):
+        self.local_attn = local_attn
+        self.axis = sequence_process_group
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        q = seq_all_to_all(query, self.axis, self.scatter_idx, self.gather_idx)
+        k = seq_all_to_all(key, self.axis, self.scatter_idx, self.gather_idx)
+        v = seq_all_to_all(value, self.axis, self.scatter_idx, self.gather_idx)
+        out = self.local_attn(q, k, v, *args, **kwargs)
+        return seq_all_to_all(out, self.axis, self.gather_idx, self.scatter_idx)
