@@ -51,6 +51,13 @@ class TransformerConfig:
     attn_block_q: int = 128
     attn_block_kv: int = 128
     seq_parallel: bool = False             # Ulysses all-to-all over "seq" axis
+    # MoE (expert parallelism; reference deepspeed/moe/layer.py:16). When
+    # moe_num_experts > 0 every layer's MLP becomes a top-k routed MoE.
+    moe_num_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.0
+    moe_min_capacity: int = 4
+    moe_aux_loss_coef: float = 0.01
 
     @property
     def kv_heads(self) -> int:
@@ -114,7 +121,13 @@ class TransformerLM:
             "wo": init(k[3], (L, nh * hd, h), out_std),
             "mlp_norm": jnp.ones((L, h), dt),
         }
-        if cfg.activation == "swiglu":
+        if cfg.moe_num_experts > 0:
+            E = cfg.moe_num_experts
+            layer["moe_gate_w"] = init(k[4], (L, h, E))
+            layer["e_gate"] = init(k[8], (L, E, h, ffn))
+            layer["e_up"] = init(k[10], (L, E, h, ffn))
+            layer["e_down"] = init(k[11], (L, E, ffn, h), out_std)
+        elif cfg.activation == "swiglu":
             layer["w_gate"] = init(k[4], (L, h, ffn))
             layer["w_up"] = init(k[5], (L, h, ffn))
             layer["w_down"] = init(k[6], (L, ffn, h), out_std)
@@ -140,22 +153,31 @@ class TransformerLM:
             params["lm_head"] = init(k[9], (h, v))
         return params
 
-    # -- sharding (TP over "model" axis; ZeRO composes on top) -------------
+    # -- sharding (TP over "model", PP over "pipe"; ZeRO composes on top) --
     def param_partition_specs(self, topo) -> Dict[str, Any]:
         cfg = self.cfg
         tp = topo.axis_size("model") if "model" in topo.sizes else 1
-        col = P(None, None, "model") if tp > 1 else P(None, None, None)
-        row = P(None, "model", None) if tp > 1 else P(None, None, None)
-        vec = P(None, None)
+        pp = topo.axis_size("pipe") if "pipe" in topo.sizes else 1
+        pipe = "pipe" if pp > 1 else None
+        col = P(pipe, None, "model") if tp > 1 else P(pipe, None, None)
+        row = P(pipe, "model", None) if tp > 1 else P(pipe, None, None)
+        vec = P(pipe, None)
         layer = {
             "attn_norm": vec, "mlp_norm": vec,
             "wq": col, "wk": col, "wv": col, "wo": row,
             "w_up": col, "w_down": row,
         }
-        if cfg.activation == "swiglu":
+        if cfg.moe_num_experts > 0:
+            ep = "expert" if topo.axis_size("expert") > 1 else None
+            layer.pop("w_up"); layer.pop("w_down")
+            layer["moe_gate_w"] = P(pipe, None, None)
+            layer["e_gate"] = P(pipe, ep, None, "model" if tp > 1 else None)
+            layer["e_up"] = P(pipe, ep, None, "model" if tp > 1 else None)
+            layer["e_down"] = P(pipe, ep, "model" if tp > 1 else None, None)
+        elif cfg.activation == "swiglu":
             layer["w_gate"] = col
         else:
-            layer["b_up"] = P(None, "model") if tp > 1 else P(None, None)
+            layer["b_up"] = P(pipe, "model") if tp > 1 else P(pipe, None)
             layer["b_down"] = vec
         if cfg.norm == "layernorm":
             layer["attn_norm_b"] = vec
@@ -205,14 +227,28 @@ class TransformerLM:
         x = x + o @ lp["wo"]
 
         hn = self._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"))
-        if cfg.activation == "swiglu":
+        aux = jnp.zeros((), jnp.float32)
+        if cfg.moe_num_experts > 0:
+            from ..moe.sharded_moe import moe_layer
+
+            def expert_fn(p, xe):
+                wg, wu, wd = p
+                return (jax.nn.silu(xe @ wg) * (xe @ wu)) @ wd
+
+            mlp_out, aux = moe_layer(
+                hn, lp["moe_gate_w"], (lp["e_gate"], lp["e_up"], lp["e_down"]),
+                expert_fn, self.topology, top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                min_capacity=cfg.moe_min_capacity)
+            x = x + mlp_out
+        elif cfg.activation == "swiglu":
             g = jax.nn.silu(hn @ lp["w_gate"])
             u = hn @ lp["w_up"]
             x = x + (g * u) @ lp["w_down"]
         else:
             u = jax.nn.gelu(hn @ lp["w_up"] + lp["b_up"])
             x = x + u @ lp["w_down"] + lp["b_down"]
-        return x
+        return x, aux
 
     def forward_hidden(self, params, input_ids):
         cfg = self.cfg
@@ -233,37 +269,111 @@ class TransformerLM:
                                   policy=jax.checkpoint_policies.nothing_saveable)
 
         def scan_fn(h, lp):
-            return body(h, lp, cos, sin), None
+            h, aux = body(h, lp, cos, sin)
+            return h, aux
 
-        x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+        x, aux = jax.lax.scan(scan_fn, x, params["layers"])
         x = self._norm(x, params["final_norm"], params.get("final_norm_b"))
-        return x
+        return x, jnp.mean(aux)
 
     def forward_logits(self, params, input_ids):
-        x = self.forward_hidden(params, input_ids)
+        x, _ = self.forward_hidden(params, input_ids)
         head = (params["embed"].T if self.cfg.tie_embeddings
                 else params["lm_head"])
         return x @ head.astype(x.dtype)
 
+    # -- pipeline-parallel forward (compiled 1F1B-style, runtime/pipe) ------
+    def _apply_pipelined(self, params, batch, train: bool = True, rng=None):
+        """Pipelined loss over the "pipe" axis. batch: {input_ids [M, B, S]}
+        where M = num microbatches (= gradient_accumulation_steps)."""
+        from ..runtime.pipe.pipeline import (broadcast_from_last,
+                                             pipeline_scan)
+        from ..parallel.topology import PIPE_AXIS
+
+        topo = self.topology
+        cfg = self.cfg
+        pp = topo.axis_size(PIPE_AXIS)
+        ids = batch["input_ids"]
+        M, B, S = ids.shape
+        cos, sin = _rope_tables(cfg, S)
+        dp_axes = topo.batch_axes
+        batch_spec = dp_axes if len(dp_axes) > 1 else dp_axes[0]
+
+        param_specs = self.param_partition_specs(topo)
+        ids_spec = P(None, batch_spec, None)
+        mask = batch.get("loss_mask")
+        mask_specs = (ids_spec,) if mask is not None else ()
+
+        def body(params, ids_local, *mask_local):
+            x = params["embed"][ids_local]               # [M, b, S, H] (all stages)
+            cos_c = cos.astype(x.dtype)
+            sin_c = sin.astype(x.dtype)
+            layers_local = params["layers"]              # [L/pp, ...]
+
+            layer_body = self._layer
+            if cfg.remat:
+                layer_body = jax.checkpoint(
+                    self._layer,
+                    policy=jax.checkpoint_policies.nothing_saveable)
+
+            def stage_fn(h):
+                def scan_fn(carry, lp):
+                    out, _aux = layer_body(carry, lp, cos_c, sin_c)
+                    return out, None
+                out, _ = jax.lax.scan(scan_fn, h, layers_local)
+                return out
+
+            ys = pipeline_scan(stage_fn, x, pp, remat=False)   # [M, b, S, H]
+            ys = self._norm(ys, params["final_norm"],
+                            params.get("final_norm_b"))
+            head = (params["embed"].T if cfg.tie_embeddings
+                    else params["lm_head"])
+            logits = (ys @ head.astype(ys.dtype)).astype(jnp.float32)[:, :, :-1]
+            targets = ids_local[:, :, 1:]
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+            if mask_local:
+                m = mask_local[0][:, :, 1:].astype(jnp.float32)
+                loss_local = jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+            else:
+                loss_local = jnp.mean(nll)
+            # only the last stage's loss is real; make it replicated everywhere
+            loss = broadcast_from_last(loss_local, pp)
+            return jax.lax.pmean(loss, dp_axes)
+
+        args = (params, ids) + ((mask,) if mask is not None else ())
+        return jax.shard_map(body, mesh=topo.mesh,
+                             in_specs=(param_specs, ids_spec) + mask_specs,
+                             out_specs=P(), check_vma=False)(*args)
+
     def apply(self, params, batch, train: bool = True, rng=None):
-        """Next-token LM loss. batch: {input_ids [B,S], optional loss_mask}."""
+        """Next-token LM loss. batch: {input_ids [B,S], optional loss_mask};
+        with pipeline parallelism active, input_ids is [M, B, S]."""
+        if self.topology is not None and self.topology.axis_size("pipe") > 1:
+            return self._apply_pipelined(params, batch, train=train, rng=rng)
         ids = batch["input_ids"]
         # shift AFTER the forward so the model sees the full (sp-divisible)
         # sequence length under sequence parallelism
-        logits = self.forward_logits(params, ids)[:, :-1]
+        x, aux = self.forward_hidden(params, ids)
+        head = (params["embed"].T if self.cfg.tie_embeddings
+                else params["lm_head"])
+        logits = (x @ head.astype(x.dtype))[:, :-1].astype(jnp.float32)
         targets = ids[:, 1:]
-        logits = logits.astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         if "loss_mask" in batch:
             mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
-            return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-        return jnp.mean(nll)
+            loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        else:
+            loss = jnp.mean(nll)
+        if self.cfg.moe_num_experts > 0:
+            loss = loss + self.cfg.moe_aux_loss_coef * aux
+        return loss
 
     def flops_per_token(self, seq_len: Optional[int] = None) -> float:
-        """6*N + attention flops per token (for MFU accounting)."""
+        """6*N_active + attention flops per token (for MFU accounting)."""
         cfg = self.cfg
-        n_params = self.num_params(include_embed=False)
+        n_params = self.active_params()
         f = 6.0 * n_params
         s = seq_len or cfg.max_seq_len
         f += 12.0 * cfg.num_layers * cfg.hidden_size * s  # attention matmuls
@@ -277,12 +387,27 @@ class TransformerLM:
                         cfg.num_layers)
         attn = h * cfg.num_heads * cfg.head_dim + 2 * h * cfg.kv_heads * cfg.head_dim \
             + cfg.num_heads * cfg.head_dim * h
-        mlp = (3 if cfg.activation == "swiglu" else 2) * h * ffn
+        if cfg.moe_num_experts > 0:
+            mlp = cfg.moe_num_experts * 3 * h * ffn + h * cfg.moe_num_experts
+        else:
+            mlp = (3 if cfg.activation == "swiglu" else 2) * h * ffn
         per_layer = attn + mlp + 2 * h
         total = L * per_layer + h
         if include_embed:
             total += v * h * (1 if cfg.tie_embeddings else 2)
         return total
+
+    def active_params(self) -> int:
+        """Params touched per token (MoE: only top_k experts are active)."""
+        cfg = self.cfg
+        h, ffn, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+        attn = h * cfg.num_heads * cfg.head_dim + 2 * h * cfg.kv_heads * cfg.head_dim \
+            + cfg.num_heads * cfg.head_dim * h
+        if cfg.moe_num_experts > 0:
+            mlp = cfg.moe_top_k * 3 * h * ffn + h * cfg.moe_num_experts
+        else:
+            mlp = (3 if cfg.activation == "swiglu" else 2) * h * ffn
+        return L * (attn + mlp + 2 * h) + h
 
 
 # -- canonical configs (model zoo) ------------------------------------------

@@ -1,0 +1,152 @@
+"""Mixture-of-Experts gating + dispatch.
+
+TPU-native analogue of the reference's expert parallelism
+(deepspeed/moe/sharded_moe.py: top1gating :184, top2gating :282, MOELayer
+:425, _AllToAll :95; deepspeed/moe/layer.py:16 MoE). The reference dispatches
+tokens with an explicit all-to-all over the expert process group; here the
+dispatch is the GShard-style einsum against a static-capacity one-hot tensor,
+with expert-stacked parameters sharded over the "expert" mesh axis — XLA
+lowers the resharding of the dispatched [E, C, H] activations onto the same
+ICI all-to-all the reference issues by hand.
+
+Static shapes (capacity = ceil(tokens/E * capacity_factor)) are exactly the
+reference's drop_tokens=True mode — which is also the only mode that maps
+well onto XLA; dropless variants need ragged kernels (future ragged_dot path).
+"""
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+              min_capacity: int) -> int:
+    cap = int(math.ceil(num_tokens / num_experts * capacity_factor))
+    return max(cap, min_capacity)
+
+
+def _one_hot(x, n):
+    return jax.nn.one_hot(x, n, dtype=jnp.float32)
+
+
+def top1gating(logits, capacity_factor: float = 1.0, min_capacity: int = 4,
+               noisy_gate_policy: Optional[str] = None, rng=None,
+               drop_tokens: bool = True):
+    if not drop_tokens:
+        raise NotImplementedError(
+            "dropless MoE requires ragged dispatch (planned via "
+            "jax.lax.ragged_dot); only drop_tokens=True (the reference's "
+            "static-capacity mode) is supported")
+    """Switch-style top-1 gating (reference sharded_moe.py:184).
+
+    logits: [T, E]. Returns (aux_loss, combine [T,E,C], dispatch mask [T,E,C]).
+    """
+    T, E = logits.shape
+    C = _capacity(T, E, capacity_factor, min_capacity)
+    if noisy_gate_policy == "RSample" and rng is not None:
+        logits_w_noise = logits + jax.random.gumbel(rng, logits.shape)
+    else:
+        logits_w_noise = logits
+    gates = jax.nn.softmax(logits, axis=-1)                     # [T, E]
+    idx = jnp.argmax(logits_w_noise, axis=-1)                   # [T]
+    mask1 = _one_hot(idx, E)                                    # [T, E]
+
+    # load-balancing aux loss (Switch eq. 4; reference l_aux at :253)
+    me = jnp.mean(gates, axis=0)                                # [E]
+    ce = jnp.mean(mask1, axis=0)                                # [E]
+    aux_loss = jnp.sum(me * ce) * E
+
+    # position of each token within its expert's capacity
+    pos = jnp.cumsum(mask1, axis=0) - mask1                     # [T, E]
+    pos_in_expert = jnp.sum(pos * mask1, axis=-1)               # [T]
+    keep = (pos_in_expert < C).astype(jnp.float32)              # drop overflow
+    mask1 = mask1 * keep[:, None]
+
+    gate1 = jnp.sum(gates * mask1, axis=-1)                     # [T]
+    pos_oh = _one_hot(pos_in_expert.astype(jnp.int32), C)       # [T, C]
+    dispatch = mask1[:, :, None] * pos_oh[:, None, :]           # [T, E, C]
+    combine = dispatch * gate1[:, None, None]
+    return aux_loss, combine, dispatch
+
+
+def top2gating(logits, capacity_factor: float = 1.0, min_capacity: int = 4,
+               rng=None, drop_tokens: bool = True):
+    """GShard top-2 gating (reference sharded_moe.py:282); deterministic
+    second expert (argmax after masking expert 1)."""
+    if not drop_tokens:
+        raise NotImplementedError(
+            "dropless MoE is not supported; see top1gating")
+    T, E = logits.shape
+    C = _capacity(T, E, capacity_factor * 2.0, min_capacity)
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = _one_hot(idx1, E)
+    gates_wo1 = gates * (1.0 - mask1)
+    idx2 = jnp.argmax(gates_wo1, axis=-1)
+    mask2 = _one_hot(idx2, E)
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux_loss = jnp.sum(me * ce) * E
+
+    pos1 = jnp.cumsum(mask1, axis=0) - mask1
+    pos_in1 = jnp.sum(pos1 * mask1, axis=-1)
+    # expert-2 positions come after all expert-1 claims (reference locations2
+    # += sum of mask1)
+    pos2 = jnp.cumsum(mask2, axis=0) - mask2 + jnp.sum(mask1, axis=0, keepdims=True)
+    pos_in2 = jnp.sum(pos2 * mask2, axis=-1)
+
+    mask1 = mask1 * (pos_in1 < C).astype(jnp.float32)[:, None]
+    mask2 = mask2 * (pos_in2 < C).astype(jnp.float32)[:, None]
+
+    g1 = jnp.sum(gates * mask1, axis=-1)
+    g2 = jnp.sum(gates * mask2, axis=-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+
+    disp1 = mask1[:, :, None] * _one_hot(pos_in1.astype(jnp.int32), C)[:, None, :]
+    disp2 = mask2[:, :, None] * _one_hot(pos_in2.astype(jnp.int32), C)[:, None, :]
+    dispatch = disp1 + disp2
+    combine = disp1 * g1[:, None, None] + disp2 * g2[:, None, None]
+    return aux_loss, combine, dispatch
+
+
+def moe_layer(x, gate_w, expert_params, expert_fn, topo=None,
+              top_k: int = 1, capacity_factor: float = 1.0,
+              min_capacity: int = 4, rng=None,
+              noisy_gate_policy: Optional[str] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Apply an expert-parallel MoE layer.
+
+    x: [B, S, H]; gate_w: [H, E]; expert_params: pytree with leading expert
+    dim [E, ...] (sharded over the "expert" axis by the caller's specs);
+    expert_fn(params_e, x_e) applies one expert to [C', H].
+
+    Returns (output [B,S,H], aux_loss scalar).
+    """
+    B, S, H = x.shape
+    T = B * S
+    xt = x.reshape(T, H)
+    logits = (xt.astype(jnp.float32) @ gate_w.astype(jnp.float32))
+    if top_k == 1:
+        aux, combine, dispatch = top1gating(logits, capacity_factor,
+                                            min_capacity, noisy_gate_policy, rng)
+    else:
+        aux, combine, dispatch = top2gating(logits, capacity_factor,
+                                            min_capacity, rng)
+
+    # dispatch: [T,E,C] x [T,H] -> [E,C,H]   (the all-to-all happens here when
+    # E is sharded over the expert axis and T over the data axes)
+    xe = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)
+    if topo is not None and topo.axis_size("expert") > 1:
+        from jax.sharding import PartitionSpec as P
+        from jax.sharding import NamedSharding
+
+        xe = jax.lax.with_sharding_constraint(
+            xe, NamedSharding(topo.mesh, P("expert", None, None)))
+
+    ye = jax.vmap(expert_fn)(expert_params, xe)                 # [E, C, H]
+    out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), ye)
+    return out.reshape(B, S, H), aux.astype(jnp.float32)

@@ -206,26 +206,55 @@ class DeepSpeedTpuEngine:
             return jax.tree.map(lambda x, s: jax.lax.with_sharding_constraint(x, s),
                                 tree, sh)
 
+        pipeline_mode = self.topology.axis_size("pipe") > 1
+        if pipeline_mode:
+            # PP composes with DP/ZeRO-1 only (same restriction as the
+            # reference: PipelineEngine asserts no ZeRO-2/3, pipe/engine.py)
+            assert self.zero_stage <= 1, "pipeline parallelism requires ZeRO stage <= 1"
+            assert self.topology.axis_size("model") == 1 and \
+                self.topology.axis_size("seq") == 1 and \
+                self.topology.axis_size("expert") == 1, \
+                "pipeline + tensor/sequence/expert parallel composition not yet supported"
+            assert getattr(getattr(self.model, "cfg", None), "moe_num_experts", 0) == 0, \
+                "pipeline + MoE not yet supported (aux loss would be dropped)"
+
         def train_step(params, master, opt_state, scale_state, step, rng, batch):
             lr = lr_fn(step)
             scale = scale_state["loss_scale"] if fp16 else jnp.asarray(1.0, jnp.float32)
 
-            def micro_fn(carry, micro):
-                grads_acc, rng = carry
+            if pipeline_mode:
+                # the pipeline consumes all microbatches in one compiled
+                # program; loss is already the mean over them
                 rng, sub = jax.random.split(rng)
-                (scaled, (loss, _aux)), grads = jax.value_and_grad(
-                    self._loss_fn, has_aux=True)(params, micro, sub, scale)
-                grads = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
-                                     grads_acc, grads)
-                grads = constrain(grads, grad_sh)
-                return (grads, rng), loss
 
-            grads0 = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            grads0 = constrain(grads0, grad_sh)
-            (grads, rng), losses = jax.lax.scan(micro_fn, (grads0, rng), batch)
-            loss = jnp.mean(losses)
-            inv = 1.0 / (gas * scale)
+                def loss_fn(p):
+                    out = self.model.apply(p, batch, train=True, rng=sub)
+                    loss, _aux = _split_loss_aux(out)
+                    loss = loss.astype(jnp.float32)
+                    return loss * scale, loss
+
+                (_, loss), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+                grads = constrain(grads, grad_sh)
+                inv = 1.0 / scale
+            else:
+                def micro_fn(carry, micro):
+                    grads_acc, rng = carry
+                    rng, sub = jax.random.split(rng)
+                    (scaled, (loss, _aux)), grads = jax.value_and_grad(
+                        self._loss_fn, has_aux=True)(params, micro, sub, scale)
+                    grads = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                                         grads_acc, grads)
+                    grads = constrain(grads, grad_sh)
+                    return (grads, rng), loss
+
+                grads0 = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                grads0 = constrain(grads0, grad_sh)
+                (grads, rng), losses = jax.lax.scan(micro_fn, (grads0, rng), batch)
+                loss = jnp.mean(losses)
+                inv = 1.0 / (gas * scale)
             grads = jax.tree.map(lambda g: g * inv, grads)
 
             finite = grads_finite(grads) if fp16 else jnp.asarray(True)
@@ -294,6 +323,11 @@ class DeepSpeedTpuEngine:
 
         # eval step
         def eval_step(params, rng, batch):
+            if pipeline_mode:
+                out = self.model.apply(params, batch, train=False, rng=rng)
+                loss, _ = _split_loss_aux(out)
+                return loss.astype(jnp.float32)
+
             def micro_fn(rng, micro):
                 rng, sub = jax.random.split(rng)
                 out = self.model.apply(params, micro, train=False, rng=sub)
@@ -380,6 +414,11 @@ class DeepSpeedTpuEngine:
     # --- torch-style forward/backward/step compatibility shims ------------
     def forward(self, batch):
         """Compat: engine(batch) -> loss (cached for backward)."""
+        if self.topology.axis_size("pipe") > 1:
+            raise RuntimeError(
+                "forward/backward/step are not supported in pipeline mode; "
+                "use train_batch/eval_batch (same restriction as the "
+                "reference PipelineEngine)")
         self._cached_batches.append(batch)
         return self._forward_loss(batch)
 

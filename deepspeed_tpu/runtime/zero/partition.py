@@ -43,7 +43,8 @@ def add_zero_axes(shape: Tuple[int, ...],
                   base_spec: Optional[P],
                   zero_axes: Tuple[str, ...],
                   zero_size: int,
-                  threshold: int = 0) -> P:
+                  threshold: int = 0,
+                  axis_sizes: Optional[dict] = None) -> P:
     """Extend `base_spec` (TP placement) with the ZeRO axes on the best free dim.
 
     Picks the largest dimension that is (a) not already sharded by the base
@@ -51,10 +52,22 @@ def add_zero_axes(shape: Tuple[int, ...],
     unchanged when nothing qualifies or the tensor is below the persistence
     threshold (small params stay replicated: cheaper than gathering).
     """
-    if zero_size <= 1:
-        return base_spec if base_spec is not None else P()
     base = tuple(base_spec) if base_spec is not None else ()
     base = base + (None,) * (len(shape) - len(base))
+    # axes already used by the base (TP/EP/PP) spec cannot be reused: an
+    # expert-sharded param's ZeRO shard spans only the remaining data axes
+    used = set()
+    for entry in base:
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            if a is not None:
+                used.add(a)
+    free_axes = tuple(a for a in zero_axes if a not in used)
+    if axis_sizes is not None:
+        zero_size = 1
+        for a in free_axes:
+            zero_size *= axis_sizes[a]
+    if not free_axes or zero_size <= 1:
+        return P(*base)
     if threshold and _numel(shape) < threshold:
         return P(*base)
     # candidate dims: unsharded in base, divisible by zero_size
@@ -64,7 +77,7 @@ def add_zero_axes(shape: Tuple[int, ...],
         return P(*base)
     dim = max(candidates, key=lambda t: t[1])[0]
     new = list(base)
-    new[dim] = zero_axes if len(zero_axes) > 1 else zero_axes[0]
+    new[dim] = free_axes if len(free_axes) > 1 else free_axes[0]
     return P(*new)
 
 
@@ -110,7 +123,7 @@ def build_zero_plan(topo: MeshTopology,
         def fn(leaf, base):
             shape = leaf.shape if hasattr(leaf, "shape") else tuple(leaf)
             return add_zero_axes(shape, base, zero_axes, zero_size,
-                                 threshold=threshold)
+                                 threshold=threshold, axis_sizes=topo.sizes)
         return fn
 
     # Optimizer-state/master/grad shards always partition (no threshold);

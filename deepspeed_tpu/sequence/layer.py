@@ -32,6 +32,16 @@ def seq_all_to_all(x, axis_name: str, scatter_dim: int, gather_dim: int):
                           concat_axis=gather_dim, tiled=True)
 
 
+def _inside_manual_region() -> bool:
+    """True when tracing inside an enclosing shard_map (manual mesh axes)."""
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        return bool(mesh.shape) and any(
+            "Manual" in str(t) for t in getattr(mesh, "axis_types", ()))
+    except Exception:
+        return False
+
+
 def _inner_attention(q, k, v, causal, use_flash, block_q, block_kv, sp_size):
     """Runs on local shards inside shard_map. q/k/v: [B_l, H_l, S_l, D]."""
     from ..ops.flash_attention import flash_attention, mha_reference
@@ -69,7 +79,9 @@ def sharded_attention(q, k, v, topo: Optional[MeshTopology], causal: bool = True
     directly. With one, wraps in shard_map: batch over data axes, heads over
     "model", sequence over "seq" (Ulysses all-to-alls inside).
     """
-    if topo is None:
+    if topo is None or _inside_manual_region():
+        # already under a shard_map (e.g. the pipeline region): arrays are
+        # local shards, call the kernel directly
         return _inner_attention(q, k, v, causal, use_flash, block_q, block_kv, 1)
 
     sp = topo.axis_size(SEQ_AXIS)

@@ -1,0 +1,64 @@
+"""Pipeline-parallel tests: compiled ppermute pipeline vs pure DP."""
+
+import sys, os
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+
+def model_cfg(layers=4):
+    return TransformerConfig(vocab_size=128, hidden_size=64,
+                             intermediate_size=128, num_layers=layers,
+                             num_heads=4, max_seq_len=64, use_flash=False)
+
+
+def run(pp, micro, gas, steps=3, zero=0, layers=4):
+    model = TransformerLM(model_cfg(layers))
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "pipeline": {"stages": pp},
+        "zero_optimization": {"stage": zero},
+        "steps_per_print": 100,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(0)
+    # fixed global token set, reshaped per (gas, gm)
+    ids = rng.integers(0, 128, (gas * gm, 64), dtype=np.int64)
+    batch = {"input_ids": ids.reshape(gas, gm, 64)}
+    losses = [engine.train_batch(batch=batch) for _ in range(steps)]
+    return losses, engine
+
+
+def test_pipeline_trains():
+    losses, engine = run(pp=4, micro=1, gas=4)
+    assert losses[-1] < losses[0]
+    assert np.isfinite(losses).all()
+    # layer params actually sharded over the pipe axis
+    spec = engine.params["layers"]["wq"].sharding.spec
+    assert "pipe" in str(spec)
+
+
+def test_pipeline_matches_dp():
+    """pp=4 x dp=2 must match pure dp=8 on the same 8x4 global tokens."""
+    l_dp, _ = run(pp=1, micro=1, gas=4)          # dp=8, gm=8
+    l_pp, _ = run(pp=4, micro=4, gas=4)          # dp=2, gm=8
+    np.testing.assert_allclose(l_dp, l_pp, rtol=2e-3)
+
+
+def test_pipeline_zero1():
+    losses, engine = run(pp=2, micro=1, gas=2, zero=1)
+    assert losses[-1] < losses[0]
+
+
+def test_pipeline_rejects_zero3():
+    with pytest.raises(AssertionError):
+        run(pp=2, micro=1, gas=2, zero=3)
