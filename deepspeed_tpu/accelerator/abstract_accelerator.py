@@ -1,0 +1,162 @@
+"""Accelerator abstraction.
+
+TPU-native analogue of the reference's ``DeepSpeedAccelerator`` ABC
+(accelerator/abstract_accelerator.py:10) — the ~70-method portability seam
+through which every upper layer touches the device. Re-designed for JAX:
+"streams" and "events" become JAX async dispatch handles (XLA already runs an
+async compute stream per device; explicit stream juggling is a CUDA-ism), and
+the op-builder hooks return Pallas/XLA kernel builders instead of nvcc
+extensions (op_builder_dir()/create_op_builder(), reference
+abstract_accelerator.py:244-259).
+"""
+
+import abc
+from typing import Any, Dict, List, Optional
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    """Portability interface. Subclasses: TpuAccelerator, CpuAccelerator."""
+
+    def __init__(self):
+        self._name: Optional[str] = None
+        self._communication_backend_name: Optional[str] = None
+
+    # --- device identity (reference abstract_accelerator.py:22-60) --------
+    @abc.abstractmethod
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        ...
+
+    @abc.abstractmethod
+    def device(self, device_index: Optional[int] = None):
+        """Return the jax.Device object."""
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        ...
+
+    def set_device(self, device_index: int) -> None:
+        # JAX places arrays explicitly per-sharding, no ambient device state.
+        self._current_device = device_index
+
+    def current_device(self) -> int:
+        return getattr(self, "_current_device", 0)
+
+    def current_device_name(self) -> str:
+        return self.device_name(self.current_device())
+
+    @abc.abstractmethod
+    def is_available(self) -> bool:
+        ...
+
+    # --- synchronization (CUDA streams/events -> async dispatch) ----------
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        """Block until all in-flight work on the device is done
+        (reference `synchronize`; here = drain the XLA async stream)."""
+        import jax
+
+        (jax.device_put(0) + 0).block_until_ready()
+
+    def default_stream(self):
+        return None  # XLA owns scheduling; one logical stream
+
+    def stream(self, _stream):
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def current_stream(self):
+        return None
+
+    def create_event(self, **kwargs):
+        return None
+
+    # --- RNG (reference :96-120) ------------------------------------------
+    def manual_seed(self, seed: int) -> None:
+        self._seed = seed
+
+    def initial_seed(self) -> int:
+        return getattr(self, "_seed", 0)
+
+    def default_generator(self, device_index: int):
+        import jax
+
+        return jax.random.PRNGKey(self.initial_seed() + device_index)
+
+    # --- memory (reference :122-170) --------------------------------------
+    @abc.abstractmethod
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, int]:
+        ...
+
+    def memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return self.memory_stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return self.memory_stats(device_index).get("peak_bytes_in_use", 0)
+
+    def reset_peak_memory_stats(self, device_index: Optional[int] = None) -> None:
+        pass
+
+    def total_memory(self, device_index: Optional[int] = None) -> int:
+        return self.memory_stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index: Optional[int] = None) -> int:
+        s = self.memory_stats(device_index)
+        return s.get("bytes_limit", 0) - s.get("bytes_in_use", 0)
+
+    def empty_cache(self) -> None:
+        pass
+
+    # --- dtype support (reference :200-240) --------------------------------
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def supported_dtypes(self) -> List[Any]:
+        import jax.numpy as jnp
+
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8]
+
+    # --- comm backend (reference :189) -------------------------------------
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name or "xla"
+
+    # --- profiler range markers (reference :177-181, NVTX) ------------------
+    def range_push(self, msg: str):
+        import jax.profiler
+
+        ctx = jax.profiler.TraceAnnotation(msg)
+        ctx.__enter__()
+        self._ranges = getattr(self, "_ranges", [])
+        self._ranges.append(ctx)
+
+    def range_pop(self):
+        ranges = getattr(self, "_ranges", [])
+        if ranges:
+            ranges.pop().__exit__(None, None, None)
+
+    # --- pinned / host memory ----------------------------------------------
+    def pin_memory(self, tensor):
+        return tensor  # jax host arrays are already transfer-ready
+
+    def is_pinned(self, tensor) -> bool:
+        return True
+
+    # --- op builder registry (reference :244-259) ---------------------------
+    @abc.abstractmethod
+    def op_builder_dir(self) -> str:
+        ...
+
+    def create_op_builder(self, class_name: str):
+        builder = self.get_op_builder(class_name)
+        return builder() if builder is not None else None
+
+    def get_op_builder(self, class_name: str):
+        import importlib
+
+        mod = importlib.import_module(self.op_builder_dir())
+        return getattr(mod, class_name, None)
+
+    def build_extension(self):
+        return None  # Pallas kernels are traced, not compiled via setuptools

@@ -1,0 +1,93 @@
+"""TPU and CPU accelerator implementations.
+
+The TPU accelerator fills the seam the reference leaves for new hardware
+(accelerator/real_accelerator.py:52-120 auto-detect; cuda_accelerator.py as the
+template implementation). Memory stats come from
+``jax.Device.memory_stats()`` (HBM allocator counters).
+"""
+
+from typing import Dict, Optional
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class TpuAccelerator(DeepSpeedAccelerator):
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        self._communication_backend_name = "xla"
+
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return "tpu"
+        return f"tpu:{device_index}"
+
+    def device(self, device_index: Optional[int] = None):
+        import jax
+
+        devs = jax.devices("tpu")
+        return devs[device_index or 0]
+
+    def device_count(self) -> int:
+        import jax
+
+        try:
+            return len(jax.devices("tpu"))
+        except RuntimeError:
+            return 0
+
+    def is_available(self) -> bool:
+        return self.device_count() > 0
+
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, int]:
+        stats = self.device(device_index).memory_stats()
+        return dict(stats or {})
+
+    def op_builder_dir(self) -> str:
+        return "deepspeed_tpu.ops.op_builder.tpu"
+
+
+class CpuAccelerator(DeepSpeedAccelerator):
+    """CPU fallback (reference cpu_accelerator.py) — used for tests and for
+    host-side work (offloaded optimizers run here via the native cpu_adam)."""
+
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "gloo"
+
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        return "cpu"
+
+    def device(self, device_index: Optional[int] = None):
+        import jax
+
+        return jax.devices("cpu")[device_index or 0]
+
+    def device_count(self) -> int:
+        import jax
+
+        return len(jax.devices("cpu"))
+
+    def is_available(self) -> bool:
+        return True
+
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, int]:
+        try:
+            with open("/proc/meminfo") as f:
+                info = {}
+                for line in f:
+                    parts = line.split()
+                    info[parts[0].rstrip(":")] = int(parts[1]) * 1024
+            total = info.get("MemTotal", 0)
+            avail = info.get("MemAvailable", 0)
+            return {"bytes_limit": total, "bytes_in_use": total - avail,
+                    "peak_bytes_in_use": total - avail}
+        except OSError:
+            return {}
+
+    def is_fp16_supported(self) -> bool:
+        return False  # matches reference cpu_accelerator (bf16 only on host)
+
+    def op_builder_dir(self) -> str:
+        return "deepspeed_tpu.ops.op_builder.cpu"

@@ -1,0 +1,1 @@
+from .builder import NativeOpBuilder, OpBuilder, PallasOpBuilder  # noqa: F401

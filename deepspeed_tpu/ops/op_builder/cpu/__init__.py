@@ -1,0 +1,40 @@
+"""Host (C++) op builders — native pieces of the TPU framework: vectorized
+CPU optimizers for ZeRO-Offload and async file IO for NVMe swap (reference
+csrc/adam/cpu_adam.cpp, csrc/aio/)."""
+
+from ..builder import NativeOpBuilder
+
+
+class CPUAdamBuilder(NativeOpBuilder):
+    NAME = "cpu_adam"
+
+    def sources(self):
+        return ["adam/cpu_adam.cpp"]
+
+
+class CPUAdagradBuilder(NativeOpBuilder):
+    NAME = "cpu_adagrad"
+
+    def sources(self):
+        return ["adagrad/cpu_adagrad.cpp"]
+
+
+class CPULionBuilder(NativeOpBuilder):
+    NAME = "cpu_lion"
+
+    def sources(self):
+        return ["lion/cpu_lion.cpp"]
+
+
+class AsyncIOBuilder(NativeOpBuilder):
+    NAME = "async_io"
+
+    def sources(self):
+        return ["aio/async_io.cpp"]
+
+    def extra_ldflags(self):
+        return ["-lpthread"]
+
+
+ALL_OPS = {b.NAME: b for b in
+           (CPUAdamBuilder, CPUAdagradBuilder, CPULionBuilder, AsyncIOBuilder)}

@@ -1,0 +1,30 @@
+"""TPU (Pallas/XLA) op builders — the per-accelerator builder dir the
+accelerator selects via ``op_builder_dir()`` (reference op_builder/ tree,
+registry op_builder/all_ops.py)."""
+
+from ..builder import PallasOpBuilder
+
+
+class FlashAttnBuilder(PallasOpBuilder):
+    NAME = "flash_attn"
+    MODULE = "deepspeed_tpu.ops.flash_attention"
+
+
+class FusedOptimizerBuilder(PallasOpBuilder):
+    NAME = "fused_optimizer"
+    MODULE = "deepspeed_tpu.ops.optimizers"
+
+
+class NormsBuilder(PallasOpBuilder):
+    NAME = "norms"
+    MODULE = "deepspeed_tpu.ops.norms"
+
+
+class QuantizerBuilder(PallasOpBuilder):
+    NAME = "quantizer"
+    MODULE = "deepspeed_tpu.ops.quantizer"
+
+
+ALL_OPS = {b.NAME: b for b in
+           (FlashAttnBuilder, FusedOptimizerBuilder, NormsBuilder,
+            QuantizerBuilder)}

@@ -1,0 +1,87 @@
+"""Blockwise quantization kernels.
+
+TPU-native equivalent of the reference quantizer ops
+(csrc/quantization/pt_binding.cpp:270-297 — quantize, dequantize,
+swizzle_quant, quantized_reduction) used by ZeRO++ qwZ/qgZ and
+weight-only-quant inference. Symmetric and asymmetric int8/int4 with
+per-block scales; everything is jnp so XLA fuses the (de)quant into the
+neighbouring collective/matmul — the reference needs hand-written CUDA for
+the same fusion.
+
+Layouts are plain blocked rows (no swizzle): TPU collectives operate on
+logical arrays, so the reference's swizzled_quantize.cu layout trick
+(grouping for hierarchical all-to-all) is handled by reshaping in
+``comm.quantized`` instead.
+"""
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+INT8_QRANGE = 127.0
+INT4_QRANGE = 7.0
+
+
+def _blocked(x: jnp.ndarray, block: int) -> Tuple[jnp.ndarray, int]:
+    """Flatten to [n_blocks, block], padding the tail with zeros."""
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    pad = (-n) % block
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(-1, block), n
+
+
+@partial(jax.jit, static_argnames=("block", "bits"))
+def quantize_symmetric(x, block: int = 2048, bits: int = 8):
+    """x -> (int8 values [nb, block], fp32 scales [nb, 1]).
+
+    Symmetric per-block: q = round(x / scale), scale = absmax / qrange.
+    (reference quantize() kernel, quantization type `Symmetric`)."""
+    qrange = INT8_QRANGE if bits == 8 else INT4_QRANGE
+    blocks, _ = _blocked(x.astype(jnp.float32), block)
+    absmax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / qrange, 1.0)
+    q = jnp.clip(jnp.round(blocks / scale), -qrange, qrange).astype(jnp.int8)
+    return q, scale
+
+
+@partial(jax.jit, static_argnames=("block", "bits"))
+def quantize_asymmetric(x, block: int = 2048, bits: int = 8):
+    """x -> (int8 values, scales, zero-points). q = round((x - zp)/scale)."""
+    levels = 255.0 if bits == 8 else 15.0
+    blocks, _ = _blocked(x.astype(jnp.float32), block)
+    lo = jnp.min(blocks, axis=1, keepdims=True)
+    hi = jnp.max(blocks, axis=1, keepdims=True)
+    scale = jnp.where(hi > lo, (hi - lo) / levels, 1.0)
+    q = jnp.clip(jnp.round((blocks - lo) / scale), 0, levels)
+    q = (q - 128.0).astype(jnp.int8)  # recentre into int8
+    return q, scale, lo
+
+
+def dequantize_symmetric(q, scale, shape, dtype=jnp.float32):
+    out = (q.astype(jnp.float32) * scale).reshape(-1)
+    n = 1
+    for d in shape:
+        n *= d
+    return out[:n].reshape(shape).astype(dtype)
+
+
+def dequantize_asymmetric(q, scale, zp, shape, dtype=jnp.float32):
+    out = ((q.astype(jnp.float32) + 128.0) * scale + zp).reshape(-1)
+    n = 1
+    for d in shape:
+        n *= d
+    return out[:n].reshape(shape).astype(dtype)
+
+
+def quantized_reduction(q, scale, n_groups: int, block: int = 2048):
+    """Dequantize n_groups interleaved quantized gradients, average them, and
+    requantize (the reference's quantized_reduction kernel inside qgZ's
+    hierarchical all-to-all, quant_reduce.cu)."""
+    vals = q.astype(jnp.float32) * scale            # [nb, block]
+    vals = vals.reshape(n_groups, -1, block)
+    avg = jnp.mean(vals, axis=0)
+    return quantize_symmetric(avg.reshape(-1), block=block)

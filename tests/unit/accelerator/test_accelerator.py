@@ -1,0 +1,60 @@
+"""Accelerator abstraction tests (reference tests/accelerator/test_ds_init.py
+pattern: the ABC surface works on whatever backend is present)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.accelerator import (DeepSpeedAccelerator, get_accelerator,
+                                       set_accelerator)
+
+
+def test_singleton_and_detect():
+    a = get_accelerator()
+    assert isinstance(a, DeepSpeedAccelerator)
+    assert a is get_accelerator()
+    assert a._name in ("tpu", "cpu")
+
+
+def test_device_surface():
+    a = get_accelerator()
+    assert a.device_count() >= 1
+    assert a.is_available()
+    d = a.device(0)
+    assert d is not None
+    assert isinstance(a.device_name(0), str)
+
+
+def test_memory_stats():
+    a = get_accelerator()
+    stats = a.memory_stats()
+    assert isinstance(stats, dict)
+    assert a.total_memory() >= 0
+
+
+def test_comm_backend_name():
+    assert get_accelerator().communication_backend_name() in ("xla", "gloo")
+
+
+def test_rng_and_sync():
+    a = get_accelerator()
+    a.manual_seed(17)
+    assert a.initial_seed() == 17
+    key = a.default_generator(0)
+    assert key is not None
+    a.synchronize()
+
+
+def test_op_builder_registry():
+    a = get_accelerator()
+    b = a.create_op_builder("QuantizerBuilder" if a._name == "tpu"
+                            else "CPUAdamBuilder")
+    assert b is not None and b.builder_available() in (True, False)
+
+
+def test_pallas_builder_load():
+    from deepspeed_tpu.ops.op_builder.tpu import QuantizerBuilder
+
+    mod = QuantizerBuilder().load()
+    q, s = mod.quantize_symmetric(np.linspace(-1, 1, 4096, dtype=np.float32))
+    out = mod.dequantize_symmetric(q, s, (4096,))
+    assert np.allclose(out, np.linspace(-1, 1, 4096), atol=1e-2)

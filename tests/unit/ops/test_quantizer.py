@@ -1,0 +1,43 @@
+"""Quantizer kernel parity tests (reference tests/unit/ops/quantizer/)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import quantizer as Q
+
+
+@pytest.mark.parametrize("bits,atol", [(8, 2e-2), (4, 2e-1)])
+def test_symmetric_roundtrip(bits, atol):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(1000,)).astype(np.float32)
+    q, s = Q.quantize_symmetric(x, block=256, bits=bits)
+    out = Q.dequantize_symmetric(q, s, x.shape)
+    assert np.abs(out - x).max() < atol * np.abs(x).max()
+
+
+@pytest.mark.parametrize("bits,atol", [(8, 2e-2), (4, 2e-1)])
+def test_asymmetric_roundtrip(bits, atol):
+    rng = np.random.default_rng(1)
+    x = (rng.normal(size=(777,)) + 3.0).astype(np.float32)  # shifted dist
+    q, s, zp = Q.quantize_asymmetric(x, block=128, bits=bits)
+    out = Q.dequantize_asymmetric(q, s, zp, x.shape)
+    assert np.abs(out - x).max() < atol * (x.max() - x.min())
+
+
+def test_blocked_padding():
+    x = np.arange(100, dtype=np.float32)  # not divisible by block
+    q, s = Q.quantize_symmetric(x, block=64)
+    out = Q.dequantize_symmetric(q, s, x.shape)
+    assert out.shape == (100,)
+    assert np.allclose(out, x, atol=1.0)
+
+
+def test_quantized_reduction_matches_mean():
+    rng = np.random.default_rng(2)
+    grads = rng.normal(size=(4, 512)).astype(np.float32)
+    qs, ss = zip(*[Q.quantize_symmetric(g, block=256) for g in grads])
+    q_in = np.concatenate([q.reshape(-1, 256) for q in qs], axis=0)
+    s_in = np.concatenate(ss, axis=0)
+    q_avg, s_avg = Q.quantized_reduction(q_in, s_in, n_groups=4, block=256)
+    out = Q.dequantize_symmetric(q_avg, s_avg, (512,))
+    assert np.abs(out - grads.mean(0)).max() < 5e-2
