@@ -54,7 +54,9 @@ class DeepSpeedAccelerator(abc.ABC):
         (reference `synchronize`; here = drain the XLA async stream)."""
         import jax
 
-        (jax.device_put(0) + 0).block_until_ready()
+        dev = self.device(device_index if device_index is not None
+                          else self.current_device())
+        jax.device_put(0, dev).block_until_ready()
 
     def default_stream(self):
         return None  # XLA owns scheduling; one logical stream

@@ -93,6 +93,38 @@ def read_latest(load_dir: str) -> Optional[str]:
         return fh.read().strip()
 
 
+def load_params_for_inference(path: str, model, dtype, param_sharding=None):
+    """Load just the model weights from a training checkpoint for inference
+    (reference InferenceEngine checkpoint loading, inference/engine.py:324).
+    ``path`` may be the run dir (uses `latest`) or a concrete tag dir."""
+    if os.path.exists(os.path.join(path, "manifest.json")):
+        ckpt_dir = path
+    else:
+        tag = read_latest(path)
+        if tag is None:
+            raise FileNotFoundError(f"no checkpoint under {path}")
+        ckpt_dir = os.path.join(path, tag)
+    with open(os.path.join(ckpt_dir, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    entry = manifest["tensors"].get("master_params")
+    if entry in (None, SENTINEL_NONE):
+        entry = manifest["tensors"]["params"]
+
+    shapes = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
+    leaves, treedef = _leaf_paths(shapes)
+    sharding_leaves = (jax.tree.leaves(param_sharding)
+                      if param_sharding is not None else [None] * len(leaves))
+    new_leaves = []
+    for (key, _leaf), sh in zip(leaves, sharding_leaves):
+        info = entry.get(key)
+        if info is None:
+            raise KeyError(f"checkpoint missing param {key}")
+        arr = np.load(os.path.join(ckpt_dir, info["file"])).astype(dtype)
+        new_leaves.append(jax.device_put(arr, sh) if sh is not None
+                          else jax.numpy.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
 def load_state(load_dir: str, tag: str, template: Dict[str, Any],
                shardings: Dict[str, Any], mesh, zero_plan
                ) -> Tuple[Dict[str, Any], Dict[str, Any]]:

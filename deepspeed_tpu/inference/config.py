@@ -1,0 +1,48 @@
+"""Inference config.
+
+Reference parity: ``DeepSpeedInferenceConfig`` (inference/config.py) — the
+subset that is meaningful on TPU. ``tensor_parallel.tp_size`` maps to the
+"model" mesh axis; ``replace_with_kernel_inject`` is implicit (the model
+family always runs the Pallas/XLA kernel path); CUDA-graph replay maps to
+jit compilation caching, which XLA does for free.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class TensorParallelConfig:
+    tp_size: int = 1
+
+
+@dataclass
+class DeepSpeedInferenceConfig:
+    dtype: str = "bfloat16"
+    tensor_parallel: TensorParallelConfig = field(
+        default_factory=TensorParallelConfig)
+    max_out_tokens: int = 1024          # reference: config.max_out_tokens
+    min_out_tokens: int = 1
+    max_batch_size: int = 8
+    replace_with_kernel_inject: bool = True
+    enable_cuda_graph: bool = False      # accepted, ignored (XLA jit caches)
+    checkpoint: Optional[str] = None
+    quant_bits: Optional[int] = None     # 8/4 weight-only quant (WOQ)
+    seed: int = 0
+
+    @classmethod
+    def from_dict_or_kwargs(cls, config: Optional[Dict[str, Any]], kwargs):
+        merged: Dict[str, Any] = dict(config or {})
+        merged.update({k: v for k, v in kwargs.items() if v is not None})
+        tp = merged.pop("tensor_parallel", {})
+        if isinstance(tp, int):
+            tp = {"tp_size": tp}
+        if "mp_size" in merged:              # reference legacy alias
+            tp = {"tp_size": merged.pop("mp_size")}
+        known = {f for f in cls.__dataclass_fields__ if f != "tensor_parallel"}
+        cfg = cls(**{k: v for k, v in merged.items() if k in known})
+        cfg.tensor_parallel = TensorParallelConfig(**tp) if isinstance(tp, dict) else tp
+        if isinstance(cfg.dtype, type):      # allow jnp dtype objects
+            cfg.dtype = {"float32": "float32", "bfloat16": "bfloat16",
+                         "float16": "float16"}.get(cfg.dtype.__name__, "bfloat16")
+        return cfg

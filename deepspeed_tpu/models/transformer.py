@@ -71,10 +71,11 @@ class TransformerConfig:
 # ---------------------------------------------------------------------------
 
 
-def _rope_tables(cfg: TransformerConfig, seq_len: int, offset: int = 0):
+def _rope_tables(cfg: TransformerConfig, seq_len: int, offset=0):
+    """offset may be a traced scalar (decode position under jit)."""
     half = cfg.head_dim // 2
     freqs = 1.0 / (cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-    t = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
+    t = offset + jnp.arange(seq_len, dtype=jnp.float32)
     angles = jnp.outer(t, freqs)                      # (S, half)
     return jnp.cos(angles), jnp.sin(angles)
 
@@ -369,6 +370,106 @@ class TransformerLM:
         if self.cfg.moe_num_experts > 0:
             loss = loss + self.cfg.moe_aux_loss_coef * aux
         return loss
+
+    # -- KV-cache inference (prefill + decode) ------------------------------
+    # TPU-native replacement for the reference's inference kernel path
+    # (csrc/transformer/inference KV transforms; inference/v2 blocked KV):
+    # dense per-layer cache updated with dynamic_update_slice under jit.
+    def init_kv_cache(self, batch_size: int, max_len: int,
+                      dtype=jnp.bfloat16) -> Dict[str, jnp.ndarray]:
+        cfg = self.cfg
+        shape = (cfg.num_layers, batch_size, cfg.kv_heads, max_len, cfg.head_dim)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def _layer_cached(self, x, lp, ck, cv, cos, sin, start_pos, max_len):
+        """One layer step attending over the cache. x: [B, S, H] (S=prefill
+        length or 1 for decode); ck/cv: [B, nkv, max_len, hd]; cos/sin:
+        position-offset RoPE tables [S, hd//2]. Returns (x, new_ck, new_cv)."""
+        cfg = self.cfg
+        B, S, H = x.shape
+        nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+
+        hn = self._norm(x, lp["attn_norm"], lp.get("attn_norm_b"))
+        q = (hn @ lp["wq"]).reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        k = (hn @ lp["wk"]).reshape(B, S, nkv, hd).transpose(0, 2, 1, 3)
+        v = (hn @ lp["wv"]).reshape(B, S, nkv, hd).transpose(0, 2, 1, 3)
+        if cfg.positional == "rope":
+            q = apply_rotary(q, cos, sin)
+            k = apply_rotary(k, cos, sin)
+
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                          (0, 0, start_pos, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                          (0, 0, start_pos, 0))
+
+        # attend over cache[0:max_len] with validity+causal mask
+        rep = nh // nkv
+        kk = jnp.repeat(ck, rep, axis=1).astype(jnp.float32)   # [B,nh,M,hd]
+        vv = jnp.repeat(cv, rep, axis=1).astype(jnp.float32)
+        qf = q.astype(jnp.float32)
+        s = jnp.einsum("bhsd,bhmd->bhsm", qf, kk) / math.sqrt(hd)
+        q_pos = start_pos + jnp.arange(S)[:, None]             # [S,1]
+        k_pos = jnp.arange(max_len)[None, :]                   # [1,M]
+        mask = k_pos <= q_pos                                  # causal+valid
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhsm,bhmd->bhsd", p, vv).astype(x.dtype)
+        o = o.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
+        x = x + o @ lp["wo"]
+
+        hn = self._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+        if cfg.moe_num_experts > 0:
+            # inference MoE: dense top-k gating without capacity dropping
+            gate = jax.nn.softmax(
+                (hn @ lp["moe_gate_w"]).astype(jnp.float32), axis=-1)
+            topv, topi = jax.lax.top_k(gate, cfg.moe_top_k)
+            topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+            out = jnp.zeros_like(hn)
+            for j in range(cfg.moe_top_k):
+                eg = lp["e_gate"][topi[..., j]]
+                eu = lp["e_up"][topi[..., j]]
+                ed = lp["e_down"][topi[..., j]]
+                h = jax.nn.silu(jnp.einsum("bsh,bshf->bsf", hn, eg)) * \
+                    jnp.einsum("bsh,bshf->bsf", hn, eu)
+                out = out + (topv[..., j:j + 1] * jnp.einsum(
+                    "bsf,bsfh->bsh", h, ed)).astype(hn.dtype)
+            x = x + out
+        elif cfg.activation == "swiglu":
+            g = jax.nn.silu(hn @ lp["w_gate"])
+            x = x + (g * (hn @ lp["w_up"])) @ lp["w_down"]
+        else:
+            u = jax.nn.gelu(hn @ lp["w_up"] + lp["b_up"])
+            x = x + u @ lp["w_down"] + lp["b_down"]
+        return x, ck, cv
+
+    def forward_cached(self, params, input_ids, cache, start_pos):
+        """Forward over [B, S] tokens attending to + updating the KV cache.
+        Returns (logits [B, S, V], new_cache). Used for both prefill
+        (start_pos=0, S=prompt) and decode (S=1)."""
+        cfg = self.cfg
+        max_len = cache["k"].shape[3]
+        S = input_ids.shape[1]
+        x = params["embed"][input_ids].astype(cache["k"].dtype)
+        if cfg.positional == "learned":
+            pos = start_pos + jnp.arange(S)
+            x = x + params["pos_embed"][pos][None].astype(x.dtype)
+        if cfg.positional == "rope":
+            cos, sin = _rope_tables(cfg, S, start_pos)
+        else:
+            cos = sin = jnp.zeros((S, 1), jnp.float32)
+
+        def scan_fn(h, layer_in):
+            lp, ck, cv = layer_in
+            h, ck, cv = self._layer_cached(h, lp, ck, cv, cos, sin,
+                                           start_pos, max_len)
+            return h, (ck, cv)
+
+        x, (new_k, new_v) = jax.lax.scan(
+            scan_fn, x, (params["layers"], cache["k"], cache["v"]))
+        x = self._norm(x, params["final_norm"], params.get("final_norm_b"))
+        head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
+        logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+        return logits, {"k": new_k, "v": new_v}
 
     def flops_per_token(self, seq_len: Optional[int] = None) -> float:
         """6*N_active + attention flops per token (for MFU accounting)."""

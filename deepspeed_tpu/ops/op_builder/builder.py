@@ -85,7 +85,9 @@ class NativeOpBuilder(OpBuilder):
     def is_compatible(self, verbose: bool = False) -> bool:
         from shutil import which
 
-        return which("g++") is not None
+        if which("g++") is None:
+            return False
+        return all((self._src_root() / s).exists() for s in self.sources())
 
     def _src_root(self) -> Path:
         return Path(__file__).resolve().parents[2] / "csrc"

@@ -77,11 +77,12 @@ def dequantize_asymmetric(q, scale, zp, shape, dtype=jnp.float32):
     return out[:n].reshape(shape).astype(dtype)
 
 
-def quantized_reduction(q, scale, n_groups: int, block: int = 2048):
+def quantized_reduction(q, scale, n_groups: int, block: int = 2048,
+                        bits: int = 8):
     """Dequantize n_groups interleaved quantized gradients, average them, and
-    requantize (the reference's quantized_reduction kernel inside qgZ's
-    hierarchical all-to-all, quant_reduce.cu)."""
+    requantize at the same width (the reference's quantized_reduction kernel
+    inside qgZ's hierarchical all-to-all, quant_reduce.cu)."""
     vals = q.astype(jnp.float32) * scale            # [nb, block]
     vals = vals.reshape(n_groups, -1, block)
     avg = jnp.mean(vals, axis=0)
-    return quantize_symmetric(avg.reshape(-1), block=block)
+    return quantize_symmetric(avg.reshape(-1), block=block, bits=bits)
