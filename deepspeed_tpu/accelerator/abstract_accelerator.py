@@ -56,7 +56,9 @@ class DeepSpeedAccelerator(abc.ABC):
 
         dev = self.device(device_index if device_index is not None
                           else self.current_device())
-        jax.device_put(0, dev).block_until_ready()
+        # the `+ 0` enqueues a compute op ordered after in-flight work on the
+        # device's stream; a bare transfer would not drain the compute queue
+        (jax.device_put(0, dev) + 0).block_until_ready()
 
     def default_stream(self):
         return None  # XLA owns scheduling; one logical stream

@@ -40,9 +40,25 @@ class DeepSpeedInferenceConfig:
         if "mp_size" in merged:              # reference legacy alias
             tp = {"tp_size": merged.pop("mp_size")}
         known = {f for f in cls.__dataclass_fields__ if f != "tensor_parallel"}
+        unknown = set(merged) - known
+        if unknown:
+            # the reference's pydantic config rejects unknown fields; warn
+            # loudly instead of silently running with defaults
+            from ..utils.logging import logger
+            logger.warning(
+                f"init_inference: ignoring unknown config keys {sorted(unknown)} "
+                f"(known: {sorted(known | {'tensor_parallel', 'mp_size'})})")
         cfg = cls(**{k: v for k, v in merged.items() if k in known})
         cfg.tensor_parallel = TensorParallelConfig(**tp) if isinstance(tp, dict) else tp
         if isinstance(cfg.dtype, type):      # allow jnp dtype objects
-            cfg.dtype = {"float32": "float32", "bfloat16": "bfloat16",
-                         "float16": "float16"}.get(cfg.dtype.__name__, "bfloat16")
+            cfg.dtype = cfg.dtype.__name__
+        aliases = {"fp32": "float32", "float": "float32", "float32": "float32",
+                   "fp16": "float16", "half": "float16", "float16": "float16",
+                   "bf16": "bfloat16", "bfloat16": "bfloat16"}
+        key = str(cfg.dtype).replace("torch.", "").replace("jnp.", "")
+        if key not in aliases:
+            raise ValueError(
+                f"unsupported inference dtype {cfg.dtype!r}; one of "
+                f"{sorted(set(aliases))}")
+        cfg.dtype = aliases[key]
         return cfg

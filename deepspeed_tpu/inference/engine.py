@@ -123,6 +123,21 @@ class InferenceEngine:
         ids = np.asarray(input_ids)
         if ids.ndim == 1:
             ids = ids[None]
+        # enforce the engine limits the reference enforces (max_out_tokens /
+        # max_batch_size in the reference config gate its workspace alloc)
+        if ids.shape[0] > self.config.max_batch_size:
+            raise ValueError(
+                f"batch size {ids.shape[0]} exceeds config.max_batch_size="
+                f"{self.config.max_batch_size}")
+        total = ids.shape[1] + int(max_new_tokens)
+        if total > self.config.max_out_tokens:
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds "
+                f"config.max_out_tokens={self.config.max_out_tokens}")
+        if int(max_new_tokens) < self.config.min_out_tokens:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} below "
+                f"config.min_out_tokens={self.config.min_out_tokens}")
         eos = -1 if eos_token_id is None else int(eos_token_id)
         if self._gen_jit is None:
             self._gen_jit = jax.jit(
@@ -150,9 +165,17 @@ class InferenceEngine:
             tok = _sample(last, sub, temperature, top_k, top_p)  # [B]
             tok = jnp.where(done, eos if eos >= 0 else 0, tok)
             done = done | (tok == eos)
-            logits, cache = self.model.forward_cached(
-                params, tok[:, None], cache, S + i)
-            return (cache, logits[:, 0], rng, done), tok
+
+            def fwd(cache):
+                logits, cache = self.model.forward_cached(
+                    params, tok[:, None], cache, S + i)
+                return cache, logits[:, 0]
+
+            # the final iteration's logits are never sampled: skip that
+            # forward entirely (runtime cond, not compile-time)
+            cache, nxt = jax.lax.cond(i < max_new_tokens - 1, fwd,
+                                      lambda c: (c, last), cache)
+            return (cache, nxt, rng, done), tok
 
         done0 = jnp.zeros((B,), bool)
         _, toks = jax.lax.scan(

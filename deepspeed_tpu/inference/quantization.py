@@ -2,11 +2,11 @@
 
 Reference parity: ``inference/quantization/quantization.py:111`` (int4/int8
 weight-only quant for ZeRO-inference). TPU-native design: weight matrices are
-stored in HBM as int8 (+per-block fp32 scales) and dequantized *inside* the
-jitted forward right before use — XLA fuses the dequant into the consuming
-matmul, so at-rest HBM is 1/2 (int8) or 1/4 (int4-in-int8) of bf16 while the
-MXU still sees bf16 operands. No custom CUDA dequant kernels needed
-(reference csrc dequantize kernels).
+stored in HBM as int8 (+per-block fp32 scales; int4 packed two-per-byte) and
+dequantized *inside* the jitted forward right before use — XLA fuses the
+dequant into the consuming matmul, so at-rest HBM is 1/2 (int8) or 1/4
+(packed int4) of bf16 while the MXU still sees bf16 operands. No custom CUDA
+dequant kernels needed (reference csrc dequantize kernels).
 """
 
 from typing import Any, Tuple
@@ -21,27 +21,33 @@ _MIN_QUANT_SIZE = 4096  # don't quantize norms/biases/small tables
 
 @jax.tree_util.register_pytree_node_class
 class QuantizedTensor:
-    """int8 blocks + fp32 scales standing in for a dense weight.
+    """int8 blocks + fp32 scales standing in for a dense weight; int4 is
+    packed two-per-byte (real 4x at-rest saving).
 
     A pytree node whose children are the device arrays and whose aux data is
-    the logical (shape, dtype) — so it flows through jit/device_put intact."""
+    the logical (shape, dtype, bits) — so it flows through jit/device_put
+    intact."""
 
-    def __init__(self, q, s, shape: Tuple[int, ...], dtype: str):
+    def __init__(self, q, s, shape: Tuple[int, ...], dtype: str,
+                 bits: int = 8):
         self.q, self.s, self.shape, self.dtype = q, s, tuple(shape), dtype
+        self.bits = bits
 
     def dequantize(self):
-        return Q.dequantize_symmetric(self.q, self.s, self.shape,
+        q = Q.unpack_int4(self.q) if self.bits == 4 else self.q
+        return Q.dequantize_symmetric(q, self.s, self.shape,
                                       dtype=jnp.dtype(self.dtype))
 
     def tree_flatten(self):
-        return (self.q, self.s), (self.shape, self.dtype)
+        return (self.q, self.s), (self.shape, self.dtype, self.bits)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         return cls(children[0], children[1], *aux)
 
     def __repr__(self):
-        return f"QuantizedTensor(shape={self.shape}, dtype={self.dtype})"
+        return (f"QuantizedTensor(shape={self.shape}, dtype={self.dtype}, "
+                f"bits={self.bits})")
 
 
 def _is_qleaf(x) -> bool:
@@ -63,7 +69,10 @@ def quantize_params(params, bits: int = 8, block: int = 2048):
     for path, leaf in flat:
         if _should_quantize(path, leaf):
             q, s = Q.quantize_symmetric(leaf, block=block, bits=bits)
-            out.append(QuantizedTensor(q, s, leaf.shape, str(leaf.dtype)))
+            if bits == 4:
+                q = Q.pack_int4(q)
+            out.append(QuantizedTensor(q, s, leaf.shape, str(leaf.dtype),
+                                       bits=bits))
             meta["n_quantized"] += 1
         else:
             out.append(leaf)

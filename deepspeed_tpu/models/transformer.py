@@ -50,7 +50,8 @@ class TransformerConfig:
     use_flash: bool = True
     attn_block_q: int = 128
     attn_block_kv: int = 128
-    seq_parallel: bool = False             # Ulysses all-to-all over "seq" axis
+    seq_parallel: bool = False             # sequence parallelism over "seq" axis
+    seq_parallel_impl: str = "ulysses"     # ulysses (all-to-all) | ring (blockwise)
     # MoE (expert parallelism; reference deepspeed/moe/layer.py:16). When
     # moe_num_experts > 0 every layer's MLP becomes a top-k routed MoE.
     moe_num_experts: int = 0
@@ -209,7 +210,8 @@ class TransformerLM:
         return sharded_attention(q, k, v, self.topology, causal=True,
                                  use_flash=cfg.use_flash,
                                  block_q=cfg.attn_block_q,
-                                 block_kv=cfg.attn_block_kv)
+                                 block_kv=cfg.attn_block_kv,
+                                 impl=cfg.seq_parallel_impl)
 
     def _layer(self, x, lp, cos, sin):
         cfg = self.cfg

@@ -61,6 +61,26 @@ def quantize_asymmetric(x, block: int = 2048, bits: int = 8):
     return q, scale, lo
 
 
+def pack_int4(q):
+    """Pack int4 values (stored one-per-int8, range [-7, 7]) two per byte:
+    [nb, block] int8 -> [nb, block//2] int8. Gives int4 its real 4x at-rest
+    memory saving (the reference stores packed int4 the same way,
+    csrc/quantization swizzled layouts)."""
+    hi = q[:, 0::2].astype(jnp.int32)
+    lo = q[:, 1::2].astype(jnp.int32)
+    return ((hi << 4) | (lo & 0xF)).astype(jnp.int8)
+
+
+def unpack_int4(packed):
+    """Inverse of pack_int4: [nb, block//2] int8 -> [nb, block] int8.
+    Arithmetic shifts sign-extend both nibbles."""
+    p = packed.astype(jnp.int32)
+    hi = p >> 4                      # sign-extends
+    lo = (p << 28) >> 28             # sign-extend the low nibble
+    out = jnp.stack([hi, lo], axis=-1).reshape(p.shape[0], -1)
+    return out.astype(jnp.int8)
+
+
 def dequantize_symmetric(q, scale, shape, dtype=jnp.float32):
     out = (q.astype(jnp.float32) * scale).reshape(-1)
     n = 1

@@ -1,0 +1,16 @@
+"""Sequence/context parallelism (reference deepspeed/sequence/).
+
+Two strategies over the "seq" mesh axis:
+  * Ulysses all-to-all (reference sequence/layer.py) — layer.py
+  * Ring attention (blockwise context parallelism; absent from the
+    reference, TPU-native superset) — ring_attention.py
+"""
+
+from .layer import (DistributedAttention, seq_all_to_all, sharded_attention,
+                    ulysses_attention)
+from .ring_attention import ring_attention, ring_attention_sharded
+
+__all__ = [
+    "DistributedAttention", "seq_all_to_all", "sharded_attention",
+    "ulysses_attention", "ring_attention", "ring_attention_sharded",
+]

@@ -42,9 +42,14 @@ def _inside_manual_region() -> bool:
         return False
 
 
-def _inner_attention(q, k, v, causal, use_flash, block_q, block_kv, sp_size):
+def _inner_attention(q, k, v, causal, use_flash, block_q, block_kv, sp_size,
+                     impl="ulysses", scale=None):
     """Runs on local shards inside shard_map. q/k/v: [B_l, H_l, S_l, D]."""
     from ..ops.flash_attention import flash_attention, mha_reference
+
+    if sp_size > 1 and impl == "ring":
+        from .ring_attention import ring_attention
+        return ring_attention(q, k, v, SEQ_AXIS, causal=causal, scale=scale)
 
     if sp_size > 1:
         # Ulysses: heads -> heads/sp, seq/sp -> seq
@@ -60,10 +65,10 @@ def _inner_attention(q, k, v, causal, use_flash, block_q, block_kv, sp_size):
     s = q.shape[2]
     if use_flash and s % block_q == 0 and k.shape[2] % block_kv == 0 \
             and s >= block_q:
-        o = flash_attention(q, k, v, causal=causal, block_q=block_q,
-                            block_kv=block_kv)
+        o = flash_attention(q, k, v, causal=causal, scale=scale,
+                            block_q=block_q, block_kv=block_kv)
     else:
-        o = mha_reference(q, k, v, causal=causal)
+        o = mha_reference(q, k, v, causal=causal, scale=scale)
 
     if sp_size > 1:
         o = seq_all_to_all(o, SEQ_AXIS, scatter_dim=2, gather_dim=1)
@@ -72,27 +77,38 @@ def _inner_attention(q, k, v, causal, use_flash, block_q, block_kv, sp_size):
 
 def sharded_attention(q, k, v, topo: Optional[MeshTopology], causal: bool = True,
                       use_flash: bool = True, block_q: int = 128,
-                      block_kv: int = 128):
+                      block_kv: int = 128, impl: str = "ulysses", scale=None):
     """Attention over [B, H, S, D] with mesh-aware partitioning.
 
     Without a topology (single device / replicated), calls the kernel
     directly. With one, wraps in shard_map: batch over data axes, heads over
-    "model", sequence over "seq" (Ulysses all-to-alls inside).
+    "model", sequence over "seq". `impl` selects the sequence-parallel
+    strategy when the "seq" axis is >1: "ulysses" (all-to-all head
+    repartition, reference sequence/layer.py) or "ring" (blockwise ring
+    attention, ring_attention.py).
     """
     if topo is None or _inside_manual_region():
         # already under a shard_map (e.g. the pipeline region): arrays are
         # local shards, call the kernel directly
-        return _inner_attention(q, k, v, causal, use_flash, block_q, block_kv, 1)
+        return _inner_attention(q, k, v, causal, use_flash, block_q, block_kv,
+                                1, scale=scale)
 
     sp = topo.axis_size(SEQ_AXIS)
     dp_axes = topo.batch_axes
-    batch_spec = dp_axes if len(dp_axes) > 1 else dp_axes[0]
+    dp_total = 1
+    for a in dp_axes:
+        dp_total *= topo.axis_size(a)
+    if dp_total > 1 and q.shape[0] % dp_total == 0:
+        batch_spec = dp_axes if len(dp_axes) > 1 else dp_axes[0]
+    else:
+        batch_spec = None  # batch replicated (e.g. single long sequence)
     tp = topo.axis_size(MODEL_AXIS)
     head_spec = MODEL_AXIS if tp > 1 else None
     qkv_spec = P(batch_spec, head_spec, SEQ_AXIS if sp > 1 else None, None)
 
     fn = partial(_inner_attention, causal=causal, use_flash=use_flash,
-                 block_q=block_q, block_kv=block_kv, sp_size=sp)
+                 block_q=block_q, block_kv=block_kv, sp_size=sp, impl=impl,
+                 scale=scale)
     # check_vma=False: pallas_call outputs don't carry vma metadata
     return jax.shard_map(fn, mesh=topo.mesh,
                          in_specs=(qkv_spec, qkv_spec, qkv_spec),

@@ -1,0 +1,138 @@
+"""Ring attention — blockwise context parallelism over the "seq" mesh axis.
+
+Long-context strategy ABSENT from the reference snapshot (SURVEY.md §5
+"Ring attention / blockwise / context-parallel: NOT present"); the reference
+only ships Ulysses all-to-all SP (deepspeed/sequence/layer.py) and
+block-sparse attention. This module supplies the TPU-idiomatic superset: the
+sequence stays sharded [B, H, S/sp, D] end-to-end while K/V chunks rotate
+around the "seq" axis ring via `lax.ppermute` (XLA lowers to ICI
+collective-permute, overlapping the next chunk's transfer with the current
+chunk's compute). Each device accumulates its queries' attention with the
+online-softmax (never materializing the [S, S] score matrix), i.e. blockwise
+attention in the style of Liu et al. 2023 (RingAttention).
+
+Advantages over Ulysses on TPU:
+  * max sequence length scales with the ring size (activations are never
+    gathered to full S on any device),
+  * no head-count divisibility constraint (Ulysses needs heads % sp == 0),
+  * comm is neighbor-only ppermute on ICI instead of all-to-all.
+
+Composition: heads may simultaneously be sharded over "model" (TP) and batch
+over the data axes — the ring only touches the sequence dim.
+
+Memory: the per-step chunk computation is wrapped in `jax.checkpoint`, so
+backward re-computes each [S_l, S_l_chunk] score block instead of storing
+all of them (the blockwise-bwd trick; gradients flow through `ppermute` via
+its built-in transpose rule).
+"""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.topology import SEQ_AXIS, MeshTopology
+
+NEG_INF = -1e30
+
+
+def _chunk_update(q, k, v, o, m, l, q_off, k_off, scale, causal):
+    """One online-softmax accumulation step against a K/V chunk.
+
+    q: [B, H, Sq, D]; k/v: [B, H, Sk, D] (kv heads already expanded);
+    o/m/l: running accumulators (f32). q_off/k_off: global position offsets
+    of the local query / current ring chunk (traced scalars).
+    """
+    sq, skv = q.shape[2], k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_off + lax.broadcasted_iota(jnp.int32, (sq, skv), 0)
+        k_pos = k_off + lax.broadcasted_iota(jnp.int32, (sq, skv), 1)
+        mask = (q_pos >= k_pos)[None, None]
+        s = jnp.where(mask, s, NEG_INF)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_cur)
+    # guard: rows with no valid key yet keep m == NEG_INF; exp(NEG_INF - NEG_INF)
+    # would be 1, so re-zero masked entries explicitly
+    p = jnp.exp(s - m_new)
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    o_new = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32),
+                                  preferred_element_type=jnp.float32)
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, axis_name: str = SEQ_AXIS, causal: bool = True,
+                   scale: Optional[float] = None, use_remat: bool = True):
+    """Ring attention on local shards inside a shard_map region.
+
+    q: [B, H, S_l, D]; k/v: [B, Hkv, S_l, D] — the sequence dim is the local
+    shard of a global sequence contiguously partitioned over `axis_name`.
+    Returns [B, H, S_l, D] in q.dtype.
+    """
+    sp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, s_l, d = q.shape
+    hkv = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if hkv != h:
+        rep = h // hkv  # expand GQA heads locally; ring comm stays at kv size
+    else:
+        rep = 1
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    update = _chunk_update
+    if use_remat:
+        update = jax.checkpoint(_chunk_update, static_argnums=(8, 9))
+
+    def step(carry, t):
+        o, m, l, k_cur, v_cur = carry
+        src = (idx - t) % sp  # which device's chunk we hold at step t
+        k_off = src * s_l
+        q_off = idx * s_l
+
+        def compute(args):
+            o, m, l = args
+            k_full = jnp.repeat(k_cur, rep, axis=1) if rep > 1 else k_cur
+            v_full = jnp.repeat(v_cur, rep, axis=1) if rep > 1 else v_cur
+            return update(q, k_full, v_full, o, m, l, q_off, k_off,
+                          scale, causal)
+
+        if causal:
+            # chunks strictly in the future are fully masked: skip the matmuls
+            o, m, l = lax.cond(src <= idx, compute, lambda a: a, (o, m, l))
+        else:
+            o, m, l = compute((o, m, l))
+        # rotate K/V to the next device; XLA overlaps this with the next
+        # iteration's compute (the ring pipelining that replaces the
+        # reference's comm/compute stream overlap, stage3.py:1151)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o, m, l, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros((b, h, s_l, d), jnp.float32)
+    m0 = jnp.full((b, h, s_l, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_l, 1), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v),
+                                  jnp.arange(sp, dtype=jnp.int32))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (o / l_safe).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, topo: MeshTopology, causal: bool = True,
+                           scale: Optional[float] = None):
+    """Mesh-level entry: q/k/v are global [B, H, S, D] arrays with S sharded
+    over the "seq" axis (and optionally H over "model", B over data axes).
+    Thin alias for ``sharded_attention(..., impl="ring")`` — one dispatch
+    path owns the partition-spec construction.
+    """
+    from .layer import sharded_attention
+    return sharded_attention(q, k, v, topo, causal=causal, impl="ring",
+                             scale=scale)

@@ -41,3 +41,31 @@ def test_quantized_reduction_matches_mean():
     q_avg, s_avg = Q.quantized_reduction(q_in, s_in, n_groups=4, block=256)
     out = Q.dequantize_symmetric(q_avg, s_avg, (512,))
     assert np.abs(out - grads.mean(0)).max() < 5e-2
+
+
+def test_int4_pack_roundtrip():
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops import quantizer as Q
+
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((8, 256)).astype(np.float32)
+    q, s = Q.quantize_symmetric(jnp.asarray(x), block=128, bits=4)
+    packed = Q.pack_int4(q)
+    assert packed.shape[1] == q.shape[1] // 2
+    unpacked = Q.unpack_int4(packed)
+    np.testing.assert_array_equal(np.asarray(unpacked), np.asarray(q))
+
+
+def test_int4_quantized_tensor_memory():
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.quantization import quantize_params, \
+        dequantize_params, quantized_nbytes
+
+    rng = np.random.default_rng(4)
+    params = {"w": jnp.asarray(rng.standard_normal((128, 64)).astype(np.float32))}
+    q8, _ = quantize_params(params, bits=8, block=128)
+    q4, _ = quantize_params(params, bits=4, block=128)
+    assert quantized_nbytes(q4) < quantized_nbytes(q8)
+    d4 = dequantize_params(q4)
+    err = np.abs(np.asarray(d4["w"]) - np.asarray(params["w"])).mean()
+    assert err < 0.2  # int4 quantization noise, not garbage
