@@ -146,7 +146,10 @@ def load_state(load_dir: str, tag: str, template: Dict[str, Any],
             if info is None:
                 raise KeyError(f"checkpoint missing tensor {name}/{key}")
             arr = np.load(os.path.join(ckpt_dir, info["file"]))
-            if hasattr(leaf, "sharding"):
+            if isinstance(leaf, np.ndarray):
+                # host-resident leaf (e.g. ZeRO-Offload state): stay on host
+                new_leaves.append(arr.astype(leaf.dtype))
+            elif hasattr(leaf, "sharding"):
                 if hasattr(leaf, "dtype"):
                     arr = arr.astype(leaf.dtype)
                 new_leaves.append(jax.device_put(arr, leaf.sharding))

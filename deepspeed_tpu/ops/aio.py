@@ -1,0 +1,97 @@
+"""Async host file IO (ctypes binding of csrc/aio/async_io.cpp).
+
+TPU-native equivalent of the reference's ``aio_handle`` pybind surface
+(csrc/aio/py_lib/py_ds_aio.cpp:16-22): asynchronous pread/pwrite of numpy
+buffers against local SSD, used by the NVMe swap layer
+(runtime/swap_tensor/). Requests overlap with Python-side compute; buffers
+must stay alive until waited.
+"""
+
+import ctypes
+from ctypes import c_char_p, c_int, c_int64, c_void_p
+from typing import Optional
+
+import numpy as np
+
+from .op_builder.cpu import AsyncIOBuilder
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        _lib = AsyncIOBuilder().load()
+        _lib.ds_aio_handle_create.restype = c_void_p
+        _lib.ds_aio_handle_create.argtypes = [c_int64, c_int]
+        _lib.ds_aio_handle_destroy.argtypes = [c_void_p]
+        for fn in (_lib.ds_aio_pread, _lib.ds_aio_pwrite):
+            fn.restype = c_int64
+            fn.argtypes = [c_void_p, c_char_p, c_void_p, c_int64, c_int64]
+        _lib.ds_aio_wait.restype = c_int64
+        _lib.ds_aio_wait.argtypes = [c_void_p, c_int64]
+        _lib.ds_aio_wait_all.restype = c_int64
+        _lib.ds_aio_wait_all.argtypes = [c_void_p]
+    return _lib
+
+
+class AsyncIOHandle:
+    """Reference aio_handle(block_size, queue_depth, single_submit,
+    overlap_events, num_threads); here block_size + num_threads are the
+    meaningful knobs for the thread-pool backend."""
+
+    def __init__(self, block_size: int = 1 << 20, num_threads: int = 8):
+        self._lib = _load()
+        self._h: Optional[int] = self._lib.ds_aio_handle_create(
+            block_size, num_threads)
+        self.block_size = block_size
+        self.num_threads = num_threads
+
+    def _buf(self, arr: np.ndarray):
+        assert arr.flags["C_CONTIGUOUS"], "AIO buffers must be contiguous"
+        return arr.ctypes.data_as(c_void_p), arr.nbytes
+
+    def pread(self, path: str, arr: np.ndarray, file_offset: int = 0) -> int:
+        ptr, nbytes = self._buf(arr)
+        return self._lib.ds_aio_pread(self._h, str(path).encode(), ptr,
+                                      nbytes, file_offset)
+
+    def pwrite(self, path: str, arr: np.ndarray, file_offset: int = 0) -> int:
+        ptr, nbytes = self._buf(arr)
+        return self._lib.ds_aio_pwrite(self._h, str(path).encode(), ptr,
+                                       nbytes, file_offset)
+
+    def wait(self, req_id: int) -> int:
+        got = self._lib.ds_aio_wait(self._h, req_id)
+        if got < 0:
+            raise OSError(-got, f"aio request {req_id} failed")
+        return got
+
+    def wait_all(self):
+        err = self._lib.ds_aio_wait_all(self._h)
+        if err < 0:
+            raise OSError(-err, "aio wait_all: a request failed")
+
+    # synchronous conveniences (reference sync_pread/sync_pwrite)
+    def sync_pread(self, path: str, arr: np.ndarray, file_offset: int = 0) -> int:
+        return self.wait(self.pread(path, arr, file_offset))
+
+    def sync_pwrite(self, path: str, arr: np.ndarray, file_offset: int = 0) -> int:
+        return self.wait(self.pwrite(path, arr, file_offset))
+
+    def close(self):
+        if self._h is not None:
+            self._lib.ds_aio_handle_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,220 @@
+"""Host (CPU) optimizers backed by the native C++ kernels.
+
+TPU-native equivalent of the reference's ``DeepSpeedCPUAdam``
+(deepspeed/ops/adam/cpu_adam.py:181, csrc/adam/cpu_adam.cpp), CPU Adagrad and
+CPU Lion. Used by ZeRO-Offload: the fp32 master weights and optimizer moments
+live in host RAM as numpy arrays and the update runs in the OpenMP/SIMD C++
+kernel while the TPU only produces gradients.
+
+The binding surface is the C ABI via ctypes (no pybind11 in this image); all
+arrays must be contiguous numpy. The bf16 fused path takes device-native
+bfloat16 gradients and emits updated bfloat16 params for the host->device
+transfer in the same pass over memory.
+"""
+
+import ctypes
+from ctypes import POINTER, c_float, c_int, c_int64, c_uint16
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .op_builder.cpu import CPUAdagradBuilder, CPUAdamBuilder, CPULionBuilder
+
+_f32p = POINTER(c_float)
+_u16p = POINTER(c_uint16)
+
+
+def _f32(arr: np.ndarray):
+    assert arr.dtype == np.float32 and arr.flags["C_CONTIGUOUS"]
+    return arr.ctypes.data_as(_f32p)
+
+
+def _bf16(arr: np.ndarray):
+    # ml_dtypes.bfloat16 arrays are 2-byte; view as uint16 for the C ABI
+    view = arr.view(np.uint16)
+    assert view.flags["C_CONTIGUOUS"]
+    return view.ctypes.data_as(_u16p)
+
+
+class _HostOptimizer:
+    """Common ctypes lifecycle: create on first use, destroy with the object."""
+
+    _lib = None
+
+    def __init__(self):
+        self._id: Optional[int] = None
+
+    def _destroy(self, fn_name: str):
+        if self._id is not None and self._lib is not None:
+            getattr(self._lib, fn_name)(self._id)
+            self._id = None
+
+    def __del__(self):  # pragma: no cover - interpreter teardown
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+
+class DeepSpeedCPUAdam(_HostOptimizer):
+    """Reference ops/adam/cpu_adam.py:181 (create_adam/adam_update)."""
+
+    def __init__(self, lr: float = 1e-3, betas: Tuple[float, float] = (0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 adamw_mode: bool = True, bias_correction: bool = True):
+        super().__init__()
+        if DeepSpeedCPUAdam._lib is None:
+            DeepSpeedCPUAdam._lib = CPUAdamBuilder().load()
+            lib = DeepSpeedCPUAdam._lib
+            lib.ds_adam_create.restype = c_int
+            lib.ds_adam_create.argtypes = [c_float] * 5 + [c_int, c_int]
+            lib.ds_adam_update.argtypes = [
+                c_int, c_int64, c_float, _f32p, _f32p, _f32p, _f32p, c_int64]
+            lib.ds_adam_update_bf16.argtypes = [
+                c_int, c_int64, c_float, _f32p, _u16p, _f32p, _f32p, _u16p, c_int64]
+        self.lr, self.betas, self.eps = lr, betas, eps
+        self.weight_decay, self.adamw_mode = weight_decay, adamw_mode
+        self.bias_correction = bias_correction
+        self._id = self._lib.ds_adam_create(
+            lr, betas[0], betas[1], eps, weight_decay,
+            int(adamw_mode), int(bias_correction))
+
+    def destroy(self):
+        self._destroy("ds_adam_destroy")
+
+    def state_keys(self):
+        return ("exp_avg", "exp_avg_sq")
+
+    def step(self, step: int, params: np.ndarray, grads: np.ndarray,
+             exp_avg: np.ndarray, exp_avg_sq: np.ndarray,
+             lr: Optional[float] = None,
+             params_out_bf16: Optional[np.ndarray] = None):
+        """In-place Adam update on flat fp32 arrays. ``grads`` may be fp32 or
+        bfloat16; with bf16 grads, ``params_out_bf16`` (same shape) receives
+        the downcast updated params in the same pass."""
+        n = params.size
+        lr_c = -1.0 if lr is None else float(lr)
+        if grads.dtype == np.float32:
+            self._lib.ds_adam_update(self._id, step, lr_c, _f32(params),
+                                     _f32(grads), _f32(exp_avg),
+                                     _f32(exp_avg_sq), n)
+            if params_out_bf16 is not None:
+                import ml_dtypes
+                np.copyto(params_out_bf16, params.astype(ml_dtypes.bfloat16))
+        else:
+            assert params_out_bf16 is not None, "bf16 path requires output buffer"
+            self._lib.ds_adam_update_bf16(self._id, step, lr_c, _f32(params),
+                                          _bf16(grads), _f32(exp_avg),
+                                          _f32(exp_avg_sq),
+                                          _bf16(params_out_bf16), n)
+
+
+class DeepSpeedCPUAdagrad(_HostOptimizer):
+    """Reference ops/adagrad/cpu_adagrad.py (create_adagrad/adagrad_update)."""
+
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0):
+        super().__init__()
+        if DeepSpeedCPUAdagrad._lib is None:
+            DeepSpeedCPUAdagrad._lib = CPUAdagradBuilder().load()
+            lib = DeepSpeedCPUAdagrad._lib
+            lib.ds_adagrad_create.restype = c_int
+            lib.ds_adagrad_create.argtypes = [c_float] * 3
+            lib.ds_adagrad_update.argtypes = [
+                c_int, c_float, _f32p, _f32p, _f32p, c_int64]
+            lib.ds_adagrad_update_bf16.argtypes = [
+                c_int, c_float, _f32p, _u16p, _f32p, _u16p, c_int64]
+        self.lr, self.eps, self.weight_decay = lr, eps, weight_decay
+        self._id = self._lib.ds_adagrad_create(lr, eps, weight_decay)
+
+    def destroy(self):
+        self._destroy("ds_adagrad_destroy")
+
+    def state_keys(self):
+        return ("sum_sq",)
+
+    def step(self, step: int, params: np.ndarray, grads: np.ndarray,
+             sum_sq: np.ndarray, lr: Optional[float] = None,
+             params_out_bf16: Optional[np.ndarray] = None):
+        n = params.size
+        lr_c = -1.0 if lr is None else float(lr)
+        if grads.dtype == np.float32:
+            self._lib.ds_adagrad_update(self._id, lr_c, _f32(params),
+                                        _f32(grads), _f32(sum_sq), n)
+            if params_out_bf16 is not None:
+                import ml_dtypes
+                np.copyto(params_out_bf16, params.astype(ml_dtypes.bfloat16))
+        else:
+            assert params_out_bf16 is not None
+            self._lib.ds_adagrad_update_bf16(self._id, lr_c, _f32(params),
+                                             _bf16(grads), _f32(sum_sq),
+                                             _bf16(params_out_bf16), n)
+
+
+class DeepSpeedCPULion(_HostOptimizer):
+    """Reference ops/lion/cpu_lion.py (create_lion/lion_update)."""
+
+    def __init__(self, lr: float = 1e-4, betas: Tuple[float, float] = (0.9, 0.99),
+                 weight_decay: float = 0.0):
+        super().__init__()
+        if DeepSpeedCPULion._lib is None:
+            DeepSpeedCPULion._lib = CPULionBuilder().load()
+            lib = DeepSpeedCPULion._lib
+            lib.ds_lion_create.restype = c_int
+            lib.ds_lion_create.argtypes = [c_float] * 4
+            lib.ds_lion_update.argtypes = [
+                c_int, c_float, _f32p, _f32p, _f32p, c_int64]
+            lib.ds_lion_update_bf16.argtypes = [
+                c_int, c_float, _f32p, _u16p, _f32p, _u16p, c_int64]
+        self.lr, self.betas, self.weight_decay = lr, betas, weight_decay
+        self._id = self._lib.ds_lion_create(lr, betas[0], betas[1], weight_decay)
+
+    def destroy(self):
+        self._destroy("ds_lion_destroy")
+
+    def state_keys(self):
+        return ("exp_avg",)
+
+    def step(self, step: int, params: np.ndarray, grads: np.ndarray,
+             exp_avg: np.ndarray, lr: Optional[float] = None,
+             params_out_bf16: Optional[np.ndarray] = None):
+        n = params.size
+        lr_c = -1.0 if lr is None else float(lr)
+        if grads.dtype == np.float32:
+            self._lib.ds_lion_update(self._id, lr_c, _f32(params),
+                                     _f32(grads), _f32(exp_avg), n)
+            if params_out_bf16 is not None:
+                import ml_dtypes
+                np.copyto(params_out_bf16, params.astype(ml_dtypes.bfloat16))
+        else:
+            assert params_out_bf16 is not None
+            self._lib.ds_lion_update_bf16(self._id, lr_c, _f32(params),
+                                          _bf16(grads), _f32(exp_avg),
+                                          _bf16(params_out_bf16), n)
+
+
+HOST_OPTIMIZERS = {
+    "adam": lambda **kw: DeepSpeedCPUAdam(**{"adamw_mode": False, **kw}),
+    "adamw": lambda **kw: DeepSpeedCPUAdam(**{"adamw_mode": True, **kw}),
+    "fusedadam": DeepSpeedCPUAdam,
+    "adagrad": DeepSpeedCPUAdagrad,
+    "lion": DeepSpeedCPULion,
+    "fusedlion": DeepSpeedCPULion,
+}
+
+
+def build_host_optimizer(name: str, params):
+    key = name.lower().replace("_", "")
+    if key not in HOST_OPTIMIZERS:
+        raise ValueError(
+            f"optimizer '{name}' has no host (offload) implementation; "
+            f"available: {sorted(HOST_OPTIMIZERS)}")
+    kw = dict(params)
+    if "betas" in kw:
+        kw["betas"] = tuple(kw["betas"])
+    kw.pop("torch_adam", None)
+    # keep adam_w_mode semantics aligned with the device registry
+    # (ops/optimizers.py): explicit adam_w_mode wins, else the name decides
+    if "adam_w_mode" in kw:
+        kw["adamw_mode"] = bool(kw.pop("adam_w_mode"))
+    return HOST_OPTIMIZERS[key](**kw)

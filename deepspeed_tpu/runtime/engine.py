@@ -112,13 +112,23 @@ class DeepSpeedTpuEngine:
         self.scale_cfg: Optional[LossScaleConfig] = (
             from_fp16_config(self.config.fp16) if self.fp16_enabled else None)
 
+        # --- ZeRO-Offload / Infinity (reference zero/offload_config.py):
+        # optimizer state lives on host (cpu) or NVMe; update runs in the
+        # native C++ kernel, the device only produces gradients.
+        off_cfg = self.config.zero_optimization.offload_optimizer
+        self.offload_device = off_cfg.device if off_cfg.device != "none" else None
+        self.host_opt = None
+
         if hasattr(self.model, "set_topology"):
             self.model.set_topology(self.topology)
 
         # --- state init under sharding constraints (zero.Init equivalent:
         # params materialize directly into their shards, partition_parameters.py:723)
         self._init_state(seed)
-        self._build_train_step()
+        if self.offload_device:
+            self._build_offload_step()
+        else:
+            self._build_train_step()
 
         # --- observability
         from ..utils.timer import ThroughputTimer
@@ -158,6 +168,16 @@ class DeepSpeedTpuEngine:
         master_sh = self.zero_plan.master_sharding
         param_sh = self.zero_plan.param_sharding
 
+        if self.offload_device:
+            self._init_offload_state(rng, param_sh)
+            self.param_count = int(sum(np.prod(l.shape)
+                                       for l in jax.tree.leaves(shapes)))
+            self._step_arr = jnp.asarray(0, jnp.int32)
+            self._model_rng = jax.random.PRNGKey(seed + 1)
+            self.scale_state = (init_scale_state(self.scale_cfg)
+                                if self.fp16_enabled else None)
+            return
+
         # materialize master fp32 directly sharded (no host round-trip)
         init_master = jax.jit(self.model.init_params, out_shardings=master_sh)
         self.master_params = init_master(rng)
@@ -179,6 +199,37 @@ class DeepSpeedTpuEngine:
         self.param_count = int(sum(np.prod(l.shape) for l in jax.tree.leaves(shapes)))
         self._step_arr = jnp.asarray(0, jnp.int32)
         self._model_rng = jax.random.PRNGKey(seed + 1)
+
+    def _init_offload_state(self, rng, param_sh):
+        """ZeRO-Offload init: fp32 master + moments as host numpy, device
+        gets only the bf16/fp16 compute params (reference
+        stage_1_and_2.py cpu_offload; Infinity via nvme device)."""
+        from .zero.offload import HostOffloadOptimizer, _leaf_names
+
+        opt_cfg = self.config.optimizer
+        cpu0 = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(cpu0):
+            master = self.model.init_params(rng)
+        master_np = jax.tree.map(lambda x: np.asarray(x, np.float32), master)
+        leaves, self._param_treedef = jax.tree_util.tree_flatten(master_np)
+        off = self.config.zero_optimization.offload_optimizer
+        aio = self.config.aio
+        self.host_opt = HostOffloadOptimizer(
+            opt_cfg.type, opt_cfg.params, leaves, _leaf_names(master_np),
+            device=self.offload_device, nvme_path=off.nvme_path,
+            aio_block_size=aio.block_size, aio_threads=aio.thread_count,
+            compute_dtype=np.dtype(self.compute_dtype))
+        del master, master_np, leaves
+        self._push_host_params(self.host_opt.current_bf16_leaves())
+        self.master_params = None
+        self.opt_state = None
+
+    def _push_host_params(self, param_leaves):
+        """Host compute-dtype leaves -> sharded device params."""
+        params_tree = jax.tree_util.tree_unflatten(
+            self._param_treedef, [np.asarray(l) for l in param_leaves])
+        self.params = jax.tree.map(jax.device_put, params_tree,
+                                   self.zero_plan.param_sharding)
 
     # ------------------------------------------------------------------
     # Compiled train step
@@ -296,14 +347,8 @@ class DeepSpeedTpuEngine:
                 metrics["loss_scale"] = scale
             return new_params, new_master, new_opt, new_scale_state, new_step, rng, metrics
 
-        batch_sh = self.topology.batch_sharding()
-
-        def batch_spec(x):
-            # [gas, global_micro, ...]: shard dim 1 over data axes
-            spec = (None,) + tuple(batch_sh.spec)
-            return NamedSharding(self.mesh, P(*spec))
-
-        self._batch_sharding_fn = batch_spec
+        # [gas, global_micro, ...]: shard dim 1 over data axes
+        self._batch_sharding_fn = self._default_batch_sharding_fn()
         repl = self.topology.replicated()
         master_sh = plan.master_sharding
         opt_sh = self._opt_shardings
@@ -338,6 +383,108 @@ class DeepSpeedTpuEngine:
             return jnp.mean(losses)
 
         self._eval_step = jax.jit(eval_step, in_shardings=(param_sh, repl, None))
+
+    def _build_offload_step(self):
+        """Grad-only device program for ZeRO-Offload: the optimizer runs on
+        host (native C++), so the compiled step stops at averaged+clipped
+        gradients. Gradients are shipped to host in the compute dtype (bf16
+        halves PCIe traffic; the reference ships fp16 grads to cpu_adam the
+        same way)."""
+        plan = self.zero_plan
+        gas = self.gas
+        clip = self.config.gradient_clipping
+        fp16 = self.fp16_enabled
+        scale_cfg = self.scale_cfg
+        grad_sh = plan.grad_sharding
+        param_sh = plan.param_sharding
+        transfer_dtype = (jnp.bfloat16 if self.compute_dtype == jnp.bfloat16
+                          else jnp.float32)
+
+        assert self.topology.axis_size("pipe") == 1, \
+            "offload_optimizer + pipeline parallelism not supported"
+
+        def constrain(tree, sh):
+            return jax.tree.map(lambda x, s: jax.lax.with_sharding_constraint(x, s),
+                                tree, sh)
+
+        def grad_step(params, scale_state, rng, batch):
+            scale = scale_state["loss_scale"] if fp16 else jnp.asarray(1.0, jnp.float32)
+
+            def micro_fn(carry, micro):
+                grads_acc, rng = carry
+                rng, sub = jax.random.split(rng)
+                (_, (loss, _aux)), grads = jax.value_and_grad(
+                    self._loss_fn, has_aux=True)(params, micro, sub, scale)
+                grads = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                                     grads_acc, grads)
+                grads = constrain(grads, grad_sh)
+                return (grads, rng), loss
+
+            grads0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            grads0 = constrain(grads0, grad_sh)
+            (grads, rng), losses = jax.lax.scan(micro_fn, (grads0, rng), batch)
+            loss = jnp.mean(losses)
+            grads = jax.tree.map(lambda g: g / (gas * scale), grads)
+
+            finite = grads_finite(grads) if fp16 else jnp.asarray(True)
+            gnorm = global_norm(grads)
+            if clip and clip > 0:
+                factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * factor, grads)
+            grads = jax.tree.map(lambda g: g.astype(transfer_dtype), grads)
+            new_scale_state = (update_scale(scale_state, finite, scale_cfg)
+                               if fp16 else scale_state)
+            metrics = {"loss": loss, "grad_norm": gnorm,
+                       "skipped": (~finite).astype(jnp.int32)}
+            if fp16:
+                metrics["loss_scale"] = scale
+            return grads, new_scale_state, rng, metrics
+
+        repl = self.topology.replicated()
+        scale_sh = (jax.tree.map(lambda _: repl, self.scale_state)
+                    if self.scale_state is not None else None)
+        self._grad_step = jax.jit(
+            grad_step,
+            in_shardings=(param_sh, scale_sh, repl, None),
+            out_shardings=(grad_sh, scale_sh, repl, None))
+
+        def eval_step(params, rng, batch):
+            def micro_fn(rng, micro):
+                rng, sub = jax.random.split(rng)
+                out = self.model.apply(params, micro, train=False, rng=sub)
+                loss, _ = _split_loss_aux(out)
+                return rng, loss.astype(jnp.float32)
+
+            rng, losses = jax.lax.scan(micro_fn, rng, batch)
+            return jnp.mean(losses)
+
+        self._eval_step = jax.jit(eval_step, in_shardings=(param_sh, repl, None))
+        self._batch_sharding_fn = self._default_batch_sharding_fn()
+
+    def _default_batch_sharding_fn(self):
+        batch_sh = self.topology.batch_sharding()
+
+        def batch_spec(x):
+            spec = (None,) + tuple(batch_sh.spec)
+            return NamedSharding(self.mesh, P(*spec))
+
+        return batch_spec
+
+    def _train_batch_offloaded(self, dev_batch):
+        grads, self.scale_state, self._model_rng, metrics = self._grad_step(
+            self.params, self.scale_state, self._model_rng, dev_batch)
+        skipped = int(metrics["skipped"])
+        if not skipped:
+            step_no = int(self._step_arr) + 1
+            lr = float(self._lr_fn(jnp.asarray(step_no - 1, jnp.int32)))
+            grad_leaves = [np.asarray(g) for g in jax.tree.leaves(grads)]
+            out = self.host_opt.step(grad_leaves, step_no, lr)
+            self._push_host_params(out)
+            self._step_arr = jnp.asarray(step_no, jnp.int32)
+            metrics["lr"] = lr
+        else:
+            metrics["lr"] = float(self._lr_fn(self._step_arr))
+        return metrics
 
     # ------------------------------------------------------------------
     # Data plumbing
@@ -378,10 +525,13 @@ class DeepSpeedTpuEngine:
             batch = jax.tree.map(lambda *xs: np.stack(xs), *micro_batches)
         dev_batch = self._shard_batch(batch)
         self.tput_timer.start()
-        (self.params, self.master_params, self.opt_state, self.scale_state,
-         self._step_arr, self._model_rng, metrics) = self._train_step(
-            self.params, self.master_params, self.opt_state, self.scale_state,
-            self._step_arr, self._model_rng, dev_batch)
+        if self.offload_device:
+            metrics = self._train_batch_offloaded(dev_batch)
+        else:
+            (self.params, self.master_params, self.opt_state, self.scale_state,
+             self._step_arr, self._model_rng, metrics) = self._train_step(
+                self.params, self.master_params, self.opt_state, self.scale_state,
+                self._step_arr, self._model_rng, dev_batch)
         self.global_steps += 1
         self.lr_scheduler.step()
         loss = float(metrics["loss"])
@@ -524,10 +674,17 @@ class DeepSpeedTpuEngine:
                         save_latest=True):
         from ..checkpoint.state_checkpoint import save_state
         tag = tag or f"global_step{self.global_steps}"
+        if self.offload_device:
+            unflat = partial(jax.tree_util.tree_unflatten, self._param_treedef)
+            master_leaves, state_leaves = self.host_opt.get_all_leaves()
+            master_tree = unflat(master_leaves)
+            opt_tree = {k: unflat(v) for k, v in state_leaves.items()}
+        else:
+            master_tree, opt_tree = self.master_params, self.opt_state
         state = {
             "params": self.params,
-            "master_params": self.master_params,
-            "opt_state": self.opt_state,
+            "master_params": master_tree,
+            "opt_state": opt_tree,
             "scale_state": self.scale_state,
             "step": self._step_arr,
         }
@@ -549,26 +706,44 @@ class DeepSpeedTpuEngine:
         tag = tag or read_latest(load_dir)
         if tag is None:
             return None, {}
+        if self.offload_device:
+            unflat = partial(jax.tree_util.tree_unflatten, self._param_treedef)
+            master_tpl_leaves, opt_tpl_leaves = self.host_opt.template_leaves()
+            master_tpl = unflat(master_tpl_leaves)
+            opt_tpl = {k: unflat(v) for k, v in opt_tpl_leaves.items()}
+        else:
+            master_tpl, opt_tpl = self.master_params, self.opt_state
         shardings = {
             "params": self.zero_plan.param_sharding,
             "master_params": self.zero_plan.master_sharding if self.has_master else None,
-            "opt_state": jax.tree.map(lambda _: None, self.opt_state) if self.opt_state else None,
+            "opt_state": jax.tree.map(lambda _: None, opt_tpl) if opt_tpl else None,
             "scale_state": None,
             "step": None,
         }
         template = {
             "params": self.params,
-            "master_params": self.master_params,
-            "opt_state": self.opt_state,
+            "master_params": master_tpl,
+            "opt_state": opt_tpl,
             "scale_state": self.scale_state,
             "step": self._step_arr,
         }
         state, meta = load_state(load_dir, tag, template, shardings, self.mesh,
                                  self.zero_plan)
         self.params = state["params"]
-        self.master_params = state["master_params"]
-        if load_optimizer_states:
-            self.opt_state = state["opt_state"]
+        if self.offload_device:
+            master_leaves = [np.asarray(l, np.float32)
+                             for l in jax.tree.leaves(state["master_params"])]
+            opt_leaves = None
+            if load_optimizer_states:
+                opt_leaves = {k: [np.asarray(l, np.float32)
+                                  for l in jax.tree.leaves(v)]
+                              for k, v in state["opt_state"].items()}
+            self.host_opt.load_leaves(master_leaves, opt_leaves)
+            self._push_host_params(self.host_opt.current_bf16_leaves())
+        else:
+            self.master_params = state["master_params"]
+            if load_optimizer_states:
+                self.opt_state = state["opt_state"]
         self.scale_state = state["scale_state"]
         self._step_arr = state["step"]
         self.global_steps = meta["global_steps"]
@@ -579,6 +754,12 @@ class DeepSpeedTpuEngine:
         return load_dir, meta.get("client_state", {})
 
     # ------------------------------------------------------------------
+    def destroy(self):
+        """Release host-side resources (reference engine.py destroy)."""
+        if self.host_opt is not None:
+            self.host_opt.close()
+            self.host_opt = None
+
     def train(self, mode: bool = True):
         return self
 

@@ -1,0 +1,49 @@
+"""Async IO handle tests (reference tests/unit/ops/aio/test_aio.py:
+parallel/single read+write roundtrips against temp files)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+
+def test_sync_roundtrip(tmp_path):
+    data = np.random.default_rng(0).standard_normal(1 << 16).astype(np.float32)
+    path = tmp_path / "x.bin"
+    with AsyncIOHandle(block_size=4096, num_threads=4) as h:
+        assert h.sync_pwrite(path, data) == data.nbytes
+        out = np.empty_like(data)
+        assert h.sync_pread(path, out) == data.nbytes
+    np.testing.assert_array_equal(out, data)
+
+
+def test_async_many_requests(tmp_path):
+    rng = np.random.default_rng(1)
+    bufs = [rng.standard_normal(1000 + 17 * i).astype(np.float32)
+            for i in range(16)]
+    with AsyncIOHandle(block_size=1024, num_threads=4) as h:
+        ids = [h.pwrite(tmp_path / f"f{i}.bin", b) for i, b in enumerate(bufs)]
+        for i, b in zip(ids, bufs):
+            assert h.wait(i) == b.nbytes
+        outs = [np.empty_like(b) for b in bufs]
+        ids = [h.pread(tmp_path / f"f{i}.bin", o) for i, o in enumerate(outs)]
+        h.wait_all()
+    for b, o in zip(bufs, outs):
+        np.testing.assert_array_equal(o, b)
+
+
+def test_offset_read(tmp_path):
+    data = np.arange(1024, dtype=np.float32)
+    path = tmp_path / "off.bin"
+    with AsyncIOHandle() as h:
+        h.sync_pwrite(path, data)
+        tail = np.empty(24, np.float32)
+        h.sync_pread(path, tail, file_offset=1000 * 4)
+    np.testing.assert_array_equal(tail, data[1000:])
+
+
+def test_read_missing_file_raises(tmp_path):
+    with AsyncIOHandle() as h:
+        buf = np.empty(16, np.float32)
+        with pytest.raises(OSError):
+            h.wait(h.pread(tmp_path / "nope.bin", buf))
