@@ -24,12 +24,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # Canonical axis names (single source of truth).
 PIPE_AXIS = "pipe"
-DATA_AXIS = "data"
+DATA_AXIS = "data"       # data-parallel replica groups (MiCS: across-group axis)
+SHARD_AXIS = "shard"     # MiCS shard group (within-group ZeRO axis); size 1 unless MiCS
 EXPERT_AXIS = "expert"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 
-AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, SHARD_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 
 @dataclass(frozen=True)
@@ -38,6 +39,9 @@ class TopologyConfig:
     model: int = 1  # tensor parallel
     seq: int = 1  # Ulysses sequence parallel
     expert: int = 1  # expert parallel (factors the data-parallel dimension)
+    # MiCS (reference runtime/zero/mics.py:55): ZeRO states shard over a
+    # sub-group of this size and replicate across groups; <=1 disables.
+    mics_shard: int = 1
 
 
 class MeshTopology:
@@ -62,10 +66,19 @@ class MeshTopology:
             raise ValueError(
                 f"{n} devices not divisible by pipe*model*seq*expert={mp}")
         data = n // mp
+        shard = 1
+        if topo.mics_shard and topo.mics_shard > 1:
+            if data % topo.mics_shard != 0:
+                raise ValueError(
+                    f"mics_shard_size={topo.mics_shard} does not divide the "
+                    f"data-parallel world of {data}")
+            shard = topo.mics_shard
+            data //= shard
         self.topo = topo
         self.sizes: Dict[str, int] = {
             PIPE_AXIS: topo.pipe,
             DATA_AXIS: data,
+            SHARD_AXIS: shard,
             EXPERT_AXIS: topo.expert,
             SEQ_AXIS: topo.seq,
             MODEL_AXIS: topo.model,
@@ -83,18 +96,37 @@ class MeshTopology:
         return self.sizes[axis]
 
     @property
+    def mics_enabled(self) -> bool:
+        return self.sizes[SHARD_AXIS] > 1
+
+    @property
     def dp_axes(self) -> Tuple[str, ...]:
-        """Axes a dense parameter's ZeRO shard spans (DP world = data*expert)."""
-        return (DATA_AXIS, EXPERT_AXIS) if self.sizes[EXPERT_AXIS] > 1 else (DATA_AXIS,)
+        """Axes a dense parameter's ZeRO shard spans.
+
+        Plain ZeRO: the full DP world. MiCS: only the `shard` sub-axis —
+        states replicate across the `data` (replica-group) axis, so XLA emits
+        reduce-scatter within the group + all-reduce across groups, the MiCS
+        comm pattern (reference runtime/zero/mics.py hierarchical collectives).
+        """
+        if self.mics_enabled:
+            return (SHARD_AXIS,)
+        axes = (DATA_AXIS, SHARD_AXIS)
+        if self.sizes[EXPERT_AXIS] > 1:
+            axes = axes + (EXPERT_AXIS,)
+        return axes
 
     @property
     def dp_world_size(self) -> int:
-        return self.sizes[DATA_AXIS] * self.sizes[EXPERT_AXIS]
+        return (self.sizes[DATA_AXIS] * self.sizes[SHARD_AXIS]
+                * self.sizes[EXPERT_AXIS])
 
     @property
     def batch_axes(self) -> Tuple[str, ...]:
         """Axes the global batch is sharded over (data-like axes)."""
-        return (DATA_AXIS, EXPERT_AXIS) if self.sizes[EXPERT_AXIS] > 1 else (DATA_AXIS,)
+        axes = (DATA_AXIS, SHARD_AXIS)
+        if self.sizes[EXPERT_AXIS] > 1:
+            axes = axes + (EXPERT_AXIS,)
+        return axes
 
     def sharding(self, *spec) -> NamedSharding:
         return NamedSharding(self.mesh, P(*spec))
@@ -123,6 +155,7 @@ def build_topology(config=None, devices=None, *, pipe=None, model=None, seq=None
             model=model or c.tensor_parallel_size,
             seq=seq or c.sequence_parallel_size,
             expert=expert or (c.moe.expert_parallel_size if c.moe.enabled else 1),
+            mics_shard=max(c.zero_optimization.mics_shard_size, 1),
         )
     else:
         topo = TopologyConfig(pipe=pipe or 1, model=model or 1, seq=seq or 1,

@@ -103,8 +103,18 @@ class DeepSpeedTpuEngine:
         if opt_cfg is None:
             from .config import OptimizerConfig
             opt_cfg = OptimizerConfig(type="adamw", params={"lr": 1e-3})
-        self.optimizer: TpuOptimizer = build_optimizer(opt_cfg.type, opt_cfg.params)
-        base_lr = opt_cfg.params.get("lr", getattr(self.optimizer, "lr", 1e-3))
+        self.config.optimizer = opt_cfg
+        # 1-bit optimizers own their communication (reference engine skips
+        # allreduce for them, engine.py optimizer-name check)
+        self.onebit_mode = (opt_cfg.type.lower().replace("_", "")
+                            .replace("-", "") in ("onebitadam", "1bitadam"))
+        if self.onebit_mode:
+            self.optimizer = None
+            base_lr = opt_cfg.params.get("lr", 1e-3)
+        else:
+            self.optimizer: TpuOptimizer = build_optimizer(opt_cfg.type,
+                                                           opt_cfg.params)
+            base_lr = opt_cfg.params.get("lr", getattr(self.optimizer, "lr", 1e-3))
         self._lr_fn = build_lr_schedule(self.config.scheduler, base_lr)
         self.lr_scheduler = lr_scheduler or LRScheduler(self._lr_fn)
 
@@ -119,6 +129,11 @@ class DeepSpeedTpuEngine:
         self.offload_device = off_cfg.device if off_cfg.device != "none" else None
         self.host_opt = None
 
+        # --- activation checkpointing config (reference engine.py:902
+        # _configure_checkpointing -> checkpointing.configure)
+        from .activation_checkpointing import checkpointing as ds_ckpt
+        ds_ckpt.configure(deepspeed_config=self.config)
+
         if hasattr(self.model, "set_topology"):
             self.model.set_topology(self.topology)
 
@@ -127,6 +142,11 @@ class DeepSpeedTpuEngine:
         self._init_state(seed)
         if self.offload_device:
             self._build_offload_step()
+        elif self.onebit_mode:
+            from .fp16.onebit import build_onebit_train_step
+            self._train_step, self.opt_state = build_onebit_train_step(self)
+            self._batch_sharding_fn = self._default_batch_sharding_fn()
+            self._build_eval_step()
         else:
             self._build_train_step()
 
@@ -188,12 +208,15 @@ class DeepSpeedTpuEngine:
         if not self.has_master:
             self.master_params = None
 
-        opt_target = self.master_params if self.has_master else self.params
-        # optimizer state mirrors master sharding per moment-subtree
-        state_shapes = jax.eval_shape(self.optimizer.init_state, opt_target)
-        self._opt_shardings = {k: self.zero_plan.master_sharding for k in state_shapes}
-        init_opt = jax.jit(self.optimizer.init_state, out_shardings=self._opt_shardings)
-        self.opt_state = init_opt(opt_target)
+        if self.onebit_mode:
+            self.opt_state = None  # created by build_onebit_train_step
+        else:
+            opt_target = self.master_params if self.has_master else self.params
+            # optimizer state mirrors master sharding per moment-subtree
+            state_shapes = jax.eval_shape(self.optimizer.init_state, opt_target)
+            self._opt_shardings = {k: self.zero_plan.master_sharding for k in state_shapes}
+            init_opt = jax.jit(self.optimizer.init_state, out_shardings=self._opt_shardings)
+            self.opt_state = init_opt(opt_target)
 
         self.scale_state = init_scale_state(self.scale_cfg) if self.fp16_enabled else None
         self.param_count = int(sum(np.prod(l.shape) for l in jax.tree.leaves(shapes)))
@@ -257,6 +280,20 @@ class DeepSpeedTpuEngine:
             return jax.tree.map(lambda x, s: jax.lax.with_sharding_constraint(x, s),
                                 tree, sh)
 
+        # --- ZeRO++ (reference zero/config.py:256-272): quantized weight
+        # gather (qwZ) / quantized gradient reduce (qgZ) run as an explicit
+        # shard_map program instead of compiler-inserted collectives.
+        zc = self.config.zero_optimization
+        zpp_w = zc.zero_quantized_weights and self.zero_stage == 3
+        zpp_g = zc.zero_quantized_gradients and self.zero_stage >= 2
+        use_zeropp = zpp_w or zpp_g
+        if use_zeropp:
+            for ax in ("model", "seq", "expert", "pipe"):
+                assert self.topology.axis_size(ax) == 1, \
+                    f"ZeRO++ quantized collectives require pure data " \
+                    f"parallelism (got {ax} size {self.topology.axis_size(ax)})"
+            zeropp_grad_fn = self._make_zeropp_grad_fn(zpp_w, zpp_g)
+
         pipeline_mode = self.topology.axis_size("pipe") > 1
         if pipeline_mode:
             # PP composes with DP/ZeRO-1 only (same restriction as the
@@ -289,6 +326,11 @@ class DeepSpeedTpuEngine:
                 grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
                 grads = constrain(grads, grad_sh)
                 inv = 1.0 / scale
+            elif use_zeropp:
+                rng, sub = jax.random.split(rng)
+                grads, loss = zeropp_grad_fn(params, sub, batch, scale)
+                grads = constrain(grads, grad_sh)
+                inv = 1.0 / (gas * scale)
             else:
                 def micro_fn(carry, micro):
                     grads_acc, rng = carry
@@ -384,6 +426,98 @@ class DeepSpeedTpuEngine:
 
         self._eval_step = jax.jit(eval_step, in_shardings=(param_sh, repl, None))
 
+    def _make_zeropp_grad_fn(self, zpp_w: bool, zpp_g: bool):
+        """Build the shard_map gradient program for ZeRO++.
+
+        Stage 3: parameters enter device-local (sharded); each microbatch
+        gathers them with qwZ int8 transport, and autodiff's VJP of the
+        gather IS the (quantized) reduce-scatter of the gradients — see
+        comm/quantized.py make_zero3_gather. Stage 1/2: params are
+        replicated; gradients are int8 all-to-all reduced at the gas
+        boundary (qgZ). Returns (params, rng, batch, scale) -> (grads, loss)
+        with grads already summed over microbatches and meaned over the DP
+        world (divide by gas only, like the SPMD path).
+        """
+        from ..comm.quantized import (all_to_all_quant_reduce,
+                                      make_zero3_gather, reduce_scatter_leaf,
+                                      shard_map_unchecked)
+
+        mesh = self.mesh
+        axes = self.topology.dp_axes
+        axis_sizes = self.topology.sizes
+        plan = self.zero_plan
+        stage3 = self.zero_stage == 3
+        model = self.model
+
+        param_specs = jax.tree.map(lambda ns: ns.spec, plan.param_sharding)
+        grad_specs = jax.tree.map(lambda ns: ns.spec, plan.grad_sharding)
+
+        def dim_of(spec):
+            # -1 sentinel (None collapses pytree structure)
+            for i, e in enumerate(spec):
+                entries = e if isinstance(e, tuple) else (e,)
+                if any(a in axes for a in entries if a is not None):
+                    return i
+            return -1
+
+        param_dims = jax.tree.map(dim_of, param_specs)
+        grad_dims = jax.tree.map(dim_of, grad_specs)
+        identity = lambda x: x  # noqa: E731
+        gather_fns = jax.tree.map(
+            lambda d: (make_zero3_gather(d, axes, fwd_quantized=zpp_w,
+                                         bwd_quantized=zpp_g)
+                       if stage3 and d >= 0 else identity),
+            param_dims)
+
+        def linear_index():
+            idx = jnp.asarray(0, jnp.int32)
+            for a in axes:
+                idx = idx * axis_sizes[a] + jax.lax.axis_index(a)
+            return idx
+
+        def body(params_l, rng, batch_l, scale):
+            def apply_model(pshards, micro, sub):
+                pf = (jax.tree.map(lambda f, p: f(p), gather_fns, pshards)
+                      if stage3 else pshards)
+                out = model.apply(pf, micro, train=True, rng=sub)
+                loss, _aux = _split_loss_aux(out)
+                loss = loss.astype(jnp.float32)
+                return loss * scale, loss
+
+            def micro_fn(carry, micro):
+                grads_acc, rng = carry
+                rng, sub = jax.random.split(rng)
+                sub = jax.random.fold_in(sub, linear_index())
+                (_, loss), g = jax.value_and_grad(
+                    apply_model, has_aux=True)(params_l, micro, sub)
+                grads_acc = jax.tree.map(
+                    lambda a, x: a + x.astype(jnp.float32), grads_acc, g)
+                return (grads_acc, rng), loss
+
+            grads0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                                  params_l)
+            (grads, rng), losses = jax.lax.scan(micro_fn, (grads0, rng),
+                                                batch_l)
+
+            def finalize(g, gd, pd):
+                if gd < 0:  # grad stays replicated: plain mean-allreduce
+                    return jax.lax.pmean(g, axes)
+                if stage3 and pd >= 0:  # already reduced by the gather's VJP
+                    return g
+                if zpp_g:
+                    return all_to_all_quant_reduce(g, gd, axes, mean=True)
+                return reduce_scatter_leaf(g, gd, axes, mean=True)
+
+            grads = jax.tree.map(finalize, grads, grad_dims, param_dims)
+            loss = jax.lax.pmean(jnp.mean(losses), axes)
+            return grads, loss
+
+        bt = self.topology.batch_axes
+        return shard_map_unchecked(
+            body, mesh=mesh,
+            in_specs=(param_specs, P(), P(None, bt), P()),
+            out_specs=(grad_specs, P()))
+
     def _build_offload_step(self):
         """Grad-only device program for ZeRO-Offload: the optimizer runs on
         host (native C++), so the compiled step stops at averaged+clipped
@@ -460,6 +594,22 @@ class DeepSpeedTpuEngine:
 
         self._eval_step = jax.jit(eval_step, in_shardings=(param_sh, repl, None))
         self._batch_sharding_fn = self._default_batch_sharding_fn()
+
+    def _build_eval_step(self):
+        param_sh = self.zero_plan.param_sharding
+        repl = self.topology.replicated()
+
+        def eval_step(params, rng, batch):
+            def micro_fn(rng, micro):
+                rng, sub = jax.random.split(rng)
+                out = self.model.apply(params, micro, train=False, rng=sub)
+                loss, _ = _split_loss_aux(out)
+                return rng, loss.astype(jnp.float32)
+
+            rng, losses = jax.lax.scan(micro_fn, rng, batch)
+            return jnp.mean(losses)
+
+        self._eval_step = jax.jit(eval_step, in_shardings=(param_sh, repl, None))
 
     def _default_batch_sharding_fn(self):
         batch_sh = self.topology.batch_sharding()
@@ -752,6 +902,53 @@ class DeepSpeedTpuEngine:
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         log_dist(f"loaded checkpoint {load_dir}/{tag}", ranks=[0])
         return load_dir, meta.get("client_state", {})
+
+    def _zero3_consolidated_16bit_state_dict(self):
+        """Full (unsharded) compute-dtype weights as {path: ndarray}
+        (reference engine.py:3395). Works for every stage — sharded arrays
+        are gathered on fetch."""
+        from ..checkpoint.state_checkpoint import _fetch, _leaf_paths
+        leaves, _ = _leaf_paths(self.params)
+        return {key: np.asarray(_fetch(leaf)) for key, leaf in leaves}
+
+    def save_16bit_model(self, save_dir, save_filename="pytorch_model.npz"):
+        """Consolidated inference-ready weights (reference engine.py:3464
+        save_16bit_model)."""
+        os.makedirs(save_dir, exist_ok=True)
+        state = self._zero3_consolidated_16bit_state_dict()
+        path = os.path.join(save_dir, save_filename)
+        if jax.process_index() == 0:
+            np.savez(path, **state)
+        log_dist(f"saved 16-bit model -> {path}", ranks=[0])
+        return path
+
+    def load_universal_checkpoint(self, universal_dir):
+        """Load weights from a universal-checkpoint directory (reference
+        engine flag load_universal_checkpoint, engine.py:794): fragments are
+        matched by tree path and re-sharded onto the current topology."""
+        from ..checkpoint.universal import load_universal_into_tree
+        shapes = jax.eval_shape(self.model.init_params, jax.random.PRNGKey(0))
+        host_tree = load_universal_into_tree(universal_dir, shapes)
+        if self.offload_device:
+            leaves = [np.asarray(l, np.float32)
+                      for l in jax.tree.leaves(host_tree)]
+            self.host_opt.load_leaves(leaves, None)
+            self._push_host_params(self.host_opt.current_bf16_leaves())
+            return
+        if self.has_master:
+            self.master_params = jax.tree.map(
+                lambda a, s: jax.device_put(np.asarray(a, np.float32), s.sharding),
+                host_tree, self.master_params)
+            cast = jax.jit(lambda p: jax.tree.map(
+                lambda x: x.astype(self.compute_dtype), p),
+                out_shardings=self.zero_plan.param_sharding)
+            self.params = cast(self.master_params)
+        else:
+            self.params = jax.tree.map(
+                lambda a, s: jax.device_put(
+                    np.asarray(a).astype(self.compute_dtype), s.sharding),
+                host_tree, self.params)
+        log_dist(f"loaded universal checkpoint from {universal_dir}", ranks=[0])
 
     # ------------------------------------------------------------------
     def destroy(self):

@@ -1,0 +1,132 @@
+"""ZeRO++ (qwZ/qgZ) and MiCS tests (reference
+tests/unit/runtime/zero/test_zeropp.py + mics coverage in test_zero.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import deepspeed_tpu
+from tests.unit.simple_model import SimpleModel, base_config, random_batches
+
+HIDDEN = 32
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    from deepspeed_tpu.comm.quantized import shard_map_unchecked
+    return shard_map_unchecked(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs)
+
+
+@pytest.fixture
+def mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+
+
+def test_quantized_all_gather_close_to_exact(mesh):
+    from deepspeed_tpu.comm.quantized import quantized_all_gather
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 16), jnp.float32)
+
+    out = _shard_map(
+        lambda s: quantized_all_gather(s, 0, ("data",), block=64),
+        mesh, in_specs=P("data"), out_specs=P())(x)
+    # int8 blockwise quantization: ~1% relative error budget
+    err = np.abs(np.asarray(out) - np.asarray(x)).max()
+    scale = np.abs(np.asarray(x)).max()
+    assert err <= scale * (2.0 / 127.0), f"quantization error too large: {err}"
+
+
+def test_all_to_all_quant_reduce_close_to_reduce_scatter(mesh):
+    from deepspeed_tpu.comm.quantized import (all_to_all_quant_reduce,
+                                              reduce_scatter_leaf)
+
+    # per-device distinct gradients, global shape [8, 64, 16] (dim 0 = device)
+    g = jax.random.normal(jax.random.PRNGKey(1), (8, 64, 16), jnp.float32)
+
+    exact = _shard_map(
+        lambda x: reduce_scatter_leaf(x[0], 0, ("data",), mean=True),
+        mesh, in_specs=P("data"), out_specs=P("data"))(g)
+    quant = _shard_map(
+        lambda x: all_to_all_quant_reduce(x[0], 0, ("data",), block=64,
+                                          mean=True),
+        mesh, in_specs=P("data"), out_specs=P("data"))(g)
+    np.testing.assert_allclose(np.asarray(quant), np.asarray(exact),
+                               atol=np.abs(np.asarray(exact)).max() * 0.05)
+
+
+def test_zero3_gather_vjp_is_reduce_scatter(mesh):
+    from deepspeed_tpu.comm.quantized import make_zero3_gather
+
+    x = jax.random.normal(jax.random.PRNGKey(2), (64, 16), jnp.float32)
+    gather = make_zero3_gather(0, ("data",), fwd_quantized=False,
+                               bwd_quantized=False)
+
+    def local_loss(shard, tgt):
+        full = gather(shard)
+        return jnp.sum((full - tgt) ** 2)  # same on every device
+
+    tgt = jnp.ones((64, 16), jnp.float32)
+    grads = _shard_map(
+        lambda s, t: jax.grad(local_loss)(s, t),
+        mesh, in_specs=(P("data"), P()), out_specs=P("data"))(x, tgt)
+    # d/dx sum((x-1)^2) = 2(x-1); VJP means over 8 identical device losses
+    np.testing.assert_allclose(np.asarray(grads), 2 * (np.asarray(x) - 1),
+                               rtol=1e-5)
+
+
+def _train(cfg, steps=5, seed=3):
+    model = SimpleModel(hidden_dim=HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    micro = engine.micro_batch_size * engine.ds_config.dp_world_size
+    losses = []
+    for b in random_batches(steps, micro * engine.gas, HIDDEN, seed=seed):
+        batch = {k: v.reshape(engine.gas, micro, HIDDEN) for k, v in b.items()}
+        losses.append(engine.train_batch(batch=batch))
+    return engine, losses
+
+
+def test_qgz_stage2_matches_baseline():
+    _, base = _train(base_config(micro=2, stage=2, dtype="bf16", lr=1e-2))
+    cfg = base_config(micro=2, stage=2, dtype="bf16", lr=1e-2)
+    cfg["zero_optimization"]["zero_quantized_gradients"] = True
+    _, qgz = _train(cfg)
+    # int8 gradient transport: small drift allowed, training must track
+    np.testing.assert_allclose(qgz, base, rtol=0.05, atol=2e-2)
+
+
+def test_qwz_qgz_stage3_matches_baseline():
+    _, base = _train(base_config(
+        micro=2, stage=3, dtype="bf16", lr=1e-2,
+        zero_optimization={"stage": 3, "stage3_param_persistence_threshold": 0}))
+    cfg = base_config(micro=2, stage=3, dtype="bf16", lr=1e-2)
+    cfg["zero_optimization"].update({
+        "stage3_param_persistence_threshold": 0,
+        "zero_quantized_weights": True,
+        "zero_quantized_gradients": True})
+    engine, qpp = _train(cfg)
+    assert engine.zero_stage == 3
+    np.testing.assert_allclose(qpp, base, rtol=0.08, atol=5e-2)
+
+
+def test_mics_shard_group_matches_full_zero():
+    _, base = _train(base_config(micro=2, stage=3, dtype="bf16", lr=1e-2))
+    cfg = base_config(micro=2, stage=3, dtype="bf16", lr=1e-2)
+    cfg["zero_optimization"]["mics_shard_size"] = 2
+    engine, mics = _train(cfg)
+    # mesh must split dp into 4 replica groups x 2-way shard groups
+    assert engine.topology.sizes["shard"] == 2
+    assert engine.topology.sizes["data"] == 4
+    assert engine.topology.mics_enabled
+    # same math, different collective decomposition
+    np.testing.assert_allclose(mics, base, rtol=1e-3, atol=1e-3)
+
+
+def test_mics_invalid_shard_size_raises():
+    cfg = base_config(micro=2, stage=3, dtype="bf16")
+    cfg["zero_optimization"]["mics_shard_size"] = 3  # does not divide 8
+    with pytest.raises(ValueError, match="mics"):
+        deepspeed_tpu.initialize(model=SimpleModel(hidden_dim=HIDDEN),
+                                 config=cfg)
