@@ -268,8 +268,8 @@ class TransformerLM:
 
         body = self._layer
         if cfg.remat:
-            body = jax.checkpoint(body,
-                                  policy=jax.checkpoint_policies.nothing_saveable)
+            from ..runtime.activation_checkpointing import checkpointing as ds_ckpt
+            body = ds_ckpt.checkpoint_wrapper(body)
 
         def scan_fn(h, lp):
             h, aux = body(h, lp, cos, sin)
@@ -315,9 +315,9 @@ class TransformerLM:
 
             layer_body = self._layer
             if cfg.remat:
-                layer_body = jax.checkpoint(
-                    self._layer,
-                    policy=jax.checkpoint_policies.nothing_saveable)
+                from ..runtime.activation_checkpointing import (
+                    checkpointing as ds_ckpt)
+                layer_body = ds_ckpt.checkpoint_wrapper(self._layer)
 
             def stage_fn(h):
                 def scan_fn(carry, lp):

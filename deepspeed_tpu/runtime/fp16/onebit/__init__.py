@@ -1,0 +1,1 @@
+from .adam import OnebitAdam, build_onebit_train_step  # noqa: F401
