@@ -1,0 +1,138 @@
+"""Autotuner: ZeRO-stage / micro-batch search.
+
+TPU-native analogue of the reference autotuner (autotuning/autotuner.py:42
+Autotuner, tune :404). The reference launches separate experiment processes
+through the cluster launcher and parses their logs; on TPU a single process
+owns the chips, so experiments run in-process: build an engine for each
+candidate (stage, micro_batch), time a few steps, stop early on OOM, and
+report the best tokens/sec (model-based pruning like the reference's
+fast-mode uses memory estimates from runtime/zero/partition.py).
+
+Usage:
+    tuner = Autotuner(model_factory, base_config, batch_factory)
+    best = tuner.tune(stages=(0, 1, 2, 3), micro_batches=(1, 2, 4, 8))
+    engine = best.build()   # engine configured with the winning settings
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..runtime.zero.partition import estimate_zero_memory
+from ..utils.logging import logger
+
+
+@dataclass
+class ExperimentResult:
+    stage: int
+    micro_batch: int
+    ok: bool
+    error: Optional[str] = None
+    steps_per_sec: float = 0.0
+    samples_per_sec: float = 0.0
+
+    @property
+    def key(self):
+        return {"zero_stage": self.stage, "micro_batch": self.micro_batch}
+
+
+@dataclass
+class TuneOutcome:
+    best: Optional[ExperimentResult]
+    results: List[ExperimentResult] = field(default_factory=list)
+    _builder: Optional[Callable[[], Any]] = None
+
+    def build(self):
+        if self._builder is None:
+            raise RuntimeError("no successful experiment to build from")
+        return self._builder()
+
+
+class Autotuner:
+    def __init__(self, model_factory: Callable[[], Any],
+                 base_config: Dict[str, Any],
+                 batch_factory: Callable[[Any], Any],
+                 warmup_steps: int = 1, measure_steps: int = 3,
+                 device_memory_bytes: Optional[float] = None):
+        """model_factory() -> model; batch_factory(engine) -> one train batch.
+        device_memory_bytes enables fast-mode pruning of configs whose model
+        state alone cannot fit (reference mem-model pruning)."""
+        self.model_factory = model_factory
+        self.base_config = dict(base_config)
+        self.batch_factory = batch_factory
+        self.warmup_steps = warmup_steps
+        self.measure_steps = measure_steps
+        self.device_memory_bytes = device_memory_bytes
+
+    def _config_for(self, stage: int, micro: int) -> Dict[str, Any]:
+        cfg = dict(self.base_config)
+        cfg["train_micro_batch_size_per_gpu"] = micro
+        zo = dict(cfg.get("zero_optimization", {}))
+        zo["stage"] = stage
+        cfg["zero_optimization"] = zo
+        cfg.pop("train_batch_size", None)
+        return cfg
+
+    def _prune(self, stage: int, param_count: int, dp: int) -> bool:
+        if self.device_memory_bytes is None:
+            return False
+        est = estimate_zero_memory(param_count, stage, dp)
+        return est["total_bytes"] > self.device_memory_bytes
+
+    def _run_experiment(self, stage: int, micro: int) -> ExperimentResult:
+        import deepspeed_tpu
+
+        try:
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=self.model_factory(),
+                config=self._config_for(stage, micro))
+            if self._prune(stage, engine.param_count,
+                           engine.ds_config.dp_world_size):
+                return ExperimentResult(stage, micro, ok=False,
+                                        error="pruned: model state exceeds memory")
+            batch = self.batch_factory(engine)
+            for _ in range(self.warmup_steps):
+                engine.train_batch(batch=batch)
+            t0 = time.perf_counter()
+            for _ in range(self.measure_steps):
+                engine.train_batch(batch=batch)
+            dt = (time.perf_counter() - t0) / self.measure_steps
+            return ExperimentResult(
+                stage, micro, ok=True, steps_per_sec=1.0 / dt,
+                samples_per_sec=engine.train_batch_size / dt)
+        except Exception as e:  # OOM / invalid combination
+            return ExperimentResult(stage, micro, ok=False,
+                                    error=f"{type(e).__name__}: {e}")
+
+    def tune(self, stages: Sequence[int] = (0, 1, 2, 3),
+             micro_batches: Sequence[int] = (1, 2, 4, 8)) -> TuneOutcome:
+        """Grid search with early stop per stage once a larger micro batch
+        fails (reference tune() micro-batch ascent)."""
+        results: List[ExperimentResult] = []
+        for stage in stages:
+            for micro in sorted(micro_batches):
+                res = self._run_experiment(stage, micro)
+                results.append(res)
+                status = (f"{res.samples_per_sec:.1f} samples/s" if res.ok
+                          else f"FAILED ({res.error})")
+                logger.info(f"autotune stage={stage} micro={micro}: {status}")
+                if not res.ok and "pruned" not in (res.error or ""):
+                    break  # larger micro batches will also fail
+        ok = [r for r in results if r.ok]
+        best = max(ok, key=lambda r: r.samples_per_sec) if ok else None
+        outcome = TuneOutcome(best=best, results=results)
+        if best is not None:
+            cfg = self._config_for(best.stage, best.micro_batch)
+
+            def builder():
+                import deepspeed_tpu
+
+                engine, _, _, _ = deepspeed_tpu.initialize(
+                    model=self.model_factory(), config=cfg)
+                return engine
+
+            outcome._builder = builder
+            logger.info(f"autotune best: stage={best.stage} "
+                        f"micro={best.micro_batch} "
+                        f"({best.samples_per_sec:.1f} samples/s)")
+        return outcome

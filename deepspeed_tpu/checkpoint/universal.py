@@ -1,0 +1,129 @@
+"""Universal checkpoint tooling.
+
+Reference counterpart: ``deepspeed/checkpoint/ds_to_universal.py:254`` (offline
+(tp,pp,dp)-sharded -> atomic per-param fragments) plus
+``universal_checkpoint.py:12 load_hp_checkpoint_state`` (runtime load under a
+new topology).
+
+Our native layout IS the universal format — state_checkpoint.py writes one
+fp32 fragment per tensor, so any mesh/zero-stage/dp-size can load any
+checkpoint directly (the engine re-shards on device_put). What this module
+adds:
+
+  * ``ds_to_universal(in_dir, out_dir)``: normalize any supported external
+    layout into the fragment format — currently native checkpoints
+    (re-written with fp32 upcast) and flat .npz/.npy state dicts (e.g. a
+    consolidated file from utils/zero_to_fp32.py or a converted torch dump).
+  * ``load_universal_into_tree(dir, template)``: read fragments into a pytree
+    by name, for tools that want the weights without an engine.
+"""
+
+import json
+import os
+import shutil
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .state_checkpoint import SENTINEL_NONE, read_latest
+
+UNIVERSAL_SUBDIR = "zero_universal"
+
+
+def _native_ckpt_dir(path: str, tag: Optional[str] = None) -> Optional[str]:
+    if os.path.exists(os.path.join(path, "manifest.json")):
+        return path
+    tag = tag or read_latest(path)
+    if tag and os.path.exists(os.path.join(path, tag, "manifest.json")):
+        return os.path.join(path, tag)
+    return None
+
+
+def ds_to_universal(input_dir: str, output_dir: str,
+                    tag: Optional[str] = None) -> str:
+    """Offline conversion (reference ds_to_universal.py main): produce a
+    directory of atomic per-param fp32 fragments + manifest."""
+    os.makedirs(output_dir, exist_ok=True)
+    native = _native_ckpt_dir(input_dir, tag)
+    if native is not None:
+        return _from_native(native, output_dir)
+    if input_dir.endswith(".npz") or os.path.isfile(input_dir):
+        return _from_flat_archive(input_dir, output_dir)
+    raise ValueError(f"unrecognized checkpoint layout at {input_dir}")
+
+
+def _from_native(ckpt_dir: str, output_dir: str) -> str:
+    with open(os.path.join(ckpt_dir, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    entry = manifest["tensors"].get("master_params")
+    if entry in (None, SENTINEL_NONE):
+        entry = manifest["tensors"]["params"]
+    out_entry: Dict[str, Any] = {}
+    for key, info in entry.items():
+        arr = np.load(os.path.join(ckpt_dir, info["file"])).astype(np.float32)
+        fname = f"param__{key.replace('/', '__')}.npy"
+        np.save(os.path.join(output_dir, fname), arr)
+        out_entry[key] = {"file": fname, "shape": list(arr.shape),
+                          "dtype": "float32"}
+    _write_universal_manifest(output_dir, out_entry,
+                              source=os.path.abspath(ckpt_dir))
+    return output_dir
+
+
+def _from_flat_archive(path: str, output_dir: str) -> str:
+    data = np.load(path)
+    keys = data.files if hasattr(data, "files") else None
+    if keys is None:
+        raise ValueError(f"{path} is not a .npz archive")
+    out_entry: Dict[str, Any] = {}
+    for key in keys:
+        arr = np.asarray(data[key]).astype(np.float32)
+        fname = f"param__{key.replace('/', '__')}.npy"
+        np.save(os.path.join(output_dir, fname), arr)
+        out_entry[key] = {"file": fname, "shape": list(arr.shape),
+                          "dtype": "float32"}
+    _write_universal_manifest(output_dir, out_entry,
+                              source=os.path.abspath(path))
+    return output_dir
+
+
+def _write_universal_manifest(output_dir, entry, source):
+    with open(os.path.join(output_dir, "universal_manifest.json"), "w") as fh:
+        json.dump({"format": "deepspeed_tpu_universal/1", "source": source,
+                   "params": entry}, fh, indent=2)
+
+
+def load_universal_params(universal_dir: str) -> Dict[str, np.ndarray]:
+    with open(os.path.join(universal_dir, "universal_manifest.json")) as fh:
+        manifest = json.load(fh)
+    return {k: np.load(os.path.join(universal_dir, v["file"]))
+            for k, v in manifest["params"].items()}
+
+
+def load_universal_into_tree(universal_dir: str, template):
+    """Fill `template` (pytree) with fragments matched by tree path."""
+    import jax
+
+    flat = load_universal_params(universal_dir)
+    paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths_and_leaves:
+        key = jax.tree_util.keystr(path).strip("[]'\"").replace("']['", "/") \
+            .replace("'].", "/").replace("['", "").replace("']", "") \
+            .replace(".", "/").replace("[", "/").replace("]", "")
+        if key not in flat:
+            raise KeyError(f"universal checkpoint missing {key}; has "
+                           f"{sorted(flat)[:8]}...")
+        arr = flat[key]
+        if hasattr(leaf, "dtype"):
+            arr = arr.astype(leaf.dtype)
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def copy_aux_files(input_dir: str, output_dir: str):
+    """Carry over non-tensor files (latest tag, client state)."""
+    for name in ("latest",):
+        src = os.path.join(input_dir, name)
+        if os.path.exists(src):
+            shutil.copy(src, os.path.join(output_dir, name))

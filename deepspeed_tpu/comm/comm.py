@@ -58,12 +58,20 @@ def init_distributed(dist_backend: str = "xla",
     global _INITIALIZED
     if _INITIALIZED:
         return
-    coord = os.environ.get("COORDINATOR_ADDRESS") or (
-        f"{os.environ['MASTER_ADDR']}:{os.environ.get('MASTER_PORT', distributed_port)}"
-        if "MASTER_ADDR" in os.environ and "RANK" in os.environ else None)
+    # env protocols, in precedence order: our launcher (DS_TPU_*), jax-native
+    # (COORDINATOR_ADDRESS), torch-style (MASTER_ADDR/RANK — the reference's)
+    coord = (os.environ.get("DS_TPU_COORDINATOR")
+             or os.environ.get("COORDINATOR_ADDRESS")
+             or (f"{os.environ['MASTER_ADDR']}:"
+                 f"{os.environ.get('MASTER_PORT', distributed_port)}"
+                 if "MASTER_ADDR" in os.environ and "RANK" in os.environ
+                 else None))
     if coord is not None:
-        nproc = world_size if world_size > 0 else int(os.environ.get("WORLD_SIZE", 1))
-        pid = rank if rank >= 0 else int(os.environ.get("RANK", 0))
+        nproc = world_size if world_size > 0 else int(
+            os.environ.get("DS_TPU_NUM_PROCESSES",
+                           os.environ.get("WORLD_SIZE", 1)))
+        pid = rank if rank >= 0 else int(
+            os.environ.get("DS_TPU_PROCESS_ID", os.environ.get("RANK", 0)))
         if nproc > 1:
             jax.distributed.initialize(coordinator_address=coord,
                                        num_processes=nproc, process_id=pid)

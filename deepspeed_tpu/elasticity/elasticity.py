@@ -1,0 +1,129 @@
+"""Elastic training configuration math.
+
+TPU-native port of the reference's elasticity subsystem
+(elasticity/elasticity.py:233 compute_elastic_config + the v0.1/v0.2 schema,
+elasticity/config.py): pre-compute a set of global batch sizes compatible
+with every admissible accelerator count so that a run can be
+stopped/restarted on a different slice size with IDENTICAL optimization
+behavior (`train_batch_size` constant).
+
+Same algorithm as the reference: candidate batch sizes are
+micro_batch x (highly composite multipliers) capped by max_train_batch_size;
+the chosen batch is the largest candidate with the most admissible chip
+counts; the (micro_batch, gas) for the current world size follows.
+"""
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from ..utils.logging import logger
+
+
+class ElasticityError(Exception):
+    pass
+
+
+@dataclass
+class ElasticityConfigData:
+    """Schema of the 'elasticity' config block (reference elasticity/config.py)."""
+
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10_000
+    min_time: int = 0
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    version: float = 0.2
+    model_parallel_size: int = 1
+    num_gpus_per_node: int = 1
+
+
+def _candidate_multipliers(max_acceptable: int) -> List[int]:
+    """Highly-composite multipliers (reference get_candidate_batch_sizes
+    uses powers of 2 x {1, 3, 5, 7} style sets)."""
+    base = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 12, 14, 15, 16, 18, 20, 21, 24,
+            28, 30, 32, 36, 40, 42, 48, 56, 60, 64, 72, 80, 84, 96, 112, 120,
+            128, 144, 160, 168, 192, 224, 240, 256]
+    return [m for m in base if m <= max_acceptable]
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int], min_gpus: int,
+                   max_gpus: int) -> List[int]:
+    """All chip counts that divide batch_size with some micro batch
+    (reference elasticity.py get_valid_gpus)."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb:
+            continue
+        max_chips = batch_size // mb
+        for chips in range(1, max_chips + 1):
+            if max_chips % chips == 0 and min_gpus <= chips <= max_gpus:
+                valid.add(chips)
+    return sorted(valid)
+
+
+def get_best_candidate_batch_size(max_batch: int, micro_batches: List[int],
+                                  min_gpus: int, max_gpus: int,
+                                  prefer_larger: bool = True
+                                  ) -> Tuple[int, List[int]]:
+    """Candidate with the most valid chip counts (ties: batch size order
+    by `prefer_larger`) — reference elasticity.py:150 _get_compatible_gpus_v01
+    candidate search."""
+    candidates = set()
+    for base in micro_batches:
+        for mult in _candidate_multipliers(max_batch // max(1, base)):
+            if base * mult <= max_batch:
+                candidates.add(base * mult)
+    best: Tuple[int, List[int]] = (0, [])
+    for candidate in sorted(candidates):
+        valid = get_valid_gpus(candidate, micro_batches, min_gpus, max_gpus)
+        better = len(valid) > len(best[1]) or (
+            len(valid) == len(best[1]) and (
+                candidate > best[0] if prefer_larger else candidate < best[0]))
+        if better:
+            best = (candidate, valid)
+    if not best[1]:
+        raise ElasticityError(
+            f"no batch size <= {max_batch} admits any chip count in "
+            f"[{min_gpus}, {max_gpus}] with micro batches {micro_batches}")
+    return best
+
+
+def compute_elastic_config(ds_config: dict, world_size: int = 0,
+                           return_microbatch: bool = False):
+    """Reference compute_elastic_config (elasticity/elasticity.py:233).
+
+    Returns (final_batch_size, valid_chip_counts[, micro_batch]) and — when
+    `world_size` is given — validates that world_size is admissible and
+    computes the per-chip micro batch.
+    """
+    block = ds_config.get("elasticity", None)
+    if block is None or not block.get("enabled", False):
+        raise ElasticityError("'elasticity' block missing or disabled")
+    cfg = ElasticityConfigData(**{k: v for k, v in block.items()
+                                  if k in ElasticityConfigData.__dataclass_fields__})
+    mp = max(cfg.model_parallel_size, 1)
+    final_batch, valid = get_best_candidate_batch_size(
+        cfg.max_train_batch_size, cfg.micro_batch_sizes, cfg.min_gpus,
+        cfg.max_gpus, cfg.prefer_larger_batch)
+    if world_size:
+        dp = world_size // mp
+        if dp not in valid:
+            raise ElasticityError(
+                f"world size {world_size} (dp={dp}) is not in the elastic "
+                f"schedule {valid} for batch {final_batch}")
+        micro = final_batch // dp
+        # snap to the largest configured micro batch that divides
+        chosen = max((mb for mb in cfg.micro_batch_sizes if micro % mb == 0),
+                     default=micro)
+        gas = micro // chosen
+        logger.info(f"elasticity: batch={final_batch} dp={dp} "
+                    f"micro={chosen} gas={gas}")
+        if return_microbatch:
+            return final_batch, valid, chosen
+        return final_batch, valid
+    if return_microbatch:
+        return final_batch, valid, None
+    return final_batch, valid

@@ -1,0 +1,78 @@
+"""Environment & op-compatibility report (reference deepspeed/env_report.py +
+bin/ds_report): prints versions, device inventory, and which op builders are
+compatible/buildable on this machine. CLI: ``python -m deepspeed_tpu.env_report``."""
+
+import importlib
+import sys
+
+GREEN_OK = "[OKAY]"
+RED_NO = "[NO]"
+
+
+def _try_version(mod):
+    try:
+        m = importlib.import_module(mod)
+        return getattr(m, "__version__", "unknown")
+    except ImportError:
+        return None
+
+
+def op_report(verbose: bool = False):
+    """Rows of (op_name, kind, compatible) for every registered builder
+    (reference env_report.py op_report)."""
+    rows = []
+    from .ops.op_builder.tpu import ALL_OPS as TPU_OPS
+    from .ops.op_builder.cpu import ALL_OPS as CPU_OPS
+
+    for name, builder_cls in sorted(TPU_OPS.items()):
+        rows.append((name, "pallas/xla", builder_cls().builder_available()))
+    for name, builder_cls in sorted(CPU_OPS.items()):
+        rows.append((name, "host C++", builder_cls().builder_available()))
+    return rows
+
+
+def software_report():
+    rows = [("python", sys.version.split()[0])]
+    for mod in ("jax", "jaxlib", "flax", "optax", "numpy", "ml_dtypes"):
+        v = _try_version(mod)
+        rows.append((mod, v or "not installed"))
+    from . import __version__ as ds_version
+    rows.append(("deepspeed_tpu", ds_version))
+    return rows
+
+
+def hardware_report():
+    rows = []
+    try:
+        import jax
+
+        rows.append(("backend", jax.default_backend()))
+        devs = jax.devices()
+        rows.append(("device count", str(len(devs))))
+        rows.append(("device kind", getattr(devs[0], "device_kind", "?")))
+        rows.append(("process count", str(jax.process_count())))
+    except Exception as e:  # report must never crash
+        rows.append(("jax devices", f"error: {e}"))
+    return rows
+
+
+def main(hide_operator_status=False, hide_errors_and_warnings=False):
+    print("-" * 60)
+    print("deepspeed_tpu environment report (ds_report)")
+    print("-" * 60)
+    print("software:")
+    for k, v in software_report():
+        print(f"  {k:>16}: {v}")
+    print("hardware:")
+    for k, v in hardware_report():
+        print(f"  {k:>16}: {v}")
+    if not hide_operator_status:
+        print("op compatibility:")
+        for name, kind, ok in op_report():
+            print(f"  {name:>20} [{kind:>9}] {GREEN_OK if ok else RED_NO}")
+    print("-" * 60)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

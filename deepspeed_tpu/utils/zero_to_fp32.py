@@ -1,0 +1,75 @@
+"""Reconstruct consolidated fp32 weights from a training checkpoint.
+
+Equivalent of the reference's ``deepspeed/utils/zero_to_fp32.py`` (587 LoC
+offline script). The reference must stitch fp32 fragments out of per-rank
+ZeRO shard files; our native checkpoint layout (checkpoint/state_checkpoint.py)
+already stores atomic per-tensor fp32 fragments, so consolidation is reading
+the manifest — any (dp, tp, pp) topology wrote the same files.
+
+Usable as a module (`get_fp32_state_dict_from_zero_checkpoint`) or CLI:
+
+    python -m deepspeed_tpu.utils.zero_to_fp32 <checkpoint_dir> <output.npz>
+"""
+
+import argparse
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..checkpoint.state_checkpoint import SENTINEL_NONE, read_latest
+
+
+def _resolve_ckpt_dir(checkpoint_dir: str, tag: Optional[str] = None) -> str:
+    if os.path.exists(os.path.join(checkpoint_dir, "manifest.json")):
+        return checkpoint_dir
+    tag = tag or read_latest(checkpoint_dir)
+    if tag is None:
+        raise FileNotFoundError(
+            f"no 'latest' file or manifest under {checkpoint_dir}")
+    return os.path.join(checkpoint_dir, tag)
+
+
+def get_fp32_state_dict_from_zero_checkpoint(
+        checkpoint_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Reference zero_to_fp32.get_fp32_state_dict_from_zero_checkpoint:
+    returns {param_name: fp32 ndarray} for the full unsharded model."""
+    ckpt_dir = _resolve_ckpt_dir(checkpoint_dir, tag)
+    with open(os.path.join(ckpt_dir, "manifest.json")) as fh:
+        manifest = json.load(fh)
+    entry = manifest["tensors"].get("master_params")
+    if entry in (None, SENTINEL_NONE):
+        entry = manifest["tensors"]["params"]
+    if entry in (None, SENTINEL_NONE):
+        raise ValueError(f"checkpoint at {ckpt_dir} holds no parameters")
+    out = {}
+    for key, info in entry.items():
+        arr = np.load(os.path.join(ckpt_dir, info["file"]))
+        out[key] = arr.astype(np.float32)
+    return out
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(
+        checkpoint_dir: str, output_file: str, tag: Optional[str] = None):
+    """Reference convert_zero_checkpoint_to_fp32_state_dict: writes one
+    consolidated file (.npz archive keyed by parameter path)."""
+    state = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    np.savez(output_file, **state)
+    total = sum(v.size for v in state.values())
+    print(f"saved {len(state)} tensors / {total:,} params -> {output_file}")
+    return output_file
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("--tag", default=None)
+    args = p.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir,
+                                               args.output_file, tag=args.tag)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,47 @@
+"""Autotuner tests (reference tests/unit/autotuning/test_autotuning.py:
+experiment generation + result selection; ours runs in-process)."""
+
+import numpy as np
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner
+from tests.unit.simple_model import SimpleModel, random_batches
+
+HIDDEN = 32
+
+
+def _batch_factory(engine):
+    micro = engine.micro_batch_size * engine.ds_config.dp_world_size
+    b = random_batches(1, micro * engine.gas, HIDDEN, seed=0)[0]
+    return {k: v.reshape(engine.gas, micro, HIDDEN) for k, v in b.items()}
+
+
+def _tuner(**kw):
+    return Autotuner(
+        model_factory=lambda: SimpleModel(hidden_dim=HIDDEN),
+        base_config={
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True},
+            "steps_per_print": 10**9,
+        },
+        batch_factory=_batch_factory,
+        warmup_steps=1, measure_steps=1, **kw)
+
+
+def test_tune_finds_best_and_builds():
+    outcome = _tuner().tune(stages=(0, 2), micro_batches=(1, 2))
+    assert outcome.best is not None
+    ok = [r for r in outcome.results if r.ok]
+    assert len(ok) >= 2
+    assert outcome.best.samples_per_sec == max(r.samples_per_sec for r in ok)
+    engine = outcome.build()
+    assert engine.zero_stage == outcome.best.stage
+    assert engine.micro_batch_size == outcome.best.micro_batch
+    loss = engine.train_batch(batch=_batch_factory(engine))
+    assert np.isfinite(loss)
+
+
+def test_memory_pruning():
+    tuner = _tuner(device_memory_bytes=10.0)  # absurdly small -> all pruned
+    outcome = tuner.tune(stages=(0,), micro_batches=(1,))
+    assert outcome.best is None
+    assert all("pruned" in (r.error or "") for r in outcome.results)

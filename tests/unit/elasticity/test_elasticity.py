@@ -1,0 +1,57 @@
+"""Elasticity tests (reference tests/unit/elasticity/test_elastic.py)."""
+
+import pytest
+
+from deepspeed_tpu.elasticity.elasticity import (ElasticityError,
+                                                 compute_elastic_config,
+                                                 get_best_candidate_batch_size,
+                                                 get_valid_gpus)
+
+BASE = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+    }
+}
+
+
+def test_valid_gpus_divisibility():
+    valid = get_valid_gpus(batch_size=24, micro_batches=[8, 12],
+                           min_gpus=1, max_gpus=100)
+    # 24/8=3 -> {1,3}; 24/12=2 -> {1,2}
+    assert valid == [1, 2, 3]
+
+
+def test_best_candidate_maximizes_flexibility():
+    batch, valid = get_best_candidate_batch_size(
+        max_batch=10000, micro_batches=[8, 12, 16, 17], min_gpus=32,
+        max_gpus=1500, prefer_larger=True)
+    assert batch <= 10000
+    assert valid
+    assert all(32 <= g <= 1500 for g in valid)
+
+
+def test_compute_elastic_config_with_world_size():
+    # any world size from the published schedule must resolve to a valid
+    # (micro, gas) pair with train_batch preserved
+    final_batch, valid = compute_elastic_config(BASE)
+    ws = valid[len(valid) // 2]
+    final_batch2, valid2, micro = compute_elastic_config(
+        BASE, world_size=ws, return_microbatch=True)
+    assert final_batch2 == final_batch
+    assert final_batch % ws == 0
+    assert (final_batch // ws) % micro == 0
+
+
+def test_incompatible_world_size_raises():
+    cfg = {"elasticity": dict(BASE["elasticity"], min_gpus=32, max_gpus=64)}
+    with pytest.raises(ElasticityError):
+        compute_elastic_config(cfg, world_size=63)  # odd, not in schedule
+
+
+def test_disabled_block_raises():
+    with pytest.raises(ElasticityError, match="missing or disabled"):
+        compute_elastic_config({"elasticity": {"enabled": False}})

@@ -1,0 +1,78 @@
+"""Launcher tests (reference tests/unit/launcher/test_run.py: hostfile
+parsing, resource filters, multinode command construction — no real ssh)."""
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (OpenMPIRunner, PDSHRunner,
+                                           SlurmRunner, build_node_command,
+                                           parse_args, parse_hostfile,
+                                           parse_inclusion_exclusion)
+
+
+@pytest.fixture
+def hostfile(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text("""
+# comment
+worker-0 slots=4
+worker-1 slots=4
+worker-2 slots=8
+""")
+    return str(p)
+
+
+def test_parse_hostfile(hostfile):
+    hosts = parse_hostfile(hostfile)
+    assert list(hosts) == ["worker-0", "worker-1", "worker-2"]
+    assert hosts["worker-2"] == 8
+
+
+def test_parse_hostfile_duplicate(tmp_path):
+    p = tmp_path / "hf"
+    p.write_text("a slots=1\na slots=2\n")
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_hostfile(str(p))
+
+
+def test_include_exclude(hostfile):
+    hosts = parse_hostfile(hostfile)
+    inc = parse_inclusion_exclusion(hosts, include="worker-2@worker-0")
+    assert list(inc) == ["worker-2", "worker-0"]
+    exc = parse_inclusion_exclusion(hosts, exclude="worker-1")
+    assert list(exc) == ["worker-0", "worker-2"]
+    with pytest.raises(ValueError, match="unknown"):
+        parse_inclusion_exclusion(hosts, include="nope")
+    with pytest.raises(ValueError, match="removed every host"):
+        parse_inclusion_exclusion(hosts, exclude="worker-0@worker-1@worker-2")
+
+
+def test_build_node_command_env():
+    cmd = build_node_command("train.py", ["--lr", "0.1"], process_id=2,
+                             num_processes=4, coordinator="w0:29500")
+    assert "DS_TPU_COORDINATOR=w0:29500" in cmd
+    assert "DS_TPU_NUM_PROCESSES=4" in cmd
+    assert "DS_TPU_PROCESS_ID=2" in cmd
+    assert cmd.endswith("train.py --lr 0.1")
+
+
+@pytest.mark.parametrize("runner_cls,rank_var", [
+    (PDSHRunner, "$PID"),
+    (OpenMPIRunner, "$OMPI_COMM_WORLD_RANK"),
+    (SlurmRunner, "$SLURM_PROCID"),
+])
+def test_runner_cmd_construction(runner_cls, rank_var):
+    hosts = {"w0": 4, "w1": 4}
+    node_cmds = [build_node_command("t.py", [], pid, 2, "w0:29500")
+                 for pid in range(2)]
+    cmd = runner_cls(args=None).get_cmd(hosts, node_cmds)
+    joined = " ".join(cmd)
+    assert rank_var in joined
+    assert "t.py" in joined
+
+
+def test_parse_args_remainder():
+    args = parse_args(["--launcher", "slurm", "--num_nodes", "2",
+                       "train.py", "--deepspeed_config", "c.json"])
+    assert args.launcher == "slurm"
+    assert args.user_script == "train.py"
+    assert args.user_args == ["--deepspeed_config", "c.json"]
