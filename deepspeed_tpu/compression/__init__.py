@@ -1,0 +1,3 @@
+from .compress import CompressionSpec, init_compression  # noqa: F401
+from .basic_layers import (fake_quantize, head_pruning_mask,  # noqa: F401
+                           magnitude_prune_mask, row_pruning_mask)

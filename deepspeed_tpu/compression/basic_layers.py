@@ -1,0 +1,106 @@
+"""Compression primitives: QAT fake-quant and pruning masks.
+
+TPU-native equivalent of the reference's compression/basic_layer.py (840 LoC:
+QuantLinear/QuantAct/LinearSparse/... torch module subclasses). Our models
+are functional, so instead of swapping nn.Module classes, compression is a
+pure transform applied to the parameter pytree inside the loss function:
+
+    params' = spec.apply(params, step);  loss = model.apply(params', batch)
+
+Gradients flow through the straight-through estimator (fake_quantize has an
+identity VJP), which is exactly what the reference's QuantLinear backward
+does. Pruning = multiplicative binary masks recomputed on a schedule.
+"""
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# Quantization-aware training: fake quant with straight-through estimator
+# ---------------------------------------------------------------------------
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def fake_quantize(w, bits: int = 8, symmetric: bool = True,
+                  per_channel: bool = False):
+    """Quantize-dequantize w at `bits` (reference basic_layer.py QuantLinear
+    weight fake-quant; Symmetric/Asymmetric per quantization_type)."""
+    return _fake_quantize_impl(w, bits, symmetric, per_channel)
+
+
+def _fake_quantize_impl(w, bits, symmetric, per_channel):
+    axis = tuple(range(1, w.ndim)) if per_channel and w.ndim > 1 else None
+    if symmetric:
+        qmax = 2.0 ** (bits - 1) - 1
+        absmax = (jnp.max(jnp.abs(w)) if axis is None
+                  else jnp.max(jnp.abs(w), axis=axis, keepdims=True))
+        scale = jnp.where(absmax > 0, absmax / qmax, 1.0)
+        return jnp.clip(jnp.round(w / scale), -qmax, qmax) * scale
+    levels = 2.0 ** bits - 1
+    lo = jnp.min(w) if axis is None else jnp.min(w, axis=axis, keepdims=True)
+    hi = jnp.max(w) if axis is None else jnp.max(w, axis=axis, keepdims=True)
+    scale = jnp.where(hi > lo, (hi - lo) / levels, 1.0)
+    return jnp.clip(jnp.round((w - lo) / scale), 0, levels) * scale + lo
+
+
+def _fq_fwd(w, bits, symmetric, per_channel):
+    return _fake_quantize_impl(w, bits, symmetric, per_channel), None
+
+
+def _fq_bwd(bits, symmetric, per_channel, _res, g):
+    return (g,)  # straight-through
+
+
+fake_quantize.defvjp(_fq_fwd, _fq_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Pruning masks (reference LinearLayer_Compress sparse/row/head pruning)
+# ---------------------------------------------------------------------------
+def magnitude_prune_mask(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Unstructured magnitude pruning: keep the top `dense_ratio` fraction
+    by |w| (reference sparse_pruning, method 'l1')."""
+    k = max(1, int(round(w.size * dense_ratio)))
+    flat = jnp.abs(w).reshape(-1)
+    thresh = jax.lax.top_k(flat, k)[0][-1]
+    return (jnp.abs(w) >= thresh).astype(w.dtype)
+
+
+def row_pruning_mask(w: jnp.ndarray, dense_ratio: float,
+                     axis: int = 0) -> jnp.ndarray:
+    """Structured row pruning: keep rows with largest L2 norm (reference
+    row_pruning)."""
+    other = tuple(i for i in range(w.ndim) if i != axis)
+    norms = jnp.sqrt(jnp.sum(w * w, axis=other))
+    k = max(1, int(round(norms.shape[0] * dense_ratio)))
+    thresh = jax.lax.top_k(norms, k)[0][-1]
+    keep = (norms >= thresh).astype(w.dtype)
+    shape = [1] * w.ndim
+    shape[axis] = -1
+    return keep.reshape(shape) * jnp.ones_like(w)
+
+
+def head_pruning_mask(w: jnp.ndarray, dense_ratio: float, num_heads: int,
+                      head_axis: int = 0) -> jnp.ndarray:
+    """Structured attention-head pruning (reference head_pruning): score each
+    head by the L2 norm of its slice of the projection, keep the top ones."""
+    assert w.shape[head_axis] % num_heads == 0, \
+        f"dim {w.shape[head_axis]} not divisible by {num_heads} heads"
+    head_dim = w.shape[head_axis] // num_heads
+    moved = jnp.moveaxis(w, head_axis, 0).reshape(num_heads, head_dim, -1)
+    norms = jnp.sqrt(jnp.sum(moved * moved, axis=(1, 2)))
+    k = max(1, int(round(num_heads * dense_ratio)))
+    thresh = jax.lax.top_k(norms, k)[0][-1]
+    keep = (norms >= thresh).astype(w.dtype)          # [num_heads]
+    mask = jnp.repeat(keep, head_dim)                  # [heads*head_dim]
+    shape = [1] * w.ndim
+    shape[head_axis] = -1
+    return mask.reshape(shape) * jnp.ones_like(w)
+
+
+def activation_quantize(x: jnp.ndarray, bits: int = 8,
+                        symmetric: bool = False) -> jnp.ndarray:
+    """Activation fake-quant (reference QuantAct); dynamic range per tensor."""
+    return fake_quantize(x, bits, symmetric, False)

@@ -1,0 +1,145 @@
+"""Compression orchestration: config -> parameter transform + schedule.
+
+TPU-native analogue of the reference's compression/compress.py
+(init_compression :100, redundancy_clean) + compression/scheduler.py:173.
+The reference rewrites nn.Modules in place; here `init_compression` builds a
+CompressionSpec from the same JSON schema (weight_quantization /
+sparse_pruning / row_pruning / head_pruning blocks with shared_parameters +
+different_groups module patterns), and the engine applies it functionally:
+``params' = spec.apply(params, step)`` inside the loss — so quantization
+noise and pruning masks participate in training (QAT) with straight-through
+gradients.
+
+Module patterns match against the parameter tree path (fnmatch), playing the
+role of the reference's `modules: ["attention.self", ...]` lists.
+"""
+
+import fnmatch
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+from .basic_layers import (fake_quantize, head_pruning_mask,
+                           magnitude_prune_mask, row_pruning_mask)
+
+
+@dataclass
+class TechniqueGroup:
+    """One `different_groups` entry resolved against shared_parameters."""
+
+    technique: str                      # weight_quantization | sparse_pruning | ...
+    patterns: List[str]                 # tree-path globs ("*" = all)
+    start_step: int = 0
+    bits: int = 8                       # quantization
+    symmetric: bool = True
+    per_channel: bool = False
+    dense_ratio: float = 1.0            # pruning
+    num_heads: int = 0                  # head pruning
+
+    def matches(self, path: str) -> bool:
+        return any(fnmatch.fnmatch(path, p) or p == "*" for p in self.patterns)
+
+
+@dataclass
+class CompressionSpec:
+    groups: List[TechniqueGroup] = field(default_factory=list)
+
+    def enabled(self) -> bool:
+        return bool(self.groups)
+
+    def apply(self, params, step) -> Any:
+        """Transform the parameter tree; `step` may be a traced int32 (the
+        schedule gate is a jnp.where so it works inside jit)."""
+        if not self.groups:
+            return params
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        out = []
+        for path, leaf in flat:
+            key = jax.tree_util.keystr(path).strip("[]'\"") \
+                .replace("']['", ".").replace("['", "").replace("']", "")
+            out.append(self._apply_leaf(key, leaf, step))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _apply_leaf(self, key: str, w, step):
+        if not hasattr(w, "ndim") or w.ndim < 2:
+            return w  # biases/norms stay uncompressed (reference skips them)
+        out = w
+        for g in self.groups:
+            if not g.matches(key):
+                continue
+            if g.technique == "weight_quantization":
+                q = fake_quantize(out, g.bits, g.symmetric, g.per_channel)
+            elif g.technique == "sparse_pruning":
+                q = out * magnitude_prune_mask(out, g.dense_ratio)
+            elif g.technique == "row_pruning":
+                q = out * row_pruning_mask(out, g.dense_ratio)
+            elif g.technique == "head_pruning":
+                q = out * head_pruning_mask(out, g.dense_ratio, g.num_heads)
+            else:
+                continue
+            gate = jnp.asarray(step, jnp.int32) >= g.start_step
+            out = jnp.where(gate, q, out)
+        return out
+
+
+_TECHNIQUES = ("weight_quantization", "sparse_pruning", "row_pruning",
+               "head_pruning")
+
+
+def _parse_technique(name: str, block: Dict[str, Any]) -> List[TechniqueGroup]:
+    shared = block.get("shared_parameters", {})
+    if not shared.get("enabled", False):
+        return []
+    groups = []
+    diff = block.get("different_groups", {}) or {"default": {}}
+    for gname, gcfg in diff.items():
+        gparams = gcfg.get("params", {})
+        modules = gcfg.get("modules", ["*"])
+        groups.append(TechniqueGroup(
+            technique=name,
+            patterns=list(modules),
+            start_step=shared.get("schedule_offset", 0),
+            bits=gparams.get("start_bits",
+                             gparams.get("bits",
+                                         shared.get("quantize_weight_in_forward", 8)
+                                         if isinstance(shared.get(
+                                             "quantize_weight_in_forward"), int)
+                                         else 8)),
+            symmetric="symmetric" in str(
+                shared.get("quantization_type", "symmetric")),
+            per_channel=shared.get("quantize_groups", 1) != 1
+            or gparams.get("per_channel", False),
+            dense_ratio=gparams.get("dense_ratio",
+                                    shared.get("dense_ratio", 1.0)),
+            num_heads=gparams.get("num_heads", shared.get("num_heads", 0)),
+        ))
+    return groups
+
+
+def init_compression(model=None, deepspeed_config: Optional[Dict] = None,
+                     teacher_model=None, mpu=None) -> CompressionSpec:
+    """Reference init_compression(model, deepspeed_config) — returns the
+    CompressionSpec; the engine (or the caller's loss fn) applies it.
+    `model` is accepted for signature parity and, when it exposes
+    `set_compression_spec`, receives the spec."""
+    cfg = deepspeed_config or {}
+    block = cfg.get("compression_training", cfg)
+    spec = CompressionSpec()
+    for name in _TECHNIQUES:
+        if name in block:
+            spec.groups.extend(_parse_technique(name, block[name]))
+    if spec.enabled():
+        logger.info("compression enabled: " + ", ".join(
+            f"{g.technique}({','.join(g.patterns)})" for g in spec.groups))
+    if model is not None and hasattr(model, "set_compression_spec"):
+        model.set_compression_spec(spec)
+    return spec
+
+
+def redundancy_clean(params, spec: CompressionSpec, step: int = 10 ** 9):
+    """Reference redundancy_clean: bake the compression into the weights
+    (final masks/quant applied once, for export)."""
+    return jax.jit(lambda p: spec.apply(p, step))(params)

@@ -1,0 +1,5 @@
+from .curriculum_scheduler import CurriculumScheduler  # noqa: F401
+from .data_sampler import DeepSpeedDataSampler, truncate_seqlen  # noqa: F401
+from .indexed_dataset import (MMapIndexedDataset,  # noqa: F401
+                              MMapIndexedDatasetBuilder, make_dataset)
+from .random_ltd import RandomLTDScheduler, random_ltd_layer  # noqa: F401

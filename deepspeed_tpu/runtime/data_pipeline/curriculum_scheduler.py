@@ -1,0 +1,82 @@
+"""Curriculum learning difficulty scheduler.
+
+Reference: runtime/data_pipeline/data_sampling/curriculum_scheduler.py (also
+the legacy runtime/curriculum_scheduler.py) — maps global step -> current
+difficulty, with the same schedule types: fixed_linear, fixed_root,
+fixed_discrete, custom.
+"""
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+    """config keys (reference schema):
+      curriculum_type: fixed_linear | fixed_root | fixed_discrete | custom
+      min_difficulty, max_difficulty
+      schedule_type-specific block `schedule_config`:
+        fixed_linear:  {total_curriculum_step, difficulty_step}
+        fixed_root:    {total_curriculum_step, difficulty_step, root_degree}
+        fixed_discrete:{difficulty: [...], max_step: [...]}
+    """
+
+    def __init__(self, config: Dict[str, Any]):
+        self.state = dict(config)
+        self.curriculum_type = config.get("curriculum_type", FIXED_LINEAR)
+        self.min_difficulty = config["min_difficulty"]
+        self.max_difficulty = config["max_difficulty"]
+        self.schedule = config.get("schedule_config", {})
+        self.custom_fn: Optional[Callable[[int], int]] = None
+        self.current_difficulty = self.min_difficulty
+        if self.curriculum_type in (FIXED_LINEAR, FIXED_ROOT):
+            assert "total_curriculum_step" in self.schedule, \
+                "schedule_config.total_curriculum_step required"
+        if self.curriculum_type == FIXED_DISCRETE:
+            d, s = self.schedule["difficulty"], self.schedule["max_step"]
+            assert len(d) == len(s) + 1, \
+                "fixed_discrete: len(difficulty) must be len(max_step)+1"
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]):
+        self.custom_fn = fn
+
+    def _root_difficulty(self, step: int, degree: float) -> int:
+        total = self.schedule["total_curriculum_step"]
+        frac = min(1.0, step / total) ** (1.0 / degree)
+        diff = self.min_difficulty + frac * (self.max_difficulty
+                                             - self.min_difficulty)
+        unit = self.schedule.get("difficulty_step", 1)
+        diff = int(diff / unit) * unit
+        return min(max(diff, self.min_difficulty), self.max_difficulty)
+
+    def get_difficulty(self, global_step: int) -> int:
+        if self.curriculum_type == CUSTOM:
+            assert self.custom_fn is not None, \
+                "custom curriculum requires set_custom_get_difficulty"
+            return self.custom_fn(global_step)
+        if self.curriculum_type == FIXED_LINEAR:
+            return self._root_difficulty(global_step, 1.0)
+        if self.curriculum_type == FIXED_ROOT:
+            return self._root_difficulty(
+                global_step, self.schedule.get("root_degree", 2))
+        if self.curriculum_type == FIXED_DISCRETE:
+            for diff, max_step in zip(self.schedule["difficulty"],
+                                      self.schedule["max_step"]):
+                if global_step < max_step:
+                    return diff
+            return self.schedule["difficulty"][-1]
+        raise ValueError(f"unknown curriculum_type {self.curriculum_type}")
+
+    def update_difficulty(self, global_step: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_step)
+        return self.current_difficulty
+
+    def state_dict(self):
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, sd):
+        self.current_difficulty = sd["current_difficulty"]

@@ -1,0 +1,102 @@
+"""Curriculum-aware data sampling.
+
+Reference: runtime/data_pipeline/data_sampling/data_sampler.py:36
+DeepSpeedDataSampler — samples batches whose difficulty metric is within the
+current curriculum difficulty, from pre-computed per-sample metric values.
+Also the seqlen-truncation helpers used by the legacy curriculum
+(engine truncates the batch to the scheduled sequence length).
+"""
+
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+class DeepSpeedDataSampler:
+    """Difficulty-filtered batch index sampler.
+
+    metric_values: per-sample difficulty (e.g. sequence length or loss-based
+    score, the reference reads these from an offline analysis run). Each
+    call to set_step(step) advances the curriculum; iterating yields batches
+    drawn only from samples with metric <= current difficulty.
+    """
+
+    def __init__(self, curriculum_config: Dict, metric_values: Sequence[float],
+                 batch_size: int, drop_last: bool = True, seed: int = 0,
+                 replacement_when_short: bool = True):
+        self.scheduler = CurriculumScheduler(curriculum_config)
+        self.metric = np.asarray(metric_values)
+        self.order = np.argsort(self.metric, kind="stable")
+        self.sorted_metric = self.metric[self.order]
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.rng = np.random.default_rng(seed)
+        self.replacement_when_short = replacement_when_short
+        self.global_step = 0
+
+    def set_step(self, global_step: int):
+        self.global_step = global_step
+        self.scheduler.update_difficulty(global_step)
+
+    @property
+    def current_difficulty(self):
+        return self.scheduler.current_difficulty
+
+    def eligible_indices(self) -> np.ndarray:
+        cutoff = np.searchsorted(self.sorted_metric,
+                                 self.scheduler.current_difficulty,
+                                 side="right")
+        return self.order[:cutoff]
+
+    def sample_batch(self) -> np.ndarray:
+        pool = self.eligible_indices()
+        if len(pool) == 0:
+            raise RuntimeError(
+                f"no samples at difficulty {self.scheduler.current_difficulty}")
+        if len(pool) < self.batch_size:
+            if not self.replacement_when_short:
+                raise RuntimeError(
+                    f"only {len(pool)} samples at difficulty "
+                    f"{self.scheduler.current_difficulty} < batch "
+                    f"{self.batch_size}")
+            return self.rng.choice(pool, self.batch_size, replace=True)
+        return self.rng.choice(pool, self.batch_size, replace=False)
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        while True:
+            yield self.sample_batch()
+
+    def state_dict(self):
+        return {"global_step": self.global_step,
+                "scheduler": self.scheduler.state_dict(),
+                "rng": self.rng.bit_generator.state}
+
+    def load_state_dict(self, sd):
+        self.global_step = sd["global_step"]
+        self.scheduler.load_state_dict(sd["scheduler"])
+        self.rng.bit_generator.state = sd["rng"]
+
+
+def truncate_seqlen(batch: Dict[str, np.ndarray], seqlen: int,
+                    seq_axis: int = -1,
+                    keys: Optional[List[str]] = None) -> Dict[str, np.ndarray]:
+    """Legacy seqlen curriculum (reference engine curriculum_seqlen path):
+    truncate token-like fields to the scheduled length. Static-shape caveat:
+    on TPU each new seqlen triggers one recompile, so schedules should use a
+    coarse difficulty_step (e.g. 64) — same guidance as the reference's
+    `difficulty_step` for tensor-core alignment."""
+    out = {}
+    for k, v in batch.items():
+        if keys is not None and k not in keys:
+            out[k] = v
+            continue
+        v = np.asarray(v)
+        axis = seq_axis if seq_axis >= 0 else v.ndim + seq_axis
+        if v.ndim > axis and v.shape[axis] > seqlen:
+            sl = [slice(None)] * v.ndim
+            sl[axis] = slice(0, seqlen)
+            v = v[tuple(sl)]
+        out[k] = v
+    return out

@@ -1,0 +1,108 @@
+"""Memory-mapped indexed dataset.
+
+Reference: runtime/data_pipeline/data_sampling/indexed_dataset.py
+(MMapIndexedDataset, Megatron .bin/.idx format). Same role: token sequences
+of ragged length stored contiguously in a .bin file with an .idx sidecar of
+dtype/sizes/offsets, read zero-copy via np.memmap. The binary format here is
+self-describing (magic + version + dtype code + counts) but intentionally
+simpler than Megatron's; a loader for that format can be added at the same
+interface.
+"""
+
+import json
+import os
+import struct
+from typing import List, Sequence
+
+import numpy as np
+
+MAGIC = b"DSTPUIDX"
+VERSION = 1
+
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+           6: np.float32, 7: np.float64, 8: np.uint16, 9: np.uint32}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+class MMapIndexedDatasetBuilder:
+    """Streaming writer (reference MMapIndexedDatasetBuilder)."""
+
+    def __init__(self, prefix: str, dtype=np.int32):
+        self.prefix = prefix
+        self.dtype = np.dtype(dtype)
+        self._bin = open(data_file_path(prefix), "wb")
+        self.sizes: List[int] = []
+        self.doc_idx: List[int] = [0]
+
+    def add_item(self, tokens: Sequence[int]):
+        arr = np.asarray(tokens, dtype=self.dtype)
+        self._bin.write(arr.tobytes(order="C"))
+        self.sizes.append(arr.size)
+
+    def end_document(self):
+        self.doc_idx.append(len(self.sizes))
+
+    def finalize(self):
+        self._bin.close()
+        with open(index_file_path(self.prefix), "wb") as idx:
+            idx.write(MAGIC)
+            idx.write(struct.pack("<QQQ", VERSION,
+                                  _DTYPE_CODES[self.dtype], len(self.sizes)))
+            np.asarray(self.sizes, np.int64).tofile(idx)
+            np.asarray(self.doc_idx, np.int64).tofile(idx)
+            idx.write(struct.pack("<Q", len(self.doc_idx)))
+
+
+class MMapIndexedDataset:
+    """Zero-copy reader (reference MMapIndexedDataset)."""
+
+    def __init__(self, prefix: str):
+        with open(index_file_path(prefix), "rb") as idx:
+            magic = idx.read(len(MAGIC))
+            if magic != MAGIC:
+                raise ValueError(f"{index_file_path(prefix)}: bad magic")
+            version, dtype_code, n = struct.unpack("<QQQ", idx.read(24))
+            if version != VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            self.dtype = np.dtype(_DTYPES[dtype_code])
+            self.sizes = np.fromfile(idx, np.int64, n)
+            rest = np.fromfile(idx, np.int64)
+            n_doc = int(rest[-1])
+            self.doc_idx = rest[:n_doc]
+        self.offsets = np.zeros(n + 1, np.int64)
+        np.cumsum(self.sizes, out=self.offsets[1:])
+        self._mmap = np.memmap(data_file_path(prefix), dtype=self.dtype,
+                               mode="r")
+
+    def __len__(self):
+        return len(self.sizes)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        return self._mmap[self.offsets[i]:self.offsets[i + 1]]
+
+    def get(self, i, offset=0, length=None):
+        start = self.offsets[i] + offset
+        stop = (self.offsets[i + 1] if length is None
+                else min(start + length, self.offsets[i + 1]))
+        return self._mmap[start:stop]
+
+    @property
+    def supports_prefetch(self):
+        return False
+
+
+def make_dataset(prefix: str, impl: str = "mmap"):
+    """Reference make_dataset entry; only the mmap impl exists on TPU."""
+    if impl != "mmap":
+        raise ValueError(f"unsupported indexed dataset impl '{impl}'")
+    return MMapIndexedDataset(prefix)

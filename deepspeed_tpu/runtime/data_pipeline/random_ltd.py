@@ -1,0 +1,88 @@
+"""Random layer token dropping (random-LTD).
+
+Reference: runtime/data_pipeline/data_routing/basic_layer.py:14
+RandomLayerTokenDrop + scheduler.py RandomLTDScheduler + the csrc/random_ltd
+token_sort/gather_scatter CUDA kernels. Each wrapped layer processes only a
+random subset of tokens; the skipped tokens bypass the layer and are
+scattered back in order. The kept-token count follows a linear schedule from
+`start_ratio` of the sequence up to the full sequence.
+
+TPU-native: the gather/scatter is jnp.take_along_axis / scatter on a static
+keep-count (static shapes under jit — the schedule changes keep_count only
+between compiled steps, mirroring the reference's per-step reconfiguration).
+The random permutation comes from jax PRNG, so dropping is identical across
+data-parallel replicas given the same key (the reference broadcasts its
+sorted indices the same way).
+"""
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_token_subset(rng, seq_len: int, keep: int) -> Tuple[jnp.ndarray,
+                                                               jnp.ndarray]:
+    """Random kept-token indices (sorted, order-preserving like the
+    reference's token_sort.cu) + their inverse scatter positions."""
+    perm = jax.random.permutation(rng, seq_len)
+    kept = jnp.sort(perm[:keep])
+    return kept, perm
+
+
+def gather_tokens(x: jnp.ndarray, kept: jnp.ndarray) -> jnp.ndarray:
+    """[B, S, H] -> [B, keep, H] (reference gather_scatter.cu gather)."""
+    return jnp.take(x, kept, axis=1)
+
+
+def scatter_tokens(full: jnp.ndarray, processed: jnp.ndarray,
+                   kept: jnp.ndarray) -> jnp.ndarray:
+    """Write processed kept tokens back into the full sequence; dropped
+    tokens keep their input values (layer bypass)."""
+    return full.at[:, kept, :].set(processed)
+
+
+def random_ltd_layer(layer_fn: Callable[[jnp.ndarray], jnp.ndarray],
+                     x: jnp.ndarray, rng, keep: int) -> jnp.ndarray:
+    """Apply `layer_fn` to a random `keep`-token subset of x [B, S, H]
+    (reference RandomLayerTokenDrop.forward)."""
+    S = x.shape[1]
+    if keep >= S:
+        return layer_fn(x)
+    kept, _ = sample_token_subset(rng, S, keep)
+    sub = gather_tokens(x, kept)
+    out = layer_fn(sub)
+    return scatter_tokens(x, out, kept)
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule (reference data_routing/scheduler.py):
+    linear ramp from min_value to max_value (full seq) over schedule steps."""
+
+    def __init__(self, config: Dict[str, Any]):
+        sched = config.get("random_ltd_schedule", {})
+        self.min_value = sched.get("min_value",
+                                   config.get("random_ltd_layer_num", 128))
+        self.max_value = sched["max_value"]
+        self.total_steps = sched.get("schedule_config", {}).get(
+            "total_layer_token_drop_step",
+            sched.get("total_layer_token_drop_step", 1000))
+        self.step_size = sched.get("schedule_config", {}).get(
+            "seq_per_step", sched.get("seq_per_step", 16))
+        self.current_seq = self.min_value
+
+    def get_value(self, global_step: int) -> int:
+        frac = min(1.0, global_step / max(self.total_steps, 1))
+        val = self.min_value + frac * (self.max_value - self.min_value)
+        val = int(val // self.step_size) * self.step_size
+        return int(min(max(val, self.min_value), self.max_value))
+
+    def update_seq(self, global_step: int) -> int:
+        self.current_seq = self.get_value(global_step)
+        return self.current_seq
+
+    def state_dict(self):
+        return {"current_seq": self.current_seq}
+
+    def load_state_dict(self, sd):
+        self.current_seq = sd["current_seq"]

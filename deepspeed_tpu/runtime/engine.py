@@ -134,6 +134,18 @@ class DeepSpeedTpuEngine:
         from .activation_checkpointing import checkpointing as ds_ckpt
         ds_ckpt.configure(deepspeed_config=self.config)
 
+        # --- compression (QAT/pruning) spec, applied inside the loss
+        # (reference compression/compress.py init_compression rewrites
+        # modules; here it is a functional param transform)
+        self.compression_spec = None
+        if self.config.compression_training:
+            from ..compression.compress import init_compression
+            spec = init_compression(
+                model=self.model,
+                deepspeed_config={"compression_training":
+                                  self.config.compression_training})
+            self.compression_spec = spec if spec.enabled() else None
+
         if hasattr(self.model, "set_topology"):
             self.model.set_topology(self.topology)
 
@@ -257,7 +269,9 @@ class DeepSpeedTpuEngine:
     # ------------------------------------------------------------------
     # Compiled train step
     # ------------------------------------------------------------------
-    def _loss_fn(self, params, micro_batch, rng, scale):
+    def _loss_fn(self, params, micro_batch, rng, scale, step=None):
+        if self.compression_spec is not None and step is not None:
+            params = self.compression_spec.apply(params, step)
         out = self.model.apply(params, micro_batch, train=True, rng=rng)
         loss, aux = _split_loss_aux(out)
         loss = loss.astype(jnp.float32)
@@ -336,7 +350,8 @@ class DeepSpeedTpuEngine:
                     grads_acc, rng = carry
                     rng, sub = jax.random.split(rng)
                     (scaled, (loss, _aux)), grads = jax.value_and_grad(
-                        self._loss_fn, has_aux=True)(params, micro, sub, scale)
+                        self._loss_fn, has_aux=True)(params, micro, sub, scale,
+                                                     step)
                     grads = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
                                          grads_acc, grads)
                     grads = constrain(grads, grad_sh)
@@ -541,14 +556,15 @@ class DeepSpeedTpuEngine:
             return jax.tree.map(lambda x, s: jax.lax.with_sharding_constraint(x, s),
                                 tree, sh)
 
-        def grad_step(params, scale_state, rng, batch):
+        def grad_step(params, scale_state, step, rng, batch):
             scale = scale_state["loss_scale"] if fp16 else jnp.asarray(1.0, jnp.float32)
 
             def micro_fn(carry, micro):
                 grads_acc, rng = carry
                 rng, sub = jax.random.split(rng)
                 (_, (loss, _aux)), grads = jax.value_and_grad(
-                    self._loss_fn, has_aux=True)(params, micro, sub, scale)
+                    self._loss_fn, has_aux=True)(params, micro, sub, scale,
+                                                 step)
                 grads = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
                                      grads_acc, grads)
                 grads = constrain(grads, grad_sh)
@@ -579,7 +595,7 @@ class DeepSpeedTpuEngine:
                     if self.scale_state is not None else None)
         self._grad_step = jax.jit(
             grad_step,
-            in_shardings=(param_sh, scale_sh, repl, None),
+            in_shardings=(param_sh, scale_sh, repl, repl, None),
             out_shardings=(grad_sh, scale_sh, repl, None))
 
         def eval_step(params, rng, batch):
@@ -622,7 +638,8 @@ class DeepSpeedTpuEngine:
 
     def _train_batch_offloaded(self, dev_batch):
         grads, self.scale_state, self._model_rng, metrics = self._grad_step(
-            self.params, self.scale_state, self._model_rng, dev_batch)
+            self.params, self.scale_state, self._step_arr, self._model_rng,
+            dev_batch)
         skipped = int(metrics["skipped"])
         if not skipped:
             step_no = int(self._step_arr) + 1
@@ -635,6 +652,45 @@ class DeepSpeedTpuEngine:
         else:
             metrics["lr"] = float(self._lr_fn(self._step_arr))
         return metrics
+
+    def _run_flops_profiler(self, dev_batch):
+        """Profile the compiled train step at flops_profiler.profile_step
+        (reference engine.py:1765 flops_profiler_profile_step). Uses AOT
+        cost analysis — no extra execution of the (donating) step."""
+        from ..profiling.flops_profiler.profiler import FlopsProfiler
+        try:
+            prof = FlopsProfiler(self.model, ds_engine=self)
+            if self.offload_device or self.onebit_mode:
+                fn = self._grad_step if self.offload_device else self._train_step
+            else:
+                fn = self._train_step
+            args = ((self.params, self.scale_state, self._step_arr,
+                     self._model_rng, dev_batch)
+                    if self.offload_device else
+                    (self.params, self.master_params, self.opt_state,
+                     self.scale_state, self._step_arr, self._model_rng,
+                     dev_batch))
+            ca = fn.lower(*args).compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            prof._flops = float((ca or {}).get("flops", 0.0))
+            prof._bytes = float((ca or {}).get("bytes accessed", 0.0))
+            prof._duration = self.tput_timer.last_duration or 0.0
+            prof._params = self.param_count
+            target = self.params
+            from ..profiling.flops_profiler.profiler import params_breakdown
+            prof._breakdown = params_breakdown(target)
+            fp_cfg = self.config.flops_profiler
+            out = (open(fp_cfg.output_file, "w")
+                   if fp_cfg.output_file else None)
+            prof.print_model_profile(profile_step=self.global_steps,
+                                     top_modules=max(fp_cfg.top_modules, 5),
+                                     detailed=fp_cfg.detailed,
+                                     output_file=out)
+            if out:
+                out.close()
+        except Exception as e:  # profiling must never break training
+            logger.warning(f"flops profiler failed: {e}")
 
     # ------------------------------------------------------------------
     # Data plumbing
@@ -684,6 +740,9 @@ class DeepSpeedTpuEngine:
                 self._step_arr, self._model_rng, dev_batch)
         self.global_steps += 1
         self.lr_scheduler.step()
+        fp_cfg = self.config.flops_profiler
+        if fp_cfg.enabled and self.global_steps == fp_cfg.profile_step:
+            self._run_flops_profiler(dev_batch)
         loss = float(metrics["loss"])
         skipped = int(metrics["skipped"])
         self.skipped_steps += skipped

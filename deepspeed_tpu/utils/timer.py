@@ -91,6 +91,7 @@ class ThroughputTimer:
         self.total_elapsed_time = 0.0
         self._start_time = None
         self.started = False
+        self.last_duration: Optional[float] = None
 
     def start(self):
         self._start_time = time.perf_counter()
@@ -101,8 +102,9 @@ class ThroughputTimer:
             return
         self.started = False
         self.global_step_count += 1
+        self.last_duration = time.perf_counter() - self._start_time
         if self.global_step_count > self.start_step:
-            self.total_elapsed_time += time.perf_counter() - self._start_time
+            self.total_elapsed_time += self.last_duration
 
     @property
     def avg_samples_per_sec(self) -> float:

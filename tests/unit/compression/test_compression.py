@@ -1,0 +1,100 @@
+"""Compression tests (reference tests/unit/compression/test_compression.py:
+quantization/pruning layer behavior + scheduled activation)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (fake_quantize, head_pruning_mask,
+                                       init_compression, magnitude_prune_mask,
+                                       row_pruning_mask)
+from tests.unit.simple_model import SimpleModel, base_config, random_batches
+
+HIDDEN = 32
+
+
+def test_fake_quantize_levels_and_ste():
+    w = jnp.asarray(np.random.default_rng(0).standard_normal((16, 16)),
+                    jnp.float32)
+    q8 = fake_quantize(w, 8, True, False)
+    # error bounded by half a quantization step
+    step = float(jnp.max(jnp.abs(w))) / 127
+    assert float(jnp.max(jnp.abs(q8 - w))) <= step
+    # 4-bit: at most 15 distinct levels
+    q4 = fake_quantize(w, 4, True, False)
+    assert len(np.unique(np.asarray(q4))) <= 15
+    # straight-through estimator: grad of sum(fake_quantize(w)) == ones
+    g = jax.grad(lambda w_: jnp.sum(fake_quantize(w_, 4, True, False)))(w)
+    np.testing.assert_array_equal(np.asarray(g), np.ones_like(g))
+
+
+def test_pruning_masks():
+    w = jnp.asarray(np.random.default_rng(1).standard_normal((8, 16)),
+                    jnp.float32)
+    m = magnitude_prune_mask(w, 0.25)
+    assert np.asarray(m).mean() == pytest.approx(0.25, abs=0.05)
+    # kept entries are the largest by magnitude
+    kept = np.abs(np.asarray(w))[np.asarray(m) > 0]
+    dropped = np.abs(np.asarray(w))[np.asarray(m) == 0]
+    assert kept.min() >= dropped.max()
+
+    rm = row_pruning_mask(w, 0.5, axis=0)
+    row_on = np.asarray(rm).mean(axis=1)
+    assert set(np.round(row_on, 3)) <= {0.0, 1.0}
+    assert row_on.sum() == 4
+
+    hm = head_pruning_mask(w, 0.5, num_heads=4, head_axis=0)
+    head_on = np.asarray(hm).reshape(4, 2, 16).mean(axis=(1, 2))
+    assert set(np.round(head_on, 3)) <= {0.0, 1.0}
+    assert head_on.sum() == 2
+
+
+def test_init_compression_schema_and_apply():
+    cfg = {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 5,
+                                  "quantization_type": "symmetric"},
+            "different_groups": {
+                "wq1": {"params": {"start_bits": 4},
+                        "modules": ["layer_0*"]}}},
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                                  "dense_ratio": 0.5},
+            "different_groups": {}}}}
+    spec = init_compression(deepspeed_config=cfg)
+    assert spec.enabled()
+    assert {g.technique for g in spec.groups} == {"weight_quantization",
+                                                  "sparse_pruning"}
+    params = {"layer_0": {"w": jnp.ones((8, 8)) * 0.5},
+              "layer_1": {"w": jnp.asarray(
+                  np.random.default_rng(0).standard_normal((8, 8)),
+                  jnp.float32)}}
+    # before schedule_offset=5, quant is gated off but pruning (offset 0) on
+    out = spec.apply(params, step=0)
+    assert np.asarray(out["layer_1"]["w"] == 0).mean() == pytest.approx(
+        0.5, abs=0.05)
+    # after offset both apply; layer_1 has no quant group
+    out5 = spec.apply(params, step=5)
+    assert np.allclose(np.asarray(out5["layer_0"]["w"]),
+                       np.asarray(out5["layer_0"]["w"]).flat[0])
+
+
+def test_engine_compression_training_runs():
+    cfg = base_config(micro=2, stage=0, dtype="bf16", lr=1e-2)
+    cfg["compression_training"] = {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"g": {"params": {"start_bits": 8},
+                                       "modules": ["*"]}}}}
+    model = SimpleModel(hidden_dim=HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    assert engine.compression_spec is not None
+    micro = engine.micro_batch_size * engine.ds_config.dp_world_size
+    losses = []
+    for b in random_batches(4, micro * engine.gas, HIDDEN, seed=0):
+        batch = {k: v.reshape(engine.gas, micro, HIDDEN) for k, v in b.items()}
+        losses.append(engine.train_batch(batch=batch))
+    assert all(np.isfinite(l) for l in losses)

@@ -1,0 +1,49 @@
+"""FLOPS profiler tests (reference
+tests/unit/profiling/flops_profiler/test_flops_profiler.py: profiled flops
+must match the analytic count of a known model)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.profiling.flops_profiler import (FlopsProfiler,
+                                                    get_model_profile)
+from tests.unit.simple_model import SimpleModel, base_config, random_batches
+
+HIDDEN = 32
+
+
+def test_profile_fn_counts_matmul_flops():
+    a = jnp.ones((64, 128), jnp.float32)
+    b = jnp.ones((128, 256), jnp.float32)
+    prof = FlopsProfiler().profile_fn(lambda x, y: x @ y, a, b, iters=1)
+    # one [64,128]x[128,256] matmul = 2*64*128*256 flops
+    assert prof.get_total_flops() == 2 * 64 * 128 * 256
+    assert prof.get_total_macs() == 64 * 128 * 256
+    assert prof.get_total_duration() > 0
+
+
+def test_get_model_profile_simple_model():
+    model = SimpleModel(hidden_dim=HIDDEN)
+    batch = {"x": np.ones((4, HIDDEN), np.float32),
+             "y": np.ones((4, HIDDEN), np.float32)}
+    flops, macs, params = get_model_profile(model, batch, print_profile=False)
+    # params: 2 layers of (H*H + H)
+    assert params == 2 * (HIDDEN * HIDDEN + HIDDEN)
+    # at least the two matmuls
+    assert flops >= 2 * 2 * 4 * HIDDEN * HIDDEN
+
+
+def test_engine_profile_step_runs(capsys):
+    cfg = base_config(micro=2, stage=0, dtype="bf16", lr=1e-3)
+    cfg["flops_profiler"] = {"enabled": True, "profile_step": 2}
+    model = SimpleModel(hidden_dim=HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    micro = engine.micro_batch_size * engine.ds_config.dp_world_size
+    for b in random_batches(3, micro * engine.gas, HIDDEN, seed=0):
+        batch = {k: v.reshape(engine.gas, micro, HIDDEN) for k, v in b.items()}
+        engine.train_batch(batch=batch)
+    # profiler must have measured a positive step flops count
+    # (log output goes through the logger; assert no crash + state updated)
+    assert engine.global_steps == 3

@@ -1,0 +1,121 @@
+"""Data-efficiency pipeline tests (reference
+tests/unit/runtime/test_data_efficiency.py + data_sampling tests)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler,
+                                                 DeepSpeedDataSampler,
+                                                 MMapIndexedDataset,
+                                                 MMapIndexedDatasetBuilder,
+                                                 RandomLTDScheduler,
+                                                 random_ltd_layer,
+                                                 truncate_seqlen)
+
+
+def test_fixed_linear_schedule():
+    s = CurriculumScheduler({
+        "curriculum_type": "fixed_linear",
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_config": {"total_curriculum_step": 100,
+                            "difficulty_step": 8}})
+    assert s.get_difficulty(0) == 8
+    assert s.get_difficulty(100) == 64
+    assert s.get_difficulty(1000) == 64
+    mid = s.get_difficulty(50)
+    assert 8 < mid < 64 and mid % 8 == 0
+    # monotone
+    vals = [s.get_difficulty(t) for t in range(0, 110, 10)]
+    assert vals == sorted(vals)
+
+
+def test_fixed_root_reaches_max_faster_than_linear():
+    common = {"min_difficulty": 8, "max_difficulty": 64,
+              "schedule_config": {"total_curriculum_step": 100,
+                                  "difficulty_step": 1, "root_degree": 2}}
+    lin = CurriculumScheduler({"curriculum_type": "fixed_linear", **common})
+    root = CurriculumScheduler({"curriculum_type": "fixed_root", **common})
+    assert root.get_difficulty(25) > lin.get_difficulty(25)
+
+
+def test_fixed_discrete():
+    s = CurriculumScheduler({
+        "curriculum_type": "fixed_discrete",
+        "min_difficulty": 2, "max_difficulty": 10,
+        "schedule_config": {"difficulty": [2, 5, 10], "max_step": [10, 20]}})
+    assert s.get_difficulty(0) == 2
+    assert s.get_difficulty(15) == 5
+    assert s.get_difficulty(25) == 10
+
+
+def test_data_sampler_respects_difficulty():
+    metric = np.arange(100)  # sample i has difficulty i
+    sampler = DeepSpeedDataSampler(
+        {"curriculum_type": "fixed_linear", "min_difficulty": 10,
+         "max_difficulty": 99,
+         "schedule_config": {"total_curriculum_step": 50,
+                             "difficulty_step": 1}},
+        metric_values=metric, batch_size=8, seed=0)
+    sampler.set_step(0)
+    batch = sampler.sample_batch()
+    assert (metric[batch] <= 10).all()
+    sampler.set_step(50)
+    pools = {i for _ in range(20) for i in sampler.sample_batch()}
+    assert max(pools) > 50  # hard samples now reachable
+
+
+def test_truncate_seqlen():
+    batch = {"input_ids": np.ones((4, 128), np.int64),
+             "labels": np.ones((4, 128), np.int64)}
+    out = truncate_seqlen(batch, 32)
+    assert out["input_ids"].shape == (4, 32)
+    assert out["labels"].shape == (4, 32)
+
+
+def test_random_ltd_layer_bypasses_dropped_tokens():
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 16, 4)),
+                    jnp.float32)
+    marker = lambda t: t + 100.0  # noqa: E731
+    out = random_ltd_layer(marker, x, jax.random.PRNGKey(0), keep=4)
+    delta = np.asarray(out - x)
+    touched = (np.abs(delta) > 50).all(axis=(0, 2))
+    assert touched.sum() == 4  # exactly `keep` positions processed
+    # untouched tokens bypass identically
+    np.testing.assert_allclose(np.asarray(out)[:, ~touched],
+                               np.asarray(x)[:, ~touched])
+    # keep >= S: full layer
+    full = random_ltd_layer(marker, x, jax.random.PRNGKey(0), keep=16)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(x) + 100.0)
+
+
+def test_random_ltd_scheduler_ramp():
+    s = RandomLTDScheduler({"random_ltd_schedule": {
+        "min_value": 128, "max_value": 512,
+        "schedule_config": {"total_layer_token_drop_step": 100,
+                            "seq_per_step": 64}}})
+    assert s.get_value(0) == 128
+    assert s.get_value(100) == 512
+    assert s.get_value(50) in (320,)  # 128 + 0.5*384 = 320, aligned to 64
+
+
+def test_indexed_dataset_roundtrip(tmp_path):
+    prefix = str(tmp_path / "data")
+    builder = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+    docs = [[1, 2, 3], [4, 5], [6, 7, 8, 9], [10]]
+    for d in docs[:2]:
+        builder.add_item(d)
+    builder.end_document()
+    for d in docs[2:]:
+        builder.add_item(d)
+    builder.end_document()
+    builder.finalize()
+
+    ds = MMapIndexedDataset(prefix)
+    assert len(ds) == 4
+    for i, d in enumerate(docs):
+        np.testing.assert_array_equal(np.asarray(ds[i]), d)
+    np.testing.assert_array_equal(ds.get(2, offset=1, length=2), [7, 8])
+    np.testing.assert_array_equal(ds.doc_idx, [0, 2, 4])
