@@ -1,0 +1,3 @@
+from .config_v2 import (DSStateManagerConfig,  # noqa: F401
+                        RaggedInferenceEngineConfig)
+from .engine_v2 import InferenceEngineV2  # noqa: F401

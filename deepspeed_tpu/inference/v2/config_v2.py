@@ -1,0 +1,39 @@
+"""Ragged inference engine configuration.
+
+Reference: inference/v2/config_v2.py (RaggedInferenceEngineConfig with
+DSStateManagerConfig: max_tracked_sequences, max_ragged_batch_size,
+max_ragged_sequence_count, memory_config) — plus the TPU-native knobs: KV
+block size and prefill bucket granularity (static-shape compilation caches).
+"""
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class DSStateManagerConfig:
+    max_tracked_sequences: int = 64          # concurrent sequences
+    max_ragged_batch_size: int = 768         # tokens per put() (prefill cap)
+    max_ragged_sequence_count: int = 512
+    max_seq_len: int = 2048
+    num_blocks: int = 256                    # KV pool size (incl. null block)
+    block_size: int = 64                     # tokens per KV block
+    memory_reserve_fraction: float = 0.0     # reference memory_config analogue
+
+
+@dataclass
+class RaggedInferenceEngineConfig:
+    state_manager: DSStateManagerConfig = field(
+        default_factory=DSStateManagerConfig)
+    tensor_parallel_size: int = 1
+    dtype: str = "bfloat16"
+    prefill_bucket: int = 64                 # prompt lengths pad to multiples
+    seed: int = 0
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RaggedInferenceEngineConfig":
+        d = dict(d or {})
+        sm = d.pop("state_manager", {})
+        if isinstance(sm, dict):
+            sm = DSStateManagerConfig(**sm)
+        return cls(state_manager=sm, **d)

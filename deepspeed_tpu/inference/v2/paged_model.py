@@ -1,0 +1,190 @@
+"""Paged (blocked-KV) transformer forward for the ragged engine.
+
+Device-side core of inference v2. Reference counterparts:
+  * blocked flash attention over the paged KV cache
+    (inference/v2/kernels/ragged_ops/blocked_flash/)
+  * fused rotary + KV-block append
+    (ragged_ops/blocked_kv_rotary/)
+  * ragged embedding + logits gather (ragged_ops/ragged_embed, logits_gather)
+
+Two entry points, both pure and jit-compiled by the engine:
+  * ``paged_prefill``: one new sequence's prompt chunk [1, C] — causal
+    attention within the chunk, K/V scattered into the sequence's cache
+    blocks, returns the last-token logits.
+  * ``paged_decode``: one token for each of N sequences — K/V appended at
+    each sequence's next slot, attention over the sequence's block table
+    (gathered pages), returns [N, V] logits.
+
+The KV pool is ``[L, num_blocks, block_size, kv_heads, head_dim]``; block 0
+is the null block (padding writes land there). Static shapes throughout:
+prompt lengths bucket to multiples of ``prefill_bucket`` and the decode
+batch is padded to the tracked-sequence cap — each bucket compiles once
+(the XLA analogue of the reference's CUDA-graph'd atom sizes).
+"""
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...models.transformer import TransformerConfig
+
+NEG_INF = -1e30
+
+
+def init_paged_kv_cache(cfg: TransformerConfig, num_blocks: int,
+                        block_size: int, dtype) -> Dict[str, jnp.ndarray]:
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.kv_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _norm(cfg, x, w, b=None):
+    from ...ops.norms import layer_norm, rms_norm
+
+    if cfg.norm == "rmsnorm":
+        return rms_norm(x, w, cfg.norm_eps)
+    return layer_norm(x, w, b, cfg.norm_eps)
+
+
+def _rope_at(cfg: TransformerConfig, pos: jnp.ndarray):
+    """cos/sin tables at integer positions `pos` [...]-> [..., half]."""
+    half = cfg.head_dim // 2
+    freqs = 1.0 / (cfg.rope_theta
+                   ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = pos.astype(jnp.float32)[..., None] * freqs
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rotate(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray):
+    """x [..., D]; cos/sin broadcastable to [..., D/2]."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                           axis=-1).astype(x.dtype)
+
+
+def _mlp(cfg, lp, x):
+    if cfg.activation == "swiglu":
+        return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+    u = jax.nn.gelu(x @ lp["w_up"] + lp["b_up"])
+    return u @ lp["w_down"] + lp["b_down"]
+
+
+def _logits(cfg, params, x):
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    return (x @ head.astype(x.dtype)).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Prefill
+# ---------------------------------------------------------------------------
+def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
+                  prompt_len: jnp.ndarray, cache: Dict[str, jnp.ndarray],
+                  block_ids: jnp.ndarray, offsets: jnp.ndarray
+                  ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """ids [1, C] (padded prompt); prompt_len scalar; block_ids/offsets [C]
+    map chunk position -> (cache block, slot) with padding -> null block.
+    Returns (last-token logits [V], cache)."""
+    C = ids.shape[1]
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    x = params["embed"][ids[0]]                                # [C, H]
+    if cfg.positional == "learned":
+        x = x + params["pos_embed"][:C]
+    pos = jnp.arange(C)
+    cos, sin = _rope_at(cfg, pos)                              # [C, half]
+    valid = pos < prompt_len                                   # [C]
+    causal = pos[:, None] >= pos[None, :]
+    mask = causal & valid[None, :]                             # [C, C]
+
+    def layer_fn(carry, inputs):
+        x, kc, vc = carry
+        lp, l = inputs
+        hn = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
+        q = (hn @ lp["wq"]).reshape(C, nh, hd)
+        k = (hn @ lp["wk"]).reshape(C, nkv, hd)
+        v = (hn @ lp["wv"]).reshape(C, nkv, hd)
+        if cfg.positional == "rope":
+            q = _rotate(q, cos[:, None], sin[:, None])
+            k = _rotate(k, cos[:, None], sin[:, None])
+        kc = kc.at[l, block_ids, offsets].set(k.astype(kc.dtype))
+        vc = vc.at[l, block_ids, offsets].set(v.astype(vc.dtype))
+        if nkv != nh:
+            k = jnp.repeat(k, nh // nkv, axis=1)
+            v = jnp.repeat(v, nh // nkv, axis=1)
+        scores = jnp.einsum("qhd,khd->hqk", q, k).astype(jnp.float32)
+        scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+        scores = jnp.where(mask[None], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        o = jnp.einsum("hqk,khd->qhd", probs, v).reshape(C, nh * hd)
+        x = x + o @ lp["wo"]
+        hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+        x = x + _mlp(cfg, lp, hn)
+        return (x, kc, vc), None
+
+    (x, kc, vc), _ = jax.lax.scan(
+        layer_fn, (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(cfg.num_layers)))
+    x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
+    last = jnp.take(x, prompt_len - 1, axis=0)                  # [H]
+    return _logits(cfg, params, last), {"k": kc, "v": vc}
+
+
+# ---------------------------------------------------------------------------
+# Decode
+# ---------------------------------------------------------------------------
+def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
+                 pos: jnp.ndarray, block_tables: jnp.ndarray,
+                 cache: Dict[str, jnp.ndarray], active: jnp.ndarray,
+                 block_size: int
+                 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """toks/pos/active [N]; block_tables [N, MB]. One token per sequence;
+    returns ([N, V] logits, cache). Inactive rows write to the null block
+    and produce garbage logits (masked by the caller)."""
+    N, MB = block_tables.shape
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    ctx = MB * block_size
+    x = params["embed"][toks]                                   # [N, H]
+    if cfg.positional == "learned":
+        x = x + params["pos_embed"][jnp.clip(pos, 0, cfg.max_seq_len - 1)]
+    cos, sin = _rope_at(cfg, pos)                               # [N, half]
+    blk = jnp.take_along_axis(block_tables,
+                              (pos // block_size)[:, None], axis=1)[:, 0]
+    blk = jnp.where(active, blk, 0)
+    off = pos % block_size
+    ctx_pos = jnp.arange(ctx)
+    attn_mask = ctx_pos[None, :] <= pos[:, None]                # [N, ctx]
+
+    def layer_fn(carry, inputs):
+        x, kc, vc = carry
+        lp, l = inputs
+        hn = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
+        q = (hn @ lp["wq"]).reshape(N, nh, hd)
+        k = (hn @ lp["wk"]).reshape(N, nkv, hd)
+        v = (hn @ lp["wv"]).reshape(N, nkv, hd)
+        if cfg.positional == "rope":
+            q = _rotate(q, cos[:, None], sin[:, None])
+            k = _rotate(k, cos[:, None], sin[:, None])
+        kc = kc.at[l, blk, off].set(k.astype(kc.dtype))
+        vc = vc.at[l, blk, off].set(v.astype(vc.dtype))
+        # gather this sequence's pages: [N, MB, bs, nkv, hd] -> [N, ctx, ...]
+        kpages = kc[l][block_tables].reshape(N, ctx, nkv, hd)
+        vpages = vc[l][block_tables].reshape(N, ctx, nkv, hd)
+        if nkv != nh:
+            kpages = jnp.repeat(kpages, nh // nkv, axis=2)
+            vpages = jnp.repeat(vpages, nh // nkv, axis=2)
+        scores = jnp.einsum("nhd,nchd->nhc", q, kpages).astype(jnp.float32)
+        scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+        scores = jnp.where(attn_mask[:, None, :], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        o = jnp.einsum("nhc,nchd->nhd", probs, vpages).reshape(N, nh * hd)
+        x = x + o @ lp["wo"]
+        hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+        x = x + _mlp(cfg, lp, hn)
+        return (x, kc, vc), None
+
+    (x, kc, vc), _ = jax.lax.scan(
+        layer_fn, (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(cfg.num_layers)))
+    x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
+    return _logits(cfg, params, x), {"k": kc, "v": vc}

@@ -1,0 +1,3 @@
+from .blocked_allocator import NULL_BLOCK, BlockedAllocator  # noqa: F401
+from .ragged_manager import DSStateManager  # noqa: F401
+from .sequence_descriptor import DSSequenceDescriptor  # noqa: F401

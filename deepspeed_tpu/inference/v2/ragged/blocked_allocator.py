@@ -1,0 +1,43 @@
+"""Free-list KV block allocator.
+
+Reference: inference/v2/ragged/blocked_allocator.py (BlockedAllocator): a
+fixed pool of KV-cache blocks handed out to sequences and returned on
+flush. Host-side (numpy int free list); block 0 is reserved as the NULL
+block that padded token slots write into, so scatters never need masking.
+"""
+
+from typing import Iterable, List
+
+import numpy as np
+
+NULL_BLOCK = 0
+
+
+class BlockedAllocator:
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (one is the null block)")
+        self.num_blocks = num_blocks
+        # LIFO free list; block 0 reserved
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, n: int) -> np.ndarray:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV cache exhausted: requested {n} blocks, "
+                f"{len(self._free)} free")
+        out = [self._free.pop() for _ in range(n)]
+        return np.asarray(out, np.int32)
+
+    def free(self, blocks: Iterable[int]) -> None:
+        for b in blocks:
+            b = int(b)
+            if b == NULL_BLOCK:
+                continue
+            if b <= 0 or b >= self.num_blocks:
+                raise ValueError(f"freeing invalid block {b}")
+            self._free.append(b)

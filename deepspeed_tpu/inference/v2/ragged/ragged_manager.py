@@ -1,0 +1,75 @@
+"""Sequence state manager for the ragged engine.
+
+Reference: inference/v2/ragged/ragged_manager.py:19 (DSStateManager): owns
+the block allocator and the per-sequence descriptors, answers schedulability
+questions, and materializes the per-step block tables the device program
+consumes.
+"""
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..config_v2 import DSStateManagerConfig
+from .blocked_allocator import NULL_BLOCK, BlockedAllocator
+from .sequence_descriptor import DSSequenceDescriptor
+
+
+class DSStateManager:
+    def __init__(self, config: DSStateManagerConfig):
+        self.config = config
+        self.block_size = config.block_size
+        self.allocator = BlockedAllocator(config.num_blocks)
+        self.seqs: Dict[int, DSSequenceDescriptor] = {}
+        self.max_blocks_per_seq = -(-config.max_seq_len // self.block_size)
+
+    # -- queries (reference DSStateManager.query / engine can_schedule) ----
+    def known_seq(self, uid: int) -> bool:
+        return uid in self.seqs
+
+    def get_or_create_sequence(self, uid: int) -> DSSequenceDescriptor:
+        if uid not in self.seqs:
+            if len(self.seqs) >= self.config.max_tracked_sequences:
+                raise RuntimeError(
+                    f"tracked-sequence limit "
+                    f"{self.config.max_tracked_sequences} reached")
+            self.seqs[uid] = DSSequenceDescriptor(uid=uid)
+        return self.seqs[uid]
+
+    def can_schedule(self, uid: int, new_tokens: int) -> bool:
+        seq = self.seqs.get(uid) or DSSequenceDescriptor(uid=uid)
+        if seq.seen_tokens + new_tokens > self.config.max_seq_len:
+            return False
+        if uid not in self.seqs and \
+                len(self.seqs) >= self.config.max_tracked_sequences:
+            return False
+        return seq.blocks_needed(new_tokens, self.block_size) \
+            <= self.allocator.free_blocks
+
+    # -- allocation ---------------------------------------------------------
+    def ensure_blocks(self, uid: int, new_tokens: int) -> DSSequenceDescriptor:
+        seq = self.get_or_create_sequence(uid)
+        need = seq.blocks_needed(new_tokens, self.block_size)
+        if need:
+            seq.blocks.extend(int(b) for b in self.allocator.allocate(need))
+        return seq
+
+    def flush_sequence(self, uid: int) -> None:
+        """Reference flush: return the sequence's blocks to the pool."""
+        seq = self.seqs.pop(uid, None)
+        if seq is not None:
+            self.allocator.free(seq.blocks)
+
+    # -- device metadata ----------------------------------------------------
+    def block_table_for(self, uid: int) -> np.ndarray:
+        """[max_blocks_per_seq] int32 padded with the null block."""
+        table = np.full(self.max_blocks_per_seq, NULL_BLOCK, np.int32)
+        blocks = self.seqs[uid].blocks
+        table[:len(blocks)] = blocks
+        return table
+
+    def free_blocks(self) -> int:
+        return self.allocator.free_blocks
+
+    def tracked_sequences(self) -> int:
+        return len(self.seqs)

@@ -1,0 +1,27 @@
+"""Per-sequence bookkeeping.
+
+Reference: inference/v2/ragged/sequence_descriptor.py (DSSequenceDescriptor):
+tracks a sequence's uid, how many tokens the KV cache has seen, and which
+cache blocks it owns.
+"""
+
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class DSSequenceDescriptor:
+    uid: int
+    seen_tokens: int = 0            # tokens whose KV is in the cache
+    blocks: List[int] = field(default_factory=list)
+    in_flight_tokens: int = 0       # tokens scheduled in the current batch
+
+    def blocks_needed(self, new_tokens: int, block_size: int) -> int:
+        total = self.seen_tokens + new_tokens
+        have = len(self.blocks)
+        need = -(-total // block_size)  # ceil
+        return max(0, need - have)
+
+    @property
+    def cur_allocated_tokens(self) -> int:
+        return len(self.blocks)
