@@ -63,10 +63,14 @@ def initialize(args=None,
             dp_world_size=ds_config.dp_world_size,
             collate_fn=collate_fn)
 
-    engine = DeepSpeedTpuEngine(model=model, config=ds_config,
-                                topology=topology, seed=seed,
-                                dataloader=RepeatingLoader(dataloader) if dataloader else None,
-                                lr_scheduler=lr_scheduler)
+    engine_cls = DeepSpeedTpuEngine
+    if ds_config.cfg.hybrid_engine.enabled:
+        from .runtime.hybrid_engine import DeepSpeedHybridEngine
+        engine_cls = DeepSpeedHybridEngine
+    engine = engine_cls(model=model, config=ds_config,
+                        topology=topology, seed=seed,
+                        dataloader=RepeatingLoader(dataloader) if dataloader else None,
+                        lr_scheduler=lr_scheduler)
     return engine, engine.optimizer, dataloader, engine.lr_scheduler
 
 

@@ -153,34 +153,44 @@ class InferenceEngine:
 
     def _generate_impl(self, params, ids, rng, *, max_new_tokens, temperature,
                        top_k, top_p, eos):
-        B, S = ids.shape
         params = self._deq(params)   # fused into first use; int8 at rest
-        cache = self.model.init_kv_cache(B, S + max_new_tokens, self.dtype)
-        logits, cache = self.model.forward_cached(params, ids, cache, 0)
-        last = logits[:, -1]
+        return generate_tokens(self.model, params, ids, rng, self.dtype,
+                               max_new_tokens=max_new_tokens,
+                               temperature=temperature, top_k=top_k,
+                               top_p=top_p, eos=eos)
 
-        def step(carry, i):
-            cache, last, rng, done = carry
-            rng, sub = jax.random.split(rng)
-            tok = _sample(last, sub, temperature, top_k, top_p)  # [B]
-            tok = jnp.where(done, eos if eos >= 0 else 0, tok)
-            done = done | (tok == eos)
 
-            def fwd(cache):
-                logits, cache = self.model.forward_cached(
-                    params, tok[:, None], cache, S + i)
-                return cache, logits[:, 0]
+def generate_tokens(model, params, ids, rng, dtype, *, max_new_tokens,
+                    temperature, top_k, top_p, eos):
+    """Prefill + scan decode loop shared by the v1 inference engine and the
+    hybrid (RLHF) engine. Jittable; returns [B, max_new_tokens] tokens."""
+    B, S = ids.shape
+    cache = model.init_kv_cache(B, S + max_new_tokens, dtype)
+    logits, cache = model.forward_cached(params, ids, cache, 0)
+    last = logits[:, -1]
 
-            # the final iteration's logits are never sampled: skip that
-            # forward entirely (runtime cond, not compile-time)
-            cache, nxt = jax.lax.cond(i < max_new_tokens - 1, fwd,
-                                      lambda c: (c, last), cache)
-            return (cache, nxt, rng, done), tok
+    def step(carry, i):
+        cache, last, rng, done = carry
+        rng, sub = jax.random.split(rng)
+        tok = _sample(last, sub, temperature, top_k, top_p)  # [B]
+        tok = jnp.where(done, eos if eos >= 0 else 0, tok)
+        done = done | (tok == eos)
 
-        done0 = jnp.zeros((B,), bool)
-        _, toks = jax.lax.scan(
-            step, (cache, last, rng, done0), jnp.arange(max_new_tokens))
-        return toks.T
+        def fwd(cache):
+            logits, cache = model.forward_cached(
+                params, tok[:, None], cache, S + i)
+            return cache, logits[:, 0]
+
+        # the final iteration's logits are never sampled: skip that
+        # forward entirely (runtime cond, not compile-time)
+        cache, nxt = jax.lax.cond(i < max_new_tokens - 1, fwd,
+                                  lambda c: (c, last), cache)
+        return (cache, nxt, rng, done), tok
+
+    done0 = jnp.zeros((B,), bool)
+    _, toks = jax.lax.scan(
+        step, (cache, last, rng, done0), jnp.arange(max_new_tokens))
+    return toks.T
 
 
 def _sample(logits, rng, temperature, top_k, top_p):

@@ -241,6 +241,19 @@ class ElasticityConfig:
 
 
 @dataclass
+class HybridEngineConfig:
+    """Reference: deepspeed/inference/config.py HybridEngineConfig (consumed
+    by runtime/hybrid_engine.py)."""
+
+    enabled: bool = False
+    max_out_tokens: int = 512
+    inference_tp_size: int = 1
+    release_inference_cache: bool = False
+    pin_parameters: bool = True
+    tp_gather_partition_size: int = 8
+
+
+@dataclass
 class DeepSpeedTpuConfig:
     """Top-level typed view of the JSON config.
 
@@ -279,6 +292,7 @@ class DeepSpeedTpuConfig:
     eigenvalue: EigenvalueConfig = subconfig(EigenvalueConfig)
     progressive_layer_drop: PLDConfig = subconfig(PLDConfig)
     elasticity: ElasticityConfig = subconfig(ElasticityConfig)
+    hybrid_engine: HybridEngineConfig = subconfig(HybridEngineConfig)
 
     # Parallel topology (TPU mesh axes; tp/sp are first-class here rather than
     # via an external mpu object as in the reference engine.py:94)

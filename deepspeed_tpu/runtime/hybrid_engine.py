@@ -1,0 +1,127 @@
+"""Hybrid engine: one set of weights for RLHF training AND fast generation.
+
+TPU-native analogue of the reference's DeepSpeedHybridEngine
+(runtime/hybrid_engine.py:32; generate :174, _zero3_forward :363, LoRA
+fuse/unfuse :118-160). The reference swaps module containers and gathers
+ZeRO-3 params into inference kernels before each generate; in JAX the same
+arrays back both paths for free — ``generate`` jits the KV-cache decode loop
+directly over the TRAINING params with their live shardings (XLA inserts the
+ZeRO-3 gathers where needed), and the actor's train_batch/step is inherited
+unchanged. LoRA adapters fuse into the base weights for generation and
+unfuse afterwards (pure tree transforms, no copies kept).
+"""
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..inference.engine import generate_tokens
+from ..utils.logging import log_dist
+from ..utils.timer import SynchronizedWallClockTimer
+from .engine import DeepSpeedTpuEngine
+
+
+# ---------------------------------------------------------------------------
+# LoRA fuse/unfuse (reference hybrid_engine.py _fuse_lora/_unfuse_lora):
+# any subtree shaped {"w": [in, out], "lora_a": [in, r], "lora_b": [r, out]}
+# fuses to w' = w + scale * (a @ b).
+# ---------------------------------------------------------------------------
+def _is_lora_group(node) -> bool:
+    return (isinstance(node, dict) and "w" in node and "lora_a" in node
+            and "lora_b" in node)
+
+
+def fuse_lora(params, scale: float = 1.0):
+    def walk(node):
+        if _is_lora_group(node):
+            new = dict(node)
+            new["w"] = node["w"] + scale * (
+                node["lora_a"] @ node["lora_b"]).astype(node["w"].dtype)
+            return new
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(params)
+
+
+def unfuse_lora(params, scale: float = 1.0):
+    return fuse_lora(params, -scale)
+
+
+class DeepSpeedHybridEngine(DeepSpeedTpuEngine):
+    """Training engine + inference-speed generate on shared weights."""
+
+    def __init__(self, *args, lora_scale: float = 1.0, **kwargs):
+        super().__init__(*args, **kwargs)
+        assert hasattr(self.model, "forward_cached") and \
+            hasattr(self.model, "init_kv_cache"), \
+            "hybrid engine needs a model with a KV-cache decode path " \
+            "(forward_cached/init_kv_cache)"
+        self.lora_scale = lora_scale
+        self._gen_jit_cache: Dict[Any, Any] = {}
+        self._gen_timer = SynchronizedWallClockTimer()
+        self.latency_stats = {"generate_calls": 0, "generate_seconds": 0.0,
+                              "generated_tokens": 0}
+        log_dist("hybrid engine ready (shared train/generate weights)",
+                 ranks=[0])
+
+    def _has_lora(self) -> bool:
+        found = []
+
+        def walk(node):
+            if _is_lora_group(node):
+                found.append(True)
+            elif isinstance(node, dict):
+                for v in node.values():
+                    walk(v)
+
+        walk(self.params)
+        return bool(found)
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
+                 eos_token_id: Optional[int] = None, seed: int = 0,
+                 **_kw) -> np.ndarray:
+        """Reference hybrid_engine.generate (:174): runs generation with the
+        CURRENT training weights (post-update actor), returning
+        [B, prompt+new] ids."""
+        ids = np.asarray(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None]
+        eos = -1 if eos_token_id is None else int(eos_token_id)
+        key = (ids.shape, int(max_new_tokens), float(temperature),
+               int(top_k), float(top_p), eos, self._has_lora())
+        if key not in self._gen_jit_cache:
+            fuse = self._has_lora()
+            scale = self.lora_scale
+            model, dtype = self.model, self.compute_dtype
+
+            def gen(params, ids, rng):
+                if fuse:  # fuse adapters for the decode loop only
+                    params = fuse_lora(params, scale)
+                return generate_tokens(
+                    model, params, ids, rng, dtype,
+                    max_new_tokens=int(max_new_tokens),
+                    temperature=float(temperature), top_k=int(top_k),
+                    top_p=float(top_p), eos=eos)
+
+            self._gen_jit_cache[key] = jax.jit(gen)
+        self._gen_timer("generate").start()
+        toks = self._gen_jit_cache[key](
+            self.params, jnp.asarray(ids), jax.random.PRNGKey(seed))
+        toks = np.asarray(jax.block_until_ready(toks))
+        self._gen_timer("generate").stop()
+        self.latency_stats["generate_calls"] += 1
+        self.latency_stats["generate_seconds"] += \
+            self._gen_timer("generate").elapsed(reset=True)
+        self.latency_stats["generated_tokens"] += int(toks.size)
+        return np.concatenate([ids, toks], axis=1)
+
+    def eval(self):
+        return self
+
+    def train(self, mode: bool = True):
+        return self

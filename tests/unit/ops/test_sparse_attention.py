@@ -1,0 +1,139 @@
+"""Sparse attention tests (reference tests/unit/ops/sparse_attention/
+test_sparse_attention.py: layout construction + kernel parity vs dense)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                BSLongformerSparsityConfig,
+                                                DenseSparsityConfig,
+                                                FixedSparsityConfig,
+                                                SparseSelfAttention,
+                                                VariableSparsityConfig,
+                                                sparse_attention)
+
+B, H, S, D = 2, 4, 64, 8
+BLOCK = 16
+
+
+def _qkv(seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.standard_normal((B, H, S, D)) * 0.5, jnp.float32)
+    return mk(), mk(), mk()
+
+
+def _dense_ref(q, k, v, causal):
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        scores = jnp.where(mask, scores, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd",
+                      jax.nn.softmax(scores, -1), v)
+
+
+def test_dense_layout_matches_dense_attention():
+    q, k, v = _qkv()
+    layout = DenseSparsityConfig(num_heads=H, block=BLOCK).make_layout(S)
+    assert layout.shape == (H, S // BLOCK, S // BLOCK)
+    assert layout.all()
+    for causal in (False, True):
+        out = sparse_attention(q, k, v, layout, BLOCK, causal=causal)
+        ref = _dense_ref(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_fixed_layout_structure():
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=2,
+                              num_global_blocks=1,
+                              attention="unidirectional")
+    layout = cfg.make_layout(S)
+    n = S // BLOCK
+    # causal: strictly upper-triangular blocks inactive
+    assert (np.triu(layout[0], 1) == 0).all()
+    # diagonal (own window) always active
+    assert all(layout[0, i, i] for i in range(n))
+    # global column (last block of first window = block 1) visible to later rows
+    assert layout[0, 3, 1] == 1
+    # non-window, non-global block inactive: row 3, col 0 (window [2,3])
+    assert layout[0, 3, 0] == 0
+
+
+def test_bigbird_layout_has_window_global_random():
+    cfg = BigBirdSparsityConfig(num_heads=1, block=BLOCK,
+                                num_sliding_window_blocks=3,
+                                num_global_blocks=1, num_random_blocks=1)
+    layout = cfg.make_layout(256)
+    n = 256 // BLOCK
+    # sliding window
+    for i in range(1, n - 1):
+        assert layout[0, i, i - 1] and layout[0, i, i] and layout[0, i, i + 1]
+    # global edges
+    assert layout[0, :, 0].all() and layout[0, 0, :].all()
+    assert layout[0, :, -1].all() and layout[0, -1, :].all()
+    # some sparsity remains
+    assert layout[0].mean() < 0.8
+
+
+def test_longformer_layout():
+    cfg = BSLongformerSparsityConfig(num_heads=1, block=BLOCK,
+                                     num_sliding_window_blocks=3,
+                                     global_block_indices=[0])
+    layout = cfg.make_layout(256)
+    assert layout[0, :, 0].all() and layout[0, 0, :].all()
+    assert layout[0, 5, 2] == 0  # outside window, not global
+
+
+def test_variable_layout_windows_and_random():
+    cfg = VariableSparsityConfig(num_heads=1, block=BLOCK,
+                                 local_window_blocks=[2, 1],
+                                 global_block_indices=[0],
+                                 num_random_blocks=1, seed=3)
+    layout = cfg.make_layout(256)
+    assert layout[0, 0, 1] == 1 and layout[0, 1, 0] == 1  # first window of 2
+    assert layout[0, :, 0].all()                          # global col
+
+
+def test_sparse_vs_dense_on_active_rows():
+    """With a causal fixed layout whose first window covers a row entirely,
+    that row's output equals dense causal attention."""
+    S2 = 128  # 8 blocks: two windows of 4, so later rows ARE sparse
+    rng = np.random.default_rng(1)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.standard_normal((B, H, S2, D)) * 0.5, jnp.float32)
+    q, k, v = mk(), mk(), mk()
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=4,
+                              num_global_blocks=1,
+                              attention="unidirectional")
+    attn = SparseSelfAttention(cfg)
+    out = attn(q, k, v, causal=True)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    mask = jnp.tril(jnp.ones((S2, S2), bool))
+    ref = jnp.einsum("bhqk,bhkd->bhqd",
+                     jax.nn.softmax(jnp.where(mask, scores, -1e30), -1), v)
+    # rows in the first window (blocks 0-3 cover all causal context for
+    # queries in blocks 0-3): identical to dense
+    np.testing.assert_allclose(np.asarray(out)[:, :, :4 * BLOCK],
+                               np.asarray(ref)[:, :, :4 * BLOCK],
+                               rtol=2e-4, atol=2e-5)
+    # later rows drop non-window non-global context: output differs (sparse)
+    assert np.abs(np.asarray(out)[:, :, 4 * BLOCK:]
+                  - np.asarray(ref)[:, :, 4 * BLOCK:]).max() > 1e-3
+
+
+def test_layout_cache():
+    attn = SparseSelfAttention(
+        FixedSparsityConfig(num_heads=H, block=BLOCK))
+    l1 = attn.get_layout(S)
+    l2 = attn.get_layout(S)
+    assert l1 is l2
+
+
+def test_indivisible_seq_raises():
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK)
+    with pytest.raises(ValueError, match="divisible"):
+        cfg.make_layout(S + 3)

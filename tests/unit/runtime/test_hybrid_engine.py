@@ -1,0 +1,96 @@
+"""Hybrid engine tests (reference tests/unit/hybrid_engine/: generate after
+train step with shared weights; LoRA fuse/unfuse)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+from deepspeed_tpu.runtime.hybrid_engine import (DeepSpeedHybridEngine,
+                                                 fuse_lora, unfuse_lora)
+
+
+def _cfg():
+    return TransformerConfig(vocab_size=64, hidden_size=32,
+                             intermediate_size=64, num_layers=2, num_heads=4,
+                             max_seq_len=64, remat=False, use_flash=False)
+
+
+def _engine():
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "hybrid_engine": {"enabled": True, "max_out_tokens": 64},
+        "steps_per_print": 10**9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=TransformerLM(_cfg()),
+                                               config=config)
+    return engine
+
+
+def _batch(engine, seq=16, seed=0):
+    micro = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(
+        0, 64, (engine.gas, micro, seq), dtype=np.int64)}
+
+
+def test_initialize_returns_hybrid_engine():
+    engine = _engine()
+    assert isinstance(engine, DeepSpeedHybridEngine)
+
+
+def test_generate_uses_current_training_weights():
+    engine = _engine()
+    prompt = np.array([[3, 5, 7, 9]])
+    out0 = engine.generate(prompt, max_new_tokens=5)
+    assert out0.shape == (1, 9)
+    # weights change after training -> generation distribution changes with
+    # them (shared storage, no stale copy)
+    for _ in range(3):
+        engine.train_batch(batch=_batch(engine))
+    out1 = engine.generate(prompt, max_new_tokens=5)
+    assert out1.shape == (1, 9)
+    stats = engine.latency_stats
+    assert stats["generate_calls"] == 2 and stats["generated_tokens"] == 10
+    # training still works after generation (reference train->generate->train)
+    loss = engine.train_batch(batch=_batch(engine, seed=1))
+    assert np.isfinite(loss)
+
+
+def test_generate_determinism_greedy():
+    engine = _engine()
+    prompt = np.array([[2, 4, 6]])
+    a = engine.generate(prompt, max_new_tokens=6, temperature=0.0)
+    b = engine.generate(prompt, max_new_tokens=6, temperature=0.0)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_lora_fuse_unfuse_roundtrip():
+    rng = np.random.default_rng(0)
+    params = {"layer": {"proj": {
+        "w": jnp.asarray(rng.standard_normal((8, 8)), jnp.float32),
+        "lora_a": jnp.asarray(rng.standard_normal((8, 2)) * 0.1, jnp.float32),
+        "lora_b": jnp.asarray(rng.standard_normal((2, 8)) * 0.1, jnp.float32),
+    }}, "other": jnp.ones((3,))}
+    fused = fuse_lora(params, scale=2.0)
+    expected = np.asarray(params["layer"]["proj"]["w"]) + 2.0 * (
+        np.asarray(params["layer"]["proj"]["lora_a"])
+        @ np.asarray(params["layer"]["proj"]["lora_b"]))
+    np.testing.assert_allclose(np.asarray(fused["layer"]["proj"]["w"]),
+                               expected, rtol=1e-6)
+    # adapters untouched; unfuse restores the base weight
+    np.testing.assert_array_equal(np.asarray(fused["layer"]["proj"]["lora_a"]),
+                                  np.asarray(params["layer"]["proj"]["lora_a"]))
+    restored = unfuse_lora(fused, scale=2.0)
+    np.testing.assert_allclose(np.asarray(restored["layer"]["proj"]["w"]),
+                               np.asarray(params["layer"]["proj"]["w"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(restored["other"]),
+                                  np.asarray(params["other"]))
