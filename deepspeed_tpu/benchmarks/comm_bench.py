@@ -1,0 +1,89 @@
+"""Collective-communication micro-benchmark (ds_bench).
+
+Reference: bin/ds_bench + benchmarks/communication/ — sweep message sizes
+over allreduce/allgather/reduce-scatter/all-to-all and report latency plus
+algorithmic and bus bandwidth (utils/comms_logging.py:34 calc_bw_log math).
+
+CLI: python -m deepspeed_tpu.benchmarks.comm_bench [--ops all_reduce ...]
+     [--maxsize 2**26] [--trials 20] [--mesh-axis data]
+"""
+
+import argparse
+import time
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..comm.quantized import shard_map_unchecked
+from ..utils.comms_logging import calc_bw_log
+
+
+def _collective_fn(op: str, axis: str):
+    if op == "all_reduce":
+        return lambda x: jax.lax.psum(x, axis)
+    if op == "all_gather":
+        return lambda x: jax.lax.all_gather(x, axis, tiled=True)
+    if op == "reduce_scatter":
+        return lambda x: jax.lax.psum_scatter(x, axis, tiled=True)
+    if op == "all_to_all":
+        return lambda x: jax.lax.all_to_all(
+            x.reshape(jax.lax.axis_size(axis), -1), axis, 0, 0,
+            tiled=False).reshape(-1)
+    raise ValueError(f"unknown op {op}")
+
+
+def run_op(op: str, size_bytes: int, trials: int = 20, warmups: int = 3,
+           axis: str = "data", dtype=jnp.bfloat16) -> Dict[str, float]:
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    n = jax.device_count()
+    mesh = Mesh(np.asarray(jax.devices()), (axis,))
+    elems = max(n * 8, size_bytes // np.dtype(dtype).itemsize)
+    elems = (elems // (n * 8)) * (n * 8)
+    x = jnp.ones((elems,), dtype)
+    fn = shard_map_unchecked(_collective_fn(op, axis), mesh,
+                             in_specs=P(axis), out_specs=P(axis)
+                             if op in ("reduce_scatter",) else P(axis))
+    jfn = jax.jit(fn)
+    for _ in range(warmups):
+        jax.block_until_ready(jfn(x))
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        out = jfn(x)
+    jax.block_until_ready(out)
+    lat = (time.perf_counter() - t0) / trials
+    algbw, busbw = calc_bw_log(op, elems * np.dtype(dtype).itemsize, lat, n)
+    return {"op": op, "bytes": elems * np.dtype(dtype).itemsize,
+            "latency_us": lat * 1e6, "algbw_gbps": algbw, "busbw_gbps": busbw}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--ops", nargs="+", default=["all_reduce", "all_gather",
+                                                "reduce_scatter",
+                                                "all_to_all"])
+    p.add_argument("--maxsize", type=int, default=24,
+                   help="max message size as a power of two (default 2^24)")
+    p.add_argument("--minsize", type=int, default=12)
+    p.add_argument("--trials", type=int, default=20)
+    p.add_argument("--mesh-axis", default="data")
+    args = p.parse_args(argv)
+    print(f"devices: {jax.device_count()} x "
+          f"{getattr(jax.devices()[0], 'device_kind', '?')}")
+    header = f"{'op':>16} {'size':>12} {'lat(us)':>10} " \
+             f"{'algbw(GB/s)':>12} {'busbw(GB/s)':>12}"
+    print(header)
+    rows: List[Dict] = []
+    for op in args.ops:
+        for pw in range(args.minsize, args.maxsize + 1, 2):
+            r = run_op(op, 1 << pw, trials=args.trials, axis=args.mesh_axis)
+            rows.append(r)
+            print(f"{r['op']:>16} {r['bytes']:>12} {r['latency_us']:>10.1f} "
+                  f"{r['algbw_gbps']:>12.2f} {r['busbw_gbps']:>12.2f}")
+    return rows
+
+
+if __name__ == "__main__":
+    main()

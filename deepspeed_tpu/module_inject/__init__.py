@@ -1,0 +1,2 @@
+from .auto_tp import (config_from_hf, load_hf_model,  # noqa: F401
+                      params_from_hf, replace_transformer_layer)

@@ -1,0 +1,167 @@
+"""HF-model injection: HuggingFace checkpoints -> TPU model family.
+
+TPU-native analogue of the reference's module_inject stack
+(replace_transformer_layer module_inject/replace_module.py:182; AutoTP
+auto_tp.py:175 with tp_parser :259; per-arch policy containers in
+module_inject/containers/). The reference swaps HF torch modules for fused
+CUDA kernels and shards Linear layers by parsing module names. Here the
+same job is a weight-format conversion: an HF model (or its state dict)
+maps onto the TransformerLM family (models/transformer.py), whose partition
+specs already carry the AutoTP column/row sharding — loading the converted
+params under a "model" mesh axis IS tensor-parallel injection.
+
+Supported architectures (reference policy containers): LlamaForCausalLM /
+MistralForCausalLM (RMSNorm+RoPE+SwiGLU+GQA) and GPT2LMHeadModel
+(LayerNorm+learned positions+GELU). torch weights are consumed as numpy;
+torch never touches the device path.
+"""
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..models.transformer import TransformerConfig, TransformerLM
+from ..utils.logging import logger
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        return t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+# ---------------------------------------------------------------------------
+# Config mapping (reference containers read the same HF config fields)
+# ---------------------------------------------------------------------------
+def config_from_hf(hf_config) -> TransformerConfig:
+    mt = getattr(hf_config, "model_type", "llama")
+    if mt in ("llama", "mistral"):
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+            max_seq_len=getattr(hf_config, "max_position_embeddings", 2048),
+            norm="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+            activation="swiglu", positional="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        )
+    if mt == "gpt2":
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.n_embd,
+            intermediate_size=4 * hf_config.n_embd,
+            num_layers=hf_config.n_layer,
+            num_heads=hf_config.n_head,
+            max_seq_len=hf_config.n_positions,
+            norm="layernorm", norm_eps=hf_config.layer_norm_epsilon,
+            activation="gelu", positional="learned", tie_embeddings=True,
+        )
+    raise ValueError(
+        f"unsupported model_type '{mt}'; supported: llama, mistral, gpt2 "
+        f"(add a mapping here the way the reference adds policy containers)")
+
+
+# ---------------------------------------------------------------------------
+# Weight mapping
+# ---------------------------------------------------------------------------
+def _stack(sd: Dict[str, np.ndarray], fmt: str, L: int,
+           transpose: bool = False) -> np.ndarray:
+    mats = [sd[fmt.format(i)] for i in range(L)]
+    out = np.stack([m.T if transpose else m for m in mats])
+    return np.ascontiguousarray(out, np.float32)
+
+
+def _params_from_llama(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+    L = cfg.num_layers
+    p = "model.layers.{}."
+    layers = {
+        "attn_norm": _stack(sd, p + "input_layernorm.weight", L),
+        "wq": _stack(sd, p + "self_attn.q_proj.weight", L, transpose=True),
+        "wk": _stack(sd, p + "self_attn.k_proj.weight", L, transpose=True),
+        "wv": _stack(sd, p + "self_attn.v_proj.weight", L, transpose=True),
+        "wo": _stack(sd, p + "self_attn.o_proj.weight", L, transpose=True),
+        "mlp_norm": _stack(sd, p + "post_attention_layernorm.weight", L),
+        "w_gate": _stack(sd, p + "mlp.gate_proj.weight", L, transpose=True),
+        "w_up": _stack(sd, p + "mlp.up_proj.weight", L, transpose=True),
+        "w_down": _stack(sd, p + "mlp.down_proj.weight", L, transpose=True),
+    }
+    params = {
+        "embed": np.ascontiguousarray(sd["model.embed_tokens.weight"],
+                                      np.float32),
+        "layers": layers,
+        "final_norm": np.ascontiguousarray(sd["model.norm.weight"],
+                                           np.float32),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = np.ascontiguousarray(sd["lm_head.weight"].T,
+                                                 np.float32)
+    return params
+
+
+def _params_from_gpt2(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+    L, h = cfg.num_layers, cfg.hidden_size
+    p = "transformer.h.{}."
+    # GPT2 Conv1D weights are already [in, out]; c_attn fuses qkv on out dim
+    c_attn = np.stack([sd[(p + "attn.c_attn.weight").format(i)]
+                       for i in range(L)]).astype(np.float32)
+    layers = {
+        "attn_norm": _stack(sd, p + "ln_1.weight", L),
+        "attn_norm_b": _stack(sd, p + "ln_1.bias", L),
+        "wq": np.ascontiguousarray(c_attn[:, :, :h]),
+        "wk": np.ascontiguousarray(c_attn[:, :, h:2 * h]),
+        "wv": np.ascontiguousarray(c_attn[:, :, 2 * h:]),
+        "wo": _stack(sd, p + "attn.c_proj.weight", L),
+        "mlp_norm": _stack(sd, p + "ln_2.weight", L),
+        "mlp_norm_b": _stack(sd, p + "ln_2.bias", L),
+        "w_up": _stack(sd, p + "mlp.c_fc.weight", L),
+        "b_up": _stack(sd, p + "mlp.c_fc.bias", L),
+        "w_down": _stack(sd, p + "mlp.c_proj.weight", L),
+        "b_down": _stack(sd, p + "mlp.c_proj.bias", L),
+    }
+    return {
+        "embed": np.ascontiguousarray(sd["transformer.wte.weight"],
+                                      np.float32),
+        "pos_embed": np.ascontiguousarray(sd["transformer.wpe.weight"],
+                                          np.float32),
+        "layers": layers,
+        "final_norm": np.ascontiguousarray(sd["transformer.ln_f.weight"],
+                                           np.float32),
+        "final_norm_b": np.ascontiguousarray(sd["transformer.ln_f.bias"],
+                                             np.float32),
+    }
+
+
+def params_from_hf(state_dict: Dict[str, Any],
+                   cfg: TransformerConfig,
+                   model_type: str = "llama") -> Dict[str, Any]:
+    """Convert an HF state dict (torch tensors or numpy) to the TransformerLM
+    parameter tree (fp32 host arrays; the engine casts/shards on load)."""
+    sd = {k: _np(v) for k, v in state_dict.items()}
+    if model_type in ("llama", "mistral"):
+        return _params_from_llama(sd, cfg)
+    if model_type == "gpt2":
+        return _params_from_gpt2(sd, cfg)
+    raise ValueError(f"unsupported model_type '{model_type}'")
+
+
+def load_hf_model(hf_model) -> Tuple[TransformerLM, Dict[str, Any]]:
+    """One-call injection (reference replace_transformer_layer entry): HF
+    torch model -> (TransformerLM, params)."""
+    cfg = config_from_hf(hf_model.config)
+    params = params_from_hf(hf_model.state_dict(), cfg,
+                            hf_model.config.model_type)
+    logger.info(f"injected HF {hf_model.config.model_type} "
+                f"({cfg.num_layers}L, {cfg.hidden_size}H) into TransformerLM")
+    return TransformerLM(cfg), params
+
+
+def replace_transformer_layer(orig_layer_impl=None, model=None,
+                              checkpoint_dict=None, config=None,
+                              model_config=None):
+    """Reference-compat signature (replace_module.py:182): returns the
+    converted (TransformerLM, params) for `model`."""
+    return load_hf_model(model)

@@ -1,0 +1,2 @@
+from .checkpoint_engine import (AsyncCheckpointEngine,  # noqa: F401
+                                CheckpointEngine, NativeCheckpointEngine)

@@ -1,0 +1,123 @@
+"""Pluggable checkpoint IO engines.
+
+Reference: runtime/checkpoint_engine/checkpoint_engine.py:9 (CheckpointEngine
+ABC: create/save/load/commit) with TorchCheckpointEngine (sync torch.save)
+and NebulaCheckpointEngine (async service). TPU-native counterparts:
+
+  * NativeCheckpointEngine — synchronous .npy/json via numpy (the format of
+    checkpoint/state_checkpoint.py).
+  * AsyncCheckpointEngine — same format, but save() snapshots to host and
+    writes on a background thread; commit() joins. Plays Nebula's role
+    (training continues while the previous checkpoint persists).
+"""
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ...utils.logging import logger
+
+
+class CheckpointEngine:
+    """Reference ABC (checkpoint_engine.py:9)."""
+
+    def __init__(self, config_params=None):
+        self.config = config_params
+
+    def create(self, tag: str):
+        """Signal start of a new checkpoint under `tag`."""
+
+    def makedirs(self, path, exist_ok=False):
+        os.makedirs(path, exist_ok=exist_ok)
+
+    def save(self, state_dict: Dict[str, Any], path: str):
+        raise NotImplementedError
+
+    def load(self, path: str, map_location=None) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def commit(self, tag: str) -> bool:
+        """Durability barrier: all saves for `tag` are complete."""
+        return True
+
+
+def _flatten(d: Dict[str, Any], prefix: str = ""):
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            yield from _flatten(v, key + "/")
+        else:
+            yield key, v
+
+
+def _unflatten(flat: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        cur = out
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = v
+    return out
+
+
+class NativeCheckpointEngine(CheckpointEngine):
+    """Synchronous engine (reference TorchCheckpointEngine): a state dict of
+    (nested) arrays -> one .npz + json sidecar for non-array leaves."""
+
+    def save(self, state_dict: Dict[str, Any], path: str):
+        arrays, meta = {}, {}
+        for key, v in _flatten(state_dict):
+            if hasattr(v, "shape"):
+                arrays[key] = np.asarray(v)
+            else:
+                meta[key] = v
+        np.savez(path, **arrays)
+        with open(path + ".meta.json", "w") as fh:
+            json.dump(meta, fh, default=str)
+        logger.info(f"[NativeCheckpointEngine] saved {path}")
+
+    def load(self, path: str, map_location=None) -> Dict[str, Any]:
+        flat: Dict[str, Any] = {}
+        with np.load(path if path.endswith(".npz") else path + ".npz",
+                     allow_pickle=False) as arc:
+            for key in arc.files:
+                flat[key] = arc[key]
+        meta_path = (path[:-4] if path.endswith(".npz") else path) \
+            + ".meta.json"
+        if not os.path.exists(meta_path):
+            meta_path = path + ".meta.json"
+        if os.path.exists(meta_path):
+            with open(meta_path) as fh:
+                flat.update(json.load(fh))
+        return _unflatten(flat)
+
+
+class AsyncCheckpointEngine(NativeCheckpointEngine):
+    """Background-thread writes (reference NebulaCheckpointEngine's role):
+    save() returns immediately after snapshotting to host memory."""
+
+    def __init__(self, config_params=None):
+        super().__init__(config_params)
+        self._pending: List[threading.Thread] = []
+
+    def save(self, state_dict: Dict[str, Any], path: str):
+        snapshot = {k: (np.asarray(v).copy() if hasattr(v, "shape") else v)
+                    for k, v in _flatten(state_dict)}
+
+        def write():
+            NativeCheckpointEngine.save(self, _unflatten(snapshot), path)
+
+        t = threading.Thread(target=write, daemon=True)
+        t.start()
+        self._pending.append(t)
+
+    def commit(self, tag: str) -> bool:
+        for t in self._pending:
+            t.join()
+        self._pending.clear()
+        logger.info(f"[AsyncCheckpointEngine] committed {tag}")
+        return True

@@ -1,0 +1,59 @@
+"""Hessian top-eigenvalue estimation by power iteration.
+
+Reference: runtime/eigenvalue.py (Eigenvalue, used by MoQ — mixed-precision
+quantization schedules keyed on layer curvature). The torch version
+differentiates twice through retained graphs; in JAX the Hessian-vector
+product is ``jvp of grad`` — exact, no graph bookkeeping.
+"""
+
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+
+
+def _normalize(tree):
+    norm = jnp.sqrt(sum(jnp.sum(jnp.square(l))
+                        for l in jax.tree.leaves(tree)))
+    norm = jnp.maximum(norm, 1e-12)
+    return jax.tree.map(lambda l: l / norm, tree), norm
+
+
+class Eigenvalue:
+    def __init__(self, verbose: bool = False, max_iter: int = 100,
+                 tol: float = 1e-2, stability: float = 1e-6,
+                 gas_boundary_resolution: int = 1,
+                 layer_name: str = "", layer_num: int = 0):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+
+    def compute_eigenvalue(self, loss_fn: Callable, params, rng
+                           ) -> Tuple[float, Dict]:
+        """Top |eigenvalue| of d2(loss)/d(params)2 via power iteration.
+        loss_fn(params) -> scalar. Returns (eigenvalue, final vector)."""
+        grad_fn = jax.grad(loss_fn)
+
+        def hvp(v):
+            return jax.jvp(grad_fn, (params,), (v,))[1]
+
+        hvp_jit = jax.jit(hvp)
+        v = jax.tree.map(
+            lambda l: jax.random.normal(rng, l.shape, jnp.float32), params)
+        v, _ = _normalize(v)
+        eig = 0.0
+        for it in range(self.max_iter):
+            hv = hvp_jit(v)
+            v, norm = _normalize(hv)
+            new_eig = float(norm)
+            if self.verbose:
+                logger.info(f"power iteration {it}: eigenvalue ~ {new_eig:.6f}")
+            if abs(new_eig - eig) <= self.tol * max(abs(new_eig), 1e-12):
+                eig = new_eig
+                break
+            eig = new_eig
+        return max(eig, self.stability), v

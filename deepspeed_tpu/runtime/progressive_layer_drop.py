@@ -1,0 +1,55 @@
+"""Progressive layer drop (PLD).
+
+Reference: runtime/progressive_layer_drop.py (ProgressiveLayerDrop): the
+keep probability theta(t) = (1 - theta_bar) * exp(-gamma * t) + theta_bar
+anneals from 1 toward `theta`; deeper layers drop more aggressively
+(keep_i = 1 - (i/L) * (1 - theta(t)), the PLD paper's depth scaling).
+
+Model integration is functional: ``layer_keep_probs`` gives per-layer keep
+probabilities for a step, and ``apply_layer_drop`` wraps a scanned layer
+body with the stochastic bypass (identity when dropped, output scaled by
+1/keep when kept so expectations match at eval).
+"""
+
+import math
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    """Reference API: pld.update_state(global_step); pld.get_theta()."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = ((1.0 - self.theta)
+                              * math.exp(-self.gamma * global_step)
+                              + self.theta)
+        return self.current_theta
+
+
+def layer_keep_probs(theta, num_layers: int) -> jnp.ndarray:
+    """[L] keep probability per layer: shallow layers keep more."""
+    i = jnp.arange(1, num_layers + 1, dtype=jnp.float32)
+    return 1.0 - (i / num_layers) * (1.0 - theta)
+
+
+def apply_layer_drop(layer_fn: Callable, x, rng, keep_prob):
+    """Stochastic depth for one layer: bypass with prob (1-keep), rescale
+    the residual branch by 1/keep when kept (inverted-dropout convention so
+    eval needs no rescaling)."""
+    keep = jax.random.bernoulli(rng, keep_prob)
+    out = layer_fn(x)
+    scaled = x + (out - x) / jnp.maximum(keep_prob, 1e-3)
+    return jnp.where(keep, scaled, x)

@@ -1,0 +1,68 @@
+"""Sparse gradient container.
+
+Reference: runtime/sparse_tensor.py (SparseTensor) — used for embedding
+gradient sparsification (config `sparse_gradients`). COO (indices, values)
+over the leading dimension, with dense round-trip and the add/scale ops the
+engine's reduction path needs. On TPU the collectives run dense (XLA), so
+the value here is host-side compression of optimizer-state updates and
+top-k gradient sparsification utilities.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SparseTensor:
+    """Rows-sparse tensor: values [nnz, ...dims], indices [nnz] into dim 0."""
+
+    def __init__(self, indices: jnp.ndarray, values: jnp.ndarray,
+                 dense_size: Tuple[int, ...]):
+        self.indices = indices
+        self.values = values
+        self.dense_size = tuple(dense_size)
+
+    @classmethod
+    def from_dense(cls, dense: jnp.ndarray) -> "SparseTensor":
+        row_nonzero = jnp.any(dense != 0, axis=tuple(range(1, dense.ndim)))
+        idx = jnp.nonzero(row_nonzero)[0]
+        return cls(idx, dense[idx], dense.shape)
+
+    def to_dense(self) -> jnp.ndarray:
+        out = jnp.zeros(self.dense_size, self.values.dtype)
+        return out.at[self.indices].set(self.values)
+
+    def to_coo_tensor(self):
+        return self.indices, self.values, self.dense_size
+
+    @property
+    def nnz_rows(self) -> int:
+        return int(self.indices.shape[0])
+
+    def scale(self, factor) -> "SparseTensor":
+        return SparseTensor(self.indices, self.values * factor,
+                            self.dense_size)
+
+    def add(self, other: "SparseTensor") -> "SparseTensor":
+        assert self.dense_size == other.dense_size
+        dense = self.to_dense().at[other.indices].add(other.values)
+        return SparseTensor.from_dense(dense)
+
+    def sparse_size(self) -> int:
+        return int(self.values.size + self.indices.size)
+
+    def __str__(self):
+        return (f"SparseTensor(rows={self.nnz_rows}/{self.dense_size[0]}, "
+                f"shape={self.dense_size})")
+
+
+def topk_sparsify(dense: jnp.ndarray, density: float) -> SparseTensor:
+    """Keep the top `density` fraction of rows by L2 norm (gradient
+    sparsification for embedding tables)."""
+    rows = dense.shape[0]
+    k = max(1, int(round(rows * density)))
+    norms = jnp.sqrt(jnp.sum(jnp.square(dense.reshape(rows, -1)), axis=1))
+    _, idx = jax.lax.top_k(norms, k)
+    idx = jnp.sort(idx)
+    return SparseTensor(idx, dense[idx], dense.shape)

@@ -1,0 +1,120 @@
+"""Tests for the small runtime subsystems: progressive layer drop,
+eigenvalue estimation, sparse tensors, checkpoint engines
+(reference tests/unit/runtime/test_pld.py, test_sparse_grads.py,
+tests/unit/checkpoint engine coverage)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.progressive_layer_drop import (
+    ProgressiveLayerDrop, apply_layer_drop, layer_keep_probs)
+from deepspeed_tpu.runtime.sparse_tensor import SparseTensor, topk_sparsify
+from deepspeed_tpu.runtime.checkpoint_engine import (AsyncCheckpointEngine,
+                                                     NativeCheckpointEngine)
+
+
+# -- progressive layer drop ------------------------------------------------
+def test_pld_theta_anneals():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    vals = [pld.update_state(t) for t in (0, 100, 1000, 100000)]
+    assert vals[0] == pytest.approx(1.0)
+    assert all(a >= b for a, b in zip(vals, vals[1:]))
+    assert vals[-1] == pytest.approx(0.5, abs=1e-3)
+    assert pld.get_state()["progressive_layer_drop"] is True
+
+
+def test_layer_keep_probs_depth_scaled():
+    probs = np.asarray(layer_keep_probs(0.6, 4))
+    assert probs[0] > probs[-1]
+    assert probs[-1] == pytest.approx(0.6)
+
+
+def test_apply_layer_drop_expectation():
+    x = jnp.ones((4, 8))
+    fn = lambda t: t * 3.0  # noqa: E731
+    # keep_prob=1: always the layer output (scaled path = exact)
+    out = apply_layer_drop(fn, x, jax.random.PRNGKey(0), 1.0)
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+    # keep_prob=tiny: essentially always bypassed
+    outs = [apply_layer_drop(fn, x, jax.random.PRNGKey(s), 1e-4)
+            for s in range(5)]
+    assert any(np.allclose(np.asarray(o), 1.0) for o in outs)
+
+
+# -- eigenvalue -------------------------------------------------------------
+def test_eigenvalue_power_iteration_quadratic():
+    # loss = 0.5 x^T A x with known top eigenvalue
+    A = jnp.diag(jnp.asarray([5.0, 2.0, 1.0]))
+
+    def loss(params):
+        x = params["x"]
+        return 0.5 * x @ A @ x
+
+    eig, _ = Eigenvalue(max_iter=200, tol=1e-4).compute_eigenvalue(
+        loss, {"x": jnp.ones((3,))}, jax.random.PRNGKey(0))
+    assert eig == pytest.approx(5.0, rel=1e-2)
+
+
+# -- sparse tensors ---------------------------------------------------------
+def test_sparse_tensor_roundtrip_and_add():
+    dense = jnp.zeros((6, 4)).at[1].set(2.0).at[4].set(-1.0)
+    st = SparseTensor.from_dense(dense)
+    assert st.nnz_rows == 2
+    np.testing.assert_array_equal(np.asarray(st.to_dense()),
+                                  np.asarray(dense))
+    other = SparseTensor.from_dense(jnp.zeros((6, 4)).at[1].set(1.0))
+    merged = st.add(other)
+    assert np.asarray(merged.to_dense())[1, 0] == 3.0
+    scaled = st.scale(0.5)
+    assert np.asarray(scaled.to_dense())[1, 0] == 1.0
+
+
+def test_topk_sparsify():
+    rng = np.random.default_rng(0)
+    dense = jnp.asarray(rng.standard_normal((10, 3)), jnp.float32)
+    st = topk_sparsify(dense, 0.3)
+    assert st.nnz_rows == 3
+    norms = np.linalg.norm(np.asarray(dense), axis=1)
+    top3 = set(np.argsort(norms)[-3:])
+    assert set(np.asarray(st.indices).tolist()) == top3
+
+
+# -- checkpoint engines -----------------------------------------------------
+def _state():
+    return {"model": {"w": np.arange(6, np.float32).reshape(2, 3)
+                      if False else np.arange(6, dtype=np.float32).reshape(2, 3)},
+            "step": 7, "tag": "x"}
+
+
+def test_native_checkpoint_engine_roundtrip(tmp_path):
+    eng = NativeCheckpointEngine()
+    path = str(tmp_path / "ck.npz")
+    eng.save(_state(), path)
+    assert eng.commit("tag")
+    loaded = eng.load(path)
+    np.testing.assert_array_equal(loaded["model"]["w"],
+                                  _state()["model"]["w"])
+    assert int(loaded["step"]) == 7
+
+
+def test_async_checkpoint_engine_commit_barrier(tmp_path):
+    eng = AsyncCheckpointEngine()
+    path = str(tmp_path / "ck_async.npz")
+    eng.save(_state(), path)
+    assert eng.commit("tag")  # joins the writer thread
+    loaded = eng.load(path)
+    np.testing.assert_array_equal(loaded["model"]["w"],
+                                  _state()["model"]["w"])
+
+
+# -- comm bench math --------------------------------------------------------
+def test_comm_bench_single_device_smoke():
+    from deepspeed_tpu.benchmarks.comm_bench import run_op
+
+    r = run_op("all_reduce", 1 << 14, trials=2, warmups=1)
+    assert r["latency_us"] > 0 and r["algbw_gbps"] > 0
