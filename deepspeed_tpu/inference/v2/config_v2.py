@@ -28,6 +28,7 @@ class RaggedInferenceEngineConfig:
     tensor_parallel_size: int = 1
     dtype: str = "bfloat16"
     prefill_bucket: int = 64                 # prompt lengths pad to multiples
+    use_paged_kernel: bool = True            # Pallas decode attention kernel
     seed: int = 0
 
     @classmethod

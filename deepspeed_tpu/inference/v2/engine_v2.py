@@ -76,7 +76,8 @@ class InferenceEngineV2:
                                             sm.block_size, self.dtype)
         self._decode_jit = jax.jit(
             lambda p, t, pos, bt, c, a: paged_decode(
-                cfg, p, t, pos, bt, c, a, sm.block_size),
+                cfg, p, t, pos, bt, c, a, sm.block_size,
+                use_kernel=config.use_paged_kernel),
             donate_argnums=(4,))
         self._prefill_jit = jax.jit(
             lambda p, ids, n, c, b, o: paged_prefill(cfg, p, ids, n, c, b, o),

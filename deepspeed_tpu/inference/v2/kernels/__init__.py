@@ -1,0 +1,1 @@
+from .paged_attention import paged_attention  # noqa: F401

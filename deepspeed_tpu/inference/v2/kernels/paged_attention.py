@@ -1,0 +1,113 @@
+"""Paged (blocked-KV) decode attention — Pallas TPU kernel.
+
+TPU-native equivalent of the reference's blocked flash attention for ragged
+decode (inference/v2/kernels/ragged_ops/blocked_flash/ + the CUDA paged-KV
+gather). One query token per sequence attends over its block table: the
+kernel walks the table with scalar-prefetched indices, streaming each KV
+block from HBM into VMEM exactly once — no [N, max_ctx, ...] gather is ever
+materialized (the jnp fallback in paged_model.py does materialize it, which
+is why this kernel is the serving hot path).
+
+Grid (N, kv_heads, max_blocks): TPU grids run sequentially over the last
+axis, so online-softmax state for one (sequence, kv head) lives in VMEM
+scratch across its page steps. GQA handled by blocking queries per kv head
+(group = nh // kvh rows). Pages past a sequence's length are skipped via
+pl.when; position masking handles the partial last page.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+            acc_sc, m_sc, l_sc, *, bs, n_pages, scale):
+    n = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    length = len_ref[n]
+
+    @pl.when(j * bs < length)
+    def _body():
+        q = q_ref[0, 0].astype(jnp.float32)           # (group, hd)
+        k = k_ref[0, :, 0].astype(jnp.float32)        # (bs, hd)
+        v = v_ref[0, :, 0].astype(jnp.float32)        # (bs, hd)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(pos < length, s, NEG_INF)
+        m_prev = m_sc[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_sc[:] = jnp.broadcast_to(
+            l_sc[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
+            l_sc.shape)
+        acc_sc[:] = acc_sc[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+
+    @pl.when(j == n_pages - 1)
+    def _finish():
+        l = l_sc[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                    v_cache: jnp.ndarray, block_tables: jnp.ndarray,
+                    lengths: jnp.ndarray) -> jnp.ndarray:
+    """q [N, nh, hd]; k/v_cache [nb, bs, kvh, hd]; block_tables [N, MB]
+    int32; lengths [N] (valid tokens incl. the current one).
+    Returns [N, nh, hd]."""
+    N, nh, hd = q.shape
+    nb, bs, kvh, _ = k_cache.shape
+    MB = block_tables.shape[1]
+    group = nh // kvh
+    scale = 1.0 / (hd ** 0.5)
+    q4 = q.reshape(N, kvh, group, hd)
+
+    kernel = functools.partial(_kernel, bs=bs, n_pages=MB, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(N, kvh, MB),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, hd),
+                         lambda n, h, j, bt, ln: (n, h, 0, 0)),
+            pl.BlockSpec((1, bs, 1, hd),
+                         lambda n, h, j, bt, ln: (bt[n, j], 0, h, 0)),
+            pl.BlockSpec((1, bs, 1, hd),
+                         lambda n, h, j, bt, ln: (bt[n, j], 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, hd),
+                               lambda n, h, j, bt, ln: (n, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, hd), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((group, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((N, kvh, group, hd), q.dtype),
+        interpret=_interpret(),
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q4, k_cache, v_cache)
+    return out.reshape(N, nh, hd)

@@ -136,11 +136,13 @@ def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
 def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
                  pos: jnp.ndarray, block_tables: jnp.ndarray,
                  cache: Dict[str, jnp.ndarray], active: jnp.ndarray,
-                 block_size: int
+                 block_size: int, use_kernel: bool = True
                  ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """toks/pos/active [N]; block_tables [N, MB]. One token per sequence;
     returns ([N, V] logits, cache). Inactive rows write to the null block
-    and produce garbage logits (masked by the caller)."""
+    and produce garbage logits (masked by the caller). ``use_kernel`` runs
+    the Pallas paged-attention kernel (kernels/paged_attention.py) instead
+    of the materializing gather fallback."""
     N, MB = block_tables.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
     ctx = MB * block_size
@@ -167,17 +169,22 @@ def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
             k = _rotate(k, cos[:, None], sin[:, None])
         kc = kc.at[l, blk, off].set(k.astype(kc.dtype))
         vc = vc.at[l, blk, off].set(v.astype(vc.dtype))
-        # gather this sequence's pages: [N, MB, bs, nkv, hd] -> [N, ctx, ...]
-        kpages = kc[l][block_tables].reshape(N, ctx, nkv, hd)
-        vpages = vc[l][block_tables].reshape(N, ctx, nkv, hd)
-        if nkv != nh:
-            kpages = jnp.repeat(kpages, nh // nkv, axis=2)
-            vpages = jnp.repeat(vpages, nh // nkv, axis=2)
-        scores = jnp.einsum("nhd,nchd->nhc", q, kpages).astype(jnp.float32)
-        scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
-        scores = jnp.where(attn_mask[:, None, :], scores, NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        o = jnp.einsum("nhc,nchd->nhd", probs, vpages).reshape(N, nh * hd)
+        if use_kernel:
+            from .kernels.paged_attention import paged_attention
+            o = paged_attention(q, kc[l], vc[l], block_tables,
+                                pos + 1).reshape(N, nh * hd)
+        else:
+            # gather this sequence's pages: [N, MB, bs, nkv, hd] -> [N, ctx, ..]
+            kpages = kc[l][block_tables].reshape(N, ctx, nkv, hd)
+            vpages = vc[l][block_tables].reshape(N, ctx, nkv, hd)
+            if nkv != nh:
+                kpages = jnp.repeat(kpages, nh // nkv, axis=2)
+                vpages = jnp.repeat(vpages, nh // nkv, axis=2)
+            scores = jnp.einsum("nhd,nchd->nhc", q, kpages).astype(jnp.float32)
+            scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+            scores = jnp.where(attn_mask[:, None, :], scores, NEG_INF)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            o = jnp.einsum("nhc,nchd->nhd", probs, vpages).reshape(N, nh * hd)
         x = x + o @ lp["wo"]
         hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
         x = x + _mlp(cfg, lp, hn)
