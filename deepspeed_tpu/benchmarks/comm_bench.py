@@ -43,9 +43,12 @@ def run_op(op: str, size_bytes: int, trials: int = 20, warmups: int = 3,
     elems = max(n * 8, size_bytes // np.dtype(dtype).itemsize)
     elems = (elems // (n * 8)) * (n * 8)
     x = jnp.ones((elems,), dtype)
+    # all_reduce/all_gather produce identical (replicated) per-device results
+    # -> P(); reduce_scatter/all_to_all produce per-device distinct shards
+    # -> P(axis), so the declared global shape matches the op's semantics.
+    out_spec = P(axis) if op in ("reduce_scatter", "all_to_all") else P()
     fn = shard_map_unchecked(_collective_fn(op, axis), mesh,
-                             in_specs=P(axis), out_specs=P(axis)
-                             if op in ("reduce_scatter",) else P(axis))
+                             in_specs=P(axis), out_specs=out_spec)
     jfn = jax.jit(fn)
     for _ in range(warmups):
         jax.block_until_ready(jfn(x))

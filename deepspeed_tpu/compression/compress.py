@@ -102,12 +102,7 @@ def _parse_technique(name: str, block: Dict[str, Any]) -> List[TechniqueGroup]:
             technique=name,
             patterns=list(modules),
             start_step=shared.get("schedule_offset", 0),
-            bits=gparams.get("start_bits",
-                             gparams.get("bits",
-                                         shared.get("quantize_weight_in_forward", 8)
-                                         if isinstance(shared.get(
-                                             "quantize_weight_in_forward"), int)
-                                         else 8)),
+            bits=gparams.get("start_bits", gparams.get("bits", 8)),
             symmetric="symmetric" in str(
                 shared.get("quantization_type", "symmetric")),
             per_channel=shared.get("quantize_groups", 1) != 1

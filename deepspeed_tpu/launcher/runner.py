@@ -121,12 +121,18 @@ class PDSHRunner(MultiNodeRunner):
 
     def get_cmd(self, hosts, node_cmds):
         hostlist = ",".join(hosts)
-        # every host runs the same wrapper; process id = line number of
-        # $(hostname) in the host list (stable, no extra files)
+        # every host runs the same wrapper; process id = line number of this
+        # host in the host list, matched against short AND fqdn hostnames so
+        # hostfile entries written either way still resolve; no match at all
+        # fails loudly instead of handing out an out-of-range id
         wrapper = (
-            "HOSTS=\"" + " ".join(hosts) + "\"; PID=0; "
-            "for h in $HOSTS; do [ \"$h\" = \"$(hostname)\" ] && break; "
+            "HOSTS=\"" + " ".join(hosts) + "\"; PID=0; FOUND=0; "
+            "for h in $HOSTS; do "
+            "if [ \"$h\" = \"$(hostname)\" ] || [ \"$h\" = \"$(hostname -s)\" ]"
+            " || [ \"$h\" = \"$(hostname -f 2>/dev/null)\" ]; then FOUND=1; break; fi; "
             "PID=$((PID+1)); done; "
+            "if [ \"$FOUND\" != 1 ]; then "
+            "echo \"deepspeed-tpu: $(hostname) not in hostfile ($HOSTS)\" >&2; exit 1; fi; "
             + node_cmds[0].replace("DS_TPU_PROCESS_ID=0",
                                    "DS_TPU_PROCESS_ID=$PID"))
         return ["pdsh", "-S", "-f", "1024", "-w", hostlist, wrapper]

@@ -46,6 +46,8 @@ class CurriculumScheduler:
 
     def _root_difficulty(self, step: int, degree: float) -> int:
         total = self.schedule["total_curriculum_step"]
+        if step >= total:  # schedule complete: exactly max, no unit flooring
+            return self.max_difficulty
         frac = min(1.0, step / total) ** (1.0 / degree)
         diff = self.min_difficulty + frac * (self.max_difficulty
                                              - self.min_difficulty)

@@ -152,6 +152,9 @@ def build_onebit_train_step(engine):
         lr = lr_fn(step)
         stepf = (step + 1).astype(jnp.float32)
 
+        def _tree_norm_sq(t):
+            return sum(jnp.sum(jnp.square(x)) for x in jax.tree.leaves(t))
+
         def warmup_branch(args):
             m, v, werr, serr, grads = args
             g_avg = jax.tree.map(lambda g: jax.lax.pmean(g, axes), grads)
@@ -162,20 +165,27 @@ def build_onebit_train_step(engine):
             upd = jax.tree.map(
                 lambda m_, v_: (m_ / bc1) / (jnp.sqrt(v_ / bc2) + opt.eps),
                 m, v)
-            return m, v, werr, serr, upd
+            # norm of the DP-averaged gradient (matches dense engine metric)
+            return m, v, werr, serr, upd, _tree_norm_sq(g_avg)
 
         def compressed_branch(args):
             m, v, werr, serr, grads = args
             # momentum from LOCAL grads, then 1-bit averaged
+            m_old = m
             m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, m, grads)
             flat = jnp.zeros(padded, jnp.float32).at[:total].set(flatten(m))
             avg, werr, serr = compressed_allreduce(flat, werr, serr, axes)
             m = unflatten(avg[:total])
             upd = jax.tree.map(
                 lambda m_, v_: m_ / (jnp.sqrt(v_) + opt.eps), m, v)
-            return m, v, werr, serr, upd
+            # averaged-grad norm recovered from the compressed-averaged
+            # momentum (exact up to compression error; no extra dense
+            # allreduce, which would defeat the 1-bit comm saving)
+            g_est = jax.tree.map(lambda mn, mo: (mn - b1 * mo) / (1 - b1),
+                                 m, m_old)
+            return m, v, werr, serr, upd, _tree_norm_sq(g_est)
 
-        m_l, v_l, werr_l, serr_l, upd = jax.lax.cond(
+        m_l, v_l, werr_l, serr_l, upd, gnorm_sq = jax.lax.cond(
             step < opt.freeze_step, warmup_branch, compressed_branch,
             (m_l, v_l, werr_l, serr_l, grads))
 
@@ -183,8 +193,6 @@ def build_onebit_train_step(engine):
             lambda p, u: p - lr * (u + opt.weight_decay * p), master_l, upd)
         new_params = jax.tree.map(lambda x: x.astype(compute_dtype),
                                   new_master)
-        gnorm_sq = jax.lax.pmean(
-            sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads)), axes)
         metrics = {"loss": loss, "grad_norm": jnp.sqrt(gnorm_sq),
                    "lr": lr, "skipped": jnp.asarray(0, jnp.int32)}
         return (new_params, new_master,

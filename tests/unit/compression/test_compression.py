@@ -82,6 +82,23 @@ def test_init_compression_schema_and_apply():
                        np.asarray(out5["layer_0"]["w"]).flat[0])
 
 
+def test_bool_quantize_weight_in_forward_not_used_as_bits():
+    # Regression: the reference schema's bool flag must never be resolved as
+    # a bit-width (bool is an int subclass; bits=True -> scale=inf -> NaN).
+    cfg = {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                                  "quantize_weight_in_forward": True},
+            "different_groups": {"g": {"modules": ["*"]}}}}}
+    spec = init_compression(deepspeed_config=cfg)
+    (group,) = spec.groups
+    assert group.bits == 8 and not isinstance(group.bits, bool)
+    w = jnp.asarray(np.random.default_rng(0).standard_normal((8, 8)),
+                    jnp.float32)
+    out = spec.apply({"layer": {"w": w}}, step=1)
+    assert np.isfinite(np.asarray(out["layer"]["w"])).all()
+
+
 def test_engine_compression_training_runs():
     cfg = base_config(micro=2, stage=0, dtype="bf16", lr=1e-2)
     cfg["compression_training"] = {
