@@ -48,12 +48,13 @@ class TransformerConfig:
     tie_embeddings: bool = False
     remat: bool = True                     # activation checkpointing per layer
     use_flash: bool = True
-    # below this sequence length XLA's fused attention beats the Pallas
-    # kernel on v5e (measured: 16.2% vs 11.1% MFU at S=2048 on the 470M
-    # flagship); flash pays off once the S^2 score tensor stops fitting
-    flash_min_seq: int = 4096
-    attn_block_q: int = 128
-    attn_block_kv: int = 128
+    # minimum sequence length for the Pallas flash kernel; below it XLA's
+    # fused attention is used. Round-1 measured flash at 11.1% vs XLA 16.2%
+    # MFU (S=2048, v5e) — but that kernel ran f32 matmuls; with bf16 MXU
+    # dots + group-accumulated dkv + auto blocks the crossover moves down.
+    flash_min_seq: int = 2048
+    attn_block_q: int = 0                  # 0 = auto (ops/flash_attention)
+    attn_block_kv: int = 0
     seq_parallel: bool = False             # sequence parallelism over "seq" axis
     seq_parallel_impl: str = "ulysses"     # ulysses (all-to-all) | ring (blockwise)
     loss_chunk: int = 512                  # chunked cross-entropy (0 = whole seq)

@@ -37,6 +37,25 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _cost(bh, sq, skv, d, causal, n_dots):
+    """CostEstimate so XLA's scheduler can overlap collectives with the
+    kernel (the pallas body is opaque to XLA's own cost analysis)."""
+    frac = 0.5 if causal else 1.0
+    return pl.CostEstimate(
+        flops=int(n_dots * 2 * bh * sq * skv * d * frac),
+        bytes_accessed=int(2 * bh * (sq + skv) * d * 2 * n_dots),
+        transcendentals=int(bh * sq * skv * frac),
+    )
+
+
+def _pick_block(s: int, target: int) -> int:
+    """Largest power-of-two-ish divisor of s that is <= target."""
+    b = min(target, s)
+    while b > 1 and s % b:
+        b //= 2
+    return max(b, 1)
+
+
 # ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
@@ -59,9 +78,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32)            # (bq, d)
-        k = k_ref[0].astype(jnp.float32)            # (bk, d)
-        v = v_ref[0].astype(jnp.float32)            # (bk, d)
+        # keep dots in the input dtype (bf16 runs the MXU at full rate; f32
+        # matmul is ~8x slower) with f32 accumulation
+        q = q_ref[0]                                 # (bq, d)
+        k = k_ref[0]                                 # (bk, d)
+        v = v_ref[0]                                 # (bk, d)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -71,11 +92,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_prev = m_sc[:, :1]                         # (bq, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                       # (bq, bk)
+        # rows fully masked so far have m_new == NEG_INF; exp(s - m_new)
+        # would be exp(0) = 1 garbage — substitute 0 so exp(NEG_INF) == 0
+        m_safe = jnp.where(m_new <= NEG_INF * 0.5, 0.0, m_new)
+        p = jnp.exp(s - m_safe)                      # (bq, bk) f32
         corr = jnp.exp(m_prev - m_new)               # (bq, 1)
         l_new = l_sc[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True)
         acc_sc[:] = acc_sc[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
         l_sc[:] = jnp.broadcast_to(l_new, l_sc.shape)
 
@@ -117,6 +142,7 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk):
             pltpu.VMEM((bq, 128), jnp.float32),
         ],
         out_shape=out_shape,
+        cost_estimate=_cost(bh, sq, skv, d, causal, n_dots=2),
         interpret=_interpret(),
     )(q, k, v)
     return o, lse
@@ -141,10 +167,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                             # (bq, 1)
         delta = delta_ref[0]                         # (bq, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -153,10 +179,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q_pos = off + i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)                         # (bq, bk)
+        # fully-masked rows carry lse == NEG_INF; exp(s - lse) would be 1
+        lse_safe = jnp.where(lse <= NEG_INF * 0.5, 0.0, lse)
+        p = jnp.exp(s - lse_safe)                    # (bq, bk) f32
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
         dq_sc[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
@@ -167,11 +195,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_sc, dv_sc,
-                    *, scale, causal, bq, bk, n_q, off):
-    j = pl.program_id(1)  # kv block (outer)
-    i = pl.program_id(2)  # q block (inner)
+                    *, scale, causal, bq, bk, n_q, n_inner, off):
+    j = pl.program_id(1)   # kv block (outer)
+    e = pl.program_id(2)   # inner: q-heads of the GQA group x q blocks
+    i = e % n_q            # q block within the head
 
-    @pl.when(i == 0)
+    @pl.when(e == 0)
     def _init():
         dk_sc[:] = jnp.zeros_like(dk_sc)
         dv_sc[:] = jnp.zeros_like(dv_sc)
@@ -182,10 +211,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]
         delta = delta_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -194,16 +223,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_pos = off + i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             k_pos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse)                         # (bq, bk)
-        dv_sc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        lse_safe = jnp.where(lse <= NEG_INF * 0.5, 0.0, lse)
+        p = jnp.exp(s - lse_safe)                    # (bq, bk) f32
+        pc = p.astype(do.dtype)
+        dv_sc[:] += jax.lax.dot_general(pc, do, (((0,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale                # (bq, bk)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)  # (bq, bk)
         dk_sc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
 
-    @pl.when(i == n_q - 1)
+    @pl.when(e == n_inner - 1)
     def _finish():
         dk_ref[0] = dk_sc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_sc[:].astype(dv_ref.dtype)
@@ -234,41 +265,50 @@ def _flash_bwd(res, g, scale, causal, bq, bk):
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        cost_estimate=_cost(bh, sq, skv, d, causal, n_dots=3),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
-    # dk/dv computed per *query* head, then reduced over the GQA group.
-    dk_h, dv_h = pl.pallas_call(
+    # dk/dv: grid over kv heads; the inner axis walks every q block of every
+    # q-head in the GQA group, accumulating in VMEM scratch — the group
+    # reduction happens in-register instead of a second [bh, skv, d] HBM pass.
+    n_inner = group * n_q
+    dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, n_q=n_q, off=skv - sq),
-        grid=(bh, n_kv, n_q),
+                          bq=bq, bk=bk, n_q=n_q, n_inner=n_inner,
+                          off=skv - sq),
+        grid=(bhk, n_kv, n_inner),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i, g_=group: (b // g_, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i, g_=group: (b // g_, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, d),
+                         lambda b, j, e, g_=group, nq=n_q:
+                         (b * g_ + e // nq, e % nq, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, e: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, e: (b, j, 0)),
+            pl.BlockSpec((1, bq, d),
+                         lambda b, j, e, g_=group, nq=n_q:
+                         (b * g_ + e // nq, e % nq, 0)),
+            pl.BlockSpec((1, bq, 1),
+                         lambda b, j, e, g_=group, nq=n_q:
+                         (b * g_ + e // nq, e % nq, 0)),
+            pl.BlockSpec((1, bq, 1),
+                         lambda b, j, e, g_=group, nq=n_q:
+                         (b * g_ + e // nq, e % nq, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, e: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, e: (b, j, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, skv, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, skv, d), v.dtype),
+            jax.ShapeDtypeStruct((bhk, skv, d), k.dtype),
+            jax.ShapeDtypeStruct((bhk, skv, d), v.dtype),
         ],
+        cost_estimate=_cost(bh, sq, skv, d, causal, n_dots=5),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
-    if group > 1:
-        dk = dk_h.reshape(bhk, group, skv, d).sum(axis=1).astype(k.dtype)
-        dv = dv_h.reshape(bhk, group, skv, d).sum(axis=1).astype(v.dtype)
-    else:
-        dk, dv = dk_h.astype(k.dtype), dv_h.astype(v.dtype)
     return dq, dk, dv
 
 
@@ -295,17 +335,21 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
-                    block_q: int = 128, block_kv: int = 128):
+                    block_q: Optional[int] = None,
+                    block_kv: Optional[int] = None):
     """Flash attention over [batch, num_heads, seq, head_dim] inputs.
 
     k/v may have fewer heads (GQA); num_heads % num_kv_heads == 0.
+    block_q/block_kv None (or 0) = auto: 256/512 capped to the seq lens —
+    large blocks amortize the online-softmax bookkeeping and keep the MXU
+    fed; VMEM cost at d<=128 is well under budget.
     """
     b, h, sq, d = q.shape
     _, hk, skv, _ = k.shape
     assert h % hk == 0, f"GQA requires h({h}) % hk({hk}) == 0"
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    bq = min(block_q, sq)
-    bk = min(block_kv, skv)
+    bq = _pick_block(sq, block_q or 256)
+    bk = _pick_block(skv, block_kv or 512)
     assert sq % bq == 0 and skv % bk == 0, \
         f"seq lengths ({sq},{skv}) must be multiples of block sizes ({bq},{bk})"
     qf = q.reshape(b * h, sq, d)

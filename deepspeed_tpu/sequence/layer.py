@@ -63,10 +63,9 @@ def _inner_attention(q, k, v, causal, use_flash, block_q, block_kv, sp_size,
         v = seq_all_to_all(v, SEQ_AXIS, scatter_dim=1, gather_dim=2)
 
     s = q.shape[2]
-    if use_flash and s % block_q == 0 and k.shape[2] % block_kv == 0 \
-            and s >= block_q:
+    if use_flash and s % 128 == 0 and k.shape[2] % 128 == 0:
         o = flash_attention(q, k, v, causal=causal, scale=scale,
-                            block_q=block_q, block_kv=block_kv)
+                            block_q=block_q or None, block_kv=block_kv or None)
     else:
         o = mha_reference(q, k, v, causal=causal, scale=scale)
 
