@@ -142,9 +142,13 @@ def log_summary(show_straggler: bool = False):
 
 
 def timed_op(fn):
-    """Wrap an in-graph collective for logging. Inside jit this traces once, so
-    timing wraps the *host-level* callers; in eager/interpret mode it times for
-    real. Size/latency accounting mirrors reference comm/comm.py:101."""
+    """Wrap an in-graph collective for logging (reference comm/comm.py:101).
+
+    In eager/interpret mode the wall-clock latency is real. Under jit the op
+    is traced once and `block_until_ready` is a no-op on tracers, so the
+    recorded time is *trace time*, not execution time — such records are
+    flagged and the summary marks them ``[trace]``; real per-op device
+    timings come from ``jax.profiler`` (see utils/xla_profile.py)."""
 
     @functools.wraps(fn)
     def wrapper(*args, log_name=None, **kwargs):
@@ -152,16 +156,16 @@ def timed_op(fn):
             return fn(*args, **kwargs)
         t0 = time.perf_counter()
         out = fn(*args, **kwargs)
-        try:
+        traced = any(isinstance(l, jax.core.Tracer) for l in jax.tree.leaves(out))
+        if not traced:
             jax.block_until_ready(out)
-        except Exception:
-            pass  # tracers inside jit can't be blocked on
         dt = time.perf_counter() - t0
         msg_size = 0
         for a in args:
             if hasattr(a, "nbytes"):
                 msg_size += a.nbytes
-        _comms_logger.append(log_name or fn.__name__, fn.__name__, dt, msg_size)
+        _comms_logger.append(log_name or fn.__name__, fn.__name__, dt, msg_size,
+                             traced=traced)
         return out
 
     return wrapper

@@ -86,6 +86,7 @@ class DeepSpeedTpuEngine:
         self.global_steps = 0
         self.skipped_steps = 0
         self.micro_steps = 0
+        self._batches_seen = 0
         self._compiled = None
         self._grad_buffer = None  # forward/backward/step compat path
         self._cached_batches = []
@@ -738,16 +739,24 @@ class DeepSpeedTpuEngine:
              self._step_arr, self._model_rng, metrics) = self._train_step(
                 self.params, self.master_params, self.opt_state, self.scale_state,
                 self._step_arr, self._model_rng, dev_batch)
-        self.global_steps += 1
-        self.lr_scheduler.step()
-        fp_cfg = self.config.flops_profiler
-        if fp_cfg.enabled and self.global_steps == fp_cfg.profile_step:
-            self._run_flops_profiler(dev_batch)
+        # Host bookkeeping mirrors the device counter: the compiled step
+        # leaves ``_step_arr`` un-advanced on fp16 overflow, so the host
+        # step count and the LR schedule must hold too (reference skips the
+        # scheduler on overflow, stage3.py:2018 area).
         loss = float(metrics["loss"])
         skipped = int(metrics["skipped"])
         self.skipped_steps += skipped
+        self._batches_seen += 1
+        if not skipped:
+            self.global_steps += 1
+            self.lr_scheduler.step()
+            fp_cfg = self.config.flops_profiler
+            if fp_cfg.enabled and self.global_steps == fp_cfg.profile_step:
+                self._run_flops_profiler(dev_batch)
         self.tput_timer.stop(global_step=True)
-        if self.global_steps % self.config.steps_per_print == 0:
+        # print cadence runs on batches seen (global_steps stalls on skips);
+        # every skipped batch is logged so overflows are visible
+        if skipped or self._batches_seen % self.config.steps_per_print == 0:
             lr = float(metrics["lr"])
             log_dist(
                 f"step={self.global_steps} loss={loss:.5f} lr={lr:.3e} "
@@ -755,7 +764,7 @@ class DeepSpeedTpuEngine:
                 + (f" loss_scale={float(metrics['loss_scale']):.0f}" if self.fp16_enabled else "")
                 + (" SKIPPED(overflow)" if skipped else ""),
                 ranks=[0])
-        if self.monitor is not None and self.monitor.enabled:
+        if self.monitor is not None and self.monitor.enabled and not skipped:
             self.monitor.write_events([
                 ("Train/loss", loss, self.global_steps),
                 ("Train/lr", float(metrics["lr"]), self.global_steps),
@@ -900,6 +909,7 @@ class DeepSpeedTpuEngine:
         meta = {
             "global_steps": self.global_steps,
             "skipped_steps": self.skipped_steps,
+            "batches_seen": self._batches_seen,
             "lr_scheduler": self.lr_scheduler.state_dict(),
             "client_state": client_state or {},
             "zero_stage": self.zero_stage,
@@ -957,6 +967,7 @@ class DeepSpeedTpuEngine:
         self._step_arr = state["step"]
         self.global_steps = meta["global_steps"]
         self.skipped_steps = meta.get("skipped_steps", 0)
+        self._batches_seen = meta.get("batches_seen", self.global_steps)
         if load_lr_scheduler_states and "lr_scheduler" in meta:
             self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         log_dist(f"loaded checkpoint {load_dir}/{tag}", ranks=[0])

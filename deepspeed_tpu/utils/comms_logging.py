@@ -54,27 +54,49 @@ class CommsLogger:
         except Exception:
             pass
 
-    def append(self, log_name: str, raw_name: str, latency_s: float, msg_size: int):
+    def append(self, log_name: str, raw_name: str, latency_s: float, msg_size: int,
+               traced: bool = False):
+        """``traced=True`` means the op was recorded during jit tracing: the
+        latency is compile-trace wall time, NOT device execution time. Such
+        records are kept (they show op/message-size coverage) but marked."""
         if not self.prof_all and log_name not in self.prof_ops:
             return
+        if traced:
+            log_name = log_name + " [trace]"
         rec = self.comms_dict[log_name][msg_size]
         rec[0] += 1
         rec[1].append(latency_s)
         if self.verbose:
-            algbw, busbw = calc_bw_log(raw_name, msg_size, latency_s, self.world_size)
-            logger.info(
-                f"comm op: {log_name} | time(ms): {latency_s*1e3:.2f} | "
-                f"msg size: {msg_size} | algbw (GB/s): {algbw:.2f} | busbw (GB/s): {busbw:.2f}")
+            if traced:
+                logger.info(
+                    f"comm op: {log_name} | msg size: {msg_size} | "
+                    f"(traced under jit; latency/bandwidth not measurable here "
+                    f"— use jax.profiler for device timings)")
+            else:
+                algbw, busbw = calc_bw_log(raw_name, msg_size, latency_s, self.world_size)
+                logger.info(
+                    f"comm op: {log_name} | time(ms): {latency_s*1e3:.2f} | "
+                    f"msg size: {msg_size} | algbw (GB/s): {algbw:.2f} | busbw (GB/s): {busbw:.2f}")
 
     def log_summary(self, show_straggler: bool = False):
         lines = [f"{'Comm. Op':<28}{'Message Size':>14}{'Count':>8}"
                  f"{'Total Lat(ms)':>16}{'Avg Lat(ms)':>14}{'algbw(GB/s)':>13}{'busbw(GB/s)':>13}"]
+        traced_any = False
         for op, sizes in sorted(self.comms_dict.items()):
+            is_trace = op.endswith(" [trace]")
+            traced_any = traced_any or is_trace
             for size, (count, lats) in sorted(sizes.items()):
                 total = sum(lats)
                 avg = total / max(count, 1)
-                algbw, busbw = calc_bw_log(op, size, avg, self.world_size)
-                lines.append(f"{op:<28}{size:>14}{count:>8}{total*1e3:>16.2f}"
-                             f"{avg*1e3:>14.3f}{algbw:>13.2f}{busbw:>13.2f}")
+                if is_trace:
+                    lines.append(f"{op:<28}{size:>14}{count:>8}"
+                                 f"{'-':>16}{'-':>14}{'-':>13}{'-':>13}")
+                else:
+                    algbw, busbw = calc_bw_log(op, size, avg, self.world_size)
+                    lines.append(f"{op:<28}{size:>14}{count:>8}{total*1e3:>16.2f}"
+                                 f"{avg*1e3:>14.3f}{algbw:>13.2f}{busbw:>13.2f}")
+        if traced_any:
+            lines.append("[trace] = recorded during jit tracing; latencies are "
+                         "not device timings (use jax.profiler)")
         logger.info("\n".join(lines))
         return "\n".join(lines)

@@ -137,3 +137,31 @@ def test_lr_scheduler_warmup():
     losses, engine = train_losses(cfg, steps=3)
     lr = engine.get_lr()[0]
     assert 0 < lr < 1e-3
+
+
+def test_fp16_overflow_keeps_host_and_device_steps_in_sync():
+    """On fp16 overflow the compiled step leaves _step_arr un-advanced; the
+    host-side global_steps and lr_scheduler must hold too (reference skips
+    the scheduler on overflow, stage3.py:2018 area)."""
+    cfg = base_config(micro=2, stage=0, dtype="fp16", lr=1e-2)
+    # scale 2^32 guarantees an overflow on the first step; hysteresis=1 so
+    # the scale halves immediately
+    cfg["fp16"].update({"initial_scale_power": 32, "hysteresis": 1})
+    cfg["scheduler"] = {"type": "WarmupLR",
+                        "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-2,
+                                   "warmup_num_steps": 100}}
+    model = SimpleModel(hidden_dim=HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    gb = make_global_batch(random_batches(1, gm, HIDDEN), 1, gm)
+    sched_before = engine.lr_scheduler.state_dict()
+    engine.train_batch(batch=gb)
+    assert engine.skipped_steps >= 1
+    # host counter == device counter == 0 after the skipped step
+    assert engine.global_steps == int(engine._step_arr) == 0
+    assert engine.lr_scheduler.state_dict() == sched_before
+    # subsequent finite steps advance both counters in lockstep
+    for _ in range(30):
+        engine.train_batch(batch=gb)
+        assert engine.global_steps == int(engine._step_arr)
+    assert engine.global_steps >= 1
