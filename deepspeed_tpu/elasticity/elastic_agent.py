@@ -1,0 +1,159 @@
+"""Elastic restart agent.
+
+TPU-native analogue of the reference's ``DSElasticAgent``
+(elasticity/elastic_agent.py:28, extending torch-elastic's
+LocalElasticAgent): babysit the local worker group and restart it — with a
+fresh rendezvous — when a worker fails, up to ``max_restarts`` times. The
+elastic batch schedule (elasticity.py compute_elastic_config) guarantees the
+global batch size stays constant when the restart comes back with a
+different admissible world size.
+
+Design departure: torch-elastic rendezvous is a c10d store negotiation; the
+JAX equivalent is simply re-running ``jax.distributed.initialize`` in the
+fresh worker processes, so the agent's job reduces to (a) deciding the new
+world layout, (b) re-spawning via NodeLauncher with bumped restart env, and
+(c) giving checkpoint-based resume a chance (workers are expected to
+load_checkpoint on start, which the engine already supports across dp
+resizes via per-tensor fragments).
+"""
+
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..launcher.launch import NodeLauncher
+from ..utils.logging import logger
+from .elasticity import ElasticityError, compute_elastic_config
+
+
+class ElasticAgentError(Exception):
+    pass
+
+
+class DSElasticAgent:
+    """Restart loop around the node launcher (reference elastic_agent.py:28).
+
+    Parameters
+    ----------
+    cmd : worker command (argv list).
+    nproc : processes per node.
+    max_restarts : worker-group failures tolerated before giving up
+        (torch-elastic's ``max_restarts``).
+    coordinator : ``host:port`` of global process 0.
+    ds_config : optional config dict with an ``elasticity`` block; when
+        given, the agent validates each (re)start's world size against the
+        elastic schedule before spawning.
+    world_size_fn : optional callable returning the world size to use for
+        the next restart (hook for cluster-size discovery); defaults to a
+        constant ``nnodes * nproc``.
+    """
+
+    def __init__(self,
+                 cmd: List[str],
+                 nproc: int = 1,
+                 nnodes: int = 1,
+                 node_rank: int = 0,
+                 max_restarts: int = 3,
+                 coordinator: str = "localhost:29500",
+                 ds_config: Optional[dict] = None,
+                 world_size_fn: Optional[Callable[[], int]] = None,
+                 restart_backoff_s: float = 1.0,
+                 extra_env: Optional[Dict[str, str]] = None):
+        self.cmd = cmd
+        self.nproc = nproc
+        self.nnodes = nnodes
+        self.node_rank = node_rank
+        self.max_restarts = max_restarts
+        self.coordinator = coordinator
+        self.ds_config = ds_config
+        self.world_size_fn = world_size_fn or (lambda: nnodes * nproc)
+        self.restart_backoff_s = restart_backoff_s
+        self.extra_env = extra_env or {}
+        self.restart_count = 0
+
+    def _validate_world(self, world_size: int):
+        if self.ds_config and self.ds_config.get("elasticity", {}).get(
+                "enabled", False):
+            # raises ElasticityError if this world size is not admissible
+            compute_elastic_config(self.ds_config, world_size=world_size)
+
+    def run(self) -> int:
+        """Spawn; on failure restart until success or restarts exhausted.
+        Returns the final exit code (0 = a generation ran to completion)."""
+        while True:
+            world = self.world_size_fn()
+            try:
+                self._validate_world(world)
+            except ElasticityError as e:
+                raise ElasticAgentError(
+                    f"world size {world} rejected by elastic schedule: {e}"
+                ) from e
+            # process grid: contiguous blocks of nproc per node. A shrunken
+            # world clips this node's block so process ids stay < world
+            # (otherwise jax.distributed.initialize rejects them).
+            base = self.node_rank * self.nproc
+            local_n = max(0, min(self.nproc, world - base))
+            if local_n == 0:
+                logger.info(
+                    f"elastic agent: node_rank={self.node_rank} not part of "
+                    f"world={world}; idle exit")
+                return 0
+            env = dict(self.extra_env)
+            env["DS_TPU_RESTART_COUNT"] = str(self.restart_count)
+            launcher = NodeLauncher(
+                self.cmd,
+                nproc=local_n,
+                base_process_id=base,
+                num_processes=world,
+                coordinator=self.coordinator,
+                extra_env=env)
+            rc = launcher.run()
+            if rc == 0:
+                logger.info(
+                    f"elastic agent: worker group completed "
+                    f"(restarts used: {self.restart_count})")
+                return 0
+            if self.restart_count >= self.max_restarts:
+                logger.error(
+                    f"elastic agent: worker group failed rc={rc} and "
+                    f"max_restarts={self.max_restarts} exhausted")
+                return rc
+            self.restart_count += 1
+            logger.warning(
+                f"elastic agent: worker group failed rc={rc}; restart "
+                f"{self.restart_count}/{self.max_restarts} in "
+                f"{self.restart_backoff_s}s")
+            time.sleep(self.restart_backoff_s)
+
+
+def main(argv=None) -> int:
+    """CLI: ``ds_tpu_elastic --max_restarts N -- script.py args``
+    (reference bin/ds_elastic)."""
+    import argparse
+    import sys
+
+    p = argparse.ArgumentParser(
+        prog="ds_tpu_elastic",
+        description="deepspeed_tpu elastic restart agent")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--master_addr", default="localhost")
+    p.add_argument("--master_port", type=int, default=29500)
+    p.add_argument("user_script")
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    agent = DSElasticAgent(
+        [sys.executable, args.user_script] + args.user_args,
+        nproc=args.nproc_per_node,
+        nnodes=args.nnodes,
+        node_rank=args.node_rank,
+        max_restarts=args.max_restarts,
+        coordinator=f"{args.master_addr}:{args.master_port}")
+    return agent.run()
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,108 @@
+"""Worker body for the 2-process jax.distributed test (run via NodeLauncher).
+
+Exercises the real multi-host code paths that single-process tests cannot:
+comm.init_distributed's jax.distributed rendezvous (comm/comm.py), global-mesh
+collectives across processes, engine training over a cross-process mesh, and
+the checkpoint multihost process_allgather + single-writer path
+(checkpoint/state_checkpoint.py:48-62).
+
+Behavior toggles (argv[1]):
+  train  — full drive (default)
+  fail   — rank 1 exits nonzero after init; rank 0 sleeps forever
+           (NodeLauncher must kill it: the sigkill_handler contract)
+"""
+
+import os
+import sys
+import time
+
+# the pytest parent sets device_count=8 in XLA_FLAGS; this worker needs
+# exactly 2 local devices, so drop any inherited forcing first
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "xla_force_host_platform_device_count" not in f]
+os.environ["XLA_FLAGS"] = " ".join(
+    _flags + ["--xla_force_host_platform_device_count=2"])
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    mode = sys.argv[1] if len(sys.argv) > 1 else "train"
+    out_dir = sys.argv[2] if len(sys.argv) > 2 else "/tmp/ds_tpu_mp_test"
+
+    # env protocol written by NodeLauncher
+    assert "DS_TPU_COORDINATOR" in os.environ
+    assert os.environ["DS_TPU_NUM_PROCESSES"] == "2"
+
+    from deepspeed_tpu.comm import comm as dist
+    dist.init_distributed()
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+    assert jax.local_device_count() == 2
+    rank = jax.process_index()
+
+    if mode == "fail":
+        if rank == 1:
+            # simulate a hard crash: os._exit skips the jax.distributed
+            # atexit shutdown barrier (a clean sys.exit would block in it
+            # waiting for rank 0, which never exits)
+            os._exit(3)
+        time.sleep(300)  # must be killed by the launcher
+        sys.exit(0)
+
+    # --- cross-process collective through the global mesh
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(4), ("data",))
+    sh = NamedSharding(mesh, P("data"))
+    x = jax.make_array_from_process_local_data(
+        sh, np.arange(4, dtype=np.float32)[2 * rank: 2 * rank + 2], (4,))
+    total = jax.jit(lambda a: jnp.sum(a),
+                    out_shardings=NamedSharding(mesh, P()))(x)
+    assert float(total) == 6.0, float(total)
+
+    # --- engine training over the cross-process mesh (dp=4 over 2 hosts)
+    import deepspeed_tpu
+    from simple_model import SimpleModel, base_config, random_batches
+
+    hidden = 16
+    cfg = base_config(micro=2, stage=2, dtype="bf16", lr=1e-2)
+    model = SimpleModel(hidden_dim=hidden)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    assert engine.ds_config.dp_world_size == 4
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    b = random_batches(1, gm * engine.gas, hidden, seed=0)[0]
+    batch = {k: v.reshape(engine.gas, gm, hidden) for k, v in b.items()}
+    losses = [engine.train_batch(batch=batch) for _ in range(4)]
+    assert losses[-1] < losses[0], losses
+
+    # --- multi-host checkpoint: process_allgather of sharded state, rank-0
+    # write, then reload on both processes and verify resumed determinism
+    ckpt = os.path.join(out_dir, "ckpt")
+    engine.save_checkpoint(ckpt, tag="t1")
+    next_loss = engine.train_batch(batch=batch)
+
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=hidden), config=cfg, seed=123)
+    dist.barrier()  # writer must finish before anyone loads
+    engine2.load_checkpoint(ckpt, tag="t1")
+    resumed_loss = engine2.train_batch(batch=batch)
+    np.testing.assert_allclose(resumed_loss, next_loss, rtol=1e-6)
+
+    # each process reports success via a rank file (the pytest side asserts
+    # both exist — proves both processes ran the full body)
+    with open(os.path.join(out_dir, f"ok_rank{rank}"), "w") as fh:
+        fh.write("ok")
+    print(f"rank {rank}: multi-process drive ok; losses {losses}")
+
+
+if __name__ == "__main__":
+    main()
