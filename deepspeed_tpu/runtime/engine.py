@@ -107,8 +107,8 @@ class DeepSpeedTpuEngine:
         self.config.optimizer = opt_cfg
         # 1-bit optimizers own their communication (reference engine skips
         # allreduce for them, engine.py optimizer-name check)
-        self.onebit_mode = (opt_cfg.type.lower().replace("_", "")
-                            .replace("-", "") in ("onebitadam", "1bitadam"))
+        from .fp16.onebit import is_onebit_optimizer
+        self.onebit_mode = is_onebit_optimizer(opt_cfg.type)
         if self.onebit_mode:
             self.optimizer = None
             base_lr = opt_cfg.params.get("lr", 1e-3)
@@ -156,8 +156,8 @@ class DeepSpeedTpuEngine:
         if self.offload_device:
             self._build_offload_step()
         elif self.onebit_mode:
-            from .fp16.onebit import build_onebit_train_step
-            self._train_step, self.opt_state = build_onebit_train_step(self)
+            from .fp16.onebit import build_train_step_for
+            self._train_step, self.opt_state = build_train_step_for(self)
             self._batch_sharding_fn = self._default_batch_sharding_fn()
             self._build_eval_step()
         else:
@@ -310,14 +310,24 @@ class DeepSpeedTpuEngine:
             zeropp_grad_fn = self._make_zeropp_grad_fn(zpp_w, zpp_g)
 
         pipeline_mode = self.topology.axis_size("pipe") > 1
+        # the 1F1B path computes unscaled grads, so fp16 loss scaling falls
+        # back to the autodiff pipeline branch below
+        pipe_own_grads = (pipeline_mode and not fp16
+                          and hasattr(self.model, "loss_and_grads"))
         if pipeline_mode:
             # PP composes with DP/ZeRO-1 only (same restriction as the
             # reference: PipelineEngine asserts no ZeRO-2/3, pipe/engine.py)
             assert self.zero_stage <= 1, "pipeline parallelism requires ZeRO stage <= 1"
-            assert self.topology.axis_size("model") == 1 and \
-                self.topology.axis_size("seq") == 1 and \
+            # pp x tp composes for models that run manual-collective TP
+            # inside the pipeline program (PipelineModule layers)
+            assert self.topology.axis_size("model") == 1 or \
+                getattr(self.model, "supports_pp_tp", False), \
+                "pipeline + tensor parallel requires a model with manual " \
+                "TP layers (PipelineModule); this model does not declare " \
+                "supports_pp_tp"
+            assert self.topology.axis_size("seq") == 1 and \
                 self.topology.axis_size("expert") == 1, \
-                "pipeline + tensor/sequence/expert parallel composition not yet supported"
+                "pipeline + sequence/expert parallel composition not yet supported"
             assert getattr(getattr(self.model, "cfg", None), "moe_num_experts", 0) == 0, \
                 "pipeline + MoE not yet supported (aux loss would be dropped)"
 
@@ -325,7 +335,17 @@ class DeepSpeedTpuEngine:
             lr = lr_fn(step)
             scale = scale_state["loss_scale"] if fp16 else jnp.asarray(1.0, jnp.float32)
 
-            if pipeline_mode:
+            if pipe_own_grads:
+                # the 1F1B pipeline IS the gradient computation (bounded
+                # activation memory; see runtime/pipe/pipeline.py)
+                rng, sub = jax.random.split(rng)
+                loss, grads = self.model.loss_and_grads(params, batch,
+                                                        rng=sub)
+                loss = loss.astype(jnp.float32)
+                grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+                grads = constrain(grads, grad_sh)
+                inv = jnp.asarray(1.0, jnp.float32)
+            elif pipeline_mode:
                 # the pipeline consumes all microbatches in one compiled
                 # program; loss is already the mean over them
                 rng, sub = jax.random.split(rng)

@@ -11,13 +11,9 @@ TPU-native equivalent of the reference's OnebitAdam
     averaged across workers through the 1-bit compressed allreduce
     (comm/compressed.py) — ~32x less gradient-sync traffic.
 
-Engine integration: the whole train step runs inside shard_map over the DP
-axes (pure data parallelism; the reference similarly bypasses the engine's
-allreduce, engine.py skips allreduce for onebit optimizers). Per-worker state
-(momentum, worker/server error feedback) lives as arrays with a leading
-world-size axis sharded over the DP axes. All momentum leaves are fused into
-ONE flat buffer for a single all-to-all + all-gather per step (the reference
-compresses per flattened param group the same way).
+Engine integration runs through the shared compressed-optimizer scaffold
+(common.py): ONE shard_map'd compiled step over the DP axes with per-worker
+momentum/error state and a single fused flat compressed collective.
 """
 
 from dataclasses import dataclass
@@ -26,10 +22,8 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ....comm.compressed import compressed_allreduce, padded_numel
-from ....comm.quantized import shard_map_unchecked
+from .common import build_compressed_train_step
 
 
 @dataclass(frozen=True)
@@ -54,128 +48,53 @@ def build_onebit_optimizer(params: Dict[str, Any]) -> OnebitAdam:
     return OnebitAdam(**kw)
 
 
-def build_onebit_train_step(engine):
-    """Build (train_step_jit, opt_state) for the 1-bit Adam engine path.
+class OnebitAdamImpl:
+    def __init__(self, opt: OnebitAdam):
+        self.opt = opt
 
-    train_step(params, master, opt_state, scale_state, step, rng, batch)
-      -> (params, master, opt_state, scale_state, step+1, rng, metrics)
-    matching the engine's standard compiled-step signature.
-    """
-    topo = engine.topology
-    mesh = topo.mesh
-    for ax in ("model", "seq", "expert", "pipe"):
-        assert topo.axis_size(ax) == 1, \
-            f"1-bit Adam requires pure data parallelism (got {ax}>1)"
-    assert engine.zero_stage == 0, \
-        "1-bit Adam handles its own communication; set zero stage 0"
-    assert not engine.fp16_enabled, \
-        "1-bit Adam: use bf16 on TPU (fp16 loss scaling unsupported)"
-    assert not engine.config.gradient_clipping, \
-        "1-bit Adam: gradient clipping is incompatible with local-momentum " \
-        "compression (reference OnebitAdam has the same restriction)"
-
-    opt = build_onebit_optimizer(engine.config.optimizer.params)
-    axes = topo.dp_axes
-    n = topo.dp_world_size
-    gas = engine.gas
-    model = engine.model
-    lr_fn = engine._lr_fn
-    compute_dtype = engine.compute_dtype
-    b1, b2 = opt.betas
-
-    master = engine.master_params if engine.has_master else engine.params
-    shapes = [l.shape for l in jax.tree.leaves(master)]
-    numels = [int(np.prod(s)) for s in shapes]
-    total = sum(numels)
-    padded = padded_numel(total, n)
-    treedef = jax.tree_util.tree_structure(master)
-
-    repl = NamedSharding(mesh, P())
-    lead = NamedSharding(mesh, P(axes if len(axes) > 1 else axes[0]))
-
-    # ---- state init: per-worker momentum + error feedback, frozen variance
-    def init_state():
-        zeros_like_master = jax.tree.map(
-            lambda l: jnp.zeros((n,) + l.shape, jnp.float32), master)
+    def init_extra(self, ctx):
+        n = ctx.n
+        zeros = jax.tree_util.tree_unflatten(
+            ctx.treedef, [jnp.zeros(s, jnp.float32) for s in ctx.shapes])
+        lead_zeros = jax.tree.map(
+            lambda l: jnp.zeros((n,) + l.shape, jnp.float32), zeros)
         return {
-            "exp_avg": jax.device_put(zeros_like_master,
-                                      jax.tree.map(lambda _: lead,
-                                                   zeros_like_master)),
-            "exp_avg_sq": jax.device_put(
-                jax.tree.map(lambda l: jnp.zeros(l.shape, jnp.float32), master),
-                jax.tree.map(lambda _: repl, master)),
-            "worker_error": jax.device_put(jnp.zeros((n, padded), jnp.float32),
-                                           lead),
-            "server_error": jax.device_put(
-                jnp.zeros((n, padded // n), jnp.float32), lead),
+            "exp_avg": (lead_zeros, "lead"),
+            "exp_avg_sq": (zeros, "repl"),
+            "worker_error": (jnp.zeros((n, ctx.padded), jnp.float32), "lead"),
+            "server_error": (jnp.zeros((n, ctx.padded // n), jnp.float32),
+                             "lead"),
         }
 
-    def flatten(tree):
-        return jnp.concatenate([l.reshape(-1) for l in jax.tree.leaves(tree)])
-
-    def unflatten(flat):
-        leaves, off = [], 0
-        for shape, numel in zip(shapes, numels):
-            leaves.append(flat[off:off + numel].reshape(shape))
-            off += numel
-        return jax.tree_util.tree_unflatten(treedef, leaves)
-
-    def body(params_l, master_l, m_l, v_l, werr_l, serr_l, step, rng, batch_l):
-        # local shapes: m_l leaves [1, *shape]; errors [1, padded(/n)]
-        m_l = jax.tree.map(lambda x: x[0], m_l)
-        werr_l, serr_l = werr_l[0], serr_l[0]
-
-        def loss_fn(p, micro, sub):
-            out = model.apply(p, micro, train=True, rng=sub)
-            loss = out[0] if isinstance(out, tuple) else out
-            return loss.astype(jnp.float32)
-
-        def linear_index():
-            idx = jnp.asarray(0, jnp.int32)
-            for a in axes:
-                idx = idx * topo.axis_size(a) + jax.lax.axis_index(a)
-            return idx
-
-        def micro_fn(carry, micro):
-            acc, rng = carry
-            rng, sub = jax.random.split(rng)
-            sub = jax.random.fold_in(sub, linear_index())
-            loss, g = jax.value_and_grad(loss_fn)(params_l, micro, sub)
-            acc = jax.tree.map(lambda a, x: a + x.astype(jnp.float32), acc, g)
-            return (acc, rng), loss
-
-        grads0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
-                              params_l)
-        (grads, rng), losses = jax.lax.scan(micro_fn, (grads0, rng), batch_l)
-        grads = jax.tree.map(lambda g: g / gas, grads)
-        loss = jax.lax.pmean(jnp.mean(losses), axes)
-        lr = lr_fn(step)
+    def update(self, ctx, grads, master, state, step, lr):
+        opt = self.opt
+        b1, b2 = opt.betas
+        axes = ctx.axes
         stepf = (step + 1).astype(jnp.float32)
-
-        def _tree_norm_sq(t):
-            return sum(jnp.sum(jnp.square(x)) for x in jax.tree.leaves(t))
+        m, v = state["exp_avg"], state["exp_avg_sq"]
+        werr, serr = state["worker_error"], state["server_error"]
 
         def warmup_branch(args):
             m, v, werr, serr, grads = args
             g_avg = jax.tree.map(lambda g: jax.lax.pmean(g, axes), grads)
             m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, m, g_avg)
-            v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, v, g_avg)
+            v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, v,
+                             g_avg)
             bc1 = 1 - b1 ** stepf
             bc2 = 1 - b2 ** stepf
             upd = jax.tree.map(
                 lambda m_, v_: (m_ / bc1) / (jnp.sqrt(v_ / bc2) + opt.eps),
                 m, v)
             # norm of the DP-averaged gradient (matches dense engine metric)
-            return m, v, werr, serr, upd, _tree_norm_sq(g_avg)
+            return m, v, werr, serr, upd, ctx.tree_norm_sq(g_avg)
 
         def compressed_branch(args):
             m, v, werr, serr, grads = args
             # momentum from LOCAL grads, then 1-bit averaged
             m_old = m
             m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, m, grads)
-            flat = jnp.zeros(padded, jnp.float32).at[:total].set(flatten(m))
-            avg, werr, serr = compressed_allreduce(flat, werr, serr, axes)
-            m = unflatten(avg[:total])
+            m, werr, serr = ctx.compressed_mean(m, werr, serr)
+            m = ctx.mask_dead(m, v)
             upd = jax.tree.map(
                 lambda m_, v_: m_ / (jnp.sqrt(v_) + opt.eps), m, v)
             # averaged-grad norm recovered from the compressed-averaged
@@ -183,43 +102,20 @@ def build_onebit_train_step(engine):
             # allreduce, which would defeat the 1-bit comm saving)
             g_est = jax.tree.map(lambda mn, mo: (mn - b1 * mo) / (1 - b1),
                                  m, m_old)
-            return m, v, werr, serr, upd, _tree_norm_sq(g_est)
+            return m, v, werr, serr, upd, ctx.tree_norm_sq(g_est)
 
-        m_l, v_l, werr_l, serr_l, upd, gnorm_sq = jax.lax.cond(
+        m, v, werr, serr, upd, gnorm_sq = jax.lax.cond(
             step < opt.freeze_step, warmup_branch, compressed_branch,
-            (m_l, v_l, werr_l, serr_l, grads))
+            (m, v, werr, serr, grads))
 
         new_master = jax.tree.map(
-            lambda p, u: p - lr * (u + opt.weight_decay * p), master_l, upd)
-        new_params = jax.tree.map(lambda x: x.astype(compute_dtype),
-                                  new_master)
-        metrics = {"loss": loss, "grad_norm": jnp.sqrt(gnorm_sq),
-                   "lr": lr, "skipped": jnp.asarray(0, jnp.int32)}
-        return (new_params, new_master,
-                jax.tree.map(lambda x: x[None], m_l),
-                v_l, werr_l[None], serr_l[None], step + 1, rng, metrics)
-
-    bt = topo.batch_axes
-    lead_spec = P(axes if len(axes) > 1 else axes[0])
-    m_specs = jax.tree.map(lambda _: lead_spec, master)
-    repl_specs = jax.tree.map(lambda _: P(), master)
-
-    sm = shard_map_unchecked(
-        body, mesh=mesh,
-        in_specs=(repl_specs, repl_specs, m_specs, repl_specs, lead_spec,
-                  lead_spec, P(), P(), P(None, bt)),
-        out_specs=(repl_specs, repl_specs, m_specs, repl_specs, lead_spec,
-                   lead_spec, P(), P(), P()))
-
-    def train_step(params, master, opt_state, scale_state, step, rng, batch):
-        master_in = params if master is None else master
-        (params, new_master, m, v, werr, serr, step, rng, metrics) = sm(
-            params, master_in, opt_state["exp_avg"], opt_state["exp_avg_sq"],
-            opt_state["worker_error"], opt_state["server_error"], step, rng,
-            batch)
+            lambda p, u: p - lr * (u + opt.weight_decay * p), master, upd)
         new_state = {"exp_avg": m, "exp_avg_sq": v, "worker_error": werr,
                      "server_error": serr}
-        master_out = None if master is None else new_master
-        return params, master_out, new_state, scale_state, step, rng, metrics
+        return new_master, new_state, gnorm_sq
 
-    return jax.jit(train_step, donate_argnums=(0, 1, 2)), init_state()
+
+def build_onebit_train_step(engine):
+    """(train_step_jit, opt_state) for the 1-bit Adam engine path."""
+    opt = build_onebit_optimizer(engine.config.optimizer.params)
+    return build_compressed_train_step(engine, OnebitAdamImpl(opt))

@@ -1,0 +1,216 @@
+"""Shared scaffold for the 1-bit optimizer family.
+
+The reference implements OnebitAdam/OnebitLamb/ZeroOneAdam as three torch
+optimizers over a compressed comm backend (runtime/fp16/onebit/{adam,lamb,
+zoadam}.py + runtime/comm/nccl.py). The TPU-native shape is shared: ONE
+shard_map'd compiled train step over the DP axes where
+
+  * gradients are computed locally per worker (scan over gas microbatches),
+  * per-worker optimizer state (momentum, error feedback) lives as arrays
+    with a leading world-size axis sharded over the DP axes,
+  * all momentum leaves fuse into ONE flat buffer for a single compressed
+    collective per sync (the reference flattens param groups the same way),
+  * the optimizer-specific math is a pluggable `update` function.
+
+Each optimizer module supplies an `impl` object:
+  impl.init_extra(ctx)  -> dict name -> (array, kind) with kind in
+      {"lead", "repl"}: lead = per-worker [n, ...] sharded over DP,
+      repl = replicated.
+  impl.update(ctx, grads, master, state, step, lr)
+      -> (new_master, new_state, gnorm_sq)
+      runs INSIDE shard_map: state leaves arrive device-local (lead entries
+      squeezed to their per-worker slice), collectives may be used freely.
+  impl.forward_params(ctx, params, master, state) [optional]
+      -> params the gradient is taken at. Default: the engine params.
+      ZeroOneAdam overrides this to apply the per-worker local-step drift.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....comm.compressed import compressed_allreduce, padded_numel
+from ....comm.quantized import shard_map_unchecked
+
+
+@dataclass
+class OnebitContext:
+    """Static info handed to the optimizer impl."""
+    opt: Any
+    axes: Tuple[str, ...]
+    n: int
+    total: int
+    padded: int
+    shapes: list
+    numels: list
+    treedef: Any
+    num_leaves: int
+    compute_dtype: Any = jnp.bfloat16
+
+    def flatten(self, tree):
+        return jnp.concatenate([l.reshape(-1) for l in jax.tree.leaves(tree)])
+
+    def unflatten(self, flat):
+        leaves, off = [], 0
+        for shape, numel in zip(self.shapes, self.numels):
+            leaves.append(flat[off:off + numel].reshape(shape))
+            off += numel
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    def pad(self, flat):
+        return jnp.zeros(self.padded, jnp.float32).at[:self.total].set(flat)
+
+    def compressed_mean(self, tree, worker_error, server_error):
+        """Fused 1-bit averaged allreduce of a full pytree.
+
+        At world size 1 there is no communication to compress, so this is
+        the identity — the reference likewise bypasses its compressed
+        backend when ``self.size == 1`` (onebit/adam.py `if self.size > 1`
+        guards)."""
+        if self.n == 1:
+            return tree, worker_error, server_error
+        flat = self.pad(self.flatten(tree))
+        avg, we, se = compressed_allreduce(flat, worker_error, server_error,
+                                           self.axes)
+        return self.unflatten(avg[:self.total]), we, se
+
+    def tree_norm_sq(self, t):
+        return sum(jnp.sum(jnp.square(x)) for x in jax.tree.leaves(t))
+
+    def mask_dead(self, tree, v):
+        """Zero entries whose variance never saw a gradient (v == 0).
+
+        Sign compression cannot represent exact zero: dead entries (dead
+        relu units, unused embedding rows) pick up +-scale noise from every
+        compressed collective, which the ~eps-sized denominator then blows
+        up. The reference handles this with a user-supplied ``exp_avg_mask``
+        (see the BERT position-embedding note in onebit/lamb.py:318); the
+        v==0 mask is the automatic equivalent."""
+        return jax.tree.map(lambda x, v_: jnp.where(v_ > 0, x, 0.0), tree, v)
+
+
+def check_engine(engine, name: str):
+    topo = engine.topology
+    for ax in ("model", "seq", "expert", "pipe"):
+        assert topo.axis_size(ax) == 1, \
+            f"{name} requires pure data parallelism (got {ax}>1)"
+    assert engine.zero_stage == 0, \
+        f"{name} handles its own communication; set zero stage 0"
+    assert not engine.fp16_enabled, \
+        f"{name}: use bf16 on TPU (fp16 loss scaling unsupported)"
+    assert not engine.config.gradient_clipping, \
+        f"{name}: gradient clipping is incompatible with local-momentum " \
+        f"compression (same restriction as the reference)"
+
+
+def build_compressed_train_step(engine, impl):
+    """(train_step_jit, opt_state) with the engine's standard compiled-step
+    signature; the optimizer math comes from `impl` (see module docstring)."""
+    check_engine(engine, type(impl).__name__)
+    topo = engine.topology
+    mesh = topo.mesh
+    axes = topo.dp_axes
+    n = topo.dp_world_size
+    gas = engine.gas
+    model = engine.model
+    lr_fn = engine._lr_fn
+    compute_dtype = engine.compute_dtype
+
+    master = engine.master_params if engine.has_master else engine.params
+    shapes = [l.shape for l in jax.tree.leaves(master)]
+    numels = [int(np.prod(s)) for s in shapes]
+    total = sum(numels)
+    ctx = OnebitContext(opt=impl.opt, axes=axes, n=n, total=total,
+                        padded=padded_numel(total, n), shapes=shapes,
+                        numels=numels,
+                        treedef=jax.tree_util.tree_structure(master),
+                        num_leaves=len(shapes),
+                        compute_dtype=compute_dtype)
+
+    repl = NamedSharding(mesh, P())
+    lead_spec = P(axes if len(axes) > 1 else axes[0])
+    lead = NamedSharding(mesh, lead_spec)
+
+    extra = impl.init_extra(ctx)
+    kinds = {k: kind for k, (_, kind) in extra.items()}
+    state_keys = list(extra)
+
+    def init_state():
+        out = {}
+        for k, (arr, kind) in extra.items():
+            sh = lead if kind == "lead" else repl
+            out[k] = jax.tree.map(lambda a: jax.device_put(a, sh), arr)
+        return out
+
+    def body(params_l, master_l, step, rng, batch_l, *state_leaves):
+        state = dict(zip(state_keys, state_leaves))
+        # lead entries arrive [1, ...]: squeeze to this worker's slice
+        state = {k: (jax.tree.map(lambda x: x[0], v) if kinds[k] == "lead"
+                     else v) for k, v in state.items()}
+        if hasattr(impl, "forward_params"):
+            params_l = impl.forward_params(ctx, params_l, master_l, state)
+
+        def loss_fn(p, micro, sub):
+            out = model.apply(p, micro, train=True, rng=sub)
+            loss = out[0] if isinstance(out, tuple) else out
+            return loss.astype(jnp.float32)
+
+        def linear_index():
+            idx = jnp.asarray(0, jnp.int32)
+            for a in axes:
+                idx = idx * topo.axis_size(a) + jax.lax.axis_index(a)
+            return idx
+
+        def micro_fn(carry, micro):
+            acc, rng = carry
+            rng, sub = jax.random.split(rng)
+            sub = jax.random.fold_in(sub, linear_index())
+            loss, g = jax.value_and_grad(loss_fn)(params_l, micro, sub)
+            acc = jax.tree.map(lambda a, x: a + x.astype(jnp.float32), acc, g)
+            return (acc, rng), loss
+
+        grads0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                              params_l)
+        (grads, rng), losses = jax.lax.scan(micro_fn, (grads0, rng), batch_l)
+        grads = jax.tree.map(lambda g: g / gas, grads)
+        loss = jax.lax.pmean(jnp.mean(losses), axes)
+        lr = lr_fn(step)
+
+        new_master, new_state, gnorm_sq = impl.update(
+            ctx, grads, master_l, state, step, lr)
+
+        new_params = jax.tree.map(lambda x: x.astype(compute_dtype),
+                                  new_master)
+        metrics = {"loss": loss, "grad_norm": jnp.sqrt(gnorm_sq), "lr": lr,
+                   "skipped": jnp.asarray(0, jnp.int32)}
+        out_state = tuple(
+            (jax.tree.map(lambda x: x[None], new_state[k])
+             if kinds[k] == "lead" else new_state[k]) for k in state_keys)
+        return (new_params, new_master, step + 1, rng, metrics) + out_state
+
+    bt = topo.batch_axes
+    repl_specs = jax.tree.map(lambda _: P(), master)
+    state_specs = tuple(
+        jax.tree.map(lambda _: lead_spec if kinds[k] == "lead" else P(),
+                     extra[k][0]) for k in state_keys)
+
+    sm = shard_map_unchecked(
+        body, mesh=mesh,
+        in_specs=(repl_specs, repl_specs, P(), P(), P(None, bt)) + state_specs,
+        out_specs=(repl_specs, repl_specs, P(), P(), P()) + state_specs)
+
+    def train_step(params, master, opt_state, scale_state, step, rng, batch):
+        master_in = params if master is None else master
+        out = sm(params, master_in, step, rng, batch,
+                 *(opt_state[k] for k in state_keys))
+        params, new_master, step, rng, metrics = out[:5]
+        new_state = dict(zip(state_keys, out[5:]))
+        master_out = None if master is None else new_master
+        return (params, master_out, new_state, scale_state, step, rng,
+                metrics)
+
+    return jax.jit(train_step, donate_argnums=(0, 1, 2)), init_state()

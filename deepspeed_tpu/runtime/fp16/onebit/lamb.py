@@ -1,0 +1,207 @@
+"""1-bit LAMB: compressed-momentum LAMB with frozen layer-wise coefficients.
+
+TPU-native equivalent of the reference's OnebitLamb
+(runtime/fp16/onebit/lamb.py:15, paper arXiv:2104.06069). Behavior matched:
+
+  * warmup (step < freeze_step): exact LAMB — DP-averaged gradients update
+    both moments; per-layer lamb coefficient = clip(||w|| / ||update||,
+    [min_coeff, max_coeff]); an EMA of the coefficient (coeff_beta)
+    accumulates into ``lamb_coeff_freeze``.
+  * at the compression boundary: the variance is frozen (a ``fresh`` copy
+    keeps updating from reconstructed gradients), and per-layer
+    ``scaling_coeff`` = united_scale / momentum_scale equalizes momentum
+    magnitudes so one shared 1-bit scale fits all layers.
+  * compression (step >= freeze_step): momentum updates locally, is scaled
+    by scaling_coeff, 1-bit averaged, unscaled; the applied coefficient is
+    ``lamb_coeff_freeze * factor`` where factor = max(frozen_denom /
+    fresh_denom) clipped to [factor_min, factor_max] and rate-limited by
+    factor_threshold per step — the reference's adaptive-coefficient rule.
+
+Runs through the shared compressed-optimizer scaffold (common.py).
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import build_compressed_train_step
+
+
+@dataclass(frozen=True)
+class OnebitLamb:
+    lr: float = 1e-3
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    freeze_step: int = 100
+    max_coeff: float = 10.0
+    min_coeff: float = 0.01
+    coeff_beta: float = 0.9
+    factor_max: float = 4.0
+    factor_min: float = 0.5
+    factor_threshold: float = 0.1
+
+
+def build_onebit_lamb(params: Dict[str, Any]) -> OnebitLamb:
+    kw = dict(params)
+    if "betas" in kw:
+        kw["betas"] = tuple(kw["betas"])
+    for drop in ("cuda_aware", "comm_backend_name", "bias_correction",
+                 "max_grad_norm", "amsgrad", "eps_inside_sqrt"):
+        kw.pop(drop, None)
+    return OnebitLamb(**kw)
+
+
+class OnebitLambImpl:
+    def __init__(self, opt: OnebitLamb):
+        self.opt = opt
+
+    def init_extra(self, ctx):
+        n, L = ctx.n, ctx.num_leaves
+        # fresh buffers per entry — sharing one zeros tree across entries
+        # would alias donated buffers in the compiled step
+        zeros = lambda: jax.tree_util.tree_unflatten(  # noqa: E731
+            ctx.treedef, [jnp.zeros(s, jnp.float32) for s in ctx.shapes])
+        lead_zeros = jax.tree.map(
+            lambda l: jnp.zeros((n,) + l.shape, jnp.float32), zeros())
+        return {
+            "exp_avg": (lead_zeros, "lead"),
+            "exp_avg_sq": (zeros(), "repl"),
+            "exp_avg_sq_fresh": (zeros(), "repl"),
+            # per-leaf scalars (reference keeps them in per-param state)
+            "scaling_coeff": (jnp.ones((L,), jnp.float32), "repl"),
+            "lamb_coeff_freeze": (jnp.zeros((L,), jnp.float32), "repl"),
+            "last_factor": (jnp.ones((L,), jnp.float32), "repl"),
+            "worker_error": (jnp.zeros((n, ctx.padded), jnp.float32), "lead"),
+            "server_error": (jnp.zeros((n, ctx.padded // n), jnp.float32),
+                             "lead"),
+        }
+
+    def update(self, ctx, grads, master, state, step, lr):
+        opt = self.opt
+        b1, b2 = opt.betas
+        axes = ctx.axes
+        leaves = jax.tree.leaves
+        unfl = lambda ls: jax.tree_util.tree_unflatten(ctx.treedef, ls)  # noqa: E731
+
+        def per_leaf_update_and_coeff(m, v_for_denom, p_tree, coeff_fn):
+            """update tree + per-leaf coeff vector via coeff_fn(i, leaf
+            tensors...)."""
+            upds, coeffs = [], []
+            for i, (m_i, v_i, p_i) in enumerate(
+                    zip(leaves(m), leaves(v_for_denom), leaves(p_tree))):
+                u_prelim = m_i / (jnp.sqrt(v_i) + opt.eps)
+                u = u_prelim + opt.weight_decay * p_i
+                upds.append(u)
+                coeffs.append(coeff_fn(i, u_prelim, u, p_i))
+            return unfl(upds), jnp.stack(coeffs)
+
+        def warmup_branch(args):
+            (m, v, v_fresh, sc, lcf, lf, werr, serr, grads) = args
+            g_avg = jax.tree.map(lambda g: jax.lax.pmean(g, axes), grads)
+            m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, m, g_avg)
+            v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, v,
+                             g_avg)
+
+            def coeff_fn(i, u_prelim, u, p_i):
+                w_norm = jnp.sqrt(jnp.sum(jnp.square(p_i)))
+                u_norm = jnp.sqrt(jnp.sum(jnp.square(u)))
+                raw = jnp.clip(w_norm / jnp.maximum(u_norm, 1e-12),
+                               opt.min_coeff, opt.max_coeff)
+                return jnp.where((w_norm > 0) & (u_norm > 0), raw, 1.0)
+
+            upd, coeffs = per_leaf_update_and_coeff(m, v, master, coeff_fn)
+            # EMA of the coefficient, skipped when coeff==1.0 (reference
+            # only folds real coefficients into the freeze value)
+            lcf = jnp.where(coeffs != 1.0,
+                            opt.coeff_beta * lcf + (1 - opt.coeff_beta) * coeffs,
+                            lcf)
+            new_master = unfl([
+                p - lr * c * u for p, c, u in
+                zip(leaves(master), list(coeffs), leaves(upd))])
+            return (m, v, v_fresh, sc, lcf, lf, werr, serr, new_master,
+                    ctx.tree_norm_sq(g_avg))
+
+        def compressed_branch(args):
+            (m, v, v_fresh, sc, lcf, lf, werr, serr, grads) = args
+            # entering compression: freeze the variance (fresh copy keeps
+            # updating) and compute the per-layer momentum equalizers —
+            # boundary-only work, so cond'd away on every later step
+            def at_boundary(ops):
+                m, v, _vf, _sc = ops
+                m_scales = jnp.stack([
+                    jnp.sqrt(jnp.sum(jnp.square(m_i)) / m_i.size)
+                    for m_i in leaves(m)])
+                united = jnp.mean(m_scales)
+                return v, united / jnp.maximum(m_scales, 1e-12)
+
+            def past_boundary(ops):
+                _m, _v, vf, sc = ops
+                return vf, sc
+
+            v_fresh, sc = jax.lax.cond(step == opt.freeze_step, at_boundary,
+                                       past_boundary, (m, v, v_fresh, sc))
+
+            m_old = m
+            m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, m, grads)
+            m_scaled = unfl([m_i * sc[i] for i, m_i in enumerate(leaves(m))])
+            m_scaled, werr, serr = ctx.compressed_mean(m_scaled, werr, serr)
+            m = unfl([m_i / sc[i] for i, m_i in enumerate(leaves(m_scaled))])
+            m = ctx.mask_dead(m, v)
+
+            g_rec = jax.tree.map(lambda mn, mo: (mn - b1 * mo) / (1 - b1),
+                                 m, m_old)
+            v_fresh = jax.tree.map(
+                lambda vf, g: b2 * vf + (1 - b2) * g * g, v_fresh, g_rec)
+
+            new_lf, coeffs, upds = [], [], []
+            for i, (m_i, v_i, vf_i, p_i) in enumerate(
+                    zip(leaves(m), leaves(v), leaves(v_fresh),
+                        leaves(master))):
+                denom = jnp.sqrt(v_i) + opt.eps
+                denom_real = jnp.sqrt(vf_i) + opt.eps
+                u_prelim = m_i / denom
+                u = u_prelim + opt.weight_decay * p_i
+                factor = jnp.max(denom / denom_real)
+                if opt.weight_decay > 0.0:
+                    un = jnp.sqrt(jnp.sum(jnp.square(u)))
+                    upn = jnp.sqrt(jnp.sum(jnp.square(u_prelim)))
+                    ratio = jnp.minimum(1.0, upn / jnp.maximum(un, 1e-12))
+                    factor = factor * ratio + (1.0 - ratio)
+                factor = jnp.clip(factor, opt.factor_min, opt.factor_max)
+                # rate limit: at most +-factor_threshold vs last step
+                factor = jnp.clip(factor,
+                                  lf[i] * (1.0 - opt.factor_threshold),
+                                  lf[i] * (1.0 + opt.factor_threshold))
+                new_lf.append(factor)
+                coeffs.append(lcf[i] * factor)
+                upds.append(u)
+            lf = jnp.stack(new_lf)
+            new_master = unfl([
+                p - lr * c * u for p, c, u in
+                zip(leaves(master), coeffs, upds)])
+            return (m, v, v_fresh, sc, lcf, lf, werr, serr, new_master,
+                    ctx.tree_norm_sq(g_rec))
+
+        (m, v, v_fresh, sc, lcf, lf, werr, serr, new_master,
+         gnorm_sq) = jax.lax.cond(
+            step < opt.freeze_step, warmup_branch, compressed_branch,
+            (state["exp_avg"], state["exp_avg_sq"],
+             state["exp_avg_sq_fresh"], state["scaling_coeff"],
+             state["lamb_coeff_freeze"], state["last_factor"],
+             state["worker_error"], state["server_error"], grads))
+
+        new_state = {"exp_avg": m, "exp_avg_sq": v, "exp_avg_sq_fresh": v_fresh,
+                     "scaling_coeff": sc, "lamb_coeff_freeze": lcf,
+                     "last_factor": lf, "worker_error": werr,
+                     "server_error": serr}
+        return new_master, new_state, gnorm_sq
+
+
+def build_onebit_lamb_train_step(engine):
+    """(train_step_jit, opt_state) for the 1-bit LAMB engine path."""
+    opt = build_onebit_lamb(engine.config.optimizer.params)
+    return build_compressed_train_step(engine, OnebitLambImpl(opt))

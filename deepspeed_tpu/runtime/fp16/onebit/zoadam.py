@@ -1,0 +1,214 @@
+"""0/1 Adam: adaptive variance freezing + 1-bit local steps.
+
+TPU-native equivalent of the reference's ZeroOneAdam
+(runtime/fp16/onebit/zoadam.py:14, paper arXiv:2202.06009). Two policies
+compose, matching the reference:
+
+  * variance-update policy (step <= var_freeze_step): the variance (and,
+    with it, an exactly-averaged gradient) is refreshed only on an
+    exponentially growing interval ``var_interval`` (doubling every
+    ``var_update_scaler`` refreshes); on all other steps the gradient is
+    averaged through the 1-bit compressed allreduce and only the momentum
+    updates.
+  * local-step policy (step > var_freeze_step): the variance is frozen;
+    workers take LOCAL steps with their own momentum (parameter replicas
+    drift), and every ``local_step_interval`` steps the accumulated updates
+    are 1-bit averaged and applied to the synced parameters, with the
+    momentum re-estimated from the averaged accumulated update divided by
+    the accumulated learning rate. The interval doubles every
+    ``local_step_scaler`` steps, clipped to ``local_step_clipper``.
+
+Engine integration: the engine's master params always hold the last SYNCED
+value; the per-worker drift lives in the ``momentum_acc`` state (= minus the
+accumulated local updates), and ``forward_params`` rebuilds the drifted
+replica (master + acc) for each worker's forward/backward. Error-feedback
+buffers are reset at the phase boundary (the reference reinitializes them
+because the compressed metric changes from gradients to accumulated
+momentum).
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .common import build_compressed_train_step
+
+
+@dataclass(frozen=True)
+class ZeroOneAdam:
+    lr: float = 1e-3
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    var_freeze_step: int = 100000
+    var_update_scaler: int = 16
+    local_step_scaler: int = 32768
+    local_step_clipper: int = 16
+
+
+def build_zeroone_adam(params: Dict[str, Any]) -> ZeroOneAdam:
+    kw = dict(params)
+    if "betas" in kw:
+        kw["betas"] = tuple(kw["betas"])
+    for drop in ("cuda_aware", "comm_backend_name", "bias_correction",
+                 "max_grad_norm", "amsgrad", "eps_inside_sqrt"):
+        kw.pop(drop, None)
+    return ZeroOneAdam(**kw)
+
+
+class ZeroOneAdamImpl:
+    def __init__(self, opt: ZeroOneAdam):
+        self.opt = opt
+
+    def init_extra(self, ctx):
+        n = ctx.n
+        # fresh buffers per entry — sharing one zeros tree across entries
+        # would alias donated buffers in the compiled step
+        zeros = lambda: jax.tree_util.tree_unflatten(  # noqa: E731
+            ctx.treedef, [jnp.zeros(s, jnp.float32) for s in ctx.shapes])
+        lead_zeros = lambda: jax.tree.map(  # noqa: E731
+            lambda l: jnp.zeros((n,) + l.shape, jnp.float32), zeros())
+        i32 = lambda x: jnp.asarray(x, jnp.int32)  # noqa: E731
+        return {
+            "exp_avg": (lead_zeros(), "lead"),
+            "exp_avg_sq": (zeros(), "repl"),
+            # minus the accumulated local updates (the reference's
+            # momentum_accumulator); drifted replica = master + acc
+            "momentum_acc": (lead_zeros(), "lead"),
+            "lrs": (jnp.zeros((), jnp.float32), "repl"),
+            "var_interval": (i32(1), "repl"),
+            "var_counter": (i32(0), "repl"),
+            "local_step_interval": (i32(1), "repl"),
+            "local_step_counter": (i32(0), "repl"),
+            "worker_error": (jnp.zeros((n, ctx.padded), jnp.float32), "lead"),
+            "server_error": (jnp.zeros((n, ctx.padded // n), jnp.float32),
+                             "lead"),
+        }
+
+    def forward_params(self, ctx, params, master, state):
+        """Gradients are taken at the drifted per-worker replica."""
+        return jax.tree.map(
+            lambda mp, a: (mp + a).astype(ctx.compute_dtype),
+            master, state["momentum_acc"])
+
+    def update(self, ctx, grads, master, state, step, lr):
+        opt = self.opt
+        b1, b2 = opt.betas
+        axes = ctx.axes
+        state_step = step + 1  # reference counts steps from 1
+
+        def var_phase(args):
+            """Variance-update policy: dense refresh on var_interval,
+            1-bit averaged gradient otherwise."""
+            (m, v, acc, lrs, vi, vc, li, lc, werr, serr, grads) = args
+            dense_now = (state_step % vi) == 0
+
+            def dense(ops):
+                m, v, werr, serr, grads = ops
+                g = jax.tree.map(lambda g_: jax.lax.pmean(g_, axes), grads)
+                v = jax.tree.map(lambda v_, g_: b2 * v_ + (1 - b2) * g_ * g_,
+                                 v, g)
+                m = jax.tree.map(lambda m_, g_: b1 * m_ + (1 - b1) * g_, m, g)
+                return m, v, werr, serr, ctx.tree_norm_sq(g)
+
+            def onebit(ops):
+                m, v, werr, serr, grads = ops
+                g, werr, serr = ctx.compressed_mean(grads, werr, serr)
+                g = ctx.mask_dead(g, v)
+                m = jax.tree.map(lambda m_, g_: b1 * m_ + (1 - b1) * g_, m, g)
+                return m, v, werr, serr, ctx.tree_norm_sq(g)
+
+            m, v, werr, serr, gnorm_sq = jax.lax.cond(
+                dense_now, dense, onebit, (m, v, werr, serr, grads))
+
+            # exponential interval growth: every var_update_scaler dense
+            # refreshes, the interval doubles
+            vc = jnp.where(dense_now, vc + 1, vc)
+            doubled = vc == opt.var_update_scaler
+            vc = jnp.where(doubled, 0, vc)
+            vi = jnp.where(doubled, vi * 2, vi)
+
+            upd = jax.tree.map(
+                lambda m_, v_, p: m_ / (jnp.sqrt(v_) + opt.eps)
+                + opt.weight_decay * p, m, v, master)
+            new_master = jax.tree.map(lambda p, u: p - lr * u, master, upd)
+            return (m, v, acc, lrs, vi, vc, li, lc, werr, serr, new_master,
+                    gnorm_sq)
+
+        def local_phase(args):
+            """Local-step policy: frozen variance, drifting replicas,
+            periodic 1-bit sync of accumulated updates."""
+            (m, v, acc, lrs, vi, vc, li, lc, werr, serr, grads) = args
+            is_first = step == opt.var_freeze_step
+            # compressed metric changes (grads -> accumulated momentum):
+            # reset error feedback at the boundary (reference
+            # reinitial_error_buffer)
+            werr = jnp.where(is_first, jnp.zeros_like(werr), werr)
+            serr = jnp.where(is_first, jnp.zeros_like(serr), serr)
+
+            p_drift = jax.tree.map(lambda p, a: p + a, master, acc)
+            m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, m, grads)
+            lrs = lrs + lr
+            upd = jax.tree.map(
+                lambda m_, v_, p: m_ / (jnp.sqrt(v_) + opt.eps)
+                + opt.weight_decay * p, m, v, p_drift)
+            acc = jax.tree.map(lambda a, u: a - lr * u, acc, upd)
+
+            sync_now = (state_step % li) == 0
+
+            def sync(ops):
+                m, v, acc, lrs, werr, serr = ops
+                denom = jax.tree.map(
+                    lambda v_: jnp.sqrt(v_) + opt.eps, v)
+                buf = jax.tree.map(lambda a, d: a * d, acc, denom)
+                buf, werr, serr = ctx.compressed_mean(buf, werr, serr)
+                buf = ctx.mask_dead(buf, v)
+                m = jax.tree.map(
+                    lambda b: -b / jnp.maximum(lrs, 1e-12), buf)
+                new_master = jax.tree.map(
+                    lambda p, b, d: p + b / d, master, buf, denom)
+                acc = jax.tree.map(jnp.zeros_like, acc)
+                return m, acc, jnp.zeros_like(lrs), werr, serr, new_master
+
+            def no_sync(ops):
+                m, v, acc, lrs, werr, serr = ops
+                # engine master stays at the last synced value; the drift
+                # continues to live in acc
+                return m, acc, lrs, werr, serr, master
+
+            m, acc, lrs, werr, serr, new_master = jax.lax.cond(
+                sync_now, sync, no_sync, (m, v, acc, lrs, werr, serr))
+
+            # interval growth: doubles every local_step_scaler steps,
+            # clipped to local_step_clipper
+            lc = lc + 1
+            grown = lc == opt.local_step_scaler
+            lc = jnp.where(grown, 0, lc)
+            li = jnp.where(grown,
+                           jnp.minimum(li * 2, opt.local_step_clipper), li)
+
+            gnorm_sq = jax.lax.pmean(ctx.tree_norm_sq(grads), axes)
+            return (m, v, acc, lrs, vi, vc, li, lc, werr, serr, new_master,
+                    gnorm_sq)
+
+        (m, v, acc, lrs, vi, vc, li, lc, werr, serr, new_master,
+         gnorm_sq) = jax.lax.cond(
+            step < opt.var_freeze_step, var_phase, local_phase,
+            (state["exp_avg"], state["exp_avg_sq"], state["momentum_acc"],
+             state["lrs"], state["var_interval"], state["var_counter"],
+             state["local_step_interval"], state["local_step_counter"],
+             state["worker_error"], state["server_error"], grads))
+
+        new_state = {"exp_avg": m, "exp_avg_sq": v, "momentum_acc": acc,
+                     "lrs": lrs, "var_interval": vi, "var_counter": vc,
+                     "local_step_interval": li, "local_step_counter": lc,
+                     "worker_error": werr, "server_error": serr}
+        return new_master, new_state, gnorm_sq
+
+
+def build_zeroone_adam_train_step(engine):
+    """(train_step_jit, opt_state) for the 0/1 Adam engine path."""
+    opt = build_zeroone_adam(engine.config.optimizer.params)
+    return build_compressed_train_step(engine, ZeroOneAdamImpl(opt))
