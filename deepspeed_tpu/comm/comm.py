@@ -211,6 +211,56 @@ def inference_all_reduce(x, axis_name="model", group=None):
     return lax.psum(x, _maybe_tuple(group or axis_name))
 
 
+# --- Megatron-style tensor-parallel boundary ops (reference AutoTP inserts
+# the same pair around sharded Linears, module_inject/auto_tp.py). Needed
+# as custom-VJP ops because under shard_map without replication tracking a
+# bare psum transposes to psum, double-counting replicated cotangents.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_copy(x, axis_name="model"):
+    """Identity forward / psum backward: marks a replicated activation
+    entering a column-parallel region (Megatron's ``f``). The backward psum
+    sums the per-shard partial input-cotangents."""
+    return x
+
+
+def _tp_copy_fwd(x, axis_name):
+    return x, None
+
+
+def _tp_copy_bwd(axis_name, _res, ct):
+    try:
+        return (lax.psum(ct, axis_name),)
+    except NameError:  # axis unbound: not under shard_map -> no TP
+        return (ct,)
+
+
+tp_copy.defvjp(_tp_copy_fwd, _tp_copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_reduce(x, axis_name="model"):
+    """psum forward / identity backward: reduces the partial outputs of a
+    row-parallel region to the full (replicated) activation (Megatron's
+    ``g``). The cotangent of a replicated output is already complete on
+    every shard."""
+    try:
+        return lax.psum(x, axis_name)
+    except NameError:  # axis unbound: not under shard_map -> no TP
+        return x
+
+
+def _tp_reduce_fwd(x, axis_name):
+    return tp_reduce(x, axis_name), None
+
+
+def _tp_reduce_bwd(axis_name, _res, ct):
+    return (ct,)
+
+
+tp_reduce.defvjp(_tp_reduce_fwd, _tp_reduce_bwd)
+
+
 @timed_op
 def all_gather_into_tensor(x, axis_name="data", axis: int = 0, group=None, tiled: bool = True):
     """Gather shards along `axis` (reference comm/comm.py:297)."""

@@ -393,6 +393,82 @@ class TransformerLM:
                              in_specs=(param_specs, ids_spec) + mask_specs,
                              out_specs=P(), check_vma=False)(*args)
 
+    def loss_and_grads(self, params, batch, rng=None):
+        """(loss, grads) through the bounded-memory 1F1B pipeline
+        (runtime/pipe/pipeline.py pipeline_1f1b) — the training path under
+        pp>1; replaces autodiff over the GPipe-shaped forward scan whose
+        tick stack grew with the microbatch count. batch: {input_ids
+        [M, B, S], optional loss_mask}."""
+        from ..runtime.pipe.pipeline import pipeline_1f1b, stage_index
+        from ..parallel.topology import PIPE_AXIS
+
+        topo = self.topology
+        cfg = self.cfg
+        pp = topo.axis_size(PIPE_AXIS)
+        ids = batch["input_ids"]
+        M, B, S = ids.shape
+        cos, sin = _rope_tables(cfg, S)
+        dp_axes = topo.dp_axes
+        bt = topo.batch_axes
+        param_specs = self.param_partition_specs(topo)
+        ids_spec = P(None, bt, None)
+        mask = batch.get("loss_mask")
+        mask_specs = (ids_spec,) if mask is not None else ()
+        # stacked layer weights are pipe-SHARDED (each stage owns its
+        # slice); everything else is replicated over pipe
+        reduce_mask = {k: jax.tree.map(lambda _: k != "layers", v)
+                       for k, v in params.items()}
+
+        def body(p, ids_l, *mask_l):
+            cos_c = cos.astype(p["embed"].dtype)
+            sin_c = sin.astype(p["embed"].dtype)
+            layer_body = self._layer
+            if cfg.remat:
+                from ..runtime.activation_checkpointing import (
+                    checkpointing as ds_ckpt)
+                layer_body = ds_ckpt.checkpoint_wrapper(self._layer)
+
+            def stage_fn(pp_, ids_mb, h):
+                x0 = pp_["embed"][ids_mb]
+                x = jnp.where(stage_index() == 0, x0, h)
+
+                def scan_fn(carry, lp):
+                    out, _aux = layer_body(carry, lp, cos_c, sin_c)
+                    return out, None
+
+                out, _ = jax.lax.scan(scan_fn, x, pp_["layers"])
+                return out
+
+            def loss_fn(p_, ys, ids_mb, *m_mb):
+                ys = self._norm(ys, p_["final_norm"], p_.get("final_norm_b"))
+                head = (p_["embed"].T if cfg.tie_embeddings
+                        else p_["lm_head"])
+                logits = (ys @ head.astype(ys.dtype)).astype(
+                    jnp.float32)[:, :-1]
+                targets = ids_mb[:, 1:]
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                nll = -jnp.take_along_axis(logp, targets[..., None],
+                                           axis=-1)[..., 0]
+                if m_mb:
+                    m = m_mb[0][:, 1:].astype(jnp.float32)
+                    return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+                return jnp.mean(nll)
+
+            b_local = ids_l.shape[1]
+            h_spec = jax.ShapeDtypeStruct((b_local, S, cfg.hidden_size),
+                                          p["embed"].dtype)
+            return pipeline_1f1b(
+                stage_fn, loss_fn, p, ids_l, pp, h_spec=h_spec,
+                loss_args=(ids_l,) + tuple(mask_l), dp_axes=dp_axes,
+                pipe_reduce_mask=reduce_mask)
+
+        args = (params, ids) + ((mask,) if mask is not None else ())
+        grad_specs = param_specs
+        return jax.shard_map(body, mesh=topo.mesh,
+                             in_specs=(param_specs, ids_spec) + mask_specs,
+                             out_specs=(P(), grad_specs),
+                             check_vma=False)(*args)
+
     def apply(self, params, batch, train: bool = True, rng=None):
         """Next-token LM loss. batch: {input_ids [B,S], optional loss_mask};
         with pipeline parallelism active, input_ids is [M, B, S]."""

@@ -1,0 +1,334 @@
+"""Generic layer-list pipeline API.
+
+TPU-native equivalent of the reference's PipelineModule family
+(runtime/pipe/module.py: LayerSpec :30, TiedLayerSpec :77, PipelineModule
+:86, _partition_layers :370 with ``parameters|uniform|type:regex``
+methods). A user describes their model as an ordered list of layers; the
+module partitions them into pp contiguous stages and trains them through
+the compiled 1F1B pipeline (pipeline.py pipeline_1f1b) over the "pipe"
+mesh axis.
+
+Layer protocol (functional, matching the engine's model protocol):
+  layer.init(rng) -> params pytree
+  layer.apply(params, x) -> x            # may use mesh collectives (TP)
+  layer.partition_spec(topo) -> spec pytree   [optional: TP sharding]
+
+Design departures from the reference, driven by XLA/SPMD:
+  * One compiled program runs on every device; each stage executes its own
+    contiguous layer slice via lax.switch on the pipe-axis index (the
+    reference builds a different torch module per rank).
+  * Parameter STORAGE is replicated over the pipe axis (stage-sliced
+    storage would make the per-device param structure heterogeneous, which
+    SPMD cannot express); parameter-memory scaling comes from ZeRO sharding
+    over the data axes, which composes orthogonally. Compute is still
+    stage-local: only the owning stage's branch touches a layer.
+  * Inter-stage activations must share ONE shape/dtype (the reference
+    pre-allocates fixed p2p buffers per num_pipe_buffers the same way,
+    schedule.py:247). Stage 0 consumes the raw microbatch input directly.
+  * Tied layers (TiedLayerSpec, e.g. embedding+head) share one parameter
+    tree under params["tied"][key]; the gradient psum over the pipe axis
+    inside pipeline_1f1b sums every stage's contribution — the reference's
+    _exec_reduce_tied_grads (pipe/engine.py:249) done by the compiler.
+"""
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ...parallel.topology import PIPE_AXIS
+from .pipeline import pipeline_1f1b
+
+__all__ = ["LayerSpec", "TiedLayerSpec", "PipelineModule",
+           "partition_balanced"]
+
+
+class LayerSpec:
+    """Deferred layer construction (reference pipe/module.py:30): holds the
+    layer class and ctor args so the module can build, count and partition
+    layers before any parameters exist."""
+
+    def __init__(self, typename, *args, **kwargs):
+        self.typename = typename
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self):
+        return self.typename(*self.args, **self.kwargs)
+
+    @property
+    def type_name(self) -> str:
+        return getattr(self.typename, "__name__", str(self.typename))
+
+
+class TiedLayerSpec(LayerSpec):
+    """LayerSpec whose parameters are shared with every other TiedLayerSpec
+    of the same ``key`` (reference pipe/module.py:77): the canonical tied
+    embedding/LM-head pattern."""
+
+    def __init__(self, key, typename, *args, forward_fn=None, **kwargs):
+        super().__init__(typename, *args, **kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+def partition_balanced(weights: Sequence[float], parts: int) -> List[int]:
+    """Optimal contiguous partition minimizing the max part weight
+    (reference deepspeed/runtime/utils.py partition_balanced used by
+    _partition_layers). Returns part boundaries of length parts+1."""
+    n = len(weights)
+    if n and not any(w > 0 for w in weights):
+        raise ValueError(
+            "partition weights are all zero (e.g. a type:regex that matches "
+            "no layer) — cannot balance stages")
+    prefix = np.concatenate([[0.0], np.cumsum(weights)])
+
+    def max_part(bounds):
+        return max(prefix[b] - prefix[a] for a, b in zip(bounds, bounds[1:]))
+
+    # binary search on capacity + greedy packing (optimal for contiguous)
+    lo = max(weights) if weights else 0.0
+    hi = float(prefix[-1])
+    best = None
+    for _ in range(64):
+        cap = (lo + hi) / 2.0
+        bounds, start, used = [0], 0, 1
+        ok = True
+        for i in range(n):
+            if prefix[i + 1] - prefix[start] > cap + 1e-9:
+                if i == start:  # single item exceeds cap
+                    ok = False
+                    break
+                bounds.append(i)
+                start = i
+                used += 1
+                if used > parts:
+                    ok = False
+                    break
+        if ok and used <= parts:
+            bounds = bounds + [n]
+            while len(bounds) < parts + 1:  # pad empty TAIL parts (never
+                bounds.append(n)            # an empty stage 0)
+            best = bounds
+            hi = cap
+        else:
+            lo = cap
+    if best is None:
+        best = list(np.linspace(0, n, parts + 1).astype(int))
+    return [int(b) for b in best]
+
+
+class PipelineModule:
+    """Layer-list model trained through the compiled 1F1B pipeline.
+
+    Parameters
+    ----------
+    layers : list of LayerSpec/TiedLayerSpec or already-built layer objects.
+    loss_fn : (last_stage_output, batch_without_x) -> scalar microbatch loss.
+    partition_method : "parameters" (balance by param count, the reference
+        default), "uniform" (equal layer counts), or "type:REGEX" (balance
+        the count of layers whose class name matches REGEX).
+    activation_spec : jax.ShapeDtypeStruct of the inter-stage activation
+        for ONE microbatch. If omitted it is probed from stage 0's output.
+    """
+
+    supports_pp_tp = True  # engine may compose pipe with the model axis
+
+    def __init__(self, layers, loss_fn: Callable,
+                 partition_method: str = "parameters",
+                 activation_spec=None, input_ndim: Optional[int] = None):
+        # input_ndim: rank of ONE microbatch's "x" (e.g. 2 for [b, D]);
+        # lets apply() accept both [M, b, ...] and single-micro [b, ...]
+        self.input_ndim = input_ndim
+        self.specs = list(layers)
+        self.layers = [s.build() if isinstance(s, LayerSpec) else s
+                       for s in self.specs]
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_spec = activation_spec
+        self.topology = None
+        self._bounds = None
+        # tied-parameter wiring: layer index -> tied key
+        self.tied_keys: Dict[int, str] = {
+            i: s.key for i, s in enumerate(self.specs)
+            if isinstance(s, TiedLayerSpec)}
+
+    # -- engine protocol ---------------------------------------------------
+    def set_topology(self, topo):
+        self.topology = topo
+        self._bounds = None
+
+    def _param_key(self, i: int) -> str:
+        return f"layer_{i:03d}"
+
+    def init_params(self, rng):
+        params: Dict[str, Any] = {}
+        tied: Dict[str, Any] = {}
+        for i, layer in enumerate(self.layers):
+            rng, sub = jax.random.split(rng)
+            if i in self.tied_keys:
+                key = self.tied_keys[i]
+                if key not in tied:  # first occurrence owns the params
+                    tied[key] = layer.init(sub)
+            else:
+                params[self._param_key(i)] = layer.init(sub)
+        if tied:
+            params["tied"] = tied
+        return params
+
+    def param_partition_specs(self, topo):
+        """Per-layer TP specs if a layer provides them; otherwise
+        replicated. The pipe axis never appears: storage is replicated
+        over pipe by design (see module docstring)."""
+        def spec_for(i, layer):
+            if hasattr(layer, "partition_spec"):
+                return layer.partition_spec(topo)
+            tpl = jax.eval_shape(layer.init, jax.random.PRNGKey(0))
+            return jax.tree.map(lambda _: P(), tpl)
+
+        specs: Dict[str, Any] = {}
+        tied: Dict[str, Any] = {}
+        for i, layer in enumerate(self.layers):
+            if i in self.tied_keys:
+                key = self.tied_keys[i]
+                if key not in tied:
+                    tied[key] = spec_for(i, layer)
+            else:
+                specs[self._param_key(i)] = spec_for(i, layer)
+        if tied:
+            specs["tied"] = tied
+        return specs
+
+    # -- partitioning (reference _partition_layers, pipe/module.py:370) ----
+    def _layer_weights(self) -> List[float]:
+        method = self.partition_method.lower()
+        if method == "uniform":
+            return [1.0] * len(self.layers)
+        if method == "parameters":
+            weights = []
+            for layer in self.layers:
+                tpl = jax.eval_shape(layer.init, jax.random.PRNGKey(0))
+                weights.append(float(sum(np.prod(l.shape)
+                                         for l in jax.tree.leaves(tpl))))
+            return weights
+        if method.startswith("type:"):
+            pat = re.compile(self.partition_method[len("type:"):],
+                             re.IGNORECASE)
+            return [1.0 if pat.search(
+                        s.type_name if isinstance(s, LayerSpec)
+                        else type(s).__name__) else 0.0
+                    for s in self.specs]
+        raise ValueError(
+            f"unknown partition_method {self.partition_method!r} "
+            f"(expected parameters|uniform|type:regex)")
+
+    def stage_bounds(self, pp: int) -> List[int]:
+        if self._bounds is None or len(self._bounds) != pp + 1:
+            self._bounds = partition_balanced(self._layer_weights(), pp)
+        return self._bounds
+
+    def _layer_params(self, params, i):
+        if i in self.tied_keys:
+            return params["tied"][self.tied_keys[i]]
+        return params[self._param_key(i)]
+
+    def _apply_layer(self, params, i, x):
+        spec = self.specs[i]
+        if isinstance(spec, TiedLayerSpec) and spec.forward_fn is not None:
+            return spec.forward_fn(self._layer_params(params, i), x)
+        return self.layers[i].apply(self._layer_params(params, i), x)
+
+    def _stage_branches(self, pp: int):
+        bounds = self.stage_bounds(pp)
+
+        def make_branch(lo, hi, is_first):
+            def branch(params, x_raw, h):
+                x = x_raw if is_first else h
+                for i in range(lo, hi):
+                    x = self._apply_layer(params, i, x)
+                return x
+            return branch
+
+        return [make_branch(bounds[s], bounds[s + 1], s == 0)
+                for s in range(pp)]
+
+    # -- execution ---------------------------------------------------------
+    def _split_batch(self, batch):
+        x = batch["x"]
+        rest_keys = sorted(k for k in batch if k != "x")
+        return x, rest_keys, tuple(batch[k] for k in rest_keys)
+
+    def loss_and_grads(self, params, batch, rng=None, scale=None):
+        """(loss, grads) through the 1F1B pipeline; called by the engine in
+        pipeline mode instead of value_and_grad (the pipeline IS the
+        gradient computation). batch leaves: [M, global_micro, ...]."""
+        topo = self.topology
+        pp = topo.axis_size(PIPE_AXIS)
+        branches = self._stage_branches(pp)
+        x, rest_keys, rest = self._split_batch(batch)
+        dp_axes = topo.dp_axes
+        bt = topo.batch_axes
+        batch_spec = P(None, bt)
+        param_specs = self.param_partition_specs(topo)
+
+        def loss_fn(_p, out, *largs):
+            # user loss needs no params: loss-side weights (e.g. a tied
+            # head) are ordinary layers in the list
+            return self.loss_fn(out, dict(zip(rest_keys, largs)))
+
+        def body(p, x_l, *rest_l):
+            return pipeline_1f1b(branches, loss_fn, p, x_l, pp,
+                                 h_spec=self.activation_spec,
+                                 loss_args=rest_l, dp_axes=dp_axes)
+
+        sm = jax.shard_map(
+            body, mesh=topo.mesh,
+            in_specs=(param_specs, batch_spec) + (batch_spec,) * len(rest),
+            out_specs=(P(), param_specs),
+            check_vma=False)
+        return sm(params, x, *rest)
+
+    def apply(self, params, batch, train: bool = True, rng=None):
+        """Loss without the pipeline schedule (eval / non-pp fallback):
+        every device runs the full layer stack — parameters are replicated
+        over pipe, so this is legal — with TP collectives intact."""
+        topo = self.topology
+        x, rest_keys, rest = self._split_batch(batch)
+        if self.input_ndim is not None and x.ndim == self.input_ndim:
+            # single microbatch (engine's non-pipeline gas scan): add M=1
+            x = x[None]
+            rest = tuple(r[None] for r in rest)
+        if topo is None:
+            def run(x_m, *rest_m):
+                h = x_m
+                for i in range(len(self.layers)):
+                    h = self._apply_layer(params, i, h)
+                return self.loss_fn(h, dict(zip(rest_keys, rest_m)))
+            losses = [run(x[m], *(r[m] for r in rest))
+                      for m in range(x.shape[0])]
+            return jnp.mean(jnp.stack(losses))
+
+        bt = topo.batch_axes
+        batch_spec = P(None, bt)
+        param_specs = self.param_partition_specs(topo)
+        dp_axes = topo.dp_axes
+
+        def body(p, x_l, *rest_l):
+            def one(m):
+                h = x_l[m]
+                for i in range(len(self.layers)):
+                    h = self._apply_layer(p, i, h)
+                return self.loss_fn(h, dict(zip(rest_keys,
+                                                (r[m] for r in rest_l))))
+            M = x_l.shape[0]
+            loss = jnp.mean(jnp.stack([one(m) for m in range(M)]))
+            return jax.lax.pmean(loss, dp_axes)
+
+        sm = jax.shard_map(
+            body, mesh=topo.mesh,
+            in_specs=(param_specs, batch_spec) + (batch_spec,) * len(rest),
+            out_specs=P(), check_vma=False)
+        return sm(params, x, *rest)

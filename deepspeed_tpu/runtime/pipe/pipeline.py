@@ -88,3 +88,162 @@ def broadcast_from_last(x, num_stages: int):
     """psum trick: zero everywhere but the last stage, then sum over pipe."""
     masked = jnp.where(last_stage_mask(num_stages), x, jnp.zeros_like(x))
     return lax.psum(masked, PIPE_AXIS)
+
+
+def pipeline_1f1b(stage_fn, loss_fn, params, x_microbatches, num_stages: int,
+                  h_spec=None, loss_args=(), dp_axes=(),
+                  pipe_reduce_mask=None):
+    """True 1F1B pipeline with BOUNDED activation memory, inside shard_map.
+
+    The compiled equivalent of the reference's TrainSchedule
+    (runtime/pipe/schedule.py:189) with its ``num_pipe_buffers``
+    bound (schedule.py:247): per stage, at most 2*pp-1 microbatch inputs are
+    live at any tick — independent of the number of microbatches M — versus
+    the GPipe-shaped forward scan that stashed every tick's output.
+
+    Mechanics (one scan over T = M + 2*(pp-1) ticks; every tick has one
+    forward slot and one backward slot, all under SPMD masks):
+
+      * forward slot: stage s runs microbatch m = t - s, stashes its INPUT
+        in a circular [2*pp-1, ...] buffer, and ppermutes the output to
+        stage s+1 (p2p.send -> ICI collective-permute).
+      * backward slot: stage s re-runs its forward from the stashed input
+        under jax.vjp (rematerialization — the reference's activation-
+        checkpointed pipeline recomputes the same way) for microbatch
+        m = t - 2*(pp-1) + s, consuming the output-gradient arriving from
+        stage s+1, accumulating its parameter gradients, and ppermuting the
+        input-gradient to stage s-1 (_exec_send_grads, pipe/engine.py:980).
+      * the LAST stage folds the loss into its backward slot (cotangent
+        1.0), so its backward of microbatch m runs in the same tick as its
+        forward — the 1F1B steady state.
+
+    Parameters
+    ----------
+    stage_fn : (stage_params, x_raw_microbatch, h) -> h_out. Branches on
+        nothing itself: it receives the per-stage params and must return the
+        UNIFORM inter-stage activation. It may be a single callable (all
+        stages structurally identical, e.g. stacked transformer layers) or a
+        list of pp callables (heterogeneous stages, dispatched by
+        lax.switch on the stage index).
+    loss_fn : (params, h_last, *loss_args_mb) -> scalar loss for ONE
+        microbatch. It receives params so loss-side weights (final norm,
+        LM head, tied embeddings) get gradients.
+    params : the (replicated-over-pipe) parameter pytree handed to every
+        stage function.
+    x_microbatches : [M, b, ...] raw input microbatches (consumed by stage
+        0's branch).
+    loss_args : tuple of [M, ...] arrays sliced per-microbatch for the loss
+        (labels, masks).
+    dp_axes : data-parallel axis names to pmean the gradients over.
+
+    pipe_reduce_mask : optional pytree of bool aligned with params. True
+        (default for every leaf) = the param is REPLICATED over pipe, so its
+        gradient is psum'd over the pipe axis — which is also what sums
+        tied-weight contributions from different stages (the reference's
+        _exec_reduce_tied_grads, pipe/engine.py:249). False = the param is
+        pipe-SHARDED (e.g. stacked layer weights, one slice per stage): the
+        local gradient is already complete and must not be reduced.
+
+    Returns (mean_loss, grads): loss replicated across stages; grads are the
+    full parameter gradient on every device.
+    """
+    pp = num_stages
+    stage = lax.axis_index(PIPE_AXIS)
+    M = x_microbatches.shape[0]
+    T = M + 2 * (pp - 1)
+    K = 2 * pp - 1          # circular stash depth: max in-flight for stage 0
+
+    branches = stage_fn if isinstance(stage_fn, (list, tuple)) else None
+
+    def run_stage(p, x_raw, h):
+        if branches is None:
+            return stage_fn(p, x_raw, h)
+        return lax.switch(stage, list(branches), p, x_raw, h)
+
+    def run_last_with_loss(p, x_raw, h, largs):
+        out = run_stage(p, x_raw, h)
+        return loss_fn(p, out, *largs)
+
+    fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+    bwd_perm = [(i + 1, i) for i in range(pp - 1)]
+
+    if h_spec is None:
+        # probe the inter-stage activation shape from stage 0's branch
+        # (stage 0 must ignore its h argument, so None is safe there)
+        h_spec = jax.eval_shape(
+            lambda p, x: (stage_fn[0] if branches is not None else stage_fn)(
+                p, x, None),
+            params, x_microbatches[0])
+    zeros_h = jnp.zeros(h_spec.shape, h_spec.dtype)
+
+    grads0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+    def tick(carry, t):
+        fwd_buf, bwd_buf, stash, grads_acc, loss_acc = carry
+
+        # ---------------- forward slot ----------------
+        m_f = t - stage
+        f_active = (m_f >= 0) & (m_f < M)
+        m_f_c = jnp.clip(m_f, 0, M - 1)
+        x_raw = x_microbatches[m_f_c]
+        h_in = jnp.where(stage == 0, zeros_h, fwd_buf)
+        out = run_stage(params, x_raw, h_in)
+        # stash this microbatch's INPUT activation for the backward recompute
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(f_active, h_in, stash[m_f_c % K]),
+            m_f_c % K, axis=0)
+        new_fwd = lax.ppermute(jnp.where(f_active, out, jnp.zeros_like(out)),
+                               PIPE_AXIS, perm=fwd_perm)
+
+        # ---------------- backward slot ----------------
+        m_b = t - 2 * (pp - 1) + stage
+        b_active = (m_b >= 0) & (m_b < M)
+        m_b_c = jnp.clip(m_b, 0, M - 1)
+        x_raw_b = x_microbatches[m_b_c]
+        h_in_b = stash[m_b_c % K]
+        largs = tuple(a[m_b_c] for a in loss_args)
+
+        def bwd_last(p):
+            lval, vjp = jax.vjp(
+                lambda pp_, h_: run_last_with_loss(pp_, x_raw_b, h_, largs),
+                p, h_in_b)
+            gp, gh = vjp(jnp.ones_like(lval))
+            return lval.astype(jnp.float32), gp, gh
+
+        def bwd_mid(p):
+            _, vjp = jax.vjp(
+                lambda pp_, h_: run_stage(pp_, x_raw_b, h_), p, h_in_b)
+            gp, gh = vjp(bwd_buf)
+            return jnp.zeros((), jnp.float32), gp, gh
+
+        loss_m, gp, gh = lax.cond(stage == pp - 1, bwd_last, bwd_mid, params)
+        gp = jax.tree.map(
+            lambda a, g: a + jnp.where(b_active, g.astype(jnp.float32), 0.0),
+            grads_acc, gp)
+        loss_acc = loss_acc + jnp.where(b_active, loss_m, 0.0)
+        new_bwd = lax.ppermute(
+            jnp.where(b_active, gh, jnp.zeros_like(gh)), PIPE_AXIS,
+            perm=bwd_perm)
+        return (new_fwd, new_bwd, stash, gp, loss_acc), None
+
+    stash0 = jnp.zeros((K,) + tuple(h_spec.shape), h_spec.dtype)
+    # gradient cotangents travel between stages in the activation dtype
+    # (the reference ships fp16 grads through p2p the same way)
+    carry0 = (zeros_h, jnp.zeros(h_spec.shape, h_spec.dtype), stash0, grads0,
+              jnp.zeros((), jnp.float32))
+    carry, _ = lax.scan(tick, carry0, jnp.arange(T))
+    _fwd, _bwd, _stash, grads, loss_sum = carry
+    loss = broadcast_from_last(loss_sum / M, pp)
+    # the scan accumulated per-microbatch gradients; the loss is the MEAN
+    # over microbatches, so the gradient is too
+    grads = jax.tree.map(lambda g: g / M, grads)
+    if pipe_reduce_mask is None:
+        grads = jax.tree.map(lambda g: lax.psum(g, PIPE_AXIS), grads)
+    else:
+        grads = jax.tree.map(
+            lambda g, m: lax.psum(g, PIPE_AXIS) if m else g,
+            grads, pipe_reduce_mask)
+    if dp_axes:
+        loss = lax.pmean(loss, dp_axes)
+        grads = jax.tree.map(lambda g: lax.pmean(g, dp_axes), grads)
+    return loss, grads

@@ -40,9 +40,11 @@ def test_package_data_covers_csrc():
 
 def test_ds_tpu_report_runs():
     """ds_tpu_report's target prints the env report and returns 0
-    (reference bin/ds_report)."""
+    (reference bin/ds_report). Pins the CPU backend so the test never
+    hangs on an unreachable TPU tunnel (the report itself probes devices)."""
     out = subprocess.run(
         [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu');"
          "from deepspeed_tpu.env_report import main; raise SystemExit(main())"],
         capture_output=True, text=True, cwd=REPO, timeout=120)
     assert out.returncode == 0, out.stderr
