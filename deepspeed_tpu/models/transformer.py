@@ -65,6 +65,11 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.0
     moe_min_capacity: int = 4
     moe_aux_loss_coef: float = 0.01
+    # Residual MoE (PR-MoE building block, reference moe/layer.py:29
+    # use_residual): dense MLP + coefficient-weighted routed experts
+    moe_use_residual: bool = False
+    # drop_tokens=False equivalent: ragged_dot grouped GEMM, ep=1 only
+    moe_dropless: bool = False
 
     @property
     def kv_heads(self) -> int:
@@ -149,7 +154,7 @@ class TransformerLM:
         hd, nh, nkv = cfg.head_dim, cfg.num_heads, cfg.kv_heads
         L = cfg.num_layers
         dt = jnp.float32
-        k = jax.random.split(rng, 12)
+        k = jax.random.split(rng, 17)
         std = 0.02
         out_std = std / math.sqrt(2 * L)
 
@@ -170,6 +175,12 @@ class TransformerLM:
             layer["e_gate"] = init(k[8], (L, E, h, ffn))
             layer["e_up"] = init(k[10], (L, E, h, ffn))
             layer["e_down"] = init(k[11], (L, E, ffn, h), out_std)
+            if cfg.moe_use_residual:
+                layer["res_gate"] = init(k[12], (L, h, ffn))
+                layer["res_up"] = init(k[13], (L, h, ffn))
+                layer["res_down"] = init(k[14], (L, ffn, h), out_std)
+                layer["res_coef_w"] = init(k[15], (L, h, 2))
+                layer["res_coef_b"] = jnp.zeros((L, 2), dt)
         elif cfg.activation == "swiglu":
             layer["w_gate"] = init(k[4], (L, h, ffn))
             layer["w_up"] = init(k[5], (L, h, ffn))
@@ -217,6 +228,12 @@ class TransformerLM:
             layer["e_gate"] = P(pipe, ep, None, "model" if tp > 1 else None)
             layer["e_up"] = P(pipe, ep, None, "model" if tp > 1 else None)
             layer["e_down"] = P(pipe, ep, "model" if tp > 1 else None, None)
+            if cfg.moe_use_residual:
+                layer["res_gate"] = col
+                layer["res_up"] = col
+                layer["res_down"] = row
+                layer["res_coef_w"] = P(pipe, None, None)
+                layer["res_coef_b"] = P(pipe, None)
         elif cfg.activation == "swiglu":
             layer["w_gate"] = col
         else:
@@ -276,18 +293,34 @@ class TransformerLM:
         hn = self._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"))
         aux = jnp.zeros((), jnp.float32)
         if cfg.moe_num_experts > 0:
-            from ..moe.sharded_moe import moe_layer
+            from ..moe.sharded_moe import (moe_layer, moe_layer_dropless,
+                                           residual_moe_combine)
 
             def expert_fn(p, xe):
                 wg, wu, wd = p
                 return (jax.nn.silu(xe @ wg) * (xe @ wu)) @ wd
 
-            mlp_out, aux = moe_layer(
-                hn, lp["moe_gate_w"], (lp["e_gate"], lp["e_up"], lp["e_down"]),
-                expert_fn, self.topology, top_k=cfg.moe_top_k,
-                capacity_factor=cfg.moe_capacity_factor,
-                min_capacity=cfg.moe_min_capacity)
-            x = x + mlp_out
+            experts = (lp["e_gate"], lp["e_up"], lp["e_down"])
+            if cfg.moe_dropless:
+                if cfg.moe_top_k != 1:
+                    raise NotImplementedError(
+                        "moe_dropless supports top-1 routing only "
+                        f"(got moe_top_k={cfg.moe_top_k})")
+                moe_out, aux = moe_layer_dropless(
+                    hn, lp["moe_gate_w"], experts, topo=self.topology)
+            else:
+                moe_out, aux = moe_layer(
+                    hn, lp["moe_gate_w"], experts,
+                    expert_fn, self.topology, top_k=cfg.moe_top_k,
+                    capacity_factor=cfg.moe_capacity_factor,
+                    min_capacity=cfg.moe_min_capacity)
+            if cfg.moe_use_residual:
+                dense = (jax.nn.silu(hn @ lp["res_gate"])
+                         * (hn @ lp["res_up"])) @ lp["res_down"]
+                moe_out = residual_moe_combine(hn, moe_out, dense,
+                                               lp["res_coef_w"],
+                                               lp["res_coef_b"])
+            x = x + moe_out
         elif cfg.activation == "swiglu":
             g = jax.nn.silu(hn @ lp["w_gate"])
             u = hn @ lp["w_up"]
@@ -353,6 +386,9 @@ class TransformerLM:
 
         def body(params, ids_local, *mask_local):
             x = params["embed"][ids_local]               # [M, b, S, H] (all stages)
+            if cfg.positional == "learned":
+                x = x + params["pos_embed"][None, None, :x.shape[2]].astype(
+                    x.dtype)
             cos_c = cos.astype(x.dtype)
             sin_c = sin.astype(x.dtype)
             layers_local = params["layers"]              # [L/pp, ...]
@@ -430,6 +466,9 @@ class TransformerLM:
 
             def stage_fn(pp_, ids_mb, h):
                 x0 = pp_["embed"][ids_mb]
+                if cfg.positional == "learned":
+                    x0 = x0 + pp_["pos_embed"][None, :x0.shape[1]].astype(
+                        x0.dtype)
                 x = jnp.where(stage_index() == 0, x0, h)
 
                 def scan_fn(carry, lp):
@@ -440,19 +479,17 @@ class TransformerLM:
                 return out
 
             def loss_fn(p_, ys, ids_mb, *m_mb):
+                # per-microbatch masked mean, averaged over microbatches by
+                # the pipeline — the same mean-of-means the engine's gas
+                # scan computes on the non-pipeline path
                 ys = self._norm(ys, p_["final_norm"], p_.get("final_norm_b"))
                 head = (p_["embed"].T if cfg.tie_embeddings
                         else p_["lm_head"])
-                logits = (ys @ head.astype(ys.dtype)).astype(
-                    jnp.float32)[:, :-1]
-                targets = ids_mb[:, 1:]
-                logp = jax.nn.log_softmax(logits, axis=-1)
-                nll = -jnp.take_along_axis(logp, targets[..., None],
-                                           axis=-1)[..., 0]
-                if m_mb:
-                    m = m_mb[0][:, 1:].astype(jnp.float32)
-                    return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
-                return jnp.mean(nll)
+                m = (m_mb[0][:, 1:].astype(jnp.float32) if m_mb
+                     else jnp.ones(ids_mb[:, 1:].shape, jnp.float32))
+                total, count = _chunked_ce_loss(ys[:, :-1], ids_mb[:, 1:],
+                                                m, head, cfg.loss_chunk)
+                return total / jnp.maximum(count, 1.0)
 
             b_local = ids_l.shape[1]
             h_spec = jax.ShapeDtypeStruct((b_local, S, cfg.hidden_size),

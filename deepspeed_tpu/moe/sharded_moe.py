@@ -36,9 +36,8 @@ def top1gating(logits, capacity_factor: float = 1.0, min_capacity: int = 4,
                drop_tokens: bool = True):
     if not drop_tokens:
         raise NotImplementedError(
-            "dropless MoE requires ragged dispatch (planned via "
-            "jax.lax.ragged_dot); only drop_tokens=True (the reference's "
-            "static-capacity mode) is supported")
+            "use moe_layer_dropless (jax.lax.ragged_dot grouped GEMM) for "
+            "drop_tokens=False; the einsum dispatch path is capacity-based")
     """Switch-style top-1 gating (reference sharded_moe.py:184).
 
     logits: [T, E]. Returns (aux_loss, combine [T,E,C], dispatch mask [T,E,C]).
@@ -150,3 +149,73 @@ def moe_layer(x, gate_w, expert_params, expert_fn, topo=None,
     ye = jax.vmap(expert_fn)(expert_params, xe)                 # [E, C, H]
     out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), ye)
     return out.reshape(B, S, H), aux.astype(jnp.float32)
+
+
+def ragged_swiglu_experts(expert_params, xs, group_sizes):
+    """SwiGLU expert stack as grouped GEMMs over token groups.
+
+    The TPU-native equivalent of the reference's CUTLASS MoE grouped GEMM
+    (inference/v2/kernels/cutlass_ops/moe_gemm): `jax.lax.ragged_dot` tiles
+    the per-expert segments onto the MXU without materializing the [E, C, H]
+    capacity tensor. xs: [T, H] tokens SORTED by expert; group_sizes: [E].
+    """
+    wg, wu, wd = expert_params                                 # [E, H, F] ...
+    g = jax.lax.ragged_dot(xs, wg, group_sizes)
+    u = jax.lax.ragged_dot(xs, wu, group_sizes)
+    return jax.lax.ragged_dot(jax.nn.silu(g) * u, wd, group_sizes)
+
+
+def moe_layer_dropless(x, gate_w, expert_params, ragged_expert_fn=None,
+                       topo=None, rng=None,
+                       noisy_gate_policy: Optional[str] = None):
+    """Dropless top-1 MoE (the reference's drop_tokens=False mode,
+    sharded_moe.py top1gating dynamic-capacity branch) via sorted tokens +
+    `jax.lax.ragged_dot` grouped GEMM — no token is ever dropped and no
+    [T, E, C] dispatch tensor is built.
+
+    Expert parameters must be device-local (ep=1): ragged groups have
+    data-dependent sizes, which cannot cross a static SPMD all-to-all —
+    the same reason the reference only composes dropless with pure DP.
+    """
+    if topo is not None and topo.axis_size("expert") > 1:
+        raise NotImplementedError(
+            "dropless MoE composes with data parallelism only (expert axis "
+            "must be 1): ragged group sizes are data-dependent and cannot "
+            "ride a static expert all-to-all")
+    B, S, H = x.shape
+    T = B * S
+    E = gate_w.shape[-1]
+    xt = x.reshape(T, H)
+    logits = xt.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    if noisy_gate_policy == "RSample" and rng is not None:
+        logits_w_noise = logits + jax.random.gumbel(rng, logits.shape)
+    else:
+        logits_w_noise = logits
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx = jnp.argmax(logits_w_noise, axis=-1)                   # [T]
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(_one_hot(idx, E), axis=0)
+    aux = jnp.sum(me * ce) * E
+
+    order = jnp.argsort(idx)                                    # stable
+    xs = xt[order]
+    group_sizes = jnp.bincount(idx, length=E).astype(jnp.int32)
+    fn = ragged_expert_fn or ragged_swiglu_experts
+    ys = fn(expert_params, xs, group_sizes)                     # [T, H]
+    ys = jnp.zeros_like(ys).at[order].set(ys)                   # unsort
+    gate_p = jnp.take_along_axis(gates, idx[:, None], axis=-1)  # [T, 1]
+    out = ys * gate_p.astype(ys.dtype)
+    return out.reshape(B, S, H), aux.astype(jnp.float32)
+
+
+def residual_moe_combine(x, moe_out, mlp_out, coef_w, coef_b=None):
+    """Residual-MoE mixture (reference moe/layer.py:118-123, the PR-MoE
+    building block, arXiv:2201.05596): a 2-way softmax over a learned
+    coefficient head weights the routed-expert output against a dense MLP
+    applied to the same input."""
+    coef = x @ coef_w.astype(x.dtype)
+    if coef_b is not None:
+        coef = coef + coef_b.astype(x.dtype)
+    coef = jax.nn.softmax(coef.astype(jnp.float32), axis=-1).astype(x.dtype)
+    return moe_out * coef[..., 0:1] + mlp_out * coef[..., 1:2]

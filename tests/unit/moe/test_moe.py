@@ -120,3 +120,203 @@ def test_moe_top2_model_trains():
     batch = {"input_ids": rng.integers(0, 128, (1, gm, 64), dtype=np.int64)}
     losses = [engine.train_batch(batch=batch) for _ in range(4)]
     assert losses[-1] < losses[0]
+
+
+def _moe_engine(model_cfg_kwargs, config_extra, steps=5, seed=0):
+    cfg = TransformerConfig(vocab_size=128, hidden_size=64,
+                            intermediate_size=128, num_layers=2, num_heads=4,
+                            max_seq_len=64, use_flash=False,
+                            moe_num_experts=4, **model_cfg_kwargs)
+    model = TransformerLM(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "steps_per_print": 100,
+    }
+    config.update(config_extra)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(seed)
+    batch = {"input_ids": rng.integers(0, 128, (1, gm, 64), dtype=np.int64)}
+    losses = [engine.train_batch(batch=batch) for _ in range(steps)]
+    return engine, losses
+
+
+def test_residual_moe_trains():
+    """Residual (PR-MoE building block) layer: dense MLP + coefficient-
+    weighted experts (reference moe/layer.py use_residual)."""
+    engine, losses = _moe_engine({"moe_use_residual": True},
+                                 {"moe": {"enabled": True, "num_experts": 4,
+                                          "expert_parallel_size": 2},
+                                  "zero_optimization": {"stage": 1}})
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    assert "res_coef_w" in engine.params["layers"]
+    # coefficient head actually learns (moved from zero init)
+    cw = np.asarray(engine.params["layers"]["res_coef_b"])
+    assert np.abs(cw).max() > 0
+
+
+def test_pr_moe_pyramid_layers():
+    """PR-MoE proper: residual MoE layers with DIFFERENT expert counts per
+    layer (reference tests SimplePRMoEModel, tests/unit/simple_model.py:106)
+    built directly on the moe_layer API."""
+    from deepspeed_tpu.moe.sharded_moe import moe_layer, residual_moe_combine
+    from jax.sharding import PartitionSpec as P
+
+    H = 32
+
+    class PRMoEModel:
+        """Two residual-MoE blocks: 2 experts then 4 experts (pyramid)."""
+
+        EXPERTS = (2, 4)
+
+        def init_params(self, rng):
+            ks = jax.random.split(rng, 12)
+            p = {}
+            for i, E in enumerate(self.EXPERTS):
+                p[f"blk{i}"] = {
+                    "gate_w": jax.random.normal(ks[4 * i], (H, E)) * 0.02,
+                    "e_w": jax.random.normal(ks[4 * i + 1], (E, H, H)) * 0.05,
+                    "mlp_w": jax.random.normal(ks[4 * i + 2], (H, H)) * 0.05,
+                    "coef_w": jax.random.normal(ks[4 * i + 3], (H, 2)) * 0.02,
+                }
+            p["out_w"] = jax.random.normal(ks[-1], (H, H)) * 0.05
+            return p
+
+        def param_partition_specs(self, topo):
+            ep = "expert" if topo.axis_size("expert") > 1 else None
+            return {
+                "blk0": {"gate_w": P(), "e_w": P(ep, None, None),
+                         "mlp_w": P(), "coef_w": P()},
+                "blk1": {"gate_w": P(), "e_w": P(ep, None, None),
+                         "mlp_w": P(), "coef_w": P()},
+                "out_w": P(),
+            }
+
+        def set_topology(self, topo):
+            self.topology = topo
+
+        def apply(self, params, batch, train=True, rng=None):
+            x = batch["x"]  # [B, H] -> add a seq dim for moe_layer
+            h = x[:, None, :]
+            aux_total = 0.0
+            for i in range(2):
+                blk = params[f"blk{i}"]
+                moe_out, aux = moe_layer(
+                    h, blk["gate_w"], blk["e_w"],
+                    lambda w, xe: jnp.tanh(xe @ w),
+                    self.topology, top_k=1, capacity_factor=2.0)
+                dense = jnp.tanh(h @ blk["mlp_w"])
+                h = h + residual_moe_combine(h, moe_out, dense,
+                                             blk["coef_w"])
+                aux_total = aux_total + aux
+            out = (h[:, 0, :] @ params["out_w"]).astype(jnp.float32)
+            loss = jnp.mean((out - batch["y"].astype(jnp.float32)) ** 2)
+            return loss + 0.01 * aux_total
+
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "moe": {"enabled": True, "num_experts": 4,
+                "expert_parallel_size": 2},
+        "steps_per_print": 100,
+    }
+    model = PRMoEModel()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.standard_normal((1, gm, H)).astype(np.float32),
+             "y": rng.standard_normal((1, gm, H)).astype(np.float32)}
+    losses = [engine.train_batch(batch=batch) for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    # pyramid: per-layer expert tensors keep their own expert count, both
+    # sharded over the expert axis
+    assert engine.params["blk0"]["e_w"].shape[0] == 2
+    assert engine.params["blk1"]["e_w"].shape[0] == 4
+    assert "expert" in str(engine.params["blk1"]["e_w"].sharding.spec)
+
+
+def test_moe_ep_x_zero3():
+    """EP x ZeRO-3 composition: expert tensors shard over BOTH the expert
+    axis and (on a free dim) the data axes (VERDICT round-2 task 4)."""
+    engine, losses = _moe_engine(
+        {}, {"moe": {"enabled": True, "num_experts": 4,
+                     "expert_parallel_size": 2},
+             "zero_optimization": {"stage": 3,
+                                   "stage3_param_persistence_threshold": 0}})
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    spec = str(engine.params["layers"]["e_up"].sharding.spec)
+    assert "expert" in spec and "data" in spec
+    # dense (non-expert) params are zero-3 sharded too
+    assert not engine.params["layers"]["wq"].sharding.is_fully_replicated
+
+
+def test_moe_expert_checkpoint_ep_resize(tmp_path):
+    """Expert checkpoints are stored once as full per-tensor fragments (no
+    per-rank duplication — the dedup the reference does in
+    _save_moe_checkpoint, engine.py:3068) and reload under a DIFFERENT
+    expert_parallel_size."""
+    engine, _ = _moe_engine(
+        {}, {"moe": {"enabled": True, "num_experts": 4,
+                     "expert_parallel_size": 2},
+             "zero_optimization": {"stage": 1}}, steps=3)
+    engine.save_checkpoint(str(tmp_path / "ck"))
+    # exactly ONE fragment file exists per expert tensor (no rank copies)
+    import glob
+    frags = glob.glob(str(tmp_path / "ck" / "*" / "params__layers__e_up.npy"))
+    assert len(frags) == 1
+
+    engine2, _ = _moe_engine(
+        {}, {"moe": {"enabled": True, "num_experts": 4,
+                     "expert_parallel_size": 4},
+             "zero_optimization": {"stage": 1}}, steps=1, seed=9)
+    engine2.load_checkpoint(str(tmp_path / "ck"))
+    a = np.asarray(jax.device_get(engine.params["layers"]["e_up"]))
+    b = np.asarray(jax.device_get(engine2.params["layers"]["e_up"]))
+    np.testing.assert_allclose(b, a, rtol=1e-6)
+    gm = engine2.micro_batch_size * engine2.ds_config.dp_world_size
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 128, (1, gm, 64), dtype=np.int64)}
+    assert np.isfinite(engine2.train_batch(batch=batch))
+
+
+def test_dropless_matches_capacity_mode_when_nothing_drops():
+    """moe_layer_dropless == capacity-mode moe_layer with capacity so large
+    no token is dropped (the reference's drop_tokens=False semantics)."""
+    from deepspeed_tpu.moe.sharded_moe import moe_layer, moe_layer_dropless
+
+    H, E, F = 16, 4, 32
+    rng = jax.random.PRNGKey(0)
+    ks = jax.random.split(rng, 5)
+    x = jax.random.normal(ks[0], (2, 8, H))
+    gate_w = jax.random.normal(ks[1], (H, E)) * 0.5
+    wg = jax.random.normal(ks[2], (E, H, F)) * 0.1
+    wu = jax.random.normal(ks[3], (E, H, F)) * 0.1
+    wd = jax.random.normal(ks[4], (E, F, H)) * 0.1
+
+    def expert_fn(p, xe):
+        g_, u_, d_ = p
+        return (jax.nn.silu(xe @ g_) * (xe @ u_)) @ d_
+
+    out_cap, aux_cap = moe_layer(x, gate_w, (wg, wu, wd), expert_fn,
+                                 top_k=1, capacity_factor=float(E))
+    out_dl, aux_dl = moe_layer_dropless(x, gate_w, (wg, wu, wd))
+    np.testing.assert_allclose(np.asarray(out_dl), np.asarray(out_cap),
+                               rtol=2e-5, atol=1e-6)
+    np.testing.assert_allclose(float(aux_dl), float(aux_cap), rtol=1e-6)
+
+
+def test_dropless_model_trains_and_rejects_ep():
+    engine, losses = _moe_engine({"moe_dropless": True}, {})
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    with pytest.raises(NotImplementedError, match="expert axis"):
+        _moe_engine({"moe_dropless": True},
+                    {"moe": {"enabled": True, "num_experts": 4,
+                             "expert_parallel_size": 2}})

@@ -62,3 +62,33 @@ def test_pipeline_zero1():
 def test_pipeline_rejects_zero3():
     with pytest.raises(AssertionError):
         run(pp=2, micro=1, gas=2, zero=3)
+
+
+def test_pipeline_learned_positions_match_dp():
+    """GPT-2-style (layernorm + learned positions + gelu) under pp=2 must
+    match pure DP — guards the pos_embed path in the pipelined stages."""
+    def run_gpt2(pp, micro, gas):
+        cfg = TransformerConfig(vocab_size=128, hidden_size=64,
+                                intermediate_size=128, num_layers=4,
+                                num_heads=4, max_seq_len=64, use_flash=False,
+                                norm="layernorm", positional="learned",
+                                activation="gelu")
+        model = TransformerLM(cfg)
+        config = {
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": gas,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "pipeline": {"stages": pp},
+            "zero_optimization": {"stage": 0},
+            "steps_per_print": 100,
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+        gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 128, (gas * gm, 64), dtype=np.int64)
+        batch = {"input_ids": ids.reshape(gas, gm, 64)}
+        return [engine.train_batch(batch=batch) for _ in range(3)]
+
+    l_dp = run_gpt2(pp=1, micro=1, gas=4)
+    l_pp = run_gpt2(pp=2, micro=2, gas=4)
+    np.testing.assert_allclose(l_pp, l_dp, rtol=2e-3)
