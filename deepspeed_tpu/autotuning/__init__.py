@@ -1,0 +1,6 @@
+"""Autotuning (reference deepspeed/autotuning/): in-process estimator
+(Autotuner) and launched-subprocess experiment sweep (ExperimentAutotuner +
+ResourceManager)."""
+
+from .autotuner import Autotuner, ExperimentAutotuner  # noqa: F401
+from .scheduler import ExperimentSpec, ResourceManager  # noqa: F401

@@ -48,6 +48,67 @@ class TuneOutcome:
         return self._builder()
 
 
+class ExperimentAutotuner:
+    """Subprocess-experiment autotuner (reference Autotuner.tune,
+    autotuning/autotuner.py:404 + scheduler.py): sweeps zero-stage x
+    micro-batch x model-variant (e.g. attention impl) candidates, each run
+    as an isolated launched process scored by measured throughput, with
+    per-lane early stop (a failed micro batch stops larger ones) and a
+    ranked results file.
+
+    The user script must define ``model_factory(**model_kwargs)`` and
+    ``batch_factory(engine)`` (the reference instead re-launches the user's
+    full training command with rewritten --deepspeed_config files).
+    """
+
+    def __init__(self, script: str, base_config: Dict[str, Any],
+                 exp_dir: str, timeout_s: float = 600.0,
+                 platform: Optional[str] = None,
+                 device_count: Optional[int] = None,
+                 warmup_steps: int = 1, measure_steps: int = 3):
+        from .scheduler import ResourceManager
+
+        self.base_config = dict(base_config)
+        self.manager = ResourceManager(script, exp_dir, timeout_s=timeout_s,
+                                       platform=platform,
+                                       device_count=device_count)
+        self.warmup_steps = warmup_steps
+        self.measure_steps = measure_steps
+
+    def _config_for(self, stage: int, micro: int) -> Dict[str, Any]:
+        cfg = dict(self.base_config)
+        cfg["train_micro_batch_size_per_gpu"] = micro
+        zo = dict(cfg.get("zero_optimization", {}))
+        zo["stage"] = stage
+        cfg["zero_optimization"] = zo
+        cfg.pop("train_batch_size", None)
+        return cfg
+
+    def tune(self, stages: Sequence[int] = (0, 1, 2, 3),
+             micro_batches: Sequence[int] = (1, 2, 4, 8),
+             model_grid: Optional[Sequence[Dict[str, Any]]] = None):
+        """Returns ranked result dicts; also written to
+        exp_dir/autotune_results.json. model_grid: list of model_kwargs
+        variants (e.g. [{"use_flash": True}, {"use_flash": False}])."""
+        from .scheduler import ExperimentSpec
+
+        model_grid = list(model_grid) if model_grid else [{}]
+        results = []
+        for mi, mkw in enumerate(model_grid):
+            for stage in stages:
+                for micro in sorted(micro_batches):
+                    name = f"m{mi}_z{stage}_mb{micro}"
+                    spec = ExperimentSpec(
+                        name=name, config=self._config_for(stage, micro),
+                        model_kwargs=mkw, warmup_steps=self.warmup_steps,
+                        measure_steps=self.measure_steps)
+                    res = self.manager.run_one(spec)
+                    results.append(res)
+                    if not res.get("ok"):
+                        break  # larger micro batches in this lane will fail
+        return self.manager.write_ranked(results)
+
+
 class Autotuner:
     def __init__(self, model_factory: Callable[[], Any],
                  base_config: Dict[str, Any],

@@ -1,0 +1,95 @@
+"""Autotuning experiment runner (one subprocess per candidate config).
+
+The reference autotuner launches each experiment as a separate training run
+through the launcher and parses metrics from its output
+(autotuning/autotuner.py:404 tune -> scheduler.py ResourceManager); this is
+the per-experiment entry point: read a spec JSON, build the user's model,
+time a few steps, write a result JSON. Crashes/OOMs kill only this process,
+and the scheduler's timeout reaps hangs (early-abort).
+
+Spec schema:
+  {"script": "/path/to/user_script.py",   # defines model_factory(**kw)
+                                          # and batch_factory(engine)
+   "config": {...},                       # candidate deepspeed config
+   "model_kwargs": {...},                 # e.g. {"use_flash": false}
+   "warmup_steps": 1, "measure_steps": 3,
+   "platform": "cpu"|null,                # pin a jax platform (tests)
+   "device_count": 8|null}                # virtual host device count
+
+Usage: python -m deepspeed_tpu.autotuning.experiment spec.json result.json
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+
+def _apply_platform(spec):
+    """Platform pinning must happen before jax initializes backends."""
+    n = spec.get("device_count")
+    if n:
+        flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        os.environ["XLA_FLAGS"] = " ".join(
+            flags + [f"--xla_force_host_platform_device_count={n}"])
+    if spec.get("platform"):
+        import jax
+
+        jax.config.update("jax_platforms", spec["platform"])
+
+
+def _load_user_module(path):
+    spec = importlib.util.spec_from_file_location("ds_tpu_autotune_user",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(path)))
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_experiment(spec: dict) -> dict:
+    _apply_platform(spec)
+    import deepspeed_tpu
+
+    mod = _load_user_module(spec["script"])
+    model = mod.model_factory(**spec.get("model_kwargs", {}))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                               config=spec["config"])
+    batch = mod.batch_factory(engine)
+    for _ in range(spec.get("warmup_steps", 1)):
+        engine.train_batch(batch=batch)
+    t0 = time.perf_counter()
+    n = spec.get("measure_steps", 3)
+    for _ in range(n):
+        loss = engine.train_batch(batch=batch)
+    dt = (time.perf_counter() - t0) / n
+    return {
+        "ok": True,
+        "steps_per_sec": 1.0 / dt,
+        "samples_per_sec": engine.train_batch_size / dt,
+        "train_batch_size": engine.train_batch_size,
+        "final_loss": float(loss),
+    }
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    spec_path, result_path = argv[0], argv[1]
+    with open(spec_path) as fh:
+        spec = json.load(fh)
+    try:
+        result = run_experiment(spec)
+    except Exception as e:  # report the failure, exit nonzero
+        with open(result_path, "w") as fh:
+            json.dump({"ok": False,
+                       "error": f"{type(e).__name__}: {e}"}, fh)
+        return 1
+    with open(result_path, "w") as fh:
+        json.dump(result, fh)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+"""Experiment scheduler: run autotuning candidates as launched subprocesses.
+
+TPU-native analogue of the reference's ResourceManager
+(autotuning/scheduler.py): each candidate config runs as its own OS process
+through the node launcher (launcher/launch.py NodeLauncher), so OOMs and
+crashes are isolated, hangs are reaped by a wall-clock timeout
+(early-abort), and results come back as JSON files. One chip => one
+experiment at a time (the reference schedules onto free GPU sets the same
+way with num_gpus-sized slots).
+"""
+
+import json
+import os
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..launcher.launch import NodeLauncher
+from ..utils.logging import logger
+
+
+@dataclass
+class ExperimentSpec:
+    """One autotuning candidate (reference autotuning/config.py exp dicts)."""
+
+    name: str
+    config: Dict[str, Any]
+    model_kwargs: Dict[str, Any] = field(default_factory=dict)
+    warmup_steps: int = 1
+    measure_steps: int = 3
+
+
+class ResourceManager:
+    """Run experiment specs sequentially with timeout-based early abort."""
+
+    def __init__(self, script: str, exp_dir: str, timeout_s: float = 600.0,
+                 platform: Optional[str] = None,
+                 device_count: Optional[int] = None,
+                 extra_env: Optional[Dict[str, Optional[str]]] = None):
+        self.script = os.path.abspath(script)
+        self.exp_dir = exp_dir
+        self.timeout_s = timeout_s
+        self.platform = platform
+        self.device_count = device_count
+        self.extra_env = extra_env or {}
+        os.makedirs(exp_dir, exist_ok=True)
+
+    def run_one(self, spec: ExperimentSpec) -> Dict[str, Any]:
+        exp_path = os.path.join(self.exp_dir, spec.name)
+        os.makedirs(exp_path, exist_ok=True)
+        spec_file = os.path.join(exp_path, "spec.json")
+        result_file = os.path.join(exp_path, "result.json")
+        if os.path.exists(result_file):  # a stale result from a previous
+            os.remove(result_file)       # sweep must never be re-reported
+        with open(spec_file, "w") as fh:
+            json.dump({"script": self.script, "config": spec.config,
+                       "model_kwargs": spec.model_kwargs,
+                       "warmup_steps": spec.warmup_steps,
+                       "measure_steps": spec.measure_steps,
+                       "platform": self.platform,
+                       "device_count": self.device_count}, fh, indent=2)
+        launcher = NodeLauncher(
+            [sys.executable, "-m", "deepspeed_tpu.autotuning.experiment",
+             spec_file, result_file],
+            nproc=1, extra_env=self.extra_env,
+            pid_file=os.path.join(exp_path, "pids"))
+        launcher.spawn()
+        deadline = time.time() + self.timeout_s
+        rc = None
+        while time.time() < deadline:
+            rc = launcher.procs[0].poll()
+            if rc is not None:
+                break
+            time.sleep(0.2)
+        if rc is None:  # early abort: hung or too slow to be competitive
+            launcher.kill_all()
+            result = {"ok": False, "error": f"timeout after {self.timeout_s}s"}
+        elif os.path.exists(result_file):
+            with open(result_file) as fh:
+                result = json.load(fh)
+        else:
+            result = {"ok": False, "error": f"no result file (rc={rc})"}
+        result.update({"name": spec.name, "config": spec.config,
+                       "model_kwargs": spec.model_kwargs})
+        status = (f"{result.get('samples_per_sec', 0):.2f} samples/s"
+                  if result.get("ok") else f"FAILED ({result.get('error')})")
+        logger.info(f"autotune experiment {spec.name}: {status}")
+        return result
+
+    def write_ranked(self, results: List[Dict[str, Any]]
+                     ) -> List[Dict[str, Any]]:
+        """Rank by throughput and write the results file (reference
+        autotuner writes exps/ + results dirs)."""
+        ranked = sorted(results,
+                        key=lambda r: r.get("samples_per_sec", 0.0),
+                        reverse=True)
+        out = os.path.join(self.exp_dir, "autotune_results.json")
+        with open(out, "w") as fh:
+            json.dump({"ranked": ranked,
+                       "best": ranked[0] if ranked and ranked[0].get("ok")
+                       else None}, fh, indent=2)
+        logger.info(f"autotune: ranked results -> {out}")
+        return ranked
+
+    def run(self, specs: List[ExperimentSpec]) -> List[Dict[str, Any]]:
+        """Run all specs; returns results ranked by throughput."""
+        return self.write_ranked([self.run_one(s) for s in specs])

@@ -1,0 +1,108 @@
+"""Serving throughput benchmark: ragged/paged v2 engine vs dense v1 engine.
+
+The reference publishes FastGen-vs-baseline serving numbers
+(blogs/deepspeed-fastgen/README.md: throughput/latency curves); this is the
+in-tree microbenchmark: same model, same prompts, measure end-to-end
+generation tokens/sec for
+
+  * the v1 dense engine (padded static [B, S] KV cache, whole batch in one
+    compiled generate loop), and
+  * the v2 ragged engine (paged KV blocks + continuous batching put()).
+
+Prints ONE JSON line. Usage:
+  python -m deepspeed_tpu.benchmarks.serving_bench [--batch 8] [--prompt 64]
+         [--new 64] [--layers 4] [--hidden 256]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_model(layers: int, hidden: int, vocab: int = 2048,
+                max_seq: int = 1024):
+    from ..models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=2 * hidden,
+        num_layers=layers, num_heads=max(hidden // 64, 1),
+        max_seq_len=max_seq, use_flash=False)
+    return TransformerLM(cfg)
+
+
+def bench_dense(model, params, prompts: np.ndarray, new_tokens: int,
+                repeats: int) -> float:
+    from ..inference.engine import InferenceEngine
+    from ..inference.config import DeepSpeedInferenceConfig
+
+    B, S = prompts.shape
+    eng = InferenceEngine(model, DeepSpeedInferenceConfig.from_dict_or_kwargs(
+        {"dtype": "bfloat16", "max_out_tokens": S + new_tokens + 8,
+         "max_batch_size": B}, {}), params=params)
+    eng.generate(prompts, max_new_tokens=new_tokens)  # compile warmup
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out = eng.generate(prompts, max_new_tokens=new_tokens)
+    dt = (time.perf_counter() - t0) / repeats
+    assert out.shape == (B, S + new_tokens)
+    return B * new_tokens / dt
+
+
+def bench_paged(model, params, prompts: np.ndarray, new_tokens: int,
+                repeats: int) -> float:
+    from ..inference.v2.engine_v2 import InferenceEngineV2
+
+    B, S = prompts.shape
+    eng = InferenceEngineV2(model, {
+        "dtype": "bfloat16",
+        "state_manager": {"max_tracked_sequences": max(B, 8),
+                          "max_ragged_batch_size": max(B * S, 512),
+                          "num_blocks": 4096},
+    }, params=params)
+    prompt_list = [list(map(int, p)) for p in prompts]
+    eng.generate(prompt_list, max_new_tokens=new_tokens)  # compile warmup
+    t0 = time.perf_counter()
+    for r in range(repeats):
+        outs = eng.generate(prompt_list, max_new_tokens=new_tokens,
+                            uids=list(range((r + 1) * 1000,
+                                            (r + 1) * 1000 + B)))
+    dt = (time.perf_counter() - t0) / repeats
+    assert len(outs) == B
+    return B * new_tokens / dt
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ds_tpu_serving_bench")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--prompt", type=int, default=64)
+    p.add_argument("--new", type=int, default=64)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--hidden", type=int, default=256)
+    p.add_argument("--repeats", type=int, default=3)
+    args = p.parse_args(argv)
+
+    import jax
+
+    model = build_model(args.layers, args.hidden)
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, 2047, (args.batch, args.prompt), dtype=np.int64)
+
+    paged = bench_paged(model, params, prompts, args.new, args.repeats)
+    dense = bench_dense(model, params, prompts, args.new, args.repeats)
+    print(json.dumps({
+        "metric": "serving_tokens_per_sec",
+        "backend": jax.default_backend(),
+        "batch": args.batch, "prompt": args.prompt, "new_tokens": args.new,
+        "paged_tok_s": round(paged, 2),
+        "dense_tok_s": round(dense, 2),
+        "paged_over_dense": round(paged / dense, 3) if dense else None,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

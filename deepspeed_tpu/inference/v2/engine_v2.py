@@ -22,7 +22,8 @@ import numpy as np
 from ...models.transformer import TransformerConfig
 from ...utils.logging import log_dist
 from .config_v2 import RaggedInferenceEngineConfig
-from .paged_model import init_paged_kv_cache, paged_decode, paged_prefill
+from .paged_model import (init_paged_kv_cache, paged_continue, paged_decode,
+                          paged_prefill)
 from .ragged.blocked_allocator import NULL_BLOCK
 from .ragged.ragged_manager import DSStateManager
 
@@ -82,6 +83,10 @@ class InferenceEngineV2:
         self._prefill_jit = jax.jit(
             lambda p, ids, n, c, b, o: paged_prefill(cfg, p, ids, n, c, b, o),
             donate_argnums=(3,))
+        self._continue_jit = jax.jit(
+            lambda p, ids, s, n, c, b, o, t: paged_continue(
+                cfg, p, ids, s, n, c, b, o, t, sm.block_size),
+            donate_argnums=(4,))
         log_dist(
             f"ragged inference engine: blocks={sm.num_blocks}x"
             f"{sm.block_size} max_seqs={sm.max_tracked_sequences} tp={tp}",
@@ -144,10 +149,45 @@ class InferenceEngineV2:
         seq.seen_tokens = n
         return np.asarray(logits)
 
+    def _continue(self, uid: int, tokens: np.ndarray) -> np.ndarray:
+        """Multi-token continuation in ONE compiled pass (replaces the
+        token-at-a-time decode loop; reference chunked prefill)."""
+        sm = self.state_manager
+        n = len(tokens)
+        seq = sm.ensure_blocks(uid, n)
+        start = seq.seen_tokens
+        C = self._bucket(n)
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :n] = tokens
+        positions = start + np.arange(C)
+        block_idx = positions // self.block_size
+        offs = positions % self.block_size
+        table = np.full(C, NULL_BLOCK, np.int32)
+        valid = np.arange(C) < n
+        seq_blocks = np.asarray(seq.blocks, np.int32)
+        table[valid] = seq_blocks[block_idx[valid]]
+        full_table = sm.block_table_for(uid)
+        logits, self.kv_cache = self._continue_jit(
+            self.params, jnp.asarray(ids), jnp.asarray(start),
+            jnp.asarray(n), self.kv_cache, jnp.asarray(table),
+            jnp.asarray(offs), jnp.asarray(full_table))
+        seq.seen_tokens = start + n
+        return np.asarray(logits)
+
+    def _decode_bucket(self, count: int) -> int:
+        """Pad the decode batch to the next power-of-two bucket instead of
+        always the tracked-sequence cap (one compiled program per bucket);
+        fixes the fixed-cap padding waste (round-2 Weak #6)."""
+        cap = self.state_manager.config.max_tracked_sequences
+        b = 1
+        while b < count:
+            b *= 2
+        return min(b, cap)
+
     def _decode_batch(self, uids: List[int],
                       tokens: List[int]) -> Dict[int, np.ndarray]:
         sm = self.state_manager
-        N = sm.config.max_tracked_sequences
+        N = self._decode_bucket(len(uids))
         MB = sm.max_blocks_per_seq
         toks = np.zeros(N, np.int32)
         pos = np.zeros(N, np.int32)
@@ -192,12 +232,8 @@ class InferenceEngineV2:
                 decode_uids.append(uid)
                 decode_toks.append(int(toks[0]))
             else:
-                # multi-token continuation: feed through decode one-by-one
-                # (correct, unfused; the chunked-prefill kernel replaces it)
-                for t in toks[:-1]:
-                    self._decode_batch([uid], [int(t)])
-                decode_uids.append(uid)
-                decode_toks.append(int(toks[-1]))
+                # multi-token continuation: one fused chunked pass
+                results[uid] = self._continue(uid, toks)
         if decode_uids:
             for chunk_start in range(0, len(decode_uids),
                                      sm.config.max_tracked_sequences):
@@ -236,11 +272,13 @@ class InferenceEngineV2:
             if not step_uids:
                 break
             step_logits = self.put(step_uids, step_toks)
-            # re-expand to the original uid order
+            # re-expand to the original uid order (O(n) via the row map;
+            # the old uids.index() scan was O(n^2), round-2 Weak #6)
+            row_of = {uid: i for i, uid in enumerate(uids)}
             expanded = np.zeros((len(uids), step_logits.shape[-1]),
                                 step_logits.dtype)
             for j, uid in enumerate(step_uids):
-                expanded[uids.index(uid)] = step_logits[j]
+                expanded[row_of[uid]] = step_logits[j]
             logits = expanded
         for uid in uids:
             self.flush(uid)

@@ -131,6 +131,76 @@ def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# Chunked continuation
+# ---------------------------------------------------------------------------
+def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
+                   start_pos: jnp.ndarray, n_new: jnp.ndarray,
+                   cache: Dict[str, jnp.ndarray], block_ids: jnp.ndarray,
+                   offsets: jnp.ndarray, block_table: jnp.ndarray,
+                   block_size: int
+                   ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Multi-token continuation of ONE existing sequence in a single pass
+    (the reference's chunked prefill over ragged atoms,
+    inference/v2/kernels/ragged_ops/atom_builder + blocked_flash): the
+    chunk's K/V are scattered into the sequence's cache blocks, then every
+    chunk token attends over the sequence's full block table (cached prefix
+    + the chunk itself) with causal masking — replacing the token-at-a-time
+    decode loop the engine previously ran for multi-token puts.
+
+    ids [1, C] (padded chunk); start_pos = tokens already cached; n_new =
+    valid tokens in the chunk; block_ids/offsets [C] map chunk position ->
+    (cache block, slot), padding -> null block; block_table [MB] is the
+    sequence's full table. Returns (last-token logits [V], cache).
+    """
+    C = ids.shape[1]
+    MB = block_table.shape[0]
+    ctx = MB * block_size
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    x = params["embed"][ids[0]]                                 # [C, H]
+    pos = start_pos + jnp.arange(C)                             # [C]
+    if cfg.positional == "learned":
+        x = x + params["pos_embed"][jnp.clip(pos, 0, cfg.max_seq_len - 1)]
+    cos, sin = _rope_at(cfg, pos)
+    ctx_pos = jnp.arange(ctx)
+    # each chunk token sees cache positions up to and including itself
+    mask = ctx_pos[None, :] <= pos[:, None]                     # [C, ctx]
+
+    def layer_fn(carry, inputs):
+        x, kc, vc = carry
+        lp, l = inputs
+        hn = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
+        q = (hn @ lp["wq"]).reshape(C, nh, hd)
+        k = (hn @ lp["wk"]).reshape(C, nkv, hd)
+        v = (hn @ lp["wv"]).reshape(C, nkv, hd)
+        if cfg.positional == "rope":
+            q = _rotate(q, cos[:, None], sin[:, None])
+            k = _rotate(k, cos[:, None], sin[:, None])
+        kc = kc.at[l, block_ids, offsets].set(k.astype(kc.dtype))
+        vc = vc.at[l, block_ids, offsets].set(v.astype(vc.dtype))
+        kpages = kc[l][block_table].reshape(ctx, nkv, hd)
+        vpages = vc[l][block_table].reshape(ctx, nkv, hd)
+        if nkv != nh:
+            kpages = jnp.repeat(kpages, nh // nkv, axis=1)
+            vpages = jnp.repeat(vpages, nh // nkv, axis=1)
+        scores = jnp.einsum("qhd,chd->hqc", q, kpages).astype(jnp.float32)
+        scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+        scores = jnp.where(mask[None], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        o = jnp.einsum("hqc,chd->qhd", probs, vpages).reshape(C, nh * hd)
+        x = x + o @ lp["wo"]
+        hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+        x = x + _mlp(cfg, lp, hn)
+        return (x, kc, vc), None
+
+    (x, kc, vc), _ = jax.lax.scan(
+        layer_fn, (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(cfg.num_layers)))
+    x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
+    last = jnp.take(x, n_new - 1, axis=0)
+    return _logits(cfg, params, last), {"k": kc, "v": vc}
+
+
+# ---------------------------------------------------------------------------
 # Decode
 # ---------------------------------------------------------------------------
 def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,

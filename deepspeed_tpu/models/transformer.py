@@ -202,7 +202,7 @@ class TransformerLM:
         if cfg.norm == "layernorm":
             params["final_norm_b"] = jnp.zeros((h,), dt)
         if cfg.positional == "learned":
-            params["pos_embed"] = init(k[8], (cfg.max_seq_len, h))
+            params["pos_embed"] = init(k[16], (cfg.max_seq_len, h))
         if not cfg.tie_embeddings:
             params["lm_head"] = init(k[9], (h, v))
         return params

@@ -208,14 +208,26 @@ class BSLongformerSparsityConfig(SparsityConfig):
 # ---------------------------------------------------------------------------
 def sparse_attention(q, k, v, layout: np.ndarray, block: int,
                      causal: bool = False, softmax_scale: Optional[float]
-                     = None) -> jnp.ndarray:
+                     = None, impl: str = "auto") -> jnp.ndarray:
     """Block-sparse attention. q/k/v: [B, H, S, D]; layout [H, S/b, S/b].
+
+    impl="kernel": Pallas block-skipping kernels (ops/sparse_kernels.py) —
+    compute and memory scale with the ACTIVE blocks, like the reference's
+    Triton sdd/dsd path. impl="dense": masked-dense jnp reference.
+    "auto" picks the kernel whenever shapes allow.
 
     Inactive blocks never contribute (masked at -inf before softmax); with a
     causal flag the intra-block diagonal is causal too (reference
     SparseSelfAttention forward over Triton matmul/softmax/matmul).
     """
     B, H, S, D = q.shape
+    if impl == "auto":
+        impl = "kernel" if S % block == 0 and block >= 8 else "dense"
+    if impl == "kernel":
+        from .sparse_kernels import sparse_flash_attention
+
+        return sparse_flash_attention(q, k, v, layout, block, causal=causal,
+                                      scale=softmax_scale)
     n = S // block
     scale = softmax_scale or 1.0 / np.sqrt(D)
     lay = jnp.asarray(layout, bool)                      # [H, n, n]

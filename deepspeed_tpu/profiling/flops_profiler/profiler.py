@@ -46,6 +46,17 @@ def params_breakdown(params) -> Dict[str, int]:
     return {k: params_count(v) for k, v in params.items()}
 
 
+def module_tree(params, depth: int = -1):
+    """Nested per-module accounting from the parameter tree: each node is
+    (param_count, {child: node}). The functional analogue of the module
+    hierarchy the reference walks with hooks (profiler.py:282
+    print_model_profile's per-module tree)."""
+    if not isinstance(params, dict) or depth == 0:
+        return params_count(params), {}
+    children = {k: module_tree(v, depth - 1) for k, v in params.items()}
+    return sum(c[0] for c in children.values()), children
+
+
 def number_to_string(num: float, units: Optional[str] = None,
                      precision: int = 2) -> str:
     """Reference number_to_string / flops_to_string helpers."""
@@ -79,6 +90,7 @@ class FlopsProfiler:
         self._duration = 0.0
         self._params = 0
         self._breakdown: Dict[str, int] = {}
+        self._params_tree = None
 
     # -- measurement ----------------------------------------------------
     def profile_fn(self, fn: Callable, *args, warmup: int = 1,
@@ -97,6 +109,7 @@ class FlopsProfiler:
         if params is not None:
             self._params = params_count(params)
             self._breakdown = params_breakdown(params)
+            self._params_tree = params
         self.started = True
         return self
 
@@ -142,14 +155,45 @@ class FlopsProfiler:
         if self._bytes and self._duration:
             emit(f"  achieved bandwidth:   "
                  f"{number_to_string(self._bytes / self._duration)}B/s")
-        if detailed and self._breakdown:
-            emit("  per-group parameters:")
-            total = max(self._params, 1)
-            rows = sorted(self._breakdown.items(), key=lambda kv: -kv[1])
-            for name, cnt in rows[:top_modules]:
-                emit(f"    {name:<32} {number_to_string(float(cnt)):>10}  "
-                     f"({100.0 * cnt / total:.1f}%)")
+        if detailed and (self._params_tree is not None or self._breakdown):
+            emit("  per-module profile "
+                 "(flops/latency attributed by parameter share):")
+            self._print_module_tree(emit, module_depth, top_modules)
         emit("-" * 72)
+
+    def _print_module_tree(self, emit, module_depth: int, top_modules: int):
+        """Depth-annotated module tree: params, share, attributed FLOPs and
+        latency per module (the reference's print_model_profile tree,
+        profiler.py:282). Under XLA the whole step is one fused program, so
+        per-module compute cannot be hooked; FLOPs/latency are attributed
+        proportionally to each module's parameter share (exact for the
+        matmul-dominated cost of dense/transformer models) and labeled as
+        such in the header."""
+        total = max(self._params, 1)
+        if self._params_tree is not None:
+            _count, children = module_tree(self._params_tree, module_depth)
+        else:
+            children = {k: (v, {}) for k, v in self._breakdown.items()}
+
+        def walk(children, indent):
+            rows = sorted(children.items(), key=lambda kv: -kv[1][0])
+            for name, (cnt, sub) in rows[:top_modules]:
+                share = cnt / total
+                line = (f"    {'  ' * indent}{name:<{32 - 2 * indent}} "
+                        f"{number_to_string(float(cnt)):>10}  "
+                        f"({100.0 * share:5.1f}%)")
+                if self._flops:
+                    line += f"  ~{number_to_string(self._flops * share)}FLOPs"
+                if self._duration:
+                    line += f"  ~{duration_to_string(self._duration * share)}"
+                emit(line)
+                if sub:
+                    walk(sub, indent + 1)
+            if len(rows) > top_modules:
+                emit(f"    {'  ' * indent}... ({len(rows) - top_modules} "
+                     f"more modules)")
+
+        walk(children, 0)
 
 
 def get_model_profile(model, batch, train: bool = False, rng=None,

@@ -701,6 +701,7 @@ class DeepSpeedTpuEngine:
             target = self.params
             from ..profiling.flops_profiler.profiler import params_breakdown
             prof._breakdown = params_breakdown(target)
+            prof._params_tree = target
             fp_cfg = self.config.flops_profiler
             out = (open(fp_cfg.output_file, "w")
                    if fp_cfg.output_file else None)

@@ -1,0 +1,37 @@
+"""User-script fixture for the experiment autotuner: the contract is
+model_factory(**model_kwargs) + batch_factory(engine)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class TinyModel:
+    def __init__(self, hidden=32, slow=False):
+        self.hidden = hidden
+        self.slow = slow
+
+    def init_params(self, rng):
+        return {"w": jax.random.normal(rng, (self.hidden, self.hidden),
+                                       jnp.float32) * 0.1}
+
+    def apply(self, params, batch, train=True, rng=None):
+        h = batch["x"].astype(params["w"].dtype)
+        # "attention impl" stand-in: the slow variant does extra matmuls
+        for _ in range(8 if self.slow else 1):
+            h = h @ params["w"]
+        return jnp.mean((h - batch["y"]).astype(jnp.float32) ** 2)
+
+
+def model_factory(slow=False, hang=False):
+    if hang:
+        import time
+        time.sleep(10 ** 6)  # scheduler must early-abort this
+    return TinyModel(slow=slow)
+
+
+def batch_factory(engine):
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(0)
+    return {"x": rng.standard_normal((engine.gas, gm, 32)).astype("f4"),
+            "y": rng.standard_normal((engine.gas, gm, 32)).astype("f4")}

@@ -45,3 +45,79 @@ def test_memory_pruning():
     outcome = tuner.tune(stages=(0,), micro_batches=(1,))
     assert outcome.best is None
     assert all("pruned" in (r.error or "") for r in outcome.results)
+
+
+def test_experiment_autotuner_ranked_subprocess_sweep(tmp_path):
+    """Launched-subprocess sweep over zero-stage x micro-batch x model
+    variant, scored by measured throughput, producing a ranked results file
+    (VERDICT round-2 task 9 'Done' criterion)."""
+    import json, os
+    from deepspeed_tpu.autotuning import ExperimentAutotuner
+
+    script = os.path.join(os.path.dirname(__file__),
+                          "autotune_user_script.py")
+    tuner = ExperimentAutotuner(
+        script,
+        {"optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+         "steps_per_print": 10 ** 9},
+        exp_dir=str(tmp_path), timeout_s=300,
+        platform="cpu", device_count=8,
+        warmup_steps=1, measure_steps=2)
+    ranked = tuner.tune(stages=(0, 2), micro_batches=(2, 4),
+                        model_grid=[{"slow": False}, {"slow": True}])
+    assert len(ranked) == 8  # full grid, nothing failed
+    ok = [r for r in ranked if r["ok"]]
+    assert len(ok) == 8
+    # ranked by throughput, best first
+    tputs = [r["samples_per_sec"] for r in ok]
+    assert tputs == sorted(tputs, reverse=True)
+    # the fast model variant must beat the 8x-matmul one at the top
+    assert ranked[0]["model_kwargs"] == {"slow": False}
+    # ranked results file exists with a best entry
+    out = json.load(open(tmp_path / "autotune_results.json"))
+    assert out["best"]["name"] == ranked[0]["name"]
+    assert len(out["ranked"]) == 8
+    # each experiment left its spec + result artifacts
+    assert (tmp_path / ranked[0]["name"] / "spec.json").exists()
+    assert (tmp_path / ranked[0]["name"] / "result.json").exists()
+
+
+def test_experiment_autotuner_early_abort_on_hang(tmp_path):
+    """A hung experiment is killed at the timeout and recorded as failed —
+    the reference scheduler's early-abort."""
+    import os, time
+    from deepspeed_tpu.autotuning import ExperimentAutotuner
+
+    script = os.path.join(os.path.dirname(__file__),
+                          "autotune_user_script.py")
+    tuner = ExperimentAutotuner(
+        script, {"optimizer": {"type": "adamw", "params": {"lr": 1e-3}}},
+        exp_dir=str(tmp_path), timeout_s=8, platform="cpu", device_count=2)
+    t0 = time.time()
+    ranked = tuner.tune(stages=(0,), micro_batches=(2,),
+                        model_grid=[{"hang": True}])
+    assert time.time() - t0 < 60
+    assert len(ranked) == 1
+    assert not ranked[0]["ok"]
+    assert "timeout" in ranked[0]["error"]
+
+
+def test_experiment_failure_isolated(tmp_path):
+    """A crashing config (invalid zero stage interaction) fails its own
+    process and is recorded; the sweep continues."""
+    import os
+    from deepspeed_tpu.autotuning import ExperimentAutotuner
+
+    script = os.path.join(os.path.dirname(__file__),
+                          "autotune_user_script.py")
+    tuner = ExperimentAutotuner(
+        script,
+        {"optimizer": {"type": "OneBitAdam", "params": {"lr": 1e-3}},
+         "bf16": {"enabled": True}, "gradient_clipping": 0.0},
+        exp_dir=str(tmp_path), timeout_s=120, platform="cpu", device_count=4)
+    # OneBitAdam requires stage 0: stage-2 lane fails, stage-0 lane succeeds
+    ranked = tuner.tune(stages=(2, 0), micro_batches=(2,))
+    by_name = {r["name"]: r for r in ranked}
+    assert not by_name["m0_z2_mb2"]["ok"]
+    assert "zero stage 0" in by_name["m0_z2_mb2"]["error"]
+    assert by_name["m0_z0_mb2"]["ok"]

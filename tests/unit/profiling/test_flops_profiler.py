@@ -47,3 +47,41 @@ def test_engine_profile_step_runs(capsys):
     # profiler must have measured a positive step flops count
     # (log output goes through the logger; assert no crash + state updated)
     assert engine.global_steps == 3
+
+
+def test_per_module_tree_report(capsys):
+    """The detailed report prints a nested per-module tree with params,
+    share, and attributed FLOPs/latency (reference print_model_profile's
+    module tree, profiler.py:282)."""
+    import io
+    from deepspeed_tpu.profiling.flops_profiler.profiler import FlopsProfiler
+
+    params = {
+        "embed": jnp.zeros((64, 32)),
+        "layers": {
+            "attn": {"wq": jnp.zeros((32, 32)), "wo": jnp.zeros((32, 32))},
+            "mlp": {"up": jnp.zeros((32, 128)), "down": jnp.zeros((128, 32))},
+        },
+        "head": jnp.zeros((32, 64)),
+    }
+
+    def fwd(p, x):
+        h = x @ p["embed"].T[:x.shape[-1]] if False else x
+        return jnp.sum((h @ p["layers"]["attn"]["wq"])
+                       @ p["layers"]["mlp"]["up"][:32])
+
+    x = jnp.ones((4, 32))
+    prof = FlopsProfiler().profile_fn(fwd, params, x, params=params)
+    buf = io.StringIO()
+    prof.print_model_profile(detailed=True, output_file=buf, top_modules=10)
+    out = buf.getvalue()
+    # nested modules appear with indentation and shares
+    assert "layers" in out and "attn" in out and "wq" in out
+    assert "mlp" in out and "down" in out
+    assert "%" in out and "FLOPs" in out
+    # depth limiting collapses the tree
+    buf2 = io.StringIO()
+    prof.print_model_profile(detailed=True, output_file=buf2,
+                             module_depth=1, top_modules=10)
+    out2 = buf2.getvalue()
+    assert "layers" in out2 and "wq" not in out2
