@@ -30,7 +30,8 @@ def peak_flops(device) -> float:
     return PEAK_FLOPS["cpu"]
 
 
-def _measure(cfg, micro, gas, steps, warmup, n_dev):
+def _measure(cfg, micro, gas, steps, warmup, n_dev, zero_stage=None,
+             remat_policy=None, profile_dir=None):
     """One timed training run; returns (mfu, detail)."""
     import jax
     import deepspeed_tpu
@@ -42,9 +43,13 @@ def _measure(cfg, micro, gas, steps, warmup, n_dev):
         "gradient_accumulation_steps": gas,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 2 if n_dev > 1 else 0},
+        "zero_optimization": {"stage": (zero_stage if zero_stage is not None
+                                        else (2 if n_dev > 1 else 0)),
+                              "stage3_param_persistence_threshold": 0},
         "steps_per_print": 10**9,
     }
+    if remat_policy:
+        config["activation_checkpointing"] = {"policy": remat_policy}
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
     gm = engine.micro_batch_size * engine.ds_config.dp_world_size
     seq = cfg.max_seq_len
@@ -55,6 +60,11 @@ def _measure(cfg, micro, gas, steps, warmup, n_dev):
     for _ in range(warmup):
         engine.train_batch(batch=batch)
     jax.block_until_ready(engine.params)
+    if profile_dir:  # committed trace artifact (VERDICT r2 task 1/7)
+        with jax.profiler.trace(profile_dir):
+            for _ in range(2):
+                engine.train_batch(batch=batch)
+            jax.block_until_ready(engine.params)
     t0 = time.perf_counter()
     for _ in range(steps):
         engine.train_batch(batch=batch)
@@ -75,6 +85,8 @@ def _measure(cfg, micro, gas, steps, warmup, n_dev):
         "micro_batch": micro,
         "attention": "flash" if cfg.use_flash
                      and seq >= cfg.flash_min_seq else "xla",
+        "remat_policy": remat_policy or "nothing_saveable",
+        "zero_stage": config["zero_optimization"]["stage"],
         "global_batch_tokens": tokens_per_step,
     }
     return mfu, detail
@@ -93,36 +105,63 @@ def main():
     on_tpu = backend == "tpu" and jax.default_backend() == "tpu"
     if on_tpu:
         base = _flagship_cfg()  # the shipped flagship, not a local copy
-        # mini-autotune: attention impl x micro-batch ladder; OOM configs are
-        # skipped, the best-MFU measurement is reported
+        # mini-autotune: attention impl x micro-batch x remat-policy ladder;
+        # OOM configs are skipped, the best-MFU measurement is reported.
+        # dots_with_no_batch_dims_saveable keeps matmul outputs instead of
+        # full per-layer recompute — the top remat-granularity candidate
+        # from the round-2 MFU review.
         trials = []
-        for use_flash in (True, False):
-            for micro in (16, 8):
-                trials.append((dataclasses.replace(
-                    base, use_flash=use_flash, flash_min_seq=2048), micro))
+        for policy in ("dots_with_no_batch_dims_saveable",
+                       "nothing_saveable"):
+            for use_flash in (True, False):
+                for micro in (16, 8):
+                    trials.append((dataclasses.replace(
+                        base, use_flash=use_flash, flash_min_seq=2048),
+                        micro, policy))
         steps, warmup = 10, 2
     else:  # CPU smoke mode
         base = TransformerConfig(vocab_size=256, hidden_size=128,
                                  intermediate_size=256, num_layers=2,
                                  num_heads=8, max_seq_len=128)
-        trials = [(base, 1)]
+        trials = [(base, 1, None)]
         steps, warmup = 5, 2
 
     best = None
     errors = []
-    for cfg, micro in trials:
+    for cfg, micro, policy in trials:
         try:
-            mfu, detail = _measure(cfg, micro, 1, steps, warmup, n_dev)
+            mfu, detail = _measure(cfg, micro, 1, steps, warmup, n_dev,
+                                   remat_policy=policy)
         except Exception as exc:  # OOM or compile failure: try next config
-            errors.append(f"micro={micro} flash={cfg.use_flash}: "
-                          f"{repr(exc)[:200]}")
+            errors.append(f"micro={micro} flash={cfg.use_flash} "
+                          f"remat={policy}: {repr(exc)[:200]}")
             continue
         if best is None or mfu > best[0]:
-            best = (mfu, detail)
+            best = (mfu, detail, cfg, micro, policy)
 
     if best is None:
         raise RuntimeError("all bench configs failed: " + " | ".join(errors))
-    mfu, detail = best
+    mfu, detail, cfg, micro, policy = best
+
+    # ZeRO-3 variant on the same (best) config: the sharding machinery runs
+    # on the degenerate dp=1 mesh so regressions in the stage-3 path show up
+    # in every bench (round-2 Weak #2), plus the profiler trace artifact.
+    import os
+    prof_dir = os.environ.get("DS_TPU_BENCH_PROFILE",
+                              "profiles/bench_trace" if on_tpu else "")
+    try:
+        z3_mfu, z3_detail = _measure(cfg, micro, 1, max(steps // 2, 3),
+                                     warmup, n_dev, zero_stage=3,
+                                     remat_policy=policy,
+                                     profile_dir=prof_dir or None)
+        detail["zero3_mfu"] = round(z3_mfu * 100, 2)
+        detail["zero3_tokens_per_sec_per_chip"] = \
+            z3_detail["tokens_per_sec_per_chip"]
+        if prof_dir:
+            detail["profile_trace"] = prof_dir
+    except Exception as exc:
+        detail["zero3_error"] = repr(exc)[:200]
+
     result = {
         "metric": "train_mfu_llama_flagship",
         "value": round(mfu * 100, 2),

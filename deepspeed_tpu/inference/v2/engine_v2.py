@@ -7,10 +7,12 @@ next-token logits for every entry. KV lives in a blocked (paged) pool
 managed by DSStateManager; sequences are freed with ``flush``.
 
 TPU-native scheduling: prompts run through ``paged_prefill`` (one compiled
-program per prompt-length bucket), running sequences batch into ONE
-``paged_decode`` call padded to the tracked-sequence cap — the compiled-
-program cache plays the role the reference's CUDA graphs + atom builder
-play. Mixed puts do the prefills first, then the fused decode batch.
+program per prompt-length bucket), multi-token continuations through ONE
+fused ``paged_continue`` chunk pass, and running sequences batch into a
+``paged_decode`` call padded to the next power-of-two bucket — the
+compiled-program cache plays the role the reference's CUDA graphs + atom
+builder play. Mixed puts do the prefills/continuations first, then the
+fused decode batch.
 """
 
 from typing import Dict, Iterable, List, Optional, Sequence
@@ -256,6 +258,7 @@ class InferenceEngineV2:
         outs: List[List[int]] = [list(map(int, p)) for p in prompts]
         logits = self.put(uids, prompts)
         live = set(uids)
+        row_of = {uid: i for i, uid in enumerate(uids)}
         for _ in range(max_new_tokens):
             nxt = np.argmax(logits, axis=-1)
             step_uids, step_toks = [], []
@@ -274,7 +277,6 @@ class InferenceEngineV2:
             step_logits = self.put(step_uids, step_toks)
             # re-expand to the original uid order (O(n) via the row map;
             # the old uids.index() scan was O(n^2), round-2 Weak #6)
-            row_of = {uid: i for i, uid in enumerate(uids)}
             expanded = np.zeros((len(uids), step_logits.shape[-1]),
                                 step_logits.dtype)
             for j, uid in enumerate(step_uids):

@@ -18,7 +18,7 @@ Two entry points, both pure and jit-compiled by the engine:
 The KV pool is ``[L, num_blocks, block_size, kv_heads, head_dim]``; block 0
 is the null block (padding writes land there). Static shapes throughout:
 prompt lengths bucket to multiples of ``prefill_bucket`` and the decode
-batch is padded to the tracked-sequence cap — each bucket compiles once
+batch pads to the next power-of-two bucket — each bucket compiles once
 (the XLA analogue of the reference's CUDA-graph'd atom sizes).
 """
 

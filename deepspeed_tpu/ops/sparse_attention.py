@@ -222,7 +222,10 @@ def sparse_attention(q, k, v, layout: np.ndarray, block: int,
     """
     B, H, S, D = q.shape
     if impl == "auto":
-        impl = "kernel" if S % block == 0 and block >= 8 else "dense"
+        # the kernel path only wins on real TPU; elsewhere it would run in
+        # interpret mode (orders of magnitude slower than masked-dense)
+        impl = ("kernel" if jax.default_backend() == "tpu"
+                and S % block == 0 and block >= 8 else "dense")
     if impl == "kernel":
         from .sparse_kernels import sparse_flash_attention
 

@@ -179,7 +179,8 @@ class FlopsProfiler:
             rows = sorted(children.items(), key=lambda kv: -kv[1][0])
             for name, (cnt, sub) in rows[:top_modules]:
                 share = cnt / total
-                line = (f"    {'  ' * indent}{name:<{32 - 2 * indent}} "
+                line = (f"    {'  ' * indent}"
+                        f"{name:<{max(32 - 2 * indent, 1)}} "
                         f"{number_to_string(float(cnt)):>10}  "
                         f"({100.0 * share:5.1f}%)")
                 if self._flops:

@@ -1,0 +1,117 @@
+"""XLA profiling helpers: traces + collective-overlap analysis.
+
+Two tools for the question the reference answers with its two CUDA streams
+(runtime/zero/stage3.py:1151 __allgather_stream / reduce_and_partition
+stream): is ZeRO communication overlapped with compute?
+
+1. ``capture_trace(fn, *args, trace_dir=...)``: run fn under
+   ``jax.profiler.trace`` — the artifact opens in TensorBoard/XProf and is
+   what the NVTX ranges + CommsLogger give on the reference.
+
+2. ``overlap_report(fn, *args)``: static scheduling analysis of the
+   OPTIMIZED HLO. XLA's latency-hiding scheduler expresses overlap as async
+   collective pairs (``all-gather-start``/``all-gather-done`` etc.) with
+   compute scheduled between start and done; a collective whose done
+   immediately follows its start is fully EXPOSED (no overlap). The report
+   counts async pairs per collective kind and the instruction distance
+   between start and done — a device-independent, committable measurement
+   of how much latency hiding the compiled program actually has.
+"""
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+# async-pair HLO opcodes emitted by the latency-hiding scheduler
+_ASYNC_KINDS = ("all-gather", "all-reduce", "reduce-scatter",
+                "collective-permute", "all-to-all")
+
+
+def capture_trace(fn: Callable, *args, trace_dir: str, steps: int = 2):
+    """Run fn(*args) `steps` times under jax.profiler.trace."""
+    out = None
+    with jax.profiler.trace(trace_dir):
+        for _ in range(steps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+    return out
+
+
+@dataclass
+class OverlapReport:
+    total_instructions: int = 0
+    sync_collectives: Dict[str, int] = field(default_factory=dict)
+    async_pairs: Dict[str, int] = field(default_factory=dict)
+    # per kind: list of instruction distances between -start and -done
+    distances: Dict[str, List[int]] = field(default_factory=dict)
+
+    @property
+    def exposed_pairs(self) -> int:
+        """Pairs with NOTHING scheduled between start and done."""
+        return sum(1 for ds in self.distances.values() for d in ds if d <= 1)
+
+    @property
+    def total_pairs(self) -> int:
+        return sum(self.async_pairs.values())
+
+    @property
+    def exposed_fraction(self) -> float:
+        """Fraction of async collectives with zero overlap window. Sync
+        (non-async) collectives are fully exposed by construction and are
+        counted too."""
+        n_sync = sum(self.sync_collectives.values())
+        total = self.total_pairs + n_sync
+        return (self.exposed_pairs + n_sync) / total if total else 0.0
+
+    def summary(self) -> str:
+        lines = [f"HLO instructions: {self.total_instructions}"]
+        for kind in sorted(set(self.async_pairs) | set(self.sync_collectives)):
+            ds = self.distances.get(kind, [])
+            avg = sum(ds) / len(ds) if ds else 0.0
+            lines.append(
+                f"  {kind:<20} async={self.async_pairs.get(kind, 0):>3} "
+                f"sync={self.sync_collectives.get(kind, 0):>3} "
+                f"avg start->done distance={avg:.1f} instrs")
+        lines.append(f"  exposed fraction: {self.exposed_fraction:.2%} "
+                     f"({self.exposed_pairs}/{self.total_pairs} async pairs "
+                     f"with empty overlap window)")
+        return "\n".join(lines)
+
+
+def overlap_report(fn: Callable, *args, **kwargs) -> OverlapReport:
+    """Compile fn(*args) and analyze collective scheduling in the optimized
+    HLO (see module docstring)."""
+    compiled = jax.jit(fn).lower(*args, **kwargs).compile()
+    texts = [m.to_string() for m in compiled.runtime_executable().hlo_modules()] \
+        if hasattr(compiled, "runtime_executable") else [compiled.as_text()]
+    return analyze_hlo("\n".join(texts))
+
+
+def analyze_hlo(hlo: str) -> OverlapReport:
+    rep = OverlapReport()
+    # walk the entry computation's instruction stream in order
+    lines = [l.strip() for l in hlo.splitlines()
+             if re.match(r"^\s*(ROOT\s+)?%?[\w.\-]+\s*=", l)]
+    rep.total_instructions = len(lines)
+    starts: Dict[str, tuple] = {}   # var name -> (kind, position)
+    for pos, line in enumerate(lines):
+        name_m = re.match(r"^(?:ROOT\s+)?%?([\w.\-]+)\s*=", line)
+        if not name_m:
+            continue
+        var = name_m.group(1)
+        for kind in _ASYNC_KINDS:
+            if re.search(rf"\b{kind}-start\(", line):
+                starts[var] = (kind, pos)
+                rep.async_pairs[kind] = rep.async_pairs.get(kind, 0) + 1
+            elif re.search(rf"\b{kind}-done\(", line):
+                # operand var name inside the parens
+                om = re.search(rf"{kind}-done\(\s*%?([\w.\-]+)", line)
+                if om and om.group(1) in starts:
+                    kind0, p0 = starts.pop(om.group(1))
+                    rep.distances.setdefault(kind0, []).append(pos - p0)
+            elif re.search(rf"\b{kind}\(", line):
+                rep.sync_collectives[kind] = \
+                    rep.sync_collectives.get(kind, 0) + 1
+    return rep
