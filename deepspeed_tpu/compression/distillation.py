@@ -1,0 +1,83 @@
+"""Knowledge distillation + layer reduction.
+
+Reference: deepspeed/compression/compress.py student_initialization (layer
+reduction: re-init a shallow student from chosen teacher layers) and the
+distillation pathway of the compression library (config keys under
+``compression_training.layer_reduction``).
+
+TPU-native shape: models stack layer parameters on a leading [L, ...] axis
+(models/transformer.py), so "take teacher layers [1, 3, 5]" is one gather —
+no module-tree walking. Distillation is a loss combinator, not a module
+rewrite: ``distillation_loss`` blends soft-target KL against the teacher
+with the hard-label loss, the standard Hinton formulation the reference's
+BERT compression examples train with.
+"""
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def student_initialization(teacher_params: Dict[str, Any],
+                           teacher_layers: Sequence[int],
+                           layers_key: str = "layers",
+                           deepspeed_config: Optional[dict] = None
+                           ) -> Dict[str, Any]:
+    """Build student params from a teacher: the student's i-th layer is the
+    teacher's ``teacher_layers[i]``-th; every non-layer tensor (embeddings,
+    norms, head — the reference's other_module_name list) is copied whole.
+
+    Config form (reference compression config schema):
+      {"compression_training": {"layer_reduction": {
+          "enabled": true, "keep_number_layer": 5,
+          "teacher_layer": [1, 3, 5, 7, 9]}}}
+    """
+    if deepspeed_config is not None:
+        lr = (deepspeed_config.get("compression_training", {})
+              .get("layer_reduction", {}))
+        if lr.get("enabled"):
+            teacher_layers = lr["teacher_layer"]
+            if "keep_number_layer" in lr:
+                assert len(teacher_layers) == lr["keep_number_layer"], \
+                    "teacher_layer list must match keep_number_layer"
+    idx = np.asarray(list(teacher_layers), np.int32)
+    L = jax.tree.leaves(teacher_params[layers_key])[0].shape[0]
+    assert (0 <= idx).all() and (idx < L).all(), \
+        f"teacher_layer indices {idx.tolist()} out of range for L={L}"
+    student = dict(teacher_params)
+    student[layers_key] = jax.tree.map(lambda t: t[idx],
+                                       teacher_params[layers_key])
+    return student
+
+
+def distillation_loss(student_logits: jnp.ndarray,
+                      teacher_logits: jnp.ndarray,
+                      hard_loss: Optional[jnp.ndarray] = None,
+                      temperature: float = 2.0,
+                      alpha: float = 0.5,
+                      mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """alpha * T^2 * KL(teacher_T || student_T) + (1 - alpha) * hard_loss
+    (the forward KL of the Hinton formulation: mass-covering, teacher as
+    the reference distribution).
+
+    logits: [..., V]; mask broadcastable over the leading dims weights the
+    per-position KL (padding). The T^2 factor keeps soft-gradient magnitude
+    independent of temperature (Hinton et al.)."""
+    t = jnp.asarray(temperature, jnp.float32)
+    sl = student_logits.astype(jnp.float32) / t
+    tl = teacher_logits.astype(jnp.float32) / t
+    log_p_s = jax.nn.log_softmax(sl, axis=-1)
+    p_t = jax.nn.softmax(tl, axis=-1)
+    log_p_t = jax.nn.log_softmax(tl, axis=-1)
+    kl = jnp.sum(p_t * (log_p_t - log_p_s), axis=-1)       # [...]
+    if mask is not None:
+        m = mask.astype(jnp.float32)
+        kl = jnp.sum(kl * m) / jnp.maximum(jnp.sum(m), 1.0)
+    else:
+        kl = jnp.mean(kl)
+    soft = (t * t) * kl
+    if hard_loss is None:
+        return alpha * soft
+    return alpha * soft + (1.0 - alpha) * hard_loss

@@ -67,7 +67,8 @@ def _rotate(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray):
 def _mlp(cfg, lp, x):
     if cfg.activation == "swiglu":
         return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
-    u = jax.nn.gelu(x @ lp["w_up"] + lp["b_up"])
+    from ...models.transformer import ffn_act
+    u = ffn_act(cfg)(x @ lp["w_up"] + lp["b_up"])
     return u @ lp["w_down"] + lp["b_down"]
 
 

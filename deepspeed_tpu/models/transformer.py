@@ -42,7 +42,7 @@ class TransformerConfig:
     max_seq_len: int = 4096
     norm: str = "rmsnorm"                  # rmsnorm | layernorm
     norm_eps: float = 1e-5
-    activation: str = "swiglu"             # swiglu | gelu
+    activation: str = "swiglu"             # swiglu | gelu | relu
     positional: str = "rope"               # rope | learned
     rope_theta: float = 10000.0
     tie_embeddings: bool = False
@@ -90,6 +90,16 @@ def _rope_tables(cfg: TransformerConfig, seq_len: int, offset=0):
     t = offset + jnp.arange(seq_len, dtype=jnp.float32)
     angles = jnp.outer(t, freqs)                      # (S, half)
     return jnp.cos(angles), jnp.sin(angles)
+
+
+def ffn_act(cfg: TransformerConfig):
+    """Non-gated FFN activation for the gelu/relu model families (one
+    definition shared by training, cached decode, and paged inference)."""
+    if cfg.activation == "relu":
+        return jax.nn.relu
+    if cfg.activation == "gelu":
+        return jax.nn.gelu
+    raise ValueError(f"unknown FFN activation {cfg.activation!r}")
 
 
 def apply_rotary(x, cos, sin):
@@ -326,7 +336,7 @@ class TransformerLM:
             u = hn @ lp["w_up"]
             x = x + (g * u) @ lp["w_down"]
         else:
-            u = jax.nn.gelu(hn @ lp["w_up"] + lp["b_up"])
+            u = ffn_act(cfg)(hn @ lp["w_up"] + lp["b_up"])
             x = x + u @ lp["w_down"] + lp["b_down"]
         return x, aux
 
@@ -594,7 +604,7 @@ class TransformerLM:
             g = jax.nn.silu(hn @ lp["w_gate"])
             x = x + (g * (hn @ lp["w_up"])) @ lp["w_down"]
         else:
-            u = jax.nn.gelu(hn @ lp["w_up"] + lp["b_up"])
+            u = ffn_act(cfg)(hn @ lp["w_up"] + lp["b_up"])
             x = x + u @ lp["w_down"] + lp["b_down"]
         return x, ck, cv
 
@@ -693,6 +703,24 @@ def gpt2_small() -> TransformerConfig:
                              num_heads=12, max_seq_len=1024, norm="layernorm",
                              activation="gelu", positional="learned",
                              tie_embeddings=True)
+
+
+def opt_1_3b() -> TransformerConfig:
+    """OPT-1.3B (reference inference/v2/model_implementations/opt/): pre-LN
+    decoder with learned positions and ReLU MLP."""
+    return TransformerConfig(vocab_size=50272, hidden_size=2048,
+                             intermediate_size=8192, num_layers=24,
+                             num_heads=32, max_seq_len=2048,
+                             norm="layernorm", activation="relu",
+                             positional="learned", tie_embeddings=True)
+
+
+def opt_125m() -> TransformerConfig:
+    return TransformerConfig(vocab_size=50272, hidden_size=768,
+                             intermediate_size=3072, num_layers=12,
+                             num_heads=12, max_seq_len=2048,
+                             norm="layernorm", activation="relu",
+                             positional="learned", tie_embeddings=True)
 
 
 def tiny_test(vocab=256, hidden=128, layers=2, heads=4, seq=128) -> TransformerConfig:

@@ -1,0 +1,142 @@
+"""Offline data difficulty analysis.
+
+Reference: deepspeed/runtime/data_pipeline/data_sampling/data_analyzer.py
+(DataAnalyzer.run_map/run_reduce): walk the dataset once with user metric
+functions, write per-sample metric files plus the sorted
+index_to_sample/index_to_metric maps that curriculum learning samples from.
+
+TPU-native simplifications: the analysis is pure host-side numpy (no
+accelerators involved), sharded by worker over contiguous ranges, and the
+output artifact set is one .npz per metric holding
+  sample_to_metric  [N]        metric value per dataset index
+  index_to_sample   [N]        dataset indices sorted by metric (ascending)
+  index_to_metric   [N]        the metric values in that sorted order
+plus a JSON manifest. These feed DeepSpeedDataSampler's metric_values
+directly (data_sampler.py).
+
+Built-in metrics mirror the reference's curriculum examples:
+  seqlen          — non-padding token count
+  vocab_rarity    — mean negative log frequency of the sample's tokens
+"""
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+
+def metric_seqlen(sample, pad_token_id: int = 0) -> float:
+    ids = np.asarray(sample["input_ids"] if isinstance(sample, dict)
+                     else sample)
+    return float((ids != pad_token_id).sum())
+
+
+class VocabRarity:
+    """Two-pass metric: token frequencies from pass one, mean -log p per
+    sample in pass two (reference data_analyzer vocab_rarity). Padding is
+    excluded from both passes — otherwise the pad token dominates both the
+    frequency table and every padded sample's mean."""
+
+    def __init__(self, vocab_size: int, pad_token_id: int = 0):
+        self.vocab_size = vocab_size
+        self.pad_token_id = pad_token_id
+        self.counts = np.zeros(vocab_size, np.int64)
+
+    def _real_tokens(self, sample):
+        ids = np.asarray(sample["input_ids"] if isinstance(sample, dict)
+                         else sample).reshape(-1)
+        return ids[ids != self.pad_token_id]
+
+    def observe(self, sample):
+        ids = self._real_tokens(sample)
+        self.counts += np.bincount(ids, minlength=self.vocab_size)
+
+    def __call__(self, sample) -> float:
+        ids = self._real_tokens(sample)
+        if ids.size == 0:
+            return 0.0
+        total = max(self.counts.sum(), 1)
+        p = self.counts[ids] / total
+        return float(np.mean(-np.log(np.maximum(p, 1e-12))))
+
+
+class DataAnalyzer:
+    """Map/reduce difficulty analysis over an indexable dataset."""
+
+    def __init__(self, dataset, metric_names: Sequence[str],
+                 metric_functions: Sequence[Callable[[Any], float]],
+                 save_path: str, num_workers: int = 1, worker_id: int = 0):
+        assert len(metric_names) == len(metric_functions)
+        self.dataset = dataset
+        self.metric_names = list(metric_names)
+        self.metric_functions = list(metric_functions)
+        self.save_path = save_path
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        os.makedirs(save_path, exist_ok=True)
+
+    def _worker_range(self):
+        n = len(self.dataset)
+        per = -(-n // self.num_workers)
+        lo = min(self.worker_id * per, n)
+        return lo, min(lo + per, n)
+
+    def run_map(self) -> Dict[str, str]:
+        """Score this worker's shard; writes one partial .npy per metric
+        (reference run_map writes per-worker metric files)."""
+        lo, hi = self._worker_range()
+        values = {m: np.empty(hi - lo, np.float64) for m in self.metric_names}
+        for i in range(lo, hi):
+            sample = self.dataset[i]
+            for name, fn in zip(self.metric_names, self.metric_functions):
+                values[name][i - lo] = fn(sample)
+        out = {}
+        for name in self.metric_names:
+            path = os.path.join(self.save_path,
+                                f"{name}_worker{self.worker_id}.npy")
+            np.save(path, values[name])
+            out[name] = path
+        return out
+
+    def run_reduce(self) -> Dict[str, str]:
+        """Merge all workers' partials into the sorted index artifacts
+        (reference run_reduce builds index_to_sample/index_to_metric)."""
+        manifest = {"num_samples": len(self.dataset), "metrics": {}}
+        out = {}
+        for name in self.metric_names:
+            parts = []
+            for w in range(self.num_workers):
+                parts.append(np.load(os.path.join(
+                    self.save_path, f"{name}_worker{w}.npy")))
+            sample_to_metric = np.concatenate(parts)
+            order = np.argsort(sample_to_metric, kind="stable")
+            path = os.path.join(self.save_path, f"{name}.npz")
+            np.savez(path,
+                     sample_to_metric=sample_to_metric,
+                     index_to_sample=order.astype(np.int64),
+                     index_to_metric=sample_to_metric[order])
+            manifest["metrics"][name] = {
+                "file": os.path.basename(path),
+                "min": float(sample_to_metric.min()),
+                "max": float(sample_to_metric.max()),
+            }
+            out[name] = path
+        with open(os.path.join(self.save_path, "manifest.json"), "w") as fh:
+            json.dump(manifest, fh, indent=2)
+        return out
+
+    def run(self) -> Dict[str, str]:
+        """Single-process convenience: map every shard, then reduce."""
+        orig = self.worker_id
+        for w in range(self.num_workers):
+            self.worker_id = w
+            self.run_map()
+        self.worker_id = orig
+        return self.run_reduce()
+
+
+def load_metric(save_path: str, name: str) -> Dict[str, np.ndarray]:
+    """Load one metric's artifacts for the sampler/curriculum."""
+    data = np.load(os.path.join(save_path, f"{name}.npz"))
+    return {k: data[k] for k in data.files}

@@ -1,0 +1,137 @@
+"""Cross-rank consistency + non-finite sanity checks (safe mode).
+
+Reference counterparts: ZeRO-3 safe_mode's
+``assert_ints_same_as_other_ranks`` (stage3.py:1152), the NaN/Inf overflow
+scan (stage3.py:2055 _has_inf_or_nan), and the trace-mismatch RuntimeError
+(partitioned_param_coordinator.py:331) — the "is every rank still looking
+at the same model?" class of checks that catch desyncs long before they
+corrupt a checkpoint.
+
+TPU-native forms:
+  * replicated arrays must be bit-identical across every device shard
+    (single process) and every process (multi-host) — a desync here means
+    non-deterministic collectives or host-divergent control flow;
+  * scalars that drive control flow (step counters, world sizes) must agree
+    across processes;
+  * any NaN/Inf in params or optimizer state is reported by tree path.
+"""
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _leaf_paths(tree):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def _fingerprint(arr: np.ndarray) -> int:
+    return hash(np.asarray(arr).tobytes())
+
+
+def check_replicated_consistency(tree, name: str = "params") -> List[str]:
+    """Return desync descriptions (empty = consistent): every fully-
+    replicated leaf must hold identical bytes on each local device shard
+    and — multi-host — an identical content digest on every process
+    (builtin hash() is per-process salted, so the cross-host comparison
+    uses a deterministic sum/sumsq digest over process_allgather)."""
+    problems = []
+    digests = []
+    for path, leaf in _leaf_paths(tree):
+        if not hasattr(leaf, "sharding"):
+            continue
+        if not leaf.sharding.is_fully_replicated:
+            continue
+        shards = getattr(leaf, "addressable_shards", None)
+        if not shards:
+            continue
+        ref = _fingerprint(shards[0].data)
+        for s in shards[1:]:
+            if _fingerprint(s.data) != ref:
+                problems.append(
+                    f"{name}{path}: replicated array differs between "
+                    f"devices {shards[0].device} and {s.device}")
+                break
+        arr = np.asarray(shards[0].data, np.float64)
+        digests.append((path, float(arr.sum()), float((arr * arr).sum())))
+    if jax.process_count() > 1 and digests:
+        from jax.experimental import multihost_utils
+
+        mine = np.asarray([[d[1], d[2]] for d in digests])
+        gathered = np.asarray(multihost_utils.process_allgather(mine))
+        for i, (path, _s, _q) in enumerate(digests):
+            if not (gathered[:, i] == gathered[0, i]).all():
+                problems.append(
+                    f"{name}{path}: replicated array digest differs "
+                    f"across processes")
+    return problems
+
+
+def check_cross_process_value(value, label: str = "value") -> List[str]:
+    """Multi-host: assert a host scalar agrees on every process (the
+    reference's same-as-other-ranks int assert). No-op single-process."""
+    if jax.process_count() <= 1:
+        return []
+    from jax.experimental import multihost_utils
+
+    mine = np.asarray(value, np.float64).reshape(-1)
+    gathered = np.asarray(
+        multihost_utils.process_allgather(mine))  # [P, ...]
+    if not (gathered == gathered[0]).all():
+        return [f"{label}: processes disagree "
+                f"({dict(enumerate(gathered[:, 0].tolist()))})"]
+    return []
+
+
+@jax.jit
+def _nonfinite_count(x):
+    return jnp.sum(~jnp.isfinite(x.astype(jnp.float32)))
+
+
+def find_nonfinite(tree, name: str = "params") -> List[str]:
+    """Tree paths containing NaN/Inf (reference _has_inf_or_nan, but with
+    the offending tensor named). The scan is a device-side reduction per
+    leaf: no host transfer of the model, and it works on globally-sharded
+    arrays that span non-addressable devices (multi-host)."""
+    bad = []
+    for path, leaf in _leaf_paths(tree):
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is None or np.dtype(dtype).kind != "f":
+            continue
+        if isinstance(leaf, np.ndarray):
+            n = int((~np.isfinite(leaf)).sum())
+        else:
+            n = int(_nonfinite_count(leaf))
+        if n:
+            size = int(np.prod(leaf.shape)) if leaf.shape else 1
+            bad.append(f"{name}{path}: {n}/{size} non-finite values")
+    return bad
+
+
+def check_engine_sanity(engine, check_finite: bool = True,
+                        raise_on_error: bool = True) -> Dict[str, Any]:
+    """Full safe-mode sweep over a training engine: replicated-param
+    consistency, cross-process step agreement, optional NaN/Inf scan.
+    Returns the report; raises RuntimeError on problems unless told not to.
+    """
+    problems: List[str] = []
+    problems += check_replicated_consistency(engine.params, "params")
+    if getattr(engine, "master_params", None) is not None:
+        problems += check_replicated_consistency(engine.master_params,
+                                                 "master_params")
+    problems += check_cross_process_value(engine.global_steps,
+                                          "global_steps")
+    problems += check_cross_process_value(int(engine._step_arr),
+                                          "device_step")
+    if check_finite:
+        problems += find_nonfinite(engine.params, "params")
+        if getattr(engine, "opt_state", None):
+            problems += find_nonfinite(engine.opt_state, "opt_state")
+    report = {"ok": not problems, "problems": problems}
+    if problems and raise_on_error:
+        raise RuntimeError("sanity check failed:\n  " +
+                           "\n  ".join(problems))
+    return report

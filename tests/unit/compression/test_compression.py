@@ -115,3 +115,63 @@ def test_engine_compression_training_runs():
         batch = {k: v.reshape(engine.gas, micro, HIDDEN) for k, v in b.items()}
         losses.append(engine.train_batch(batch=batch))
     assert all(np.isfinite(l) for l in losses)
+
+
+def test_layer_reduction_student_initialization():
+    """Student layers come from the chosen teacher layers; all non-layer
+    tensors copy whole (reference compress.py student_initialization)."""
+    from deepspeed_tpu.compression.distillation import student_initialization
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+    t_cfg = TransformerConfig(vocab_size=64, hidden_size=32,
+                              intermediate_size=64, num_layers=4, num_heads=4,
+                              max_seq_len=32, use_flash=False)
+    teacher = TransformerLM(t_cfg).init_params(jax.random.PRNGKey(0))
+    student = student_initialization(teacher, [1, 3])
+    assert jax.tree.leaves(student["layers"])[0].shape[0] == 2
+    np.testing.assert_array_equal(np.asarray(student["layers"]["wq"][0]),
+                                  np.asarray(teacher["layers"]["wq"][1]))
+    np.testing.assert_array_equal(np.asarray(student["layers"]["wq"][1]),
+                                  np.asarray(teacher["layers"]["wq"][3]))
+    np.testing.assert_array_equal(np.asarray(student["embed"]),
+                                  np.asarray(teacher["embed"]))
+
+    # config-driven form + student trains
+    student2 = student_initialization(
+        teacher, [], deepspeed_config={"compression_training": {
+            "layer_reduction": {"enabled": True, "keep_number_layer": 2,
+                                "teacher_layer": [0, 2]}}})
+    s_cfg = TransformerConfig(vocab_size=64, hidden_size=32,
+                              intermediate_size=64, num_layers=2, num_heads=4,
+                              max_seq_len=32, use_flash=False)
+    student_model = TransformerLM(s_cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 32)))
+    loss = student_model.apply(student2, {"input_ids": ids})
+    assert np.isfinite(float(loss))
+
+    with pytest.raises(AssertionError, match="out of range"):
+        student_initialization(teacher, [0, 9])
+
+
+def test_distillation_loss():
+    from deepspeed_tpu.compression.distillation import distillation_loss
+
+    rng = jax.random.PRNGKey(0)
+    t = jax.random.normal(rng, (4, 8, 16))
+    # identical student == zero KL; pure soft loss is 0
+    z = distillation_loss(t, t, temperature=2.0, alpha=1.0)
+    np.testing.assert_allclose(float(z), 0.0, atol=1e-6)
+    # blending: alpha=0 returns the hard loss untouched
+    hard = jnp.asarray(1.7)
+    out = distillation_loss(t, t + 1.0, hard_loss=hard, alpha=0.0)
+    np.testing.assert_allclose(float(out), 1.7, rtol=1e-6)
+    # diverging student increases the loss; masking selects positions
+    s = t + jax.random.normal(jax.random.PRNGKey(1), t.shape)
+    full = distillation_loss(s, t, alpha=1.0)
+    assert float(full) > 0.0
+    mask = jnp.zeros((4, 8)).at[0, 0].set(1.0)
+    masked = distillation_loss(s, t, alpha=1.0, mask=mask)
+    assert float(masked) != float(full)
+    # distillation gradient actually flows to the student
+    g = jax.grad(lambda sl: distillation_loss(sl, t, alpha=1.0))(s)
+    assert np.isfinite(np.asarray(g)).all() and np.abs(np.asarray(g)).max() > 0

@@ -119,3 +119,47 @@ def test_indexed_dataset_roundtrip(tmp_path):
         np.testing.assert_array_equal(np.asarray(ds[i]), d)
     np.testing.assert_array_equal(ds.get(2, offset=1, length=2), [7, 8])
     np.testing.assert_array_equal(ds.doc_idx, [0, 2, 4])
+
+
+def test_data_analyzer_map_reduce(tmp_path):
+    """Difficulty analysis artifacts (reference data_analyzer run_map/
+    run_reduce): per-sample metrics + sorted index maps, multi-worker."""
+    from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+        DataAnalyzer, VocabRarity, load_metric, metric_seqlen)
+
+    rng = np.random.default_rng(0)
+    lens = rng.integers(4, 20, 32)
+    data = [{"input_ids": np.concatenate([
+        rng.integers(1, 50, n), np.zeros(24 - n, np.int64)])}
+        for n in lens]
+
+    rarity = VocabRarity(vocab_size=50)
+    for s in data:
+        rarity.observe(s)
+    an = DataAnalyzer(data, ["seqlen", "rarity"],
+                      [metric_seqlen, rarity], str(tmp_path), num_workers=3)
+    out = an.run()
+    assert set(out) == {"seqlen", "rarity"}
+
+    m = load_metric(str(tmp_path), "seqlen")
+    np.testing.assert_array_equal(m["sample_to_metric"],
+                                  lens.astype(np.float64))
+    # index_to_sample sorts ascending by metric
+    assert (np.diff(m["index_to_metric"]) >= 0).all()
+    np.testing.assert_array_equal(
+        m["sample_to_metric"][m["index_to_sample"]], m["index_to_metric"])
+    # artifacts feed the curriculum sampler directly
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+        DeepSpeedDataSampler)
+    sampler = DeepSpeedDataSampler(
+        {"curriculum_type": "fixed_linear", "min_difficulty": 4,
+         "max_difficulty": 20,
+         "schedule_config": {"total_curriculum_step": 10,
+                             "difficulty_step": 1}},
+        m["sample_to_metric"], batch_size=4, seed=0)
+    sampler.set_step(1)
+    idx = sampler.sample_batch()
+    assert (m["sample_to_metric"][idx] <= sampler.current_difficulty).all()
+    import json, os
+    man = json.load(open(os.path.join(tmp_path, "manifest.json")))
+    assert man["num_samples"] == 32 and "rarity" in man["metrics"]

@@ -1,0 +1,62 @@
+"""Safe-mode sanity checks (reference stage3.py:1152 cross-rank asserts +
+_has_inf_or_nan scans)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.utils.sanity import (check_engine_sanity,
+                                        check_replicated_consistency,
+                                        find_nonfinite)
+from tests.unit.simple_model import SimpleModel, base_config, random_batches
+
+HIDDEN = 32
+
+
+def test_find_nonfinite_names_offenders():
+    tree = {"a": jnp.ones((4,)), "b": {"c": jnp.asarray([1.0, np.nan])}}
+    bad = find_nonfinite(tree)
+    assert len(bad) == 1 and "b" in bad[0] and "c" in bad[0]
+    assert find_nonfinite({"a": jnp.ones((4,))}) == []
+
+
+def test_replicated_consistency_clean_engine():
+    model = SimpleModel(hidden_dim=HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=base_config(micro=2, stage=1))
+    micro = engine.micro_batch_size * engine.ds_config.dp_world_size
+    b = random_batches(1, micro, HIDDEN)[0]
+    engine.train_batch(batch={k: v.reshape(1, micro, HIDDEN)
+                              for k, v in b.items()})
+    report = check_engine_sanity(engine)
+    assert report["ok"], report
+
+
+def test_engine_sanity_raises_on_nan():
+    model = SimpleModel(hidden_dim=HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=base_config(micro=2, stage=0))
+    engine.params["layer_0"]["w"] = engine.params["layer_0"]["w"].at[0, 0].set(
+        jnp.nan)
+    with pytest.raises(RuntimeError, match="non-finite"):
+        check_engine_sanity(engine)
+    rep = check_engine_sanity(engine, raise_on_error=False)
+    assert not rep["ok"] and any("layer_0" in p for p in rep["problems"])
+
+
+def test_replicated_desync_detected():
+    """A replicated array whose shards differ is a desync; build one by
+    hand from per-device buffers."""
+    devs = jax.devices()[:2]
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(devs), ("x",))
+    sh = NamedSharding(mesh, P())  # replicated over x
+    good = jax.device_put(jnp.ones((4,)), sh)
+    assert check_replicated_consistency({"w": good}) == []
+    bad = jax.make_array_from_single_device_arrays(
+        (4,), sh, [jax.device_put(jnp.ones((4,)), devs[0]),
+                   jax.device_put(jnp.zeros((4,)), devs[1])])
+    probs = check_replicated_consistency({"w": bad})
+    assert len(probs) == 1 and "differs" in probs[0]
