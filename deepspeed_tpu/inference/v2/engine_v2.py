@@ -83,7 +83,9 @@ class InferenceEngineV2:
                 use_kernel=config.use_paged_kernel),
             donate_argnums=(4,))
         self._prefill_jit = jax.jit(
-            lambda p, ids, n, c, b, o: paged_prefill(cfg, p, ids, n, c, b, o),
+            lambda p, ids, n, c, b, o: paged_prefill(
+                cfg, p, ids, n, c, b, o,
+                use_kernel=config.use_paged_kernel),
             donate_argnums=(3,))
         self._continue_jit = jax.jit(
             lambda p, ids, s, n, c, b, o, t: paged_continue(

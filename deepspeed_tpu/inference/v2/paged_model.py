@@ -82,16 +82,29 @@ def _logits(cfg, params, x):
 # ---------------------------------------------------------------------------
 def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
                   prompt_len: jnp.ndarray, cache: Dict[str, jnp.ndarray],
-                  block_ids: jnp.ndarray, offsets: jnp.ndarray
+                  block_ids: jnp.ndarray, offsets: jnp.ndarray,
+                  use_kernel: bool = True
                   ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """ids [1, C] (padded prompt); prompt_len scalar; block_ids/offsets [C]
     map chunk position -> (cache block, slot) with padding -> null block.
-    Returns (last-token logits [V], cache)."""
+    Returns (last-token logits [V], cache).
+
+    ``use_kernel`` runs the prompt's causal self-attention through the
+    Pallas flash kernel (the reference's blocked-flash prefill,
+    inference/v2/kernels/ragged_ops/blocked_flash/) — padding keys sit at
+    positions AFTER every valid query, so causal masking excludes them and
+    no explicit valid mask is needed; K/V still scatter into the cache
+    blocks in the same pass."""
     C = ids.shape[1]
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    # shape gates only: off-TPU the kernel runs in interpret mode (slow but
+    # identical math), which is what lets CPU tests cover this path
+    flash_ok = use_kernel and C % 128 == 0 and hd % 8 == 0
     x = params["embed"][ids[0]]                                # [C, H]
     if cfg.positional == "learned":
-        x = x + params["pos_embed"][:C]
+        # the bucket C may round past max_seq_len; clip like paged_continue
+        x = x + params["pos_embed"][
+            jnp.clip(jnp.arange(C), 0, cfg.max_seq_len - 1)]
     pos = jnp.arange(C)
     cos, sin = _rope_at(cfg, pos)                              # [C, half]
     valid = pos < prompt_len                                   # [C]
@@ -110,14 +123,24 @@ def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
             k = _rotate(k, cos[:, None], sin[:, None])
         kc = kc.at[l, block_ids, offsets].set(k.astype(kc.dtype))
         vc = vc.at[l, block_ids, offsets].set(v.astype(vc.dtype))
-        if nkv != nh:
-            k = jnp.repeat(k, nh // nkv, axis=1)
-            v = jnp.repeat(v, nh // nkv, axis=1)
-        scores = jnp.einsum("qhd,khd->hqk", q, k).astype(jnp.float32)
-        scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
-        scores = jnp.where(mask[None], scores, NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-        o = jnp.einsum("hqk,khd->qhd", probs, v).reshape(C, nh * hd)
+        if flash_ok:
+            from ...ops.flash_attention import flash_attention
+
+            o = flash_attention(
+                q.transpose(1, 0, 2)[None],      # [1, nh, C, hd]
+                k.transpose(1, 0, 2)[None],      # [1, nkv, C, hd]
+                v.transpose(1, 0, 2)[None],
+                causal=True)[0].transpose(1, 0, 2).reshape(C, nh * hd)
+        else:
+            kf, vf = k, v
+            if nkv != nh:
+                kf = jnp.repeat(kf, nh // nkv, axis=1)
+                vf = jnp.repeat(vf, nh // nkv, axis=1)
+            scores = jnp.einsum("qhd,khd->hqk", q, kf).astype(jnp.float32)
+            scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+            scores = jnp.where(mask[None], scores, NEG_INF)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            o = jnp.einsum("hqk,khd->qhd", probs, vf).reshape(C, nh * hd)
         x = x + o @ lp["wo"]
         hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
         x = x + _mlp(cfg, lp, hn)

@@ -226,3 +226,24 @@ def test_serving_bench_smoke():
     rec = json.loads(buf.getvalue().strip().splitlines()[-1])
     assert rec["metric"] == "serving_tokens_per_sec"
     assert rec["paged_tok_s"] > 0 and rec["dense_tok_s"] > 0
+
+
+def test_prefill_flash_kernel_parity(tiny_model):
+    """The flash-kernel prefill path (C % 128 == 0 engages it, interpret
+    mode on CPU) must match both the fallback path and the dense forward."""
+    model, params = tiny_model
+    prompt = list(range(3, 3 + 100))   # buckets to C=128 with bucket=128
+
+    def engine(use_kernel):
+        cfg = RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_tracked_sequences=4, max_seq_len=128, num_blocks=33,
+                block_size=16),
+            dtype="float32", prefill_bucket=128, use_paged_kernel=use_kernel)
+        return InferenceEngineV2(model, cfg, params=params)
+
+    lk = engine(True).put([1], [prompt])
+    lf = engine(False).put([1], [prompt])
+    ref = np.asarray(model.forward_logits(params, jnp.asarray([prompt])))
+    np.testing.assert_allclose(lk[0], ref[0, -1], rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(lk[0], lf[0], rtol=2e-3, atol=2e-3)
