@@ -1,0 +1,69 @@
+"""Diffusers-model acceleration wrappers (UNet / VAE / CLIP encoder).
+
+Reference: deepspeed/model_implementations/diffusers/{unet,vae}.py — torch
+wrappers whose value is (a) CUDA-graph capture/replay of the hot forward and
+(b) dtype/layout management, attached by init_inference to a StableDiffusion
+pipeline's modules.
+
+TPU-native form: XLA jit IS the graph capture (compiled once per shape,
+replayed from cache — the same property CUDAGraph.replay buys), so the
+wrapper reduces to: freeze the params, cast to the inference dtype, and
+serve every call through one cached jitted apply. Works for any functional
+``apply(params, *args, **kwargs)`` module (flax `.apply` included), which
+covers UNet, VAE encoder/decoder, and CLIP text encoders uniformly instead
+of one wrapper class per architecture.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+          "float16": jnp.float16}
+
+
+class DSInferenceModule:
+    """jit-cached frozen-weight inference wrapper (the role of the
+    reference's CUDAGraph mixin, model_implementations/features/cuda_graph.py).
+    """
+
+    def __init__(self, apply_fn: Callable, params, dtype: str = "bfloat16",
+                 static_argnames: Optional[tuple] = None):
+        self.dtype = DTYPES[dtype] if isinstance(dtype, str) else dtype
+        self._cast = lambda x: (x.astype(self.dtype)
+                                if hasattr(x, "astype")
+                                and jnp.issubdtype(
+                                    jnp.asarray(x).dtype, jnp.floating)
+                                else x)
+        self.params = jax.tree.map(self._cast, params)
+        self.fwd_count = 0
+        self._jit = jax.jit(apply_fn,
+                            static_argnames=static_argnames or ())
+
+    def __call__(self, *args, **kwargs):
+        self.fwd_count += 1
+        return self._jit(self.params, *args, **kwargs)
+
+
+class DSUNet(DSInferenceModule):
+    """UNet wrapper (reference diffusers/unet.py DSUNet): call signature
+    (sample, timestep, encoder_hidden_states, ...)."""
+
+
+class DSVAE(DSInferenceModule):
+    """VAE wrapper (reference diffusers/vae.py DSVAE). Build one per
+    encode/decode apply fn, or use ``from_encode_decode``."""
+
+    @classmethod
+    def from_encode_decode(cls, encode_fn, decode_fn, params,
+                           dtype: str = "bfloat16"):
+        vae = cls(decode_fn, params, dtype=dtype)
+        vae.decode = vae.__call__
+        enc = DSInferenceModule(encode_fn, vae.params, dtype=dtype)
+        vae.encode = enc.__call__
+        return vae
+
+
+class DSClipEncoder(DSInferenceModule):
+    """CLIP text-encoder wrapper (reference transformers/clip_encoder.py)."""

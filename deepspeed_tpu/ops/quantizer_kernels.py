@@ -14,18 +14,15 @@ one fp32 scale per block; parity-tested against the jnp reference.
 """
 
 import functools
+import math
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-INT8_QRANGE = 127.0
-INT4_QRANGE = 7.0
-
-
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+from .flash_attention import _interpret
+from .quantizer import INT4_QRANGE, INT8_QRANGE
 
 
 def _quant_kernel(x_ref, q_ref, s_ref, *, qrange):
@@ -39,7 +36,7 @@ def _quant_kernel(x_ref, q_ref, s_ref, *, qrange):
 
 def _dequant_kernel(q_ref, s_ref, o_ref, *, out_dtype):
     q = q_ref[...].astype(jnp.float32)
-    o_ref[...] = (q * s_ref[..., :1]).astype(out_dtype)
+    o_ref[...] = (q * s_ref[...]).astype(out_dtype)
 
 
 def _row_tile(nb: int, target: int = 8) -> int:
@@ -62,12 +59,12 @@ def quantize_blocks_pallas(blocks: jnp.ndarray, bits: int = 8
         grid=(nb // R,),
         in_specs=[pl.BlockSpec((R, block), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((R, block), lambda i: (i, 0)),
-                   pl.BlockSpec((R, 128), lambda i: (i, 0))],
+                   pl.BlockSpec((R, 1), lambda i: (i, 0))],
         out_shape=[jax.ShapeDtypeStruct((nb, block), jnp.int8),
-                   jax.ShapeDtypeStruct((nb, 128), jnp.float32)],
+                   jax.ShapeDtypeStruct((nb, 1), jnp.float32)],
         interpret=_interpret(),
     )(blocks)
-    return q, s[:, :1]
+    return q, s
 
 
 @functools.partial(jax.jit, static_argnames=("out_dtype",))
@@ -76,16 +73,15 @@ def dequantize_blocks_pallas(q: jnp.ndarray, scale: jnp.ndarray,
     """(int8 [nb, block], fp32 [nb, 1]) -> values [nb, block]."""
     nb, block = q.shape
     R = _row_tile(nb)
-    scale_b = jnp.broadcast_to(scale, (nb, 128))
     return pl.pallas_call(
         functools.partial(_dequant_kernel, out_dtype=out_dtype),
         grid=(nb // R,),
         in_specs=[pl.BlockSpec((R, block), lambda i: (i, 0)),
-                  pl.BlockSpec((R, 128), lambda i: (i, 0))],
+                  pl.BlockSpec((R, 1), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((R, block), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((nb, block), out_dtype),
         interpret=_interpret(),
-    )(q, scale_b)
+    )(q, scale)
 
 
 def quantize_symmetric_pallas(x, block: int = 2048, bits: int = 8):
@@ -97,9 +93,8 @@ def quantize_symmetric_pallas(x, block: int = 2048, bits: int = 8):
 
 
 def dequantize_symmetric_pallas(q, scale, shape, dtype=jnp.float32):
-    """Drop-in for ops.quantizer.dequantize_symmetric."""
-    out = dequantize_blocks_pallas(q, scale, out_dtype=jnp.float32)
-    n = 1
-    for d in shape:
-        n *= d
-    return out.reshape(-1)[:n].reshape(shape).astype(dtype)
+    """Drop-in for ops.quantizer.dequantize_symmetric; the kernel writes
+    the target dtype directly (no fp32 round trip through HBM)."""
+    out = dequantize_blocks_pallas(q, scale, out_dtype=dtype)
+    n = math.prod(shape)
+    return out.reshape(-1)[:n].reshape(shape)

@@ -1,0 +1,42 @@
+"""Diffusers-wrapper tests (reference model_implementations/diffusers)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.diffusers_models import (DSInferenceModule, DSUNet,
+                                                   DSVAE)
+
+
+def test_jit_cached_frozen_forward():
+    def apply_fn(params, x, t):
+        return jnp.tanh(x @ params["w"]) * t
+
+    params = {"w": jax.random.normal(jax.random.PRNGKey(0), (16, 16))}
+    mod = DSUNet(apply_fn, params, dtype="bfloat16")
+    # weights cast to the inference dtype
+    assert mod.params["w"].dtype == jnp.bfloat16
+    x = jnp.ones((2, 16))
+    y1 = mod(x, jnp.asarray(0.5))
+    y2 = mod(x, jnp.asarray(0.5))
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+    assert mod.fwd_count == 2
+    # non-float leaves are left alone
+    mod2 = DSInferenceModule(apply_fn, {"w": params["w"],
+                                        "steps": jnp.asarray(3)})
+    assert mod2.params["steps"].dtype == jnp.int32
+
+
+def test_vae_encode_decode_pair():
+    def enc(params, x):
+        return x @ params["w"]
+
+    def dec(params, z):
+        return z @ params["w"].T
+
+    params = {"w": jax.random.normal(jax.random.PRNGKey(1), (8, 4))}
+    vae = DSVAE.from_encode_decode(enc, dec, params, dtype="float32")
+    x = jnp.ones((2, 8))
+    z = vae.encode(x)
+    assert z.shape == (2, 4)
+    assert vae.decode(z).shape == (2, 8)
