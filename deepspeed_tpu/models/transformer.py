@@ -568,18 +568,23 @@ class TransformerLM:
         cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
                                           (0, 0, start_pos, 0))
 
-        # attend over cache[0:max_len] with validity+causal mask
+        # attend over cache[0:max_len] with validity+causal mask. Dots stay
+        # in the cache dtype with f32 accumulation (decode is HBM-bound:
+        # upcasting the cache to f32 would double the read traffic — the
+        # fix the reference makes with its fp16 inference kernels,
+        # csrc/transformer/inference)
         rep = nh // nkv
-        kk = jnp.repeat(ck, rep, axis=1).astype(jnp.float32)   # [B,nh,M,hd]
-        vv = jnp.repeat(cv, rep, axis=1).astype(jnp.float32)
-        qf = q.astype(jnp.float32)
-        s = jnp.einsum("bhsd,bhmd->bhsm", qf, kk) / math.sqrt(hd)
+        kk = jnp.repeat(ck, rep, axis=1)                       # [B,nh,M,hd]
+        vv = jnp.repeat(cv, rep, axis=1)
+        s = jnp.einsum("bhsd,bhmd->bhsm", q.astype(kk.dtype), kk,
+                       preferred_element_type=jnp.float32) / math.sqrt(hd)
         q_pos = start_pos + jnp.arange(S)[:, None]             # [S,1]
         k_pos = jnp.arange(max_len)[None, :]                   # [1,M]
         mask = k_pos <= q_pos                                  # causal+valid
         s = jnp.where(mask[None, None], s, -1e30)
         p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhsm,bhmd->bhsd", p, vv).astype(x.dtype)
+        o = jnp.einsum("bhsm,bhmd->bhsd", p.astype(vv.dtype), vv,
+                       preferred_element_type=jnp.float32).astype(x.dtype)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
         x = x + o @ lp["wo"]
 

@@ -16,7 +16,6 @@ rematerialized per-chunk softmax (never materializing the full
 adds into the score matmul.
 """
 
-import functools
 from typing import List, Optional, Sequence
 
 import jax
@@ -57,8 +56,15 @@ def evoformer_attention(q, k, v, biases: Optional[Sequence] = None,
         out = _attend_chunk(qt, kt, vt, b1, b2, scale)
         return out.transpose(0, 1, 3, 2, 4)
 
-    assert R % chunk == 0, f"n_res {R} not divisible by chunk {chunk}"
-    n_chunks = R // chunk
+    # pad the QUERY axis to a chunk multiple (keys stay unpadded, so padded
+    # queries produce garbage rows that are sliced off — no mask needed)
+    pad = (-R) % chunk
+    if pad:
+        qt = jnp.pad(qt, ((0, 0),) * 3 + ((0, pad), (0, 0)))
+        if b2 is not None:
+            b2 = jnp.pad(b2, ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0)))
+    Rq = R + pad
+    n_chunks = Rq // chunk
     q_chunks = qt.reshape(B, S, H, n_chunks, chunk, D).transpose(
         3, 0, 1, 2, 4, 5)                           # [n, B, S, H, c, D]
     if b2 is not None:
@@ -75,8 +81,8 @@ def evoformer_attention(q, k, v, biases: Optional[Sequence] = None,
         return carry, out
 
     _, outs = jax.lax.scan(body, 0, (q_chunks, b2_chunks))
-    # [n, B, S, H, c, D] -> [B, S, H, R, D]
-    out = outs.transpose(1, 2, 3, 0, 4, 5).reshape(B, S, H, R, D)
+    # [n, B, S, H, c, D] -> [B, S, H, Rq, D] -> drop query padding
+    out = outs.transpose(1, 2, 3, 0, 4, 5).reshape(B, S, H, Rq, D)[:, :, :, :R]
     return out.transpose(0, 1, 3, 2, 4)
 
 

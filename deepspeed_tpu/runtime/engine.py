@@ -318,16 +318,24 @@ class DeepSpeedTpuEngine:
             # PP composes with DP/ZeRO-1 only (same restriction as the
             # reference: PipelineEngine asserts no ZeRO-2/3, pipe/engine.py)
             assert self.zero_stage <= 1, "pipeline parallelism requires ZeRO stage <= 1"
-            # pp x tp composes for models that run manual-collective TP
-            # inside the pipeline program (PipelineModule layers)
+            # pp x tp / pp x sp compose for models that declare manual
+            # collectives over those axes inside the pipeline program
+            # (pp_manual_axes; PipelineModule declares both, and its layers
+            # are the user's responsibility per axis)
+            manual_axes = set(getattr(self.model, "pp_manual_axes", ()))
+            if getattr(self.model, "supports_pp_tp", False):
+                manual_axes.add("model")
             assert self.topology.axis_size("model") == 1 or \
-                getattr(self.model, "supports_pp_tp", False), \
+                "model" in manual_axes, \
                 "pipeline + tensor parallel requires a model with manual " \
                 "TP layers (PipelineModule); this model does not declare " \
-                "supports_pp_tp"
-            assert self.topology.axis_size("seq") == 1 and \
-                self.topology.axis_size("expert") == 1, \
-                "pipeline + sequence/expert parallel composition not yet supported"
+                "'model' in pp_manual_axes"
+            assert self.topology.axis_size("seq") == 1 or \
+                "seq" in manual_axes, \
+                "pipeline + sequence parallel requires a model declaring " \
+                "'seq' in pp_manual_axes (manual seq-axis layers)"
+            assert self.topology.axis_size("expert") == 1, \
+                "pipeline + expert parallel composition not yet supported"
             assert getattr(getattr(self.model, "cfg", None), "moe_num_experts", 0) == 0, \
                 "pipeline + MoE not yet supported (aux loss would be dropped)"
 

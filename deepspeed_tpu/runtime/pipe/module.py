@@ -136,6 +136,10 @@ class PipelineModule:
     """
 
     supports_pp_tp = True  # engine may compose pipe with the model axis
+    # axes the engine may compose with pipe because layers own their
+    # collectives there (user layers must actually use the axis — a layer
+    # list with no seq-axis ops under sp>1 just replicates work)
+    pp_manual_axes = ("model", "seq")
 
     def __init__(self, layers, loss_fn: Callable,
                  partition_method: str = "parameters",

@@ -58,3 +58,17 @@ def test_reference_surface_contract():
     b1 = jnp.zeros((B, S, 1, 1, R))
     out2 = DS4Sci_EvoformerAttention(q, k, v, [b1])
     np.testing.assert_allclose(np.asarray(out2), np.asarray(out), rtol=1e-6)
+
+
+def test_non_divisible_chunk_padding():
+    """chunked path pads the query axis for arbitrary n_res (the CUDA
+    reference accepts any length)."""
+    B, S, R, H, D = 1, 2, 40, 2, 8
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (B, S, R, H, D))
+    k = jax.random.normal(ks[1], (B, S, R, H, D))
+    v = jax.random.normal(ks[2], (B, S, R, H, D))
+    out = evoformer_attention(q, k, v, chunk=16)   # 40 % 16 != 0
+    ref = evoformer_attention(q, k, v, chunk=0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
