@@ -578,8 +578,14 @@ class DeepSpeedTpuEngine:
         transfer_dtype = (jnp.bfloat16 if self.compute_dtype == jnp.bfloat16
                           else jnp.float32)
 
-        assert self.topology.axis_size("pipe") == 1, \
-            "offload_optimizer + pipeline parallelism not supported"
+        pipe_mode = self.topology.axis_size("pipe") > 1
+        if pipe_mode:
+            # offload x pp: the 1F1B pipeline produces the gradients, the
+            # host C++ optimizer consumes them (reference runs PP with
+            # ZeRO-1 offload the same split way, engine.py:1445-1583)
+            assert hasattr(self.model, "loss_and_grads") and not fp16, \
+                "offload_optimizer + pipeline requires a 1F1B-capable " \
+                "model (loss_and_grads) and bf16"
 
         def constrain(tree, sh):
             return jax.tree.map(lambda x, s: jax.lax.with_sharding_constraint(x, s),
@@ -587,6 +593,23 @@ class DeepSpeedTpuEngine:
 
         def grad_step(params, scale_state, step, rng, batch):
             scale = scale_state["loss_scale"] if fp16 else jnp.asarray(1.0, jnp.float32)
+
+            if pipe_mode:
+                rng, sub = jax.random.split(rng)
+                loss, grads = self.model.loss_and_grads(params, batch,
+                                                        rng=sub)
+                loss = loss.astype(jnp.float32)
+                grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+                grads = constrain(grads, grad_sh)
+                gnorm = global_norm(grads)
+                if clip and clip > 0:
+                    factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                    grads = jax.tree.map(lambda g: g * factor, grads)
+                grads = jax.tree.map(lambda g: g.astype(transfer_dtype),
+                                     grads)
+                metrics = {"loss": loss, "grad_norm": gnorm,
+                           "skipped": jnp.asarray(0, jnp.int32)}
+                return grads, scale_state, rng, metrics
 
             def micro_fn(carry, micro):
                 grads_acc, rng = carry
@@ -628,6 +651,12 @@ class DeepSpeedTpuEngine:
             out_shardings=(grad_sh, scale_sh, repl, None))
 
         def eval_step(params, rng, batch):
+            if pipe_mode:
+                # the pipelined apply consumes the whole [M, B, ...] batch
+                out = self.model.apply(params, batch, train=False, rng=rng)
+                loss, _ = _split_loss_aux(out)
+                return loss.astype(jnp.float32)
+
             def micro_fn(rng, micro):
                 rng, sub = jax.random.split(rng)
                 out = self.model.apply(params, micro, train=False, rng=sub)
