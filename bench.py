@@ -107,11 +107,14 @@ def main():
         base = _flagship_cfg()  # the shipped flagship, not a local copy
         # mini-autotune: attention impl x micro-batch x remat-policy ladder;
         # OOM configs are skipped, the best-MFU measurement is reported.
-        # dots_with_no_batch_dims_saveable keeps matmul outputs instead of
-        # full per-layer recompute — the top remat-granularity candidate
-        # from the round-2 MFU review.
+        # save_dots_and_attn keeps matmul outputs AND the tagged attention
+        # output (the Pallas call is opaque to dot policies, so without the
+        # tag the flash forward re-runs in backward);
+        # dots_with_no_batch_dims_saveable keeps matmul outputs only;
+        # nothing_saveable is full per-layer recompute.
         trials = []
-        for policy in ("dots_with_no_batch_dims_saveable",
+        for policy in ("save_dots_and_attn",
+                       "dots_with_no_batch_dims_saveable",
                        "nothing_saveable"):
             for use_flash in (True, False):
                 for micro in (16, 8):
@@ -172,6 +175,17 @@ def main():
             detail["profile_trace"] = prof_dir
     except Exception as exc:
         detail["zero3_error"] = repr(exc)[:200]
+
+    if on_tpu:
+        # on-chip flash parity evidence in every bench record (round-2
+        # Weak #9: parity was previously interpret-mode-on-CPU only)
+        try:
+            from deepspeed_tpu.ops.attention_autotune import parity_check
+            detail["flash_parity"] = parity_check(
+                heads=cfg.num_heads, kv_heads=cfg.kv_heads,
+                head_dim=cfg.head_dim, seq=512)
+        except Exception as exc:
+            detail["flash_parity_error"] = repr(exc)[:150]
 
     result = {
         "metric": "train_mfu_llama_flagship",

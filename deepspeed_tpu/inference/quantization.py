@@ -58,6 +58,12 @@ def _should_quantize(path: Tuple, leaf) -> bool:
     if leaf.ndim < 2 or leaf.size < _MIN_QUANT_SIZE:
         return False
     name = str(path[-1]) if path else ""
+    key = getattr(path[-1], "key", name) if path else name
+    # biases are stacked per layer into 2-D arrays (b_q [L, nh*hd] etc.),
+    # so the ndim/size gate alone would quantize them — additive biases
+    # must stay exact
+    if str(key).startswith("b_") or str(key).endswith("_b"):
+        return False
     return "norm" not in name
 
 

@@ -27,7 +27,7 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-from ...models.transformer import TransformerConfig
+from ...models.transformer import TransformerConfig, out_proj, qkv_proj
 
 NEG_INF = -1e30
 
@@ -115,9 +115,10 @@ def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
         x, kc, vc = carry
         lp, l = inputs
         hn = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
-        q = (hn @ lp["wq"]).reshape(C, nh, hd)
-        k = (hn @ lp["wk"]).reshape(C, nkv, hd)
-        v = (hn @ lp["wv"]).reshape(C, nkv, hd)
+        q, k, v = qkv_proj(lp, hn)
+        q = q.reshape(C, nh, hd)
+        k = k.reshape(C, nkv, hd)
+        v = v.reshape(C, nkv, hd)
         if cfg.positional == "rope":
             q = _rotate(q, cos[:, None], sin[:, None])
             k = _rotate(k, cos[:, None], sin[:, None])
@@ -141,7 +142,7 @@ def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
             scores = jnp.where(mask[None], scores, NEG_INF)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             o = jnp.einsum("hqk,khd->qhd", probs, vf).reshape(C, nh * hd)
-        x = x + o @ lp["wo"]
+        x = x + out_proj(lp, o)
         hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
         x = x + _mlp(cfg, lp, hn)
         return (x, kc, vc), None
@@ -193,9 +194,10 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
         x, kc, vc = carry
         lp, l = inputs
         hn = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
-        q = (hn @ lp["wq"]).reshape(C, nh, hd)
-        k = (hn @ lp["wk"]).reshape(C, nkv, hd)
-        v = (hn @ lp["wv"]).reshape(C, nkv, hd)
+        q, k, v = qkv_proj(lp, hn)
+        q = q.reshape(C, nh, hd)
+        k = k.reshape(C, nkv, hd)
+        v = v.reshape(C, nkv, hd)
         if cfg.positional == "rope":
             q = _rotate(q, cos[:, None], sin[:, None])
             k = _rotate(k, cos[:, None], sin[:, None])
@@ -211,7 +213,7 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
         scores = jnp.where(mask[None], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         o = jnp.einsum("hqc,chd->qhd", probs, vpages).reshape(C, nh * hd)
-        x = x + o @ lp["wo"]
+        x = x + out_proj(lp, o)
         hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
         x = x + _mlp(cfg, lp, hn)
         return (x, kc, vc), None
@@ -255,9 +257,10 @@ def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
         x, kc, vc = carry
         lp, l = inputs
         hn = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
-        q = (hn @ lp["wq"]).reshape(N, nh, hd)
-        k = (hn @ lp["wk"]).reshape(N, nkv, hd)
-        v = (hn @ lp["wv"]).reshape(N, nkv, hd)
+        q, k, v = qkv_proj(lp, hn)
+        q = q.reshape(N, nh, hd)
+        k = k.reshape(N, nkv, hd)
+        v = v.reshape(N, nkv, hd)
         if cfg.positional == "rope":
             q = _rotate(q, cos[:, None], sin[:, None])
             k = _rotate(k, cos[:, None], sin[:, None])
@@ -279,7 +282,7 @@ def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
             scores = jnp.where(attn_mask[:, None, :], scores, NEG_INF)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             o = jnp.einsum("nhc,nchd->nhd", probs, vpages).reshape(N, nh * hd)
-        x = x + o @ lp["wo"]
+        x = x + out_proj(lp, o)
         hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
         x = x + _mlp(cfg, lp, hn)
         return (x, kc, vc), None

@@ -26,6 +26,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from ..ops.norms import layer_norm, rms_norm
@@ -44,6 +45,10 @@ class TransformerConfig:
     norm_eps: float = 1e-5
     activation: str = "swiglu"             # swiglu | gelu | relu
     positional: str = "rope"               # rope | learned
+    attn_bias: bool = False                # q/k/v/o projection biases (GPT-2/OPT)
+    # v1 decode: Pallas dense-cache attention kernel (ops/decode_attention)
+    # instead of the repeat+einsum path; interpret-mode off-TPU
+    decode_kernel: bool = True
     rope_theta: float = 10000.0
     tie_embeddings: bool = False
     remat: bool = True                     # activation checkpointing per layer
@@ -110,6 +115,27 @@ def apply_rotary(x, cos, sin):
     c = cos[None, None, :, :]
     s = sin[None, None, :, :]
     return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1).astype(x.dtype)
+
+
+def qkv_proj(lp, hn):
+    """q/k/v projections with optional biases (attn_bias families: GPT-2/OPT).
+    hn: [..., H]; returns flat [..., nh*hd] / [..., nkv*hd] projections."""
+    q = hn @ lp["wq"]
+    k = hn @ lp["wk"]
+    v = hn @ lp["wv"]
+    if "b_q" in lp:
+        q = q + lp["b_q"]
+        k = k + lp["b_k"]
+        v = v + lp["b_v"]
+    return q, k, v
+
+
+def out_proj(lp, o):
+    """Attention output projection with optional bias."""
+    x = o @ lp["wo"]
+    if "b_o" in lp:
+        x = x + lp["b_o"]
+    return x
 
 
 def _chunked_ce_loss(x, targets, mask, head, chunk: int):
@@ -203,6 +229,11 @@ class TransformerLM:
         if cfg.norm == "layernorm":
             layer["attn_norm_b"] = jnp.zeros((L, h), dt)
             layer["mlp_norm_b"] = jnp.zeros((L, h), dt)
+        if cfg.attn_bias:
+            layer["b_q"] = jnp.zeros((L, nh * hd), dt)
+            layer["b_k"] = jnp.zeros((L, nkv * hd), dt)
+            layer["b_v"] = jnp.zeros((L, nkv * hd), dt)
+            layer["b_o"] = jnp.zeros((L, h), dt)
 
         params = {
             "embed": init(k[7], (v, h)),
@@ -252,6 +283,12 @@ class TransformerLM:
         if cfg.norm == "layernorm":
             layer["attn_norm_b"] = vec
             layer["mlp_norm_b"] = vec
+        if cfg.attn_bias:
+            col_b = P(pipe, "model") if tp > 1 else P(pipe, None)
+            layer["b_q"] = col_b
+            layer["b_k"] = col_b
+            layer["b_v"] = col_b
+            layer["b_o"] = vec
         specs = {
             "embed": P("model", None) if tp > 1 else P(None, None),
             "layers": layer,
@@ -278,11 +315,15 @@ class TransformerLM:
         # policy: XLA fused attention for short sequences, Pallas flash once
         # the S^2 score tensor dominates (see flash_min_seq rationale)
         use_flash = cfg.use_flash and q.shape[2] >= cfg.flash_min_seq
-        return sharded_attention(q, k, v, self.topology, causal=True,
-                                 use_flash=use_flash,
-                                 block_q=cfg.attn_block_q,
-                                 block_kv=cfg.attn_block_kv,
-                                 impl=cfg.seq_parallel_impl)
+        o = sharded_attention(q, k, v, self.topology, causal=True,
+                              use_flash=use_flash,
+                              block_q=cfg.attn_block_q,
+                              block_kv=cfg.attn_block_kv,
+                              impl=cfg.seq_parallel_impl)
+        # tag for selective remat (save_attn / save_dots_and_attn policies,
+        # runtime/activation_checkpointing): saving o skips the attention
+        # forward re-run in backward — the most expensive recompute at long S
+        return checkpoint_name(o, "attn_out")
 
     def _layer(self, x, lp, cos, sin):
         cfg = self.cfg
@@ -290,15 +331,16 @@ class TransformerLM:
         nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
 
         hn = self._norm(x, lp["attn_norm"], lp.get("attn_norm_b"))
-        q = (hn @ lp["wq"]).reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
-        k = (hn @ lp["wk"]).reshape(B, S, nkv, hd).transpose(0, 2, 1, 3)
-        v = (hn @ lp["wv"]).reshape(B, S, nkv, hd).transpose(0, 2, 1, 3)
+        q, k, v = qkv_proj(lp, hn)
+        q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, nkv, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, nkv, hd).transpose(0, 2, 1, 3)
         if cfg.positional == "rope":
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
         o = self._attention(q, k, v)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
-        x = x + o @ lp["wo"]
+        x = x + out_proj(lp, o)
 
         hn = self._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"))
         aux = jnp.zeros((), jnp.float32)
@@ -556,9 +598,10 @@ class TransformerLM:
         nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
 
         hn = self._norm(x, lp["attn_norm"], lp.get("attn_norm_b"))
-        q = (hn @ lp["wq"]).reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
-        k = (hn @ lp["wk"]).reshape(B, S, nkv, hd).transpose(0, 2, 1, 3)
-        v = (hn @ lp["wv"]).reshape(B, S, nkv, hd).transpose(0, 2, 1, 3)
+        q, k, v = qkv_proj(lp, hn)
+        q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, nkv, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, nkv, hd).transpose(0, 2, 1, 3)
         if cfg.positional == "rope":
             q = apply_rotary(q, cos, sin)
             k = apply_rotary(k, cos, sin)
@@ -568,25 +611,42 @@ class TransformerLM:
         cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
                                           (0, 0, start_pos, 0))
 
-        # attend over cache[0:max_len] with validity+causal mask. Dots stay
-        # in the cache dtype with f32 accumulation (decode is HBM-bound:
-        # upcasting the cache to f32 would double the read traffic — the
-        # fix the reference makes with its fp16 inference kernels,
-        # csrc/transformer/inference)
-        rep = nh // nkv
-        kk = jnp.repeat(ck, rep, axis=1)                       # [B,nh,M,hd]
-        vv = jnp.repeat(cv, rep, axis=1)
-        s = jnp.einsum("bhsd,bhmd->bhsm", q.astype(kk.dtype), kk,
-                       preferred_element_type=jnp.float32) / math.sqrt(hd)
-        q_pos = start_pos + jnp.arange(S)[:, None]             # [S,1]
-        k_pos = jnp.arange(max_len)[None, :]                   # [1,M]
-        mask = k_pos <= q_pos                                  # causal+valid
-        s = jnp.where(mask[None, None], s, -1e30)
-        p = jax.nn.softmax(s, axis=-1)
-        o = jnp.einsum("bhsm,bhmd->bhsd", p.astype(vv.dtype), vv,
-                       preferred_element_type=jnp.float32).astype(x.dtype)
+        topo = self.topology
+        tp1 = topo is None or ("model" not in topo.sizes
+                               or topo.axis_size("model") <= 1)
+        # tp>1 keeps the einsum path: GSPMD can partition it over the head
+        # axis, while a bare pallas_call is not partition-safe
+        if cfg.decode_kernel and S == 1 and hd % 8 == 0 and tp1:
+            # Pallas dense-cache decode: streams each kv head's cache once
+            # (no GQA repeat materialization) and skips blocks past the
+            # sequence length — the v1-kernel decode path (reference
+            # csrc/transformer/inference attention kernels)
+            from ..ops.decode_attention import dense_decode_attention
+
+            lengths = jnp.broadcast_to(start_pos + 1, (B,))
+            o = dense_decode_attention(q[:, :, 0].astype(ck.dtype), ck, cv,
+                                       lengths)
+            o = o[:, :, None].astype(x.dtype)                  # [B,nh,1,hd]
+        else:
+            # attend over cache[0:max_len] with validity+causal mask. Dots
+            # stay in the cache dtype with f32 accumulation (decode is
+            # HBM-bound: upcasting the cache to f32 would double the read
+            # traffic — the fix the reference makes with its fp16 inference
+            # kernels, csrc/transformer/inference)
+            rep = nh // nkv
+            kk = jnp.repeat(ck, rep, axis=1)                   # [B,nh,M,hd]
+            vv = jnp.repeat(cv, rep, axis=1)
+            s = jnp.einsum("bhsd,bhmd->bhsm", q.astype(kk.dtype), kk,
+                           preferred_element_type=jnp.float32) / math.sqrt(hd)
+            q_pos = start_pos + jnp.arange(S)[:, None]         # [S,1]
+            k_pos = jnp.arange(max_len)[None, :]               # [1,M]
+            mask = k_pos <= q_pos                              # causal+valid
+            s = jnp.where(mask[None, None], s, -1e30)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhsm,bhmd->bhsd", p.astype(vv.dtype), vv,
+                           preferred_element_type=jnp.float32).astype(x.dtype)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
-        x = x + o @ lp["wo"]
+        x = x + out_proj(lp, o)
 
         hn = self._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"))
         if cfg.moe_num_experts > 0:
@@ -706,7 +766,7 @@ def gpt2_small() -> TransformerConfig:
     return TransformerConfig(vocab_size=50257, hidden_size=768,
                              intermediate_size=3072, num_layers=12,
                              num_heads=12, max_seq_len=1024, norm="layernorm",
-                             activation="gelu", positional="learned",
+                             activation="gelu", positional="learned", attn_bias=True,
                              tie_embeddings=True)
 
 
@@ -717,7 +777,7 @@ def opt_1_3b() -> TransformerConfig:
                              intermediate_size=8192, num_layers=24,
                              num_heads=32, max_seq_len=2048,
                              norm="layernorm", activation="relu",
-                             positional="learned", tie_embeddings=True)
+                             positional="learned", attn_bias=True, tie_embeddings=True)
 
 
 def opt_125m() -> TransformerConfig:
@@ -725,7 +785,7 @@ def opt_125m() -> TransformerConfig:
                              intermediate_size=3072, num_layers=12,
                              num_heads=12, max_seq_len=2048,
                              norm="layernorm", activation="relu",
-                             positional="learned", tie_embeddings=True)
+                             positional="learned", attn_bias=True, tie_embeddings=True)
 
 
 def tiny_test(vocab=256, hidden=128, layers=2, heads=4, seq=128) -> TransformerConfig:

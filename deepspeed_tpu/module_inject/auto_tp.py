@@ -10,10 +10,13 @@ maps onto the TransformerLM family (models/transformer.py), whose partition
 specs already carry the AutoTP column/row sharding — loading the converted
 params under a "model" mesh axis IS tensor-parallel injection.
 
-Supported architectures (reference policy containers): LlamaForCausalLM /
-MistralForCausalLM (RMSNorm+RoPE+SwiGLU+GQA) and GPT2LMHeadModel
-(LayerNorm+learned positions+GELU). torch weights are consumed as numpy;
-torch never touches the device path.
+Supported architectures (reference policy containers, and the reference's
+in-tree inference-v2 families inference/v2/model_implementations/
+{llama_v2,mistral,opt}): LlamaForCausalLM / MistralForCausalLM
+(RMSNorm+RoPE+SwiGLU+GQA, optional attention_bias), GPT2LMHeadModel
+(LayerNorm+learned positions+GELU+attn biases) and OPTForCausalLM
+(pre-LN LayerNorm+learned positions with the HF +2 offset+ReLU+biases).
+torch weights are consumed as numpy; torch never touches the device path.
 """
 
 from typing import Any, Dict, Optional, Tuple
@@ -48,6 +51,7 @@ def config_from_hf(hf_config) -> TransformerConfig:
             activation="swiglu", positional="rope",
             rope_theta=getattr(hf_config, "rope_theta", 10000.0),
             tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+            attn_bias=getattr(hf_config, "attention_bias", False),
         )
     if mt == "gpt2":
         return TransformerConfig(
@@ -59,10 +63,41 @@ def config_from_hf(hf_config) -> TransformerConfig:
             max_seq_len=hf_config.n_positions,
             norm="layernorm", norm_eps=hf_config.layer_norm_epsilon,
             activation="gelu", positional="learned", tie_embeddings=True,
+            attn_bias=True,
+        )
+    if mt == "opt":
+        if not getattr(hf_config, "do_layer_norm_before", True):
+            raise ValueError(
+                "OPT with do_layer_norm_before=False (OPT-350M) is post-LN; "
+                "the TransformerLM family is pre-LN only")
+        if getattr(hf_config, "word_embed_proj_dim",
+                   hf_config.hidden_size) != hf_config.hidden_size:
+            raise ValueError(
+                "OPT word_embed_proj_dim != hidden_size (project_in/out) "
+                "is not supported")
+        act = {"relu": "relu", "gelu": "gelu"}.get(
+            hf_config.activation_function)
+        if act is None:
+            raise ValueError(
+                f"OPT activation_function "
+                f"{hf_config.activation_function!r} is not supported; "
+                f"supported: relu, gelu")
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.ffn_dim,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            max_seq_len=hf_config.max_position_embeddings,
+            norm="layernorm", norm_eps=1e-5,
+            activation=act, positional="learned",
+            tie_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+            attn_bias=True,
         )
     raise ValueError(
-        f"unsupported model_type '{mt}'; supported: llama, mistral, gpt2 "
-        f"(add a mapping here the way the reference adds policy containers)")
+        f"unsupported model_type '{mt}'; supported: llama, mistral, gpt2, "
+        f"opt (add a mapping here the way the reference adds policy "
+        f"containers)")
 
 
 # ---------------------------------------------------------------------------
@@ -89,6 +124,11 @@ def _params_from_llama(sd, cfg: TransformerConfig) -> Dict[str, Any]:
         "w_up": _stack(sd, p + "mlp.up_proj.weight", L, transpose=True),
         "w_down": _stack(sd, p + "mlp.down_proj.weight", L, transpose=True),
     }
+    if cfg.attn_bias:
+        layers["b_q"] = _stack(sd, p + "self_attn.q_proj.bias", L)
+        layers["b_k"] = _stack(sd, p + "self_attn.k_proj.bias", L)
+        layers["b_v"] = _stack(sd, p + "self_attn.v_proj.bias", L)
+        layers["b_o"] = _stack(sd, p + "self_attn.o_proj.bias", L)
     params = {
         "embed": np.ascontiguousarray(sd["model.embed_tokens.weight"],
                                       np.float32),
@@ -108,13 +148,19 @@ def _params_from_gpt2(sd, cfg: TransformerConfig) -> Dict[str, Any]:
     # GPT2 Conv1D weights are already [in, out]; c_attn fuses qkv on out dim
     c_attn = np.stack([sd[(p + "attn.c_attn.weight").format(i)]
                        for i in range(L)]).astype(np.float32)
+    c_attn_b = np.stack([sd[(p + "attn.c_attn.bias").format(i)]
+                         for i in range(L)]).astype(np.float32)
     layers = {
         "attn_norm": _stack(sd, p + "ln_1.weight", L),
         "attn_norm_b": _stack(sd, p + "ln_1.bias", L),
         "wq": np.ascontiguousarray(c_attn[:, :, :h]),
         "wk": np.ascontiguousarray(c_attn[:, :, h:2 * h]),
         "wv": np.ascontiguousarray(c_attn[:, :, 2 * h:]),
+        "b_q": np.ascontiguousarray(c_attn_b[:, :h]),
+        "b_k": np.ascontiguousarray(c_attn_b[:, h:2 * h]),
+        "b_v": np.ascontiguousarray(c_attn_b[:, 2 * h:]),
         "wo": _stack(sd, p + "attn.c_proj.weight", L),
+        "b_o": _stack(sd, p + "attn.c_proj.bias", L),
         "mlp_norm": _stack(sd, p + "ln_2.weight", L),
         "mlp_norm_b": _stack(sd, p + "ln_2.bias", L),
         "w_up": _stack(sd, p + "mlp.c_fc.weight", L),
@@ -135,6 +181,47 @@ def _params_from_gpt2(sd, cfg: TransformerConfig) -> Dict[str, Any]:
     }
 
 
+def _params_from_opt(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+    L = cfg.num_layers
+    p = "model.decoder.layers.{}."
+    layers = {
+        "attn_norm": _stack(sd, p + "self_attn_layer_norm.weight", L),
+        "attn_norm_b": _stack(sd, p + "self_attn_layer_norm.bias", L),
+        "wq": _stack(sd, p + "self_attn.q_proj.weight", L, transpose=True),
+        "wk": _stack(sd, p + "self_attn.k_proj.weight", L, transpose=True),
+        "wv": _stack(sd, p + "self_attn.v_proj.weight", L, transpose=True),
+        "b_q": _stack(sd, p + "self_attn.q_proj.bias", L),
+        "b_k": _stack(sd, p + "self_attn.k_proj.bias", L),
+        "b_v": _stack(sd, p + "self_attn.v_proj.bias", L),
+        "wo": _stack(sd, p + "self_attn.out_proj.weight", L, transpose=True),
+        "b_o": _stack(sd, p + "self_attn.out_proj.bias", L),
+        "mlp_norm": _stack(sd, p + "final_layer_norm.weight", L),
+        "mlp_norm_b": _stack(sd, p + "final_layer_norm.bias", L),
+        "w_up": _stack(sd, p + "fc1.weight", L, transpose=True),
+        "b_up": _stack(sd, p + "fc1.bias", L),
+        "w_down": _stack(sd, p + "fc2.weight", L, transpose=True),
+        "b_down": _stack(sd, p + "fc2.bias", L),
+    }
+    # HF OPTLearnedPositionalEmbedding carries a +2 offset: the table has
+    # max_position_embeddings + 2 rows and position i reads row i + 2 —
+    # slicing the first two rows off lets plain arange indexing work
+    params = {
+        "embed": np.ascontiguousarray(
+            sd["model.decoder.embed_tokens.weight"], np.float32),
+        "pos_embed": np.ascontiguousarray(
+            sd["model.decoder.embed_positions.weight"][2:], np.float32),
+        "layers": layers,
+        "final_norm": np.ascontiguousarray(
+            sd["model.decoder.final_layer_norm.weight"], np.float32),
+        "final_norm_b": np.ascontiguousarray(
+            sd["model.decoder.final_layer_norm.bias"], np.float32),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = np.ascontiguousarray(sd["lm_head.weight"].T,
+                                                 np.float32)
+    return params
+
+
 def params_from_hf(state_dict: Dict[str, Any],
                    cfg: TransformerConfig,
                    model_type: str = "llama") -> Dict[str, Any]:
@@ -145,6 +232,8 @@ def params_from_hf(state_dict: Dict[str, Any],
         return _params_from_llama(sd, cfg)
     if model_type == "gpt2":
         return _params_from_gpt2(sd, cfg)
+    if model_type == "opt":
+        return _params_from_opt(sd, cfg)
     raise ValueError(f"unsupported model_type '{model_type}'")
 
 

@@ -1,0 +1,52 @@
+"""Spatial (diffusers/UNet) inference ops.
+
+TPU-native equivalent of the reference's spatial kernels
+(csrc/spatial/csrc/opt_bias_add.cu, bound at pt_binding.cpp:109-111 as
+nhwc_bias_add / nhwc_bias_add_add / nhwc_bias_add_bias_add, and wrapped by
+deepspeed/ops/transformer/inference/bias_add.py). The CUDA versions exist
+because torch eager would launch three kernels for bias + residual adds in
+the UNet hot path; under jit XLA fuses the whole expression into one
+elementwise kernel (SURVEY.md §2.2 "Spatial ops -> XLA fusion"), so the
+TPU implementation is the fused expression itself with the reference's
+exact call signature.
+
+Layout note: the reference is NHWC (channels-last) because its conv
+kernels want it; JAX convs default to NCHW but accept either. The bias
+here broadcasts over the trailing channel axis, matching NHWC inputs.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _bias_add(activation, bias):
+    return activation + bias
+
+
+@jax.jit
+def _bias_add_add(activation, bias, other):
+    return activation + bias + other
+
+
+@jax.jit
+def _bias_add_bias_add(activation, bias, other, other_bias):
+    return activation + bias + other + other_bias
+
+
+def nhwc_bias_add(activation: jnp.ndarray, bias: jnp.ndarray,
+                  other: Optional[jnp.ndarray] = None,
+                  other_bias: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Fused bias(+residual)(+residual-bias) add over NHWC activations.
+
+    activation [..., C]; bias [C]; optional other [..., C] with its own
+    optional other_bias [C] — the three dispatch cases of the reference's
+    bias_add.py wrapper.
+    """
+    if other is None:
+        return _bias_add(activation, bias)
+    if other_bias is None:
+        return _bias_add_add(activation, bias, other)
+    return _bias_add_bias_add(activation, bias, other, other_bias)

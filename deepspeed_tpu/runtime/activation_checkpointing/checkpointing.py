@@ -46,10 +46,21 @@ def _resolve_policy(name: str, cpu_checkpointing: bool = False):
         # keeping them in HBM (reference checkpoint_in_cpu / copy_to_main_memory)
         return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
             "device", "pinned_host")
+    if name == "save_attn":
+        # keep attention outputs (tagged checkpoint_name("attn_out") in the
+        # model): dots_with_no_batch_dims skips them (attention einsums have
+        # batch dims, and the Pallas flash call is opaque to dot policies),
+        # so without the tag the whole attention fwd re-runs in backward
+        return jax.checkpoint_policies.save_only_these_names("attn_out")
+    if name == "save_dots_and_attn":
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names("attn_out"))
     policy = getattr(jax.checkpoint_policies, name, None)
     if policy is None:
         raise ValueError(
             f"unknown activation-checkpointing policy '{name}'; options: "
+            f"save_attn, save_dots_and_attn, "
             f"{[p for p in dir(jax.checkpoint_policies) if not p.startswith('_')]}")
     return policy
 

@@ -7,7 +7,7 @@ import jax.numpy as jnp
 
 
 class TinyModel:
-    def __init__(self, hidden=32, slow=False):
+    def __init__(self, hidden=128, slow=False):
         self.hidden = hidden
         self.slow = slow
 
@@ -17,8 +17,11 @@ class TinyModel:
 
     def apply(self, params, batch, train=True, rng=None):
         h = batch["x"].astype(params["w"].dtype)
-        # "attention impl" stand-in: the slow variant does extra matmuls
-        for _ in range(8 if self.slow else 1):
+        # "attention impl" stand-in: the slow variant does extra matmuls.
+        # 64 x (128x128) keeps the fast/slow step-time gap physical (tens of
+        # ms of real flops) so the ranking assertion survives a loaded host;
+        # at the original 8 x (32x32) the gap was dispatch-overhead noise.
+        for _ in range(64 if self.slow else 1):
             h = h @ params["w"]
         return jnp.mean((h - batch["y"]).astype(jnp.float32) ** 2)
 
@@ -33,5 +36,5 @@ def model_factory(slow=False, hang=False):
 def batch_factory(engine):
     gm = engine.micro_batch_size * engine.ds_config.dp_world_size
     rng = np.random.default_rng(0)
-    return {"x": rng.standard_normal((engine.gas, gm, 32)).astype("f4"),
-            "y": rng.standard_normal((engine.gas, gm, 32)).astype("f4")}
+    return {"x": rng.standard_normal((engine.gas, gm, 128)).astype("f4"),
+            "y": rng.standard_normal((engine.gas, gm, 128)).astype("f4")}

@@ -247,3 +247,33 @@ def test_prefill_flash_kernel_parity(tiny_model):
     ref = np.asarray(model.forward_logits(params, jnp.asarray([prompt])))
     np.testing.assert_allclose(lk[0], ref[0, -1], rtol=2e-3, atol=2e-3)
     np.testing.assert_allclose(lk[0], lf[0], rtol=2e-3, atol=2e-3)
+
+
+def test_opt_family_paged_matches_dense():
+    """OPT-family config (layernorm + learned positions + attn biases +
+    ReLU) through prefill + decode: the paged path must honor the bias and
+    pos-embed params exactly like the dense forward (reference in-tree
+    family inference/v2/model_implementations/opt/)."""
+    cfg = _tiny_cfg(norm="layernorm", positional="learned", attn_bias=True,
+                    activation="relu", tie_embeddings=True)
+    model = TransformerLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(3))
+    # init_params zero-fills biases; fill with noise so a dropped bias fails
+    keys = jax.random.split(jax.random.PRNGKey(7), 16)
+    it = iter(range(16))
+
+    def noisify(path, x):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name.startswith("b_") or name.endswith("_b"):
+            return jax.random.normal(keys[next(it)], x.shape, x.dtype) * 0.1
+        return x
+
+    params = jax.tree_util.tree_map_with_path(noisify, params)
+    engine = _v2_engine(model, params)
+    prompt = list(range(3, 10))
+    engine.put([1], [prompt])
+    l1 = engine.put([1], [[11]])
+    full = jnp.asarray(np.array(prompt + [11])[None])
+    ref = np.asarray(model.forward_logits(params, full))
+    np.testing.assert_allclose(l1[0], ref[0, len(prompt)], rtol=2e-4,
+                               atol=2e-4)

@@ -13,6 +13,17 @@ transformers = pytest.importorskip("transformers")
 from deepspeed_tpu.module_inject import load_hf_model  # noqa: E402
 
 
+def _randomize_biases(hf_model, seed=0):
+    """HF zero-initializes projection biases (GPT2 Conv1D, OPT _init_weights)
+    — a conversion that silently drops them would still pass parity on a
+    fresh random model. Fill every bias with noise so dropped biases fail."""
+    gen = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for name, p in hf_model.named_parameters():
+            if name.endswith("bias"):
+                p.copy_(torch.randn(p.shape, generator=gen) * 0.1)
+
+
 def _assert_logits_match(hf_model, ids_np, rtol=2e-3, atol=2e-3):
     model, params = load_hf_model(hf_model)
     params = {k: jnp.asarray(v) if not isinstance(v, dict)
@@ -36,6 +47,20 @@ def test_llama_injection_matches_hf():
     _assert_logits_match(hf, ids)
 
 
+def test_llama_attention_bias_injection_matches_hf():
+    """Qwen-style LlamaConfig(attention_bias=True) carries q/k/v/o biases."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attention_bias=True)
+    torch.manual_seed(5)
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    _randomize_biases(hf, seed=5)
+    ids = np.random.default_rng(5).integers(0, 96, (2, 10), dtype=np.int64)
+    _assert_logits_match(hf, ids)
+
+
 def test_mistral_injection_matches_hf():
     cfg = transformers.MistralConfig(
         vocab_size=96, hidden_size=32, intermediate_size=64,
@@ -54,8 +79,31 @@ def test_gpt2_injection_matches_hf():
         resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
     torch.manual_seed(2)
     hf = transformers.GPT2LMHeadModel(cfg).eval()
+    _randomize_biases(hf, seed=2)
     ids = np.random.default_rng(2).integers(0, 96, (2, 8), dtype=np.int64)
     _assert_logits_match(hf, ids)
+
+
+def test_opt_injection_matches_hf():
+    cfg = transformers.OPTConfig(
+        vocab_size=96, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        do_layer_norm_before=True, activation_function="relu",
+        word_embed_proj_dim=32, dropout=0.0)
+    torch.manual_seed(4)
+    hf = transformers.OPTForCausalLM(cfg).eval()
+    _randomize_biases(hf, seed=4)
+    ids = np.random.default_rng(4).integers(0, 96, (2, 9), dtype=np.int64)
+    _assert_logits_match(hf, ids)
+
+
+def test_opt_post_ln_rejected():
+    from deepspeed_tpu.module_inject import config_from_hf
+    cfg = transformers.OPTConfig(
+        vocab_size=96, hidden_size=32, ffn_dim=64, num_hidden_layers=2,
+        num_attention_heads=4, do_layer_norm_before=False)
+    with pytest.raises(ValueError, match="post-LN"):
+        config_from_hf(cfg)
 
 
 def test_injected_model_generates():

@@ -90,3 +90,23 @@ def test_flash_cross_length_fwd_bwd():
     for a, b, name in zip(g1, g2, "qkv"):
         np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-3,
                                    err_msg=f"d{name} mismatch")
+
+
+def test_attention_autotune_parity_and_crossover():
+    """parity_check + measure_crossover run on the test backend (interpret
+    mode here; the same entry runs on-chip via ds_tpu_flash_check and is
+    recorded in every bench)."""
+    from deepspeed_tpu.ops.attention_autotune import (measure_crossover,
+                                                      parity_check)
+
+    rep = parity_check(batch=1, heads=2, kv_heads=1, seq=128, head_dim=8,
+                       dtype=jnp.float32)
+    assert rep["out_rel_err"] < 1e-5
+    assert max(rep["dq_rel_err"], rep["dk_rel_err"],
+               rep["dv_rel_err"]) < 1e-4
+
+    crossover, timings = measure_crossover(
+        batch=1, heads=2, kv_heads=2, head_dim=8, dtype=jnp.float32,
+        seqs=(128,), steps=1)
+    assert 128 in timings
+    assert crossover in (None, 128)

@@ -110,3 +110,23 @@ class TestPallasQuantizer:
         assert np.asarray(q).max() == 0
         back = dequantize_symmetric_pallas(q, s, x.shape)
         np.testing.assert_array_equal(np.asarray(back), 0.0)
+
+
+def test_woq_skips_stacked_biases():
+    """Per-layer stacked biases (b_q [L, nh*hd] etc.) are 2-D and large, but
+    additive biases must never be weight-only-quantized (code-review r3)."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.inference.quantization import (QuantizedTensor,
+                                                      quantize_params)
+
+    params = {"layers": {
+        "wq": jnp.ones((4, 64, 64)),            # quantized
+        "b_q": jnp.ones((4, 4096)),             # bias: must stay exact
+        "attn_norm_b": jnp.ones((4, 4096)),     # norm bias: must stay exact
+    }}
+    q, meta = quantize_params(params, bits=8, block=128)
+    assert isinstance(q["layers"]["wq"], QuantizedTensor)
+    assert not isinstance(q["layers"]["b_q"], QuantizedTensor)
+    assert not isinstance(q["layers"]["attn_norm_b"], QuantizedTensor)
+    assert meta["n_quantized"] == 1

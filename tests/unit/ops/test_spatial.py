@@ -1,0 +1,38 @@
+"""Spatial (diffusers) bias-add ops — parity with the reference dispatch
+(deepspeed/ops/transformer/inference/bias_add.py three-way signature)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.spatial import nhwc_bias_add
+
+
+def _rand(shape, seed):
+    return np.random.default_rng(seed).standard_normal(shape).astype("f4")
+
+
+def test_bias_add():
+    x = _rand((2, 4, 4, 8), 0)
+    b = _rand((8,), 1)
+    out = np.asarray(nhwc_bias_add(jnp.asarray(x), jnp.asarray(b)))
+    np.testing.assert_allclose(out, x + b, rtol=1e-6)
+
+
+def test_bias_add_add():
+    x = _rand((2, 4, 4, 8), 0)
+    b = _rand((8,), 1)
+    o = _rand((2, 4, 4, 8), 2)
+    out = np.asarray(nhwc_bias_add(jnp.asarray(x), jnp.asarray(b),
+                                   other=jnp.asarray(o)))
+    np.testing.assert_allclose(out, x + b + o, rtol=1e-6)
+
+
+def test_bias_add_bias_add():
+    x = _rand((2, 4, 4, 8), 0)
+    b = _rand((8,), 1)
+    o = _rand((2, 4, 4, 8), 2)
+    ob = _rand((8,), 3)
+    out = np.asarray(nhwc_bias_add(jnp.asarray(x), jnp.asarray(b),
+                                   other=jnp.asarray(o),
+                                   other_bias=jnp.asarray(ob)))
+    np.testing.assert_allclose(out, x + b + o + ob, rtol=1e-6)
