@@ -180,10 +180,14 @@ def main():
         # on-chip flash parity evidence in every bench record (round-2
         # Weak #9: parity was previously interpret-mode-on-CPU only)
         try:
-            from deepspeed_tpu.ops.attention_autotune import parity_check
+            from deepspeed_tpu.ops.attention_autotune import (
+                decode_parity_check, parity_check)
             detail["flash_parity"] = parity_check(
                 heads=cfg.num_heads, kv_heads=cfg.kv_heads,
                 head_dim=cfg.head_dim, seq=512)
+            detail["decode_parity"] = decode_parity_check(
+                heads=cfg.num_heads, kv_heads=cfg.kv_heads,
+                head_dim=cfg.head_dim)
         except Exception as exc:
             detail["flash_parity_error"] = repr(exc)[:150]
 

@@ -451,14 +451,24 @@ class TransformerLM:
                     checkpointing as ds_ckpt)
                 layer_body = ds_ckpt.checkpoint_wrapper(self._layer)
 
+            moe = cfg.moe_num_experts > 0
+
             def stage_fn(h):
                 def scan_fn(carry, lp):
-                    out, _aux = layer_body(carry, lp, cos_c, sin_c)
-                    return out, None
-                out, _ = jax.lax.scan(scan_fn, h, layers_local)
+                    out, aux = layer_body(carry, lp, cos_c, sin_c)
+                    return out, aux
+                out, auxs = jax.lax.scan(scan_fn, h, layers_local)
+                if moe:
+                    # stage-local share of the layer-mean aux loss
+                    return out, (cfg.moe_aux_loss_coef * jnp.sum(auxs)
+                                 / cfg.num_layers)
                 return out
 
-            ys = pipeline_scan(stage_fn, x, pp, remat=False)   # [M, b, S, H]
+            if moe:
+                ys, aux_sum = pipeline_scan(stage_fn, x, pp, remat=False,
+                                            stage_aux=True)
+            else:
+                ys = pipeline_scan(stage_fn, x, pp, remat=False)  # [M,b,S,H]
             ys = self._norm(ys, params["final_norm"],
                             params.get("final_norm_b"))
             head = (params["embed"].T if cfg.tie_embeddings
@@ -474,6 +484,9 @@ class TransformerLM:
                 loss_local = jnp.mean(nll)
             # only the last stage's loss is real; make it replicated everywhere
             loss = broadcast_from_last(loss_local, pp)
+            if moe:
+                # every stage contributed aux for its own layers
+                loss = loss + jax.lax.psum(aux_sum, "pipe") / M
             return jax.lax.pmean(loss, dp_axes)
 
         args = (params, ids) + ((mask,) if mask is not None else ())
@@ -516,6 +529,8 @@ class TransformerLM:
                     checkpointing as ds_ckpt)
                 layer_body = ds_ckpt.checkpoint_wrapper(self._layer)
 
+            moe = cfg.moe_num_experts > 0
+
             def stage_fn(pp_, ids_mb, h):
                 x0 = pp_["embed"][ids_mb]
                 if cfg.positional == "learned":
@@ -524,10 +539,16 @@ class TransformerLM:
                 x = jnp.where(stage_index() == 0, x0, h)
 
                 def scan_fn(carry, lp):
-                    out, _aux = layer_body(carry, lp, cos_c, sin_c)
-                    return out, None
+                    out, aux = layer_body(carry, lp, cos_c, sin_c)
+                    return out, aux
 
-                out, _ = jax.lax.scan(scan_fn, x, pp_["layers"])
+                out, auxs = jax.lax.scan(scan_fn, x, pp_["layers"])
+                if moe:
+                    # stage-local, pre-scaled share of the layer-mean aux
+                    # loss; pipeline_1f1b differentiates it in this stage's
+                    # backward slot (cotangent 1.0)
+                    return out, (cfg.moe_aux_loss_coef * jnp.sum(auxs)
+                                 / cfg.num_layers).astype(jnp.float32)
                 return out
 
             def loss_fn(p_, ys, ids_mb, *m_mb):
@@ -549,7 +570,7 @@ class TransformerLM:
             return pipeline_1f1b(
                 stage_fn, loss_fn, p, ids_l, pp, h_spec=h_spec,
                 loss_args=(ids_l,) + tuple(mask_l), dp_axes=dp_axes,
-                pipe_reduce_mask=reduce_mask)
+                pipe_reduce_mask=reduce_mask, stage_aux=moe)
 
         args = (params, ids) + ((mask,) if mask is not None else ())
         grad_specs = param_specs

@@ -75,6 +75,39 @@ def parity_check(batch: int = 1, heads: int = 8, kv_heads: int = 4,
     }
 
 
+def decode_parity_check(batch: int = 4, heads: int = 8, kv_heads: int = 4,
+                        cache_len: int = 300, head_dim: int = 64,
+                        dtype=jnp.bfloat16) -> Dict[str, float]:
+    """Max error of the dense-cache decode kernel (ops/decode_attention,
+    the v1 inference hot path) vs the repeat+einsum reference on the
+    CURRENT backend. cache_len deliberately defaults to a non-power-of-two
+    (masked tail block). Recorded by bench.py so every round's BENCH JSON
+    carries on-chip evidence for the default-on decode kernel."""
+    from .decode_attention import dense_decode_attention
+
+    ks = jax.random.split(jax.random.PRNGKey(2), 4)
+    q = jax.random.normal(ks[0], (batch, heads, head_dim), dtype)
+    kc = jax.random.normal(ks[1], (batch, kv_heads, cache_len, head_dim),
+                           dtype)
+    vc = jax.random.normal(ks[2], (batch, kv_heads, cache_len, head_dim),
+                           dtype)
+    lengths = jnp.asarray(
+        jax.random.randint(ks[3], (batch,), 1, cache_len + 1))
+    out = dense_decode_attention(q, kc, vc, lengths).astype(jnp.float32)
+
+    rep = heads // kv_heads
+    kk = jnp.repeat(kc, rep, axis=1).astype(jnp.float32)
+    vv = jnp.repeat(vc, rep, axis=1).astype(jnp.float32)
+    s = jnp.einsum("bhd,bhmd->bhm", q.astype(jnp.float32), kk) / (
+        head_dim ** 0.5)
+    mask = jnp.arange(cache_len)[None, None, :] < lengths[:, None, None]
+    p = jax.nn.softmax(jnp.where(mask, s, -1e30), axis=-1)
+    ref = jnp.einsum("bhm,bhmd->bhd", p, vv)
+    denom = jnp.maximum(jnp.max(jnp.abs(ref)), 1e-6)
+    return {"decode_rel_err": float(jnp.max(jnp.abs(out - ref)) / denom),
+            "backend": jax.default_backend(), "cache_len": cache_len}
+
+
 def _time_step(fn, args, steps: int = 5, warmup: int = 2) -> float:
     for _ in range(warmup):
         jax.block_until_ready(fn(*args))

@@ -33,7 +33,7 @@ NEG_INF = -1e30
 
 
 def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
-            *, bs, n_blocks, scale):
+            *, bs, n_blocks, scale, m_total):
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -54,6 +54,10 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
                                 preferred_element_type=jnp.float32) * scale
         pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(pos < length, s, NEG_INF)
+        # the tail block may extend past M (Pallas pads with garbage/NaN);
+        # p is 0 there but 0 * NaN = NaN in the p @ v dot — zero v's pad
+        lane = j * bs + jax.lax.broadcasted_iota(jnp.int32, v.shape, 0)
+        v = jnp.where(lane < m_total, v, 0.0)
         m_prev = m_sc[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -82,14 +86,19 @@ def dense_decode_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     B, nh, hd = q.shape
     _, kvh, M, _ = k_cache.shape
     group = nh // kvh
-    bs = min(block_kv, M)
-    while bs > 1 and M % bs:
-        bs //= 2
-    n_blocks = M // bs
+    # bs need not divide M: the grid covers ceil(M/bs) blocks and Pallas
+    # pads the tail block, whose garbage lanes the `pos < length` mask
+    # already excludes (length <= M always). Keeping bs large matters —
+    # cache lengths are arbitrary user numbers (prompt + max_new_tokens),
+    # and degrading to tiny blocks on non-power-of-two M would be a silent
+    # perf cliff on the hot decode path.
+    bs = min(block_kv, max(8, -(-M // 8) * 8))
+    n_blocks = -(-M // bs)  # cdiv
     scale = 1.0 / (hd ** 0.5)
     q4 = q.reshape(B, kvh, group, hd)
 
-    kernel = functools.partial(_kernel, bs=bs, n_blocks=n_blocks, scale=scale)
+    kernel = functools.partial(_kernel, bs=bs, n_blocks=n_blocks,
+                               scale=scale, m_total=M)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, kvh, n_blocks),

@@ -40,13 +40,20 @@ from ...parallel.topology import MeshTopology, PIPE_AXIS
 
 
 def pipeline_scan(stage_fn: Callable, x_microbatches, num_stages: int,
-                  remat: bool = True):
+                  remat: bool = True, stage_aux: bool = False):
     """Run `stage_fn(x) -> y` as a pipeline over the pipe axis, inside
     shard_map.
 
     x_microbatches: [M, ...] microbatch activations entering stage 0.
     Returns [M, ...] outputs of the LAST stage (garbage on other stages —
     callers mask with stage == num_stages-1).
+
+    stage_aux: stage_fn returns (y, aux_scalar) — a stage-LOCAL auxiliary
+    loss (MoE load balancing; reference sharded_moe.py l_aux). The return
+    becomes (ys, aux_sum) where aux_sum is this stage's aux summed over its
+    REAL microbatch ticks (bubble ticks run on garbage activations whose
+    gating aux is nonzero, so they must be masked out); callers psum over
+    the pipe axis and divide by M.
     """
     pp = num_stages
     stage = lax.axis_index(PIPE_AXIS)
@@ -61,18 +68,28 @@ def pipeline_scan(stage_fn: Callable, x_microbatches, num_stages: int,
     fwd_perm = [(i, i + 1) for i in range(pp - 1)]
 
     def tick(carry, t):
-        buf = carry                                   # activation entering my stage
+        buf, aux_acc = carry              # activation entering my stage
+        m = t - stage                     # my microbatch index this tick
         m_in = jnp.clip(t, 0, M - 1)
         inp = jnp.where(stage == 0, x_microbatches[m_in], buf)
-        out = body(inp)
+        if stage_aux:
+            out, aux = body(inp)
+            active = (m >= 0) & (m < M)
+            aux_acc = aux_acc + jnp.where(active, aux.astype(jnp.float32),
+                                          0.0)
+        else:
+            out = body(inp)
         nxt = lax.ppermute(out, PIPE_AXIS, perm=fwd_perm)
         # last stage's finished microbatch this tick
         y = jnp.where(stage == pp - 1, out, jnp.zeros_like(out))
-        return nxt, y
+        return (nxt, aux_acc), y
 
     buf0 = jnp.zeros_like(x_microbatches[0])
-    _, ys = lax.scan(tick, buf0, jnp.arange(T))
+    (_, aux_sum), ys = lax.scan(tick, (buf0, jnp.zeros((), jnp.float32)),
+                                jnp.arange(T))
     # tick t finishes microbatch t-(pp-1) on the last stage
+    if stage_aux:
+        return ys[pp - 1:], aux_sum
     return ys[pp - 1:]
 
 
@@ -92,7 +109,7 @@ def broadcast_from_last(x, num_stages: int):
 
 def pipeline_1f1b(stage_fn, loss_fn, params, x_microbatches, num_stages: int,
                   h_spec=None, loss_args=(), dp_axes=(),
-                  pipe_reduce_mask=None):
+                  pipe_reduce_mask=None, stage_aux: bool = False):
     """True 1F1B pipeline with BOUNDED activation memory, inside shard_map.
 
     The compiled equivalent of the reference's TrainSchedule
@@ -144,6 +161,14 @@ def pipeline_1f1b(stage_fn, loss_fn, params, x_microbatches, num_stages: int,
         pipe-SHARDED (e.g. stacked layer weights, one slice per stage): the
         local gradient is already complete and must not be reduced.
 
+    stage_aux : stage_fn returns (h_out, aux_scalar) — a stage-LOCAL,
+        pre-scaled auxiliary loss term (MoE load balancing; reference
+        sharded_moe.py l_aux). Each stage differentiates its own aux with
+        cotangent 1.0 inside its backward slot — no cross-stage gradient
+        flow is needed because aux depends only on that stage's activations
+        and params — and the reported loss is the psum of every stage's
+        (ce + aux) contributions over the pipe axis.
+
     Returns (mean_loss, grads): loss replicated across stages; grads are the
     full parameter gradient on every device.
     """
@@ -157,12 +182,16 @@ def pipeline_1f1b(stage_fn, loss_fn, params, x_microbatches, num_stages: int,
 
     def run_stage(p, x_raw, h):
         if branches is None:
-            return stage_fn(p, x_raw, h)
-        return lax.switch(stage, list(branches), p, x_raw, h)
+            out = stage_fn(p, x_raw, h)
+        else:
+            out = lax.switch(stage, list(branches), p, x_raw, h)
+        if stage_aux:
+            return out                       # (h_out, aux)
+        return out, jnp.zeros((), jnp.float32)
 
     def run_last_with_loss(p, x_raw, h, largs):
-        out = run_stage(p, x_raw, h)
-        return loss_fn(p, out, *largs)
+        out, aux = run_stage(p, x_raw, h)
+        return loss_fn(p, out, *largs) + aux
 
     fwd_perm = [(i, i + 1) for i in range(pp - 1)]
     bwd_perm = [(i + 1, i) for i in range(pp - 1)]
@@ -170,10 +199,11 @@ def pipeline_1f1b(stage_fn, loss_fn, params, x_microbatches, num_stages: int,
     if h_spec is None:
         # probe the inter-stage activation shape from stage 0's branch
         # (stage 0 must ignore its h argument, so None is safe there)
-        h_spec = jax.eval_shape(
-            lambda p, x: (stage_fn[0] if branches is not None else stage_fn)(
-                p, x, None),
-            params, x_microbatches[0])
+        raw0 = stage_fn[0] if branches is not None else stage_fn
+        h_spec = jax.eval_shape(lambda p, x: raw0(p, x, None),
+                                params, x_microbatches[0])
+        if stage_aux:
+            h_spec = h_spec[0]
     zeros_h = jnp.zeros(h_spec.shape, h_spec.dtype)
 
     grads0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
@@ -187,7 +217,7 @@ def pipeline_1f1b(stage_fn, loss_fn, params, x_microbatches, num_stages: int,
         m_f_c = jnp.clip(m_f, 0, M - 1)
         x_raw = x_microbatches[m_f_c]
         h_in = jnp.where(stage == 0, zeros_h, fwd_buf)
-        out = run_stage(params, x_raw, h_in)
+        out, _aux_f = run_stage(params, x_raw, h_in)  # aux counted in bwd slot
         # stash this microbatch's INPUT activation for the backward recompute
         stash = lax.dynamic_update_index_in_dim(
             stash, jnp.where(f_active, h_in, stash[m_f_c % K]),
@@ -211,10 +241,12 @@ def pipeline_1f1b(stage_fn, loss_fn, params, x_microbatches, num_stages: int,
             return lval.astype(jnp.float32), gp, gh
 
         def bwd_mid(p):
-            _, vjp = jax.vjp(
+            (out_b, aux_b), vjp = jax.vjp(
                 lambda pp_, h_: run_stage(pp_, x_raw_b, h_), p, h_in_b)
-            gp, gh = vjp(bwd_buf)
-            return jnp.zeros((), jnp.float32), gp, gh
+            # the stage's own aux loss differentiates locally: cotangent 1.0
+            # alongside the activation cotangent arriving from stage s+1
+            gp, gh = vjp((bwd_buf, jnp.ones((), aux_b.dtype)))
+            return aux_b.astype(jnp.float32), gp, gh
 
         loss_m, gp, gh = lax.cond(stage == pp - 1, bwd_last, bwd_mid, params)
         gp = jax.tree.map(
@@ -233,7 +265,10 @@ def pipeline_1f1b(stage_fn, loss_fn, params, x_microbatches, num_stages: int,
               jnp.zeros((), jnp.float32))
     carry, _ = lax.scan(tick, carry0, jnp.arange(T))
     _fwd, _bwd, _stash, grads, loss_sum = carry
-    loss = broadcast_from_last(loss_sum / M, pp)
+    # psum over pipe: the last stage holds ce(+aux); with stage_aux the mid
+    # stages contribute their own aux terms too (zero otherwise, making this
+    # identical to the old broadcast_from_last)
+    loss = lax.psum(loss_sum, PIPE_AXIS) / M
     # the scan accumulated per-microbatch gradients; the loss is the MEAN
     # over microbatches, so the gradient is too
     grads = jax.tree.map(lambda g: g / M, grads)

@@ -50,3 +50,27 @@ def test_decode_kernel_bf16_cache():
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                np.asarray(ref, np.float32),
                                rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("M,block", [(48, 32), (20, 256), (300, 256)])
+def test_decode_kernel_nondivisible_cache(M, block):
+    """Cache lengths are arbitrary (prompt + max_new_tokens): the kernel
+    must keep large blocks and mask the padded tail, not degrade block
+    size (code-review r3)."""
+    B, nh, kvh, hd = 2, 4, 2, 16
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (B, nh, hd), jnp.float32)
+    kc = jax.random.normal(ks[1], (B, kvh, M, hd), jnp.float32)
+    vc = jax.random.normal(ks[2], (B, kvh, M, hd), jnp.float32)
+    lengths = jnp.array([max(1, M - 7), M])
+    out = dense_decode_attention(q, kc, vc, lengths, block_kv=block)
+    ref = _ref(q, kc, vc, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_decode_parity_check_runs():
+    from deepspeed_tpu.ops.attention_autotune import decode_parity_check
+    rep = decode_parity_check(batch=2, heads=4, kv_heads=2, cache_len=40,
+                              head_dim=16, dtype=jnp.float32)
+    assert rep["decode_rel_err"] < 1e-5

@@ -92,3 +92,65 @@ def test_pipeline_learned_positions_match_dp():
     l_dp = run_gpt2(pp=1, micro=1, gas=4)
     l_pp = run_gpt2(pp=2, micro=2, gas=4)
     np.testing.assert_allclose(l_pp, l_dp, rtol=2e-3)
+
+
+def run_moe(pp, micro, gas, experts, steps=3, coef=0.05, **cfg_kw):
+    cfg = TransformerConfig(vocab_size=128, hidden_size=64,
+                            intermediate_size=128, num_layers=4,
+                            num_heads=4, max_seq_len=32, use_flash=False,
+                            moe_num_experts=experts, moe_top_k=1,
+                            moe_capacity_factor=1.0, moe_min_capacity=4,
+                            moe_aux_loss_coef=coef, **cfg_kw)
+    model = TransformerLM(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "pipeline": {"stages": pp},
+        "steps_per_print": 100,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, (gas * gm, 32), dtype=np.int64)
+    batch = {"input_ids": ids.reshape(gas, gm, 32)}
+    losses = [engine.train_batch(batch=batch) for _ in range(steps)]
+    return losses, engine
+
+
+def test_pipeline_moe_single_expert_matches_dp():
+    """pp x MoE exact parity check: with E=1 the routing is deterministic in
+    ANY token grouping and the aux loss is exactly 1.0 everywhere, so pp=2
+    must match pure DP bit-for-bit (up to float tolerance) INCLUDING the
+    coef * aux term — proving the stage-local aux plumbing adds exactly one
+    layer-mean aux to the loss."""
+    l_dp, _ = run_moe(pp=1, micro=1, gas=4, experts=1)
+    l_pp, _ = run_moe(pp=2, micro=2, gas=4, experts=1)
+    np.testing.assert_allclose(l_pp, l_dp, rtol=2e-3)
+    # aux plumbing really adds coef * 1.0: rerun with coef=0
+    l_pp0, _ = run_moe(pp=2, micro=2, gas=4, experts=1, steps=1, coef=0.0)
+    assert abs((l_pp[0] - l_pp0[0]) - 0.05) < 5e-3
+
+
+def test_pipeline_moe_trains():
+    """pp=2 x MoE (E=4, top-1) trains: loss decreases and the router gets
+    gradient updates (the aux loss differentiates inside each stage)."""
+    losses, engine = run_moe(pp=2, micro=2, gas=2, experts=4)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    # the router gets gradient updates: one more step changes its weights
+    g_before = np.asarray(jax.device_get(
+        engine.params["layers"]["moe_gate_w"])).copy()
+    engine.train_batch(batch={"input_ids": np.random.default_rng(1).integers(
+        0, 128, (2, 2 * engine.ds_config.dp_world_size, 32),
+        dtype=np.int64)})
+    g_after = np.asarray(jax.device_get(engine.params["layers"]["moe_gate_w"]))
+    assert not np.allclose(g_before, g_after)
+
+
+def test_pipeline_residual_moe_trains():
+    """pp=2 x PR-MoE (residual dense MLP + routed experts) trains."""
+    losses, _ = run_moe(pp=2, micro=1, gas=2, experts=2,
+                        moe_use_residual=True)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
