@@ -85,6 +85,7 @@ def _measure(cfg, micro, gas, steps, warmup, n_dev, zero_stage=None,
         "micro_batch": micro,
         "attention": "flash" if cfg.use_flash
                      and seq >= cfg.flash_min_seq else "xla",
+        "attn_blocks": [cfg.attn_block_q, cfg.attn_block_kv],
         "remat_policy": remat_policy or "nothing_saveable",
         "zero_stage": config["zero_optimization"]["stage"],
         "global_batch_tokens": tokens_per_step,
@@ -121,6 +122,13 @@ def main():
                     trials.append((dataclasses.replace(
                         base, use_flash=use_flash, flash_min_seq=2048),
                         micro, policy))
+            # flash block-size variant (default auto is 256x512): bigger q
+            # blocks amortize the online-softmax bookkeeping further
+            trials.insert(2 if policy == "save_dots_and_attn" else len(trials),
+                          (dataclasses.replace(
+                              base, use_flash=True, flash_min_seq=2048,
+                              attn_block_q=512, attn_block_kv=512),
+                           16, policy))
         steps, warmup = 10, 2
     else:  # CPU smoke mode
         base = TransformerConfig(vocab_size=256, hidden_size=128,

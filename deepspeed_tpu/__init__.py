@@ -23,6 +23,7 @@ from .runtime.lr_schedules import LRScheduler  # noqa: F401
 from .runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader  # noqa: F401
 from .parallel.topology import MeshTopology, TopologyConfig, build_topology  # noqa: F401
 from .runtime.pipe import LayerSpec, PipelineModule, TiedLayerSpec  # noqa: F401
+from .sequence.layer import DistributedAttention  # noqa: F401 (reference deepspeed/__init__.py:38)
 from .utils.logging import log_dist, logger  # noqa: F401
 
 

@@ -127,3 +127,21 @@ def copy_aux_files(input_dir: str, output_dir: str):
         src = os.path.join(input_dir, name)
         if os.path.exists(src):
             shutil.copy(src, os.path.join(output_dir, name))
+
+
+def main(argv=None):
+    """Console entry (reference checkpoint/ds_to_universal.py:254 main):
+    convert a saved checkpoint into atomic per-param fp32 fragments that
+    load under ANY (dp, tp, pp, zero-stage) topology."""
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("input_dir", help="checkpoint dir (or flat .npz archive)")
+    p.add_argument("output_dir", help="where to write universal fragments")
+    p.add_argument("--tag", default=None,
+                   help="checkpoint tag (default: read 'latest' file)")
+    args = p.parse_args(argv)
+    out = ds_to_universal(args.input_dir, args.output_dir, tag=args.tag)
+    copy_aux_files(args.input_dir, args.output_dir)
+    print(f"universal checkpoint written to {out}")
+    return 0

@@ -188,13 +188,63 @@ def parse_args(argv=None):
     p.add_argument("--master_port", type=int, default=DEFAULT_COORD_PORT)
     p.add_argument("--launcher", default="pdsh", choices=sorted(RUNNERS))
     p.add_argument("--force_multi", action="store_true")
+    # reference bin/deepspeed --autotuning {tune,run} (launcher/runner.py:360
+    # run_autotuning): sweep configs via launched experiments before/instead
+    # of the real run
+    p.add_argument("--autotuning", choices=("tune", "run"), default=None)
+    p.add_argument("--autotuning_config", default=None,
+                   help="JSON file with the base engine config for autotuning")
+    p.add_argument("--autotuning_exp_dir", default="autotuning_exps")
     p.add_argument("user_script")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
+def run_autotuning(args):
+    """reference launcher/runner.py:360: sweep experiment configs on the
+    user script and write ranked results + the winning config. Returns 0/1
+    in mode 'tune'; in mode 'run' returns the winning-config path so main()
+    proceeds to launch the real run with it."""
+    import json
+
+    from ..autotuning import ExperimentAutotuner
+
+    base = {"optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
+            "steps_per_print": 10 ** 9}
+    if args.autotuning_config:
+        with open(args.autotuning_config) as fh:
+            base = json.load(fh)
+    tuner = ExperimentAutotuner(args.user_script, base,
+                                exp_dir=args.autotuning_exp_dir)
+    ranked = tuner.tune()
+    best = next((r for r in ranked if r.get("ok")), None)
+    if best is None:
+        logger.error("autotuning: every experiment failed")
+        return 1
+    best_path = os.path.join(args.autotuning_exp_dir, "best_config.json")
+    with open(best_path, "w") as fh:
+        json.dump(best.get("config", {}), fh, indent=2)
+    logger.info(f"autotuning best: {best['name']} "
+                f"({best['samples_per_sec']:.1f} samples/s) — results in "
+                f"{args.autotuning_exp_dir}/autotune_results.json, winning "
+                f"config in {best_path}")
+    if args.autotuning == "run":
+        return best_path   # caller launches the real run with this config
+    return 0
+
+
 def main(argv=None):
     args = parse_args(argv)
+    if args.autotuning:
+        out = run_autotuning(args)
+        if not isinstance(out, str):
+            return out
+        # mode 'run' (reference bin/deepspeed semantics): tune, then launch
+        # the real training with the winning config exported for the user
+        # script / engine to pick up
+        os.environ["DS_TPU_AUTOTUNED_CONFIG"] = out
+        logger.info("autotuning done; launching user script with "
+                    f"DS_TPU_AUTOTUNED_CONFIG={out}")
     multi_node = args.force_multi or os.path.exists(args.hostfile)
     if not multi_node:
         # single host: exec in place with a 1-process grid

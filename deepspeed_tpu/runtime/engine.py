@@ -178,6 +178,10 @@ class DeepSpeedTpuEngine:
             f"mesh={self.topology.sizes} batch={self.train_batch_size} "
             f"(micro={self.micro_batch_size} gas={self.gas} dp={config.dp_world_size})",
             ranks=[0])
+        if getattr(config.cfg, "memory_breakdown", False):
+            from ..utils.memory import see_memory_usage
+            see_memory_usage("after engine init (params + optimizer state)",
+                             force=True)
 
     # ------------------------------------------------------------------
     # Initialization

@@ -1,0 +1,2 @@
+from .logging import log_dist, logger  # noqa: F401
+from .memory import see_memory_usage  # noqa: F401

@@ -1,0 +1,51 @@
+"""Memory breadcrumbs (reference deepspeed/utils — see_memory_usage).
+
+The reference prints torch.cuda allocated/reserved/max stats at engine
+milestones; the TPU equivalents come from PJRT ``device.memory_stats()``
+(bytes_in_use / peak_bytes_in_use / bytes_limit on real chips; sparse or
+absent on the CPU test backend) plus host RSS via ``resource``.
+"""
+
+import resource
+from typing import Optional
+
+import jax
+
+from .logging import logger
+
+
+def _device_stats(device) -> dict:
+    try:
+        return device.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def see_memory_usage(message: str, force: bool = False,
+                     ranks: Optional[list] = None) -> dict:
+    """Log device + host memory usage. Returns the stats dict so tests and
+    tools can assert on it; logging obeys `force` like the reference, and
+    `ranks` restricts which processes log (default [0], matching log_dist)."""
+    dev = jax.devices()[0]
+    log_ranks = ranks if ranks is not None else [0]
+    try:
+        my_rank = jax.process_index()
+    except Exception:
+        my_rank = 0
+    if my_rank not in log_ranks:
+        force = False
+    stats = _device_stats(dev)
+    gib = 1024 ** 3
+    used = stats.get("bytes_in_use", 0) / gib
+    peak = stats.get("peak_bytes_in_use", 0) / gib
+    limit = stats.get("bytes_limit", 0) / gib
+    host_rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024 ** 2
+    out = {"device_used_gb": round(used, 3),
+           "device_peak_gb": round(peak, 3),
+           "device_limit_gb": round(limit, 3),
+           "host_max_rss_gb": round(host_rss, 3)}
+    if force:
+        logger.info(
+            f"{message} | device used {used:.2f} GB (peak {peak:.2f}, "
+            f"limit {limit:.2f}) | host maxRSS {host_rss:.2f} GB")
+    return out

@@ -90,3 +90,15 @@ def _fixed_batch(engine):
     micro = engine.micro_batch_size * engine.ds_config.dp_world_size
     b = random_batches(1, micro * engine.gas, HIDDEN, seed=1234)[0]
     return {k: v.reshape(engine.gas, micro, HIDDEN) for k, v in b.items()}
+
+
+def test_ds_to_universal_cli(tmp_path):
+    """Console entry (ds_tpu_to_universal) converts a saved checkpoint."""
+    from deepspeed_tpu.checkpoint import universal as uni_mod
+
+    engine = _train(base_config(micro=2, stage=1, dtype="bf16", lr=1e-2))
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    rc = uni_mod.main([str(tmp_path / "ckpt"), str(tmp_path / "universal")])
+    assert rc == 0
+    params = load_universal_params(str(tmp_path / "universal"))
+    assert params  # at least one fragment written

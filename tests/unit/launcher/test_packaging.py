@@ -21,7 +21,9 @@ def test_console_scripts_resolve():
 
     scripts = _pyproject()["project"]["scripts"]
     assert set(scripts) == {"ds_tpu", "ds_tpu_launch", "ds_tpu_report",
-                            "ds_tpu_bench", "ds_tpu_elastic"}
+                            "ds_tpu_bench", "ds_tpu_elastic",
+                            "ds_tpu_flash_check", "ds_tpu_to_universal",
+                            "ds_tpu_zero_to_fp32"}
     for name, target in scripts.items():
         mod_name, func_name = target.split(":")
         mod = importlib.import_module(mod_name)

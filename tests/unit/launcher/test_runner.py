@@ -76,3 +76,46 @@ def test_parse_args_remainder():
     assert args.launcher == "slurm"
     assert args.user_script == "train.py"
     assert args.user_args == ["--deepspeed_config", "c.json"]
+
+
+def test_runner_autotuning_mode(monkeypatch, tmp_path, capsys):
+    """`ds_tpu --autotuning run script` drives the experiment autotuner
+    (reference launcher/runner.py:360 run_autotuning)."""
+    import deepspeed_tpu.autotuning as at
+    from deepspeed_tpu.launcher import runner
+
+    calls = {}
+
+    class StubTuner:
+        def __init__(self, script, base, exp_dir):
+            calls["script"] = script
+            calls["exp_dir"] = exp_dir
+
+        def tune(self):
+            return [{"ok": True, "name": "z1_mb4",
+                     "samples_per_sec": 123.0, "config": {"zero": 1}}]
+
+    monkeypatch.setattr(at, "ExperimentAutotuner", StubTuner)
+    rc = runner.main(["--autotuning", "tune",
+                      "--autotuning_exp_dir", str(tmp_path),
+                      "train.py"])
+    assert rc == 0
+    assert calls == {"script": "train.py", "exp_dir": str(tmp_path)}
+    # the winning config was persisted for the user
+    import json
+    assert json.load(open(tmp_path / "best_config.json")) == {"zero": 1}
+
+    # mode 'run': after tuning, the real launch happens with the winning
+    # config exported (reference bin/deepspeed --autotuning run semantics)
+    launched = {}
+    monkeypatch.setattr(runner.subprocess, "call",
+                        lambda cmd: launched.update(cmd=cmd) or 0)
+    rc = runner.main(["--autotuning", "run",
+                      "--autotuning_exp_dir", str(tmp_path),
+                      "--hostfile", str(tmp_path / "nonexistent"),
+                      "train.py"])
+    assert rc == 0
+    assert "train.py" in " ".join(launched["cmd"])
+    import os as _os
+    assert _os.environ["DS_TPU_AUTOTUNED_CONFIG"] == \
+        str(tmp_path / "best_config.json")

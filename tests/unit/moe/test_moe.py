@@ -320,3 +320,62 @@ def test_dropless_model_trains_and_rejects_ep():
         _moe_engine({"moe_dropless": True},
                     {"moe": {"enabled": True, "num_experts": 4,
                              "expert_parallel_size": 2}})
+
+
+def test_moe_class_facade_matches_functional():
+    """deepspeed_tpu.moe.MoE (reference moe/layer.py:16 class surface) wraps
+    the functional core exactly."""
+    from deepspeed_tpu.moe import MoE, moe_layer
+
+    layer = MoE(hidden_size=16, intermediate_size=32, num_experts=4, k=2,
+                capacity_factor=2.0)
+    params = layer.init_params(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16), jnp.float32)
+    out, aux = layer(params, x)
+    experts = (params["e_gate"], params["e_up"], params["e_down"])
+    ref_out, ref_aux = moe_layer(
+        x, params["gate_w"], experts, MoE._swiglu_expert, None,
+        top_k=2, capacity_factor=2.0, min_capacity=4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(aux), float(ref_aux), rtol=1e-6)
+    assert out.shape == x.shape and np.isfinite(np.asarray(out)).all()
+
+
+def test_moe_class_residual_and_dropless():
+    from deepspeed_tpu.moe import MoE
+
+    res = MoE(hidden_size=16, intermediate_size=32, num_experts=2, k=1,
+              use_residual=True)
+    p = res.init_params(jax.random.PRNGKey(2))
+    x = jax.random.normal(jax.random.PRNGKey(3), (1, 8, 16), jnp.float32)
+    out, aux = res(p, x)
+    assert out.shape == x.shape and np.isfinite(np.asarray(out)).all()
+
+    dl = MoE(hidden_size=16, intermediate_size=32, num_experts=2, k=1,
+             drop_tokens=False)
+    p2 = dl.init_params(jax.random.PRNGKey(4))
+    out2, aux2 = dl(p2, x)
+    assert out2.shape == x.shape and np.isfinite(np.asarray(out2)).all()
+
+
+def test_top_level_reference_exports():
+    """Reference deepspeed/__init__.py:21-45 export parity."""
+    import deepspeed_tpu as ds
+
+    assert callable(ds.DistributedAttention)
+    assert callable(ds.PipelineModule)
+    from deepspeed_tpu.moe.layer import MoE
+    assert callable(MoE)
+
+
+def test_moe_class_dropless_guards():
+    from deepspeed_tpu.moe import MoE
+    import pytest as _pt
+
+    with _pt.raises(NotImplementedError, match="top-1"):
+        MoE(hidden_size=16, intermediate_size=32, num_experts=2, k=2,
+            drop_tokens=False)
+    with _pt.raises(NotImplementedError, match="expert_fn"):
+        MoE(hidden_size=16, intermediate_size=32, num_experts=2, k=1,
+            drop_tokens=False, expert_fn=lambda p, x: x)

@@ -118,3 +118,12 @@ def test_comm_bench_single_device_smoke():
 
     r = run_op("all_reduce", 1 << 14, trials=2, warmups=1)
     assert r["latency_us"] > 0 and r["algbw_gbps"] > 0
+
+
+def test_see_memory_usage():
+    from deepspeed_tpu.utils import see_memory_usage
+
+    stats = see_memory_usage("after init", force=True)
+    assert set(stats) == {"device_used_gb", "device_peak_gb",
+                          "device_limit_gb", "host_max_rss_gb"}
+    assert stats["host_max_rss_gb"] > 0
