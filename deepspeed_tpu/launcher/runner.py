@@ -195,6 +195,11 @@ def parse_args(argv=None):
     p.add_argument("--autotuning_config", default=None,
                    help="JSON file with the base engine config for autotuning")
     p.add_argument("--autotuning_exp_dir", default="autotuning_exps")
+    p.add_argument("--autotuning_platform", default=None,
+                   help="pin experiment subprocesses to a jax platform "
+                        "(e.g. cpu); default = the real device")
+    p.add_argument("--autotuning_timeout", type=float, default=600.0,
+                   help="per-experiment wall-clock timeout (hang reaper)")
     p.add_argument("user_script")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -215,7 +220,9 @@ def run_autotuning(args):
         with open(args.autotuning_config) as fh:
             base = json.load(fh)
     tuner = ExperimentAutotuner(args.user_script, base,
-                                exp_dir=args.autotuning_exp_dir)
+                                exp_dir=args.autotuning_exp_dir,
+                                platform=args.autotuning_platform,
+                                timeout_s=args.autotuning_timeout)
     ranked = tuner.tune()
     best = next((r for r in ranked if r.get("ok")), None)
     if best is None:
@@ -235,21 +242,27 @@ def run_autotuning(args):
 
 def main(argv=None):
     args = parse_args(argv)
+    extra_env: Dict[str, str] = {}
     if args.autotuning:
         out = run_autotuning(args)
         if not isinstance(out, str):
             return out
         # mode 'run' (reference bin/deepspeed semantics): tune, then launch
         # the real training with the winning config exported for the user
-        # script / engine to pick up
+        # script / engine to pick up. The var rides the per-node command
+        # (pdsh/mpirun/srun shells do NOT inherit this launcher's environ);
+        # note best_config.json lives on this host — multi-node runs need it
+        # on a shared filesystem, like the reference's rewritten config files
         os.environ["DS_TPU_AUTOTUNED_CONFIG"] = out
+        extra_env["DS_TPU_AUTOTUNED_CONFIG"] = out
         logger.info("autotuning done; launching user script with "
                     f"DS_TPU_AUTOTUNED_CONFIG={out}")
     multi_node = args.force_multi or os.path.exists(args.hostfile)
     if not multi_node:
         # single host: exec in place with a 1-process grid
         cmd = build_node_command(args.user_script, args.user_args, 0, 1,
-                                 f"localhost:{args.master_port}")
+                                 f"localhost:{args.master_port}",
+                                 extra_env=extra_env)
         logger.info(f"single-node launch: {cmd}")
         return subprocess.call(["bash", "-c", cmd])
 
@@ -260,7 +273,8 @@ def main(argv=None):
     coordinator = (args.master_addr or next(iter(hosts))) + \
         f":{args.master_port}"
     node_cmds = [build_node_command(args.user_script, args.user_args, pid,
-                                    len(hosts), coordinator)
+                                    len(hosts), coordinator,
+                                    extra_env=extra_env)
                  for pid in range(len(hosts))]
     runner = RUNNERS[args.launcher](args)
     if not runner.backend_exists():

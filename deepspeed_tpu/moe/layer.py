@@ -38,6 +38,10 @@ class MoE:
                  drop_tokens: bool = True,
                  expert_fn: Optional[Callable] = None):
         assert k in (1, 2), "top-1 and top-2 gating only (reference parity)"
+        if k == 2 and noisy_gate_policy is not None:
+            raise NotImplementedError(
+                "noisy_gate_policy applies to top-1 gating only (top2gating "
+                "has no noise path, matching reference sharded_moe.py:282)")
         if not drop_tokens:
             # same guard as the config path (models/transformer.py
             # moe_dropless): the ragged grouped-GEMM path is top-1 with its

@@ -26,7 +26,9 @@ def see_memory_usage(message: str, force: bool = False,
     """Log device + host memory usage. Returns the stats dict so tests and
     tools can assert on it; logging obeys `force` like the reference, and
     `ranks` restricts which processes log (default [0], matching log_dist)."""
-    dev = jax.devices()[0]
+    # local_devices: on multi-host meshes devices()[0] may belong to another
+    # process, whose memory_stats are not addressable here
+    dev = jax.local_devices()[0]
     log_ranks = ranks if ranks is not None else [0]
     try:
         my_rank = jax.process_index()

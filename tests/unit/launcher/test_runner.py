@@ -87,7 +87,7 @@ def test_runner_autotuning_mode(monkeypatch, tmp_path, capsys):
     calls = {}
 
     class StubTuner:
-        def __init__(self, script, base, exp_dir):
+        def __init__(self, script, base, exp_dir, **kw):
             calls["script"] = script
             calls["exp_dir"] = exp_dir
 
@@ -119,3 +119,28 @@ def test_runner_autotuning_mode(monkeypatch, tmp_path, capsys):
     import os as _os
     assert _os.environ["DS_TPU_AUTOTUNED_CONFIG"] == \
         str(tmp_path / "best_config.json")
+
+
+def test_autotuned_config_rides_node_command(monkeypatch, tmp_path):
+    """Mode 'run' must export DS_TPU_AUTOTUNED_CONFIG IN the launched node
+    command — remote pdsh/mpirun shells don't inherit the launcher env."""
+    import deepspeed_tpu.autotuning as at
+    from deepspeed_tpu.launcher import runner
+
+    class StubTuner:
+        def __init__(self, *a, **k):
+            pass
+
+        def tune(self):
+            return [{"ok": True, "name": "best", "samples_per_sec": 1.0,
+                     "config": {"zero": 2}}]
+
+    monkeypatch.setattr(at, "ExperimentAutotuner", StubTuner)
+    launched = {}
+    monkeypatch.setattr(runner.subprocess, "call",
+                        lambda cmd: launched.update(cmd=cmd) or 0)
+    rc = runner.main(["--autotuning", "run",
+                      "--autotuning_exp_dir", str(tmp_path),
+                      "--hostfile", str(tmp_path / "none"), "train.py"])
+    assert rc == 0
+    assert "DS_TPU_AUTOTUNED_CONFIG" in " ".join(launched["cmd"])

@@ -379,3 +379,12 @@ def test_moe_class_dropless_guards():
     with _pt.raises(NotImplementedError, match="expert_fn"):
         MoE(hidden_size=16, intermediate_size=32, num_experts=2, k=1,
             drop_tokens=False, expert_fn=lambda p, x: x)
+
+
+def test_moe_class_top2_noise_guard():
+    from deepspeed_tpu.moe import MoE
+    import pytest as _pt
+
+    with _pt.raises(NotImplementedError, match="top-1"):
+        MoE(hidden_size=16, intermediate_size=32, num_experts=2, k=2,
+            noisy_gate_policy="RSample")
