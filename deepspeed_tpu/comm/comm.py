@@ -130,6 +130,8 @@ def configure(comms_config=None, enabled=None, prof_all=None, prof_ops=None,
         _comms_logger = CommsLogger(verbose=bool(verbose), debug=bool(debug),
                                     prof_all=prof_all is not False,
                                     prof_ops=list(prof_ops or []))
+    elif enabled is False:   # explicit disable (None = leave unchanged)
+        _comms_logger = None
 
 
 def get_comms_logger():

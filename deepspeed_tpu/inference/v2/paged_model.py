@@ -20,6 +20,16 @@ is the null block (padding writes land there). Static shapes throughout:
 prompt lengths bucket to multiples of ``prefill_bucket`` and the decode
 batch pads to the next power-of-two bucket — each bucket compiles once
 (the XLA analogue of the reference's CUDA-graph'd atom sizes).
+
+Design note — why there is no dedicated rotary+KV-append kernel (reference
+inference/v2/kernels/ragged_ops/blocked_kv_rotary/): that CUDA kernel exists
+because torch eager would otherwise launch separate rotary, transpose and
+scatter kernels per layer. Here the rotary and the ``.at[block_ids,
+offsets].set`` cache write sit INSIDE the jitted, scanned layer body, so XLA
+fuses them into the same program as the qkv projections — the "fusion" the
+reference hand-writes is the compiler's default. The Pallas budget goes
+where fusion cannot: the attention reads (paged_attention.py,
+ops/decode_attention.py, flash prefill).
 """
 
 from typing import Any, Dict, Tuple

@@ -228,7 +228,10 @@ def run_autotuning(args):
     if best is None:
         logger.error("autotuning: every experiment failed")
         return 1
-    best_path = os.path.join(args.autotuning_exp_dir, "best_config.json")
+    # absolute: the path is exported into remote node commands, whose shells
+    # start in $HOME, not this launcher's cwd
+    best_path = os.path.abspath(
+        os.path.join(args.autotuning_exp_dir, "best_config.json"))
     with open(best_path, "w") as fh:
         json.dump(best.get("config", {}), fh, indent=2)
     logger.info(f"autotuning best: {best['name']} "
