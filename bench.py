@@ -117,6 +117,7 @@ def main():
 
     n_dev = jax.device_count()
     on_tpu = backend == "tpu" and jax.default_backend() == "tpu"
+    tpu_unreachable = False
     if on_tpu:
         base = _flagship_cfg()  # the shipped flagship, not a local copy
         # mini-autotune: attention impl x micro-batch x remat-policy ladder;
@@ -155,8 +156,13 @@ def main():
                                  num_heads=8, max_seq_len=128)
         trials = [(base, 1, None)]
         steps, warmup = 5, 2
+        if os.environ.get("DS_TPU_PLATFORM_FALLBACK") == "1":
+            # the platform probe found an accelerator plugin but its device
+            # init failed/hung, so _ensure_jax_platform pinned CPU: say so
+            # in the record instead of letting a CPU smoke number
+            # masquerade as the chip
+            tpu_unreachable = True
 
-    import os
     best = None
     errors = []
     # wall-clock budget for the trial ladder: cold compiles cost ~40s per
@@ -218,6 +224,11 @@ def main():
         except Exception as exc:
             detail["flash_parity_error"] = repr(exc)[:150]
 
+    if tpu_unreachable:
+        detail["tpu_unreachable"] = True
+        detail["note"] = ("JAX_PLATFORMS requested a TPU but device init "
+                          "failed or hung; this is a CPU smoke number, not "
+                          "a chip measurement")
     result = {
         "metric": "train_mfu_llama_flagship",
         "value": round(mfu * 100, 2),

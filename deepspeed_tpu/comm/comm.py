@@ -126,6 +126,8 @@ def configure(comms_config=None, enabled=None, prof_all=None, prof_ops=None,
         if getattr(cl, "enabled", False):
             _comms_logger = CommsLogger(verbose=cl.verbose, debug=cl.debug,
                                         prof_all=cl.prof_all, prof_ops=list(cl.prof_ops))
+        else:   # re-applying a config with logging off disables it
+            _comms_logger = None
     elif enabled:
         _comms_logger = CommsLogger(verbose=bool(verbose), debug=bool(debug),
                                     prof_all=prof_all is not False,

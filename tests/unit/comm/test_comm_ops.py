@@ -137,18 +137,21 @@ def test_timed_op_logs_trace_labeled():
     """The comms logger records ops (labeled trace-time under jit, round-2
     Weak #5)."""
     comm.configure(enabled=True, prof_all=True)
-    logger = comm.get_comms_logger()
+    try:
+        logger = comm.get_comms_logger()
 
-    def n_records():
-        return sum(rec[0] for sizes in logger.comms_dict.values()
-                   for rec in sizes.values())
+        def n_records():
+            return sum(rec[0] for sizes in logger.comms_dict.values()
+                       for rec in sizes.values())
 
-    before = n_records()
-    mesh = Mesh(np.array(jax.devices()[:N]), ("data",))
-    x = jnp.ones((N,), jnp.float32)
-    _run(mesh, lambda v: comm.all_reduce(v), x)
-    # the fresh lambda forces a retrace, so a working logger MUST add a row,
-    # and under jit it must be flagged as trace-time (round-2 Weak #5)
-    assert n_records() > before
-    assert any(name.endswith("[trace]") for name in logger.comms_dict)
-    comm.configure(enabled=False)
+        before = n_records()
+        mesh = Mesh(np.array(jax.devices()[:N]), ("data",))
+        x = jnp.ones((N,), jnp.float32)
+        _run(mesh, lambda v: comm.all_reduce(v), x)
+        # the fresh lambda forces a retrace, so a working logger MUST add a
+        # row, flagged as trace-time under jit (round-2 Weak #5)
+        assert n_records() > before
+        assert any(name.endswith("[trace]") for name in logger.comms_dict)
+    finally:
+        comm.configure(enabled=False)
+    assert comm.get_comms_logger() is None
