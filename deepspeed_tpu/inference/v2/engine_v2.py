@@ -77,15 +77,20 @@ class InferenceEngineV2:
         self.state_manager = DSStateManager(sm)
         self.kv_cache = init_paged_kv_cache(cfg, sm.num_blocks,
                                             sm.block_size, self.dtype)
+        # Pallas kernels only at tp=1: a bare pallas_call is not
+        # GSPMD-partitionable, so sharded-param (tp>1) serving keeps the
+        # jnp paths, which the partitioner splits over the head axis (same
+        # gate as the v1 decode kernel, models/transformer.py)
+        use_kernel = config.use_paged_kernel and tp == 1
         self._decode_jit = jax.jit(
             lambda p, t, pos, bt, c, a: paged_decode(
                 cfg, p, t, pos, bt, c, a, sm.block_size,
-                use_kernel=config.use_paged_kernel),
+                use_kernel=use_kernel),
             donate_argnums=(4,))
         self._prefill_jit = jax.jit(
             lambda p, ids, n, c, b, o: paged_prefill(
                 cfg, p, ids, n, c, b, o,
-                use_kernel=config.use_paged_kernel),
+                use_kernel=use_kernel),
             donate_argnums=(3,))
         self._continue_jit = jax.jit(
             lambda p, ids, s, n, c, b, o, t: paged_continue(

@@ -49,6 +49,15 @@ class TransformerConfig:
     # v1 decode: Pallas dense-cache attention kernel (ops/decode_attention)
     # instead of the repeat+einsum path; interpret-mode off-TPU
     decode_kernel: bool = True
+    # layer-scan unroll factor. A lax.scan iteration is a scheduling
+    # barrier: with ZeRO-3 the per-layer param all-gather cannot overlap
+    # the PREVIOUS layer's compute across it. Unrolling by 2 puts
+    # gather(l+1) and compute(l) in one block where XLA's latency-hiding
+    # scheduler can interleave them — the compiled-program equivalent of
+    # the reference's two-stream prefetch (stage3.py:1151). The engine
+    # raises this via scan_unroll_hint when zero_optimization.overlap_comm
+    # is on (runtime/engine.py).
+    scan_unroll: int = 1
     rope_theta: float = 10000.0
     tie_embeddings: bool = False
     remat: bool = True                     # activation checkpointing per layer
@@ -404,7 +413,9 @@ class TransformerLM:
             h, aux = body(h, lp, cos, sin)
             return h, aux
 
-        x, aux = jax.lax.scan(scan_fn, x, params["layers"])
+        unroll = max(self.cfg.scan_unroll,
+                     getattr(self, "scan_unroll_hint", 1))
+        x, aux = jax.lax.scan(scan_fn, x, params["layers"], unroll=unroll)
         x = self._norm(x, params["final_norm"], params.get("final_norm_b"))
         return x, jnp.mean(aux)
 

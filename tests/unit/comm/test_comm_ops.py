@@ -155,3 +155,16 @@ def test_timed_op_logs_trace_labeled():
     finally:
         comm.configure(enabled=False)
     assert comm.get_comms_logger() is None
+
+
+def test_configure_comms_config_disable():
+    """Re-applying a comms_config with logging off disables an active
+    logger (disable symmetry between the two configure entry points)."""
+    comm.configure(enabled=True, prof_all=True)
+    assert comm.get_comms_logger() is not None
+
+    class Off:
+        enabled = False
+
+    comm.configure(comms_config=Off())
+    assert comm.get_comms_logger() is None

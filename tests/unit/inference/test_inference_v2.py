@@ -277,3 +277,30 @@ def test_opt_family_paged_matches_dense():
     ref = np.asarray(model.forward_logits(params, full))
     np.testing.assert_allclose(l1[0], ref[0, len(prompt)], rtol=2e-4,
                                atol=2e-4)
+
+
+def test_v2_tensor_parallel_matches_single():
+    """tp=2 serving must produce the same logits as tp=1 (params sharded
+    over the model axis; the partitioner splits the jnp attention paths —
+    Pallas kernels are gated off under tp>1)."""
+    cfg = _tiny_cfg()
+    model = TransformerLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(5))
+    prompt = list(range(4, 14))
+
+    out = {}
+    for tp in (1, 2):
+        m = TransformerLM(cfg)
+        sm = DSStateManagerConfig(max_tracked_sequences=4, max_seq_len=128,
+                                  num_blocks=17, block_size=16)
+        eng = InferenceEngineV2(
+            m, RaggedInferenceEngineConfig(state_manager=sm, dtype="float32",
+                                           prefill_bucket=16,
+                                           tensor_parallel_size=tp),
+            params=params)
+        l1 = eng.put([1], [prompt])
+        l2 = eng.put([1], [[30]])
+        out[tp] = (np.asarray(l1[0]), np.asarray(l2[0]))
+
+    np.testing.assert_allclose(out[2][0], out[1][0], rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(out[2][1], out[1][1], rtol=2e-4, atol=2e-4)

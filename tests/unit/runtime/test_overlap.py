@@ -54,3 +54,33 @@ def test_overlap_report_on_sharded_grad():
     assert total >= 1           # param gather and/or grad reduce present
     assert rep.total_instructions > 0
     assert "exposed fraction" in rep.summary()
+
+
+def test_zero3_overlap_comm_unrolls_layer_scan():
+    """stage 3 + overlap_comm widens the layer-scan scheduling window
+    (scan_unroll_hint=2) and training stays numerically identical to the
+    un-unrolled scan."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32,
+                            intermediate_size=64, num_layers=4, num_heads=4,
+                            max_seq_len=32, use_flash=False, remat=False)
+    losses = {}
+    for overlap in (False, True):
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=TransformerLM(cfg),
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                    "zero_optimization": {
+                        "stage": 3, "overlap_comm": overlap,
+                        "stage3_param_persistence_threshold": 0},
+                    "steps_per_print": 10 ** 9})
+        assert getattr(engine.model, "scan_unroll_hint", 1) == \
+            (2 if overlap else 1)
+        gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+        batch = {"input_ids": np.random.default_rng(0).integers(
+            0, 64, (1, gm, 32), dtype=np.int64)}
+        losses[overlap] = [float(engine.train_batch(batch=batch))
+                           for _ in range(2)]
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-6)
