@@ -200,12 +200,13 @@ class DeepSpeedTpuEngine:
             self.topology, self.zero_stage, shapes, base_specs,
             persistence_threshold=(zc.stage3_param_persistence_threshold
                                    if self.zero_stage == 3 else 0))
-        if self.zero_stage == 3 and zc.overlap_comm:
-            # widen the layer-scan scheduling window so stage-3 param
-            # gathers overlap the previous layer's compute (the scan
-            # iteration boundary otherwise serializes them; see
-            # TransformerConfig.scan_unroll)
-            self.model.scan_unroll_hint = 2
+        # widen the layer-scan scheduling window so stage-3 param gathers
+        # overlap the previous layer's compute (the scan iteration boundary
+        # otherwise serializes them; see TransformerConfig.scan_unroll).
+        # Assigned unconditionally so re-initializing with the same model
+        # object cannot leak a stale hint.
+        self.model.scan_unroll_hint = \
+            2 if (self.zero_stage == 3 and zc.overlap_comm) else 1
         self.has_master = (self.compute_dtype != jnp.float32) or self.zero_stage >= 1
 
         master_sh = self.zero_plan.master_sharding

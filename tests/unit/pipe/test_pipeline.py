@@ -154,3 +154,26 @@ def test_pipeline_residual_moe_trains():
                         moe_use_residual=True)
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_pipeline_fp16_loss_scaling():
+    """fp16 under pp=2 routes through the autodiff pipeline branch with
+    dynamic loss scaling; training must stay finite and decrease."""
+    cfg = model_cfg()
+    model = TransformerLM(cfg)
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "pipeline": {"stages": 2},
+        "fp16": {"enabled": True, "initial_scale_power": 8},
+        "steps_per_print": 100,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, (2 * gm, 64), dtype=np.int64)
+    batch = {"input_ids": ids.reshape(2, gm, 64)}
+    losses = [engine.train_batch(batch=batch) for _ in range(3)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

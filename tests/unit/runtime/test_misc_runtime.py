@@ -127,3 +127,21 @@ def test_see_memory_usage():
     assert set(stats) == {"device_used_gb", "device_peak_gb",
                           "device_limit_gb", "host_max_rss_gb"}
     assert stats["host_max_rss_gb"] > 0
+
+
+def test_north_star_7b_fits_v5e_64():
+    """BASELINE north star: ZeRO-3 Llama-2-7B on v5e-64. The stage-3 model
+    -state estimate (ZeRO paper 2+2+12 breakdown) must fit a v5e chip's
+    16 GB HBM with headroom for activations; stage 0 must NOT fit — the
+    reason ZeRO exists."""
+    from deepspeed_tpu.models import TransformerLM
+    from deepspeed_tpu.models.transformer import llama2_7b
+    from deepspeed_tpu.runtime.zero.partition import estimate_zero_memory
+
+    n = TransformerLM(llama2_7b()).num_params()
+    assert 6.5e9 < n < 7.5e9, n
+    z3 = estimate_zero_memory(n, stage=3, dp=64)
+    hbm = 16e9
+    assert z3["total_bytes"] < 0.2 * hbm        # ~1.7 GB/chip: plenty left
+    z0 = estimate_zero_memory(n, stage=0, dp=64)
+    assert z0["total_bytes"] > hbm              # 112 GB: ZeRO is mandatory
