@@ -153,6 +153,14 @@ class DeepSpeedTpuEngine:
         # --- state init under sharding constraints (zero.Init equivalent:
         # params materialize directly into their shards, partition_parameters.py:723)
         self._init_state(seed)
+        if (self.offload_device or self.onebit_mode) and \
+                getattr(self.model, "frozen_mask", None) is not None:
+            # frozen params are honored only by the standard jitted step;
+            # silently updating a "frozen" backbone would corrupt a
+            # LoRA-style finetune, so reject the combination outright
+            raise NotImplementedError(
+                "frozen_mask is not supported with ZeRO-Offload or 1-bit "
+                "optimizers yet; use the standard optimizer path")
         if self.offload_device:
             self._build_offload_step()
         elif self.onebit_mode:
@@ -354,6 +362,12 @@ class DeepSpeedTpuEngine:
                 "pipeline + expert-parallel (ep>1) composition not yet " \
                 "supported; pp composes with MoE at ep=1"
 
+        # frozen parameters (reference requires_grad=False, e.g. the frozen
+        # backbone under LoRA-style finetuning): a pytree of static bools
+        # aligned with params, from a model attribute or zero-arg callable
+        fm = getattr(self.model, "frozen_mask", None)
+        frozen_mask = fm() if callable(fm) else fm
+
         def train_step(params, master, opt_state, scale_state, step, rng, batch):
             lr = lr_fn(step)
             scale = scale_state["loss_scale"] if fp16 else jnp.asarray(1.0, jnp.float32)
@@ -408,6 +422,13 @@ class DeepSpeedTpuEngine:
                 loss = jnp.mean(losses)
                 inv = 1.0 / (gas * scale)
             grads = jax.tree.map(lambda g: g * inv, grads)
+            if frozen_mask is not None:
+                # frozen leaves (reference requires_grad=False): zero their
+                # grads so moments/grad-norm stay clean; the post-update
+                # restore below also kills decoupled weight decay on them
+                grads = jax.tree.map(
+                    lambda g, f: jnp.zeros_like(g) if f else g,
+                    grads, frozen_mask)
 
             finite = grads_finite(grads) if fp16 else jnp.asarray(True)
             gnorm = global_norm(grads)
@@ -418,6 +439,10 @@ class DeepSpeedTpuEngine:
             target = master if has_master else params
             new_target, new_opt = optimizer.apply(target, grads, opt_state,
                                                   step + 1, lr=lr)
+            if frozen_mask is not None:
+                new_target = jax.tree.map(
+                    lambda n, o, f: o if f else n, new_target, target,
+                    frozen_mask)
             # functional skip-step on overflow (reference stage3.py:2018)
             new_target = jax.tree.map(
                 lambda n, o: jnp.where(finite, n, o), new_target, target)

@@ -60,3 +60,25 @@ def test_replicated_desync_detected():
                    jax.device_put(jnp.zeros((4,)), devs[1])])
     probs = check_replicated_consistency({"w": bad})
     assert len(probs) == 1 and "differs" in probs[0]
+
+
+def test_training_is_deterministic_across_engines():
+    """Same seed + same batch -> bit-identical loss trajectories across two
+    independent engine instances (SPMD determinism; the property that makes
+    cross-rank divergence detection meaningful at all)."""
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel, base_config
+
+    def run():
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=32),
+            config=base_config(micro=2, stage=2, dtype="bf16", lr=1e-2),
+            seed=7)
+        gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+        rng = np.random.default_rng(5)
+        batch = {"x": rng.standard_normal((1, gm, 32)).astype("f4"),
+                 "y": rng.standard_normal((1, gm, 32)).astype("f4")}
+        return [float(engine.train_batch(batch=batch)) for _ in range(3)]
+
+    a, b = run(), run()
+    assert a == b, (a, b)

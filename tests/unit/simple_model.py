@@ -46,6 +46,21 @@ class SimpleModel:
         return loss
 
 
+class SimpleFrozenModel(SimpleModel):
+    """First layer frozen (reference tests/unit/simple_model.py:37
+    SimpleFrozenModel, requires_grad=False): the engine must not update
+    frozen leaves — not by gradient, not by weight decay."""
+
+    def frozen_mask(self):
+        mask = {}
+        for i in range(self.nlayers):
+            frozen = i == 0
+            mask[f"layer_{i}"] = {"w": frozen}
+            if self.use_bias:
+                mask[f"layer_{i}"]["b"] = frozen
+        return mask
+
+
 class SimpleTPModel(SimpleModel):
     """SimpleModel with tensor-parallel column/row sharding on alternate layers."""
 
