@@ -65,8 +65,31 @@ def _from_native(ckpt_dir: str, output_dir: str) -> str:
         np.save(os.path.join(output_dir, fname), arr)
         out_entry[key] = {"file": fname, "shape": list(arr.shape),
                           "dtype": "float32"}
+    # optimizer moments ride along (reference ds_to_universal emits
+    # exp_avg/exp_avg_sq fragments, ds_to_universal.py:254 area) so a
+    # universal restore resumes optimization, not just weights. Original
+    # dtypes are preserved — step counters may be integral.
+    opt_entry: Dict[str, Any] = {}
+    opt = manifest["tensors"].get("opt_state")
+    if opt not in (None, SENTINEL_NONE):
+        for key, info in opt.items():
+            arr = np.load(os.path.join(ckpt_dir, info["file"]))
+            fname = f"opt__{key.replace('/', '__')}.npy"
+            np.save(os.path.join(output_dir, fname), arr)
+            opt_entry[key] = {"file": fname, "shape": list(arr.shape),
+                              "dtype": str(arr.dtype)}
+    # the step counter MUST travel with the moments: Adam bias correction
+    # divides by (1 - beta^step) — moments resumed at step 0 get amplified
+    # ~1/(1-beta) on the first update. meta carries global_steps/lr state.
+    extras: Dict[str, Any] = {"meta": manifest.get("meta", {})}
+    step = manifest["tensors"].get("step")
+    if opt not in (None, SENTINEL_NONE) and isinstance(step, dict):
+        info = step.get("") or next(iter(step.values()))
+        extras["step"] = int(
+            np.load(os.path.join(ckpt_dir, info["file"])).reshape(()))
     _write_universal_manifest(output_dir, out_entry,
-                              source=os.path.abspath(ckpt_dir))
+                              source=os.path.abspath(ckpt_dir),
+                              opt_entry=opt_entry, extras=extras)
     return output_dir
 
 
@@ -87,24 +110,45 @@ def _from_flat_archive(path: str, output_dir: str) -> str:
     return output_dir
 
 
-def _write_universal_manifest(output_dir, entry, source):
+def _write_universal_manifest(output_dir, entry, source, opt_entry=None,
+                              extras=None):
+    doc = {"format": "deepspeed_tpu_universal/1", "source": source,
+           "params": entry, "opt_state": opt_entry or {}}
+    doc.update(extras or {})
     with open(os.path.join(output_dir, "universal_manifest.json"), "w") as fh:
-        json.dump({"format": "deepspeed_tpu_universal/1", "source": source,
-                   "params": entry}, fh, indent=2)
+        json.dump(doc, fh, indent=2)
 
 
-def load_universal_params(universal_dir: str) -> Dict[str, np.ndarray]:
+def load_universal_extras(universal_dir: str) -> Dict[str, Any]:
+    """step counter + meta (global_steps, lr_scheduler state) if present."""
+    with open(os.path.join(universal_dir, "universal_manifest.json")) as fh:
+        m = json.load(fh)
+    return {"step": m.get("step"), "meta": m.get("meta", {})}
+
+
+def load_universal_params(universal_dir: str,
+                          section: str = "params") -> Dict[str, np.ndarray]:
     with open(os.path.join(universal_dir, "universal_manifest.json")) as fh:
         manifest = json.load(fh)
     return {k: np.load(os.path.join(universal_dir, v["file"]))
-            for k, v in manifest["params"].items()}
+            for k, v in manifest.get(section, {}).items()}
 
 
-def load_universal_into_tree(universal_dir: str, template):
+def has_universal_opt_state(universal_dir: str) -> bool:
+    try:
+        with open(os.path.join(universal_dir,
+                               "universal_manifest.json")) as fh:
+            return bool(json.load(fh).get("opt_state"))
+    except OSError:
+        return False
+
+
+def load_universal_into_tree(universal_dir: str, template,
+                             section: str = "params"):
     """Fill `template` (pytree) with fragments matched by tree path."""
     import jax
 
-    flat = load_universal_params(universal_dir)
+    flat = load_universal_params(universal_dir, section=section)
     paths_and_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
     leaves = []
     for path, leaf in paths_and_leaves:

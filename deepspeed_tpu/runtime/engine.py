@@ -153,14 +153,15 @@ class DeepSpeedTpuEngine:
         # --- state init under sharding constraints (zero.Init equivalent:
         # params materialize directly into their shards, partition_parameters.py:723)
         self._init_state(seed)
-        if (self.offload_device or self.onebit_mode) and \
-                getattr(self.model, "frozen_mask", None) is not None:
-            # frozen params are honored only by the standard jitted step;
-            # silently updating a "frozen" backbone would corrupt a
-            # LoRA-style finetune, so reject the combination outright
-            raise NotImplementedError(
-                "frozen_mask is not supported with ZeRO-Offload or 1-bit "
-                "optimizers yet; use the standard optimizer path")
+        if self.offload_device or self.onebit_mode:
+            fm = getattr(self.model, "frozen_mask", None)
+            if (fm() if callable(fm) else fm) is not None:
+                # frozen params are honored only by the standard jitted
+                # step; silently updating a "frozen" backbone would corrupt
+                # a LoRA-style finetune, so reject the combination outright
+                raise NotImplementedError(
+                    "frozen_mask is not supported with ZeRO-Offload or "
+                    "1-bit optimizers yet; use the standard optimizer path")
         if self.offload_device:
             self._build_offload_step()
         elif self.onebit_mode:
@@ -1094,7 +1095,9 @@ class DeepSpeedTpuEngine:
         """Load weights from a universal-checkpoint directory (reference
         engine flag load_universal_checkpoint, engine.py:794): fragments are
         matched by tree path and re-sharded onto the current topology."""
-        from ..checkpoint.universal import load_universal_into_tree
+        from ..checkpoint.universal import (has_universal_opt_state,
+                                            load_universal_extras,
+                                            load_universal_into_tree)
         shapes = jax.eval_shape(self.model.init_params, jax.random.PRNGKey(0))
         host_tree = load_universal_into_tree(universal_dir, shapes)
         if self.offload_device:
@@ -1116,6 +1119,35 @@ class DeepSpeedTpuEngine:
                 lambda a, s: jax.device_put(
                     np.asarray(a).astype(self.compute_dtype), s.sharding),
                 host_tree, self.params)
+        if self.opt_state is not None and has_universal_opt_state(universal_dir):
+            # moments ride the universal format too (reference emits
+            # exp_avg/exp_avg_sq fragments): restore so the optimizer
+            # resumes, not restarts. A different optimizer type has a
+            # different state tree — fall back to weights-only then.
+            try:
+                opt_host = load_universal_into_tree(
+                    universal_dir, self.opt_state, section="opt_state")
+                self.opt_state = jax.tree.map(
+                    lambda a, o: jax.device_put(
+                        np.asarray(a).astype(o.dtype), o.sharding),
+                    opt_host, self.opt_state)
+                extras = load_universal_extras(universal_dir)
+                if extras.get("step") is not None:
+                    # the step counter must travel with the moments: Adam
+                    # bias correction at step 0 would amplify them
+                    self._step_arr = jnp.asarray(extras["step"], jnp.int32)
+                meta = extras.get("meta", {})
+                if "global_steps" in meta:
+                    self.global_steps = meta["global_steps"]
+                    self.skipped_steps = meta.get("skipped_steps", 0)
+                    self._batches_seen = meta.get("batches_seen",
+                                                  self.global_steps)
+                if "lr_scheduler" in meta:
+                    self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+            except KeyError as exc:
+                logger.warning(
+                    f"universal checkpoint optimizer state does not match "
+                    f"this optimizer ({exc}); restored weights only")
         log_dist(f"loaded universal checkpoint from {universal_dir}", ranks=[0])
 
     # ------------------------------------------------------------------
