@@ -80,13 +80,20 @@ def _from_native(ckpt_dir: str, output_dir: str) -> str:
                               "dtype": str(arr.dtype)}
     # the step counter MUST travel with the moments: Adam bias correction
     # divides by (1 - beta^step) — moments resumed at step 0 get amplified
-    # ~1/(1-beta) on the first update. meta carries global_steps/lr state.
+    # ~1/(1-beta) on the first update. meta carries global_steps/lr state;
+    # scale_state carries the fp16 dynamic loss scale (a reset scale would
+    # overflow-and-skip the first resumed steps).
     extras: Dict[str, Any] = {"meta": manifest.get("meta", {})}
     step = manifest["tensors"].get("step")
     if opt not in (None, SENTINEL_NONE) and isinstance(step, dict):
         info = step.get("") or next(iter(step.values()))
         extras["step"] = int(
             np.load(os.path.join(ckpt_dir, info["file"])).reshape(()))
+    scale = manifest["tensors"].get("scale_state")
+    if isinstance(scale, dict):
+        extras["scale_state"] = {
+            key: np.load(os.path.join(ckpt_dir, info["file"])).tolist()
+            for key, info in scale.items()}
     _write_universal_manifest(output_dir, out_entry,
                               source=os.path.abspath(ckpt_dir),
                               opt_entry=opt_entry, extras=extras)
@@ -120,10 +127,12 @@ def _write_universal_manifest(output_dir, entry, source, opt_entry=None,
 
 
 def load_universal_extras(universal_dir: str) -> Dict[str, Any]:
-    """step counter + meta (global_steps, lr_scheduler state) if present."""
+    """step counter + meta (global_steps, lr_scheduler state) + fp16
+    scale_state, if present."""
     with open(os.path.join(universal_dir, "universal_manifest.json")) as fh:
         m = json.load(fh)
-    return {"step": m.get("step"), "meta": m.get("meta", {})}
+    return {"step": m.get("step"), "meta": m.get("meta", {}),
+            "scale_state": m.get("scale_state")}
 
 
 def load_universal_params(universal_dir: str,

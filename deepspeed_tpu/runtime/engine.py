@@ -816,6 +816,19 @@ class DeepSpeedTpuEngine:
     # ------------------------------------------------------------------
     # Public API (reference surface)
     # ------------------------------------------------------------------
+    def lower_train_step(self, batch):
+        """AOT-compile the train step for analysis (HLO text, overlap
+        report, cost) without executing it. Returns the jax Compiled."""
+        if self.offload_device or self.onebit_mode:
+            raise NotImplementedError(
+                "lower_train_step supports the standard jitted step only "
+                "(offload runs a host optimizer; onebit builds its own step)")
+        dev_batch = self._shard_batch(batch)
+        return self._train_step.lower(
+            self.params, self.master_params, self.opt_state,
+            self.scale_state, self._step_arr, self._model_rng,
+            dev_batch).compile()
+
     def train_batch(self, data_iter=None, batch=None):
         """Run one full (micro*gas) training batch; returns scalar loss.
 
@@ -1105,6 +1118,11 @@ class DeepSpeedTpuEngine:
                       for l in jax.tree.leaves(host_tree)]
             self.host_opt.load_leaves(leaves, None)
             self._push_host_params(self.host_opt.current_bf16_leaves())
+            if has_universal_opt_state(universal_dir):
+                logger.warning(
+                    "universal checkpoint carries optimizer state, but the "
+                    "offload engine restored weights only (host-optimizer "
+                    "state restore from universal format not implemented)")
             return
         if self.has_master:
             self.master_params = jax.tree.map(
@@ -1122,15 +1140,30 @@ class DeepSpeedTpuEngine:
         if self.opt_state is not None and has_universal_opt_state(universal_dir):
             # moments ride the universal format too (reference emits
             # exp_avg/exp_avg_sq fragments): restore so the optimizer
-            # resumes, not restarts. A different optimizer type has a
-            # different state tree — fall back to weights-only then.
+            # resumes, not restarts. A different optimizer (different state
+            # tree / shapes) falls back to weights-only — and the fallback
+            # must be ATOMIC: validate everything before mutating anything,
+            # so a mismatch can never leave the engine half-restored.
             try:
                 opt_host = load_universal_into_tree(
                     universal_dir, self.opt_state, section="opt_state")
-                self.opt_state = jax.tree.map(
+                mismatch = [
+                    (np.asarray(a).shape, o.shape)
+                    for a, o in zip(jax.tree.leaves(opt_host),
+                                    jax.tree.leaves(self.opt_state))
+                    if tuple(np.asarray(a).shape) != tuple(o.shape)]
+                if mismatch:
+                    raise KeyError(f"opt-state shape mismatch {mismatch[0]}")
+                new_opt = jax.tree.map(
                     lambda a, o: jax.device_put(
                         np.asarray(a).astype(o.dtype), o.sharding),
                     opt_host, self.opt_state)
+            except KeyError as exc:
+                logger.warning(
+                    f"universal checkpoint optimizer state does not match "
+                    f"this optimizer ({exc}); restored weights only")
+            else:
+                self.opt_state = new_opt
                 extras = load_universal_extras(universal_dir)
                 if extras.get("step") is not None:
                     # the step counter must travel with the moments: Adam
@@ -1142,12 +1175,18 @@ class DeepSpeedTpuEngine:
                     self.skipped_steps = meta.get("skipped_steps", 0)
                     self._batches_seen = meta.get("batches_seen",
                                                   self.global_steps)
+                if self.scale_state is not None and extras.get("scale_state"):
+                    self.scale_state = {
+                        k: jnp.asarray(v, self.scale_state[k].dtype)
+                        for k, v in extras["scale_state"].items()
+                        if k in self.scale_state}
                 if "lr_scheduler" in meta:
-                    self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
-            except KeyError as exc:
-                logger.warning(
-                    f"universal checkpoint optimizer state does not match "
-                    f"this optimizer ({exc}); restored weights only")
+                    try:
+                        self.lr_scheduler.load_state_dict(
+                            meta["lr_scheduler"])
+                    except Exception as exc:
+                        logger.warning(
+                            f"lr scheduler state not restored: {exc}")
         log_dist(f"loaded universal checkpoint from {universal_dir}", ranks=[0])
 
     # ------------------------------------------------------------------

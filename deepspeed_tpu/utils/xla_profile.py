@@ -80,13 +80,21 @@ class OverlapReport:
         return "\n".join(lines)
 
 
+def overlap_report_from_compiled(compiled) -> OverlapReport:
+    """Analyze an already-compiled executable. Prefers the runtime
+    executable's post-scheduling modules (where the latency-hiding
+    scheduler's async start/done pairs live) over the pre-scheduling
+    as_text()."""
+    texts = [m.to_string() for m in compiled.runtime_executable().hlo_modules()] \
+        if hasattr(compiled, "runtime_executable") else [compiled.as_text()]
+    return analyze_hlo("\n".join(texts))
+
+
 def overlap_report(fn: Callable, *args, **kwargs) -> OverlapReport:
     """Compile fn(*args) and analyze collective scheduling in the optimized
     HLO (see module docstring)."""
     compiled = jax.jit(fn).lower(*args, **kwargs).compile()
-    texts = [m.to_string() for m in compiled.runtime_executable().hlo_modules()] \
-        if hasattr(compiled, "runtime_executable") else [compiled.as_text()]
-    return analyze_hlo("\n".join(texts))
+    return overlap_report_from_compiled(compiled)
 
 
 def analyze_hlo(hlo: str) -> OverlapReport:

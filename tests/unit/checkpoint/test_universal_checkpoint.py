@@ -142,3 +142,20 @@ def test_universal_restores_optimizer_state(tmp_path):
         assert l_native == l_uni, (i, l_native, l_uni)
     # the step counter traveled: bias correction continues, not restarts
     assert int(e_uni._step_arr) == int(e_native._step_arr)
+
+
+def test_universal_restores_fp16_scale_state(tmp_path):
+    """The fp16 dynamic loss scale travels through the universal format: a
+    reset scale would overflow-and-skip the first resumed steps."""
+    engine = _train(base_config(micro=2, stage=1, dtype="fp16", lr=1e-3),
+                    steps=2)
+    engine.save_checkpoint(str(tmp_path / "ckpt"), tag="t")
+    uni = ds_to_universal(str(tmp_path / "ckpt"), str(tmp_path / "uni"),
+                          tag="t")
+    e2, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=HIDDEN),
+        config=base_config(micro=2, stage=1, dtype="fp16", lr=1e-3))
+    e2.load_universal_checkpoint(uni)
+    for k, v in engine.scale_state.items():
+        np.testing.assert_array_equal(np.asarray(e2.scale_state[k]),
+                                      np.asarray(v), err_msg=k)
