@@ -1,0 +1,78 @@
+"""CPU-Adam throughput micro-benchmark (reference tests/perf/adam_test.py).
+
+The reference claims 5.1-6.5x over torch.optim.Adam on AVX-512
+(docs/_pages/training.md:383). This asserts a LOOSE bound only — the OMP+
+SIMD C++ update must not be dramatically slower than torch's — so the test
+stays robust on loaded CI hosts while still catching a broken native build
+falling back to scalar code.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from deepspeed_tpu.ops.cpu_optimizers import DeepSpeedCPUAdam
+
+N = 1_000_000
+STEPS = 5
+
+
+def _time(fn):
+    fn()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        fn()
+    return (time.perf_counter() - t0) / STEPS
+
+
+def test_cpu_adam_throughput_vs_torch():
+    rng = np.random.default_rng(0)
+    p = rng.standard_normal(N).astype(np.float32)
+    g = rng.standard_normal(N).astype(np.float32)
+    m = np.zeros(N, np.float32)
+    v = np.zeros(N, np.float32)
+    opt = DeepSpeedCPUAdam(lr=1e-3)
+    step_holder = [0]
+
+    def ours():
+        step_holder[0] += 1
+        opt.step(step_holder[0], p, g, m, v)
+
+    tp = torch.from_numpy(p.copy()).requires_grad_(True)
+    tp.grad = torch.from_numpy(g.copy())
+    topt = torch.optim.Adam([tp], lr=1e-3)
+
+    def theirs():
+        topt.step()
+
+    t_ours = _time(ours)
+    t_torch = _time(theirs)
+    # per-element update throughput must be within 5x of torch (reference
+    # is 5-6x FASTER; anything slower than 5x slower means the SIMD/OMP
+    # path is broken)
+    assert t_ours < 5 * t_torch, (t_ours, t_torch)
+    opt.destroy()
+
+
+def test_cpu_adam_matches_torch_numerically():
+    rng = np.random.default_rng(1)
+    p = rng.standard_normal(4096).astype(np.float32)
+    g = rng.standard_normal(4096).astype(np.float32)
+    m = np.zeros(4096, np.float32)
+    v = np.zeros(4096, np.float32)
+    opt = DeepSpeedCPUAdam(lr=1e-2, adamw_mode=False)
+    p_ours = p.copy()
+    for s in range(1, 4):
+        opt.step(s, p_ours, g, m, v)
+
+    tp = torch.from_numpy(p.copy()).requires_grad_(True)
+    tp.grad = torch.from_numpy(g.copy())
+    topt = torch.optim.Adam([tp], lr=1e-2)
+    for _ in range(3):
+        topt.step()
+    np.testing.assert_allclose(p_ours, tp.detach().numpy(), rtol=2e-5,
+                               atol=2e-5)
+    opt.destroy()
