@@ -85,6 +85,26 @@ class TransformerConfig:
     # drop_tokens=False equivalent: ragged_dot grouped GEMM, ep=1 only
     moe_dropless: bool = False
 
+    # training objective: "causal_lm" (next-token, causal attention) or
+    # "mlm" (BERT-family masked-LM: bidirectional attention, loss at the
+    # positions marked by batch["loss_mask"] against batch["labels"]).
+    # The reference's BERT-era training kernel (csrc/transformer/
+    # ds_transformer_cuda.cpp) and its test models (tests/unit/modeling.py)
+    # are this family.
+    objective: str = "causal_lm"
+
+    def __post_init__(self):
+        if self.objective not in ("causal_lm", "mlm"):
+            # a typo here would silently pair bidirectional attention with
+            # the shifted next-token loss — label leakage, loss collapse
+            raise ValueError(
+                f"objective must be 'causal_lm' or 'mlm', got "
+                f"{self.objective!r}")
+
+    @property
+    def is_causal(self) -> bool:
+        return self.objective == "causal_lm"
+
     @property
     def kv_heads(self) -> int:
         return self.num_kv_heads or self.num_heads
@@ -324,7 +344,7 @@ class TransformerLM:
         # policy: XLA fused attention for short sequences, Pallas flash once
         # the S^2 score tensor dominates (see flash_min_seq rationale)
         use_flash = cfg.use_flash and q.shape[2] >= cfg.flash_min_seq
-        o = sharded_attention(q, k, v, self.topology, causal=True,
+        o = sharded_attention(q, k, v, self.topology, causal=cfg.is_causal,
                               use_flash=use_flash,
                               block_q=cfg.attn_block_q,
                               block_kv=cfg.attn_block_kv,
@@ -591,9 +611,14 @@ class TransformerLM:
                              check_vma=False)(*args)
 
     def apply(self, params, batch, train: bool = True, rng=None):
-        """Next-token LM loss. batch: {input_ids [B,S], optional loss_mask};
-        with pipeline parallelism active, input_ids is [M, B, S]."""
+        """Loss for one batch. objective="causal_lm": next-token loss on
+        {input_ids [B,S], optional loss_mask}; objective="mlm" (BERT
+        family): masked-LM loss on {input_ids, labels, loss_mask} with
+        bidirectional attention, no shift. Under pipeline parallelism
+        input_ids is [M, B, S]."""
         if self.topology is not None and self.topology.axis_size("pipe") > 1:
+            assert self.cfg.is_causal, \
+                "pipeline parallelism supports objective='causal_lm' only"
             return self._apply_pipelined(params, batch, train=train, rng=rng)
         ids = batch["input_ids"]
         # shift AFTER the forward so the model sees the full (sp-divisible)
@@ -602,10 +627,22 @@ class TransformerLM:
         head = (params["embed"].T if self.cfg.tie_embeddings
                 else params["lm_head"])
         mask = batch.get("loss_mask")
-        mask = (mask[:, 1:].astype(jnp.float32) if mask is not None
-                else jnp.ones(ids[:, 1:].shape, jnp.float32))
-        total, count = _chunked_ce_loss(x[:, :-1], ids[:, 1:], mask, head,
-                                        self.cfg.loss_chunk)
+        if self.cfg.objective == "mlm":
+            # loss at the masked positions against the original tokens. A
+            # missing loss_mask is always a caller error for MLM: defaulting
+            # to all-ones would make ~85% of the loss a trivial copy task
+            labels = batch["labels"]
+            assert mask is not None, \
+                "objective='mlm' requires batch['loss_mask'] (1 at masked " \
+                "positions)"
+            total, count = _chunked_ce_loss(x, labels,
+                                            mask.astype(jnp.float32), head,
+                                            self.cfg.loss_chunk)
+        else:
+            mask = (mask[:, 1:].astype(jnp.float32) if mask is not None
+                    else jnp.ones(ids[:, 1:].shape, jnp.float32))
+            total, count = _chunked_ce_loss(x[:, :-1], ids[:, 1:], mask,
+                                            head, self.cfg.loss_chunk)
         loss = total / jnp.maximum(count, 1.0)
         if self.cfg.moe_num_experts > 0:
             loss = loss + self.cfg.moe_aux_loss_coef * aux
@@ -618,6 +655,9 @@ class TransformerLM:
     def init_kv_cache(self, batch_size: int, max_len: int,
                       dtype=jnp.bfloat16) -> Dict[str, jnp.ndarray]:
         cfg = self.cfg
+        assert cfg.is_causal, \
+            "KV-cache generation requires objective='causal_lm' (the MLM " \
+            "encoder family attends bidirectionally and does not decode)"
         shape = (cfg.num_layers, batch_size, cfg.kv_heads, max_len, cfg.head_dim)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
@@ -818,6 +858,20 @@ def opt_125m() -> TransformerConfig:
                              num_heads=12, max_seq_len=2048,
                              norm="layernorm", activation="relu",
                              positional="learned", attn_bias=True, tie_embeddings=True)
+
+
+def bert_base() -> TransformerConfig:
+    """BERT-base MLM encoder (the family behind the reference's BERT-era
+    training kernel csrc/transformer/ds_transformer_cuda.cpp and its
+    tests/unit/modeling.py fixture): bidirectional attention, post-LN is
+    NOT modeled (pre-LN only, like the reference kernel's pre_layer_norm
+    mode)."""
+    return TransformerConfig(vocab_size=30522, hidden_size=768,
+                             intermediate_size=3072, num_layers=12,
+                             num_heads=12, max_seq_len=512,
+                             norm="layernorm", activation="gelu",
+                             positional="learned", attn_bias=True,
+                             tie_embeddings=True, objective="mlm")
 
 
 def tiny_test(vocab=256, hidden=128, layers=2, heads=4, seq=128) -> TransformerConfig:
