@@ -1,0 +1,49 @@
+"""End-to-end convergence runs (reference tests/model/Megatron_GPT2
+run_sanity_check.py scaled down): a small causal LM must actually LEARN a
+synthetic language — not just tick the loss down — within a step budget."""
+
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+
+def _synthetic_language(rng, n, seq, vocab):
+    """Deterministic next-token structure: token[t+1] = (token[t] * 3 + 1)
+    mod vocab, with random start tokens. A model that learns the rule can
+    reach near-zero loss; one that only memorizes the batch cannot (fresh
+    sequences every batch)."""
+    starts = rng.integers(0, vocab, (n, 1))
+    seqs = [starts]
+    for _ in range(seq - 1):
+        seqs.append((seqs[-1] * 3 + 1) % vocab)
+    return np.concatenate(seqs, axis=1).astype(np.int64)
+
+
+def test_small_lm_learns_synthetic_language():
+    cfg = TransformerConfig(vocab_size=64, hidden_size=64,
+                            intermediate_size=128, num_layers=2, num_heads=4,
+                            max_seq_len=32, use_flash=False, remat=False)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=TransformerLM(cfg),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+                "scheduler": {"type": "WarmupLR",
+                              "params": {"warmup_num_steps": 10}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 2},
+                "steps_per_print": 10 ** 9})
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(0)
+    first = last = None
+    for step in range(60):
+        ids = _synthetic_language(rng, gm, 32, 64)
+        loss = float(engine.train_batch(
+            batch={"input_ids": ids.reshape(1, gm, 32)}))
+        if first is None:
+            first = loss
+        last = loss
+    # ln(64) ~ 4.16 at chance; the deterministic rule is learnable to ~0.
+    # Require real learning on UNSEEN sequences, not just a downward tick.
+    assert first > 3.0, first
+    assert last < 1.0, (first, last)
