@@ -76,6 +76,14 @@ def hardware_report(probe_timeout: int = 30):
         r = subprocess.run([sys.executable, "-c", probe],
                            capture_output=True, text=True,
                            timeout=probe_timeout, env=env)
+        if r.returncode != 0 or not r.stdout.strip():
+            # surface the probe's real failure (e.g. missing jax, plugin
+            # crash), not a parse error — this is a diagnostic tool
+            err = (r.stderr or "").strip().splitlines()
+            rows.append(("jax devices",
+                         f"probe failed rc={r.returncode}: "
+                         f"{err[-1] if err else 'no output'}"))
+            return rows
         info = json.loads(r.stdout.strip().splitlines()[-1])
         rows.append(("backend", info["backend"]))
         rows.append(("device count", str(info["count"])))

@@ -44,6 +44,9 @@ NEG_INF = -1e30
 
 def init_paged_kv_cache(cfg: TransformerConfig, num_blocks: int,
                         block_size: int, dtype) -> Dict[str, jnp.ndarray]:
+    assert cfg.is_causal and cfg.norm_scheme == "pre", \
+        "paged serving requires a causal pre-LN model (the MLM/post-LN " \
+        "encoder family does not decode)"
     shape = (cfg.num_layers, num_blocks, block_size, cfg.kv_heads,
              cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}

@@ -20,6 +20,7 @@ TPU-first design:
     (reference runtime/activation_checkpointing/checkpointing.py:477).
 """
 
+import functools
 import math
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
@@ -92,6 +93,17 @@ class TransformerConfig:
     # ds_transformer_cuda.cpp) and its test models (tests/unit/modeling.py)
     # are this family.
     objective: str = "causal_lm"
+    # residual/norm ordering: "pre" (norm before the sublayer, the modern
+    # default and what every causal preset uses) or "post" (norm AFTER the
+    # residual add — original BERT; the reference kernel's
+    # pre_layer_norm=False mode, ds_transformer_cuda.cpp). Post-LN has no
+    # final norm: the last layer's output LayerNorm plays that role.
+    norm_scheme: str = "pre"
+    # BERT-family extras: LayerNorm over the summed embeddings
+    # (bert.embeddings.LayerNorm) and the MLM prediction head transform
+    # (cls.predictions: dense+gelu+LN+decoder bias)
+    embed_ln: bool = False
+    mlm_head: bool = False
 
     def __post_init__(self):
         if self.objective not in ("causal_lm", "mlm"):
@@ -100,6 +112,12 @@ class TransformerConfig:
             raise ValueError(
                 f"objective must be 'causal_lm' or 'mlm', got "
                 f"{self.objective!r}")
+        if self.norm_scheme not in ("pre", "post"):
+            raise ValueError(
+                f"norm_scheme must be 'pre' or 'post', got "
+                f"{self.norm_scheme!r}")
+        if self.norm_scheme == "post" and self.moe_num_experts > 0:
+            raise NotImplementedError("post-LN + MoE is not supported")
 
     @property
     def is_causal(self) -> bool:
@@ -128,11 +146,16 @@ def _rope_tables(cfg: TransformerConfig, seq_len: int, offset=0):
 
 def ffn_act(cfg: TransformerConfig):
     """Non-gated FFN activation for the gelu/relu model families (one
-    definition shared by training, cached decode, and paged inference)."""
+    definition shared by training, cached decode, and paged inference).
+    "gelu" is the tanh approximation (HF gelu_new, GPT-2); "gelu_exact" is
+    the erf form (HF "gelu", BERT) — they differ by ~1e-3 and conversions
+    must pick the right one."""
     if cfg.activation == "relu":
         return jax.nn.relu
     if cfg.activation == "gelu":
         return jax.nn.gelu
+    if cfg.activation == "gelu_exact":
+        return functools.partial(jax.nn.gelu, approximate=False)
     raise ValueError(f"unknown FFN activation {cfg.activation!r}")
 
 
@@ -167,7 +190,7 @@ def out_proj(lp, o):
     return x
 
 
-def _chunked_ce_loss(x, targets, mask, head, chunk: int):
+def _chunked_ce_loss(x, targets, mask, head, chunk: int, bias=None):
     """Cross-entropy without materializing [B, S, V] logits: scan over
     sequence chunks, each chunk's logits+logsumexp rematerialized in the
     backward (jax.checkpoint). Peak memory drops from O(S*V) to O(chunk*V),
@@ -189,6 +212,8 @@ def _chunked_ce_loss(x, targets, mask, head, chunk: int):
     @jax.checkpoint
     def chunk_nll(x_c, t_c, m_c):
         logits = (x_c @ head.astype(x_c.dtype)).astype(jnp.float32)
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
         tgt = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
         return jnp.sum((lse - tgt) * m_c)
@@ -219,7 +244,7 @@ class TransformerLM:
         hd, nh, nkv = cfg.head_dim, cfg.num_heads, cfg.kv_heads
         L = cfg.num_layers
         dt = jnp.float32
-        k = jax.random.split(rng, 17)
+        k = jax.random.split(rng, 18)
         std = 0.02
         out_std = std / math.sqrt(2 * L)
 
@@ -267,12 +292,23 @@ class TransformerLM:
         params = {
             "embed": init(k[7], (v, h)),
             "layers": layer,
-            "final_norm": jnp.ones((h,), dt),
         }
-        if cfg.norm == "layernorm":
-            params["final_norm_b"] = jnp.zeros((h,), dt)
+        if cfg.norm_scheme == "pre":
+            # post-LN has no final norm (the last layer's output LN is it)
+            params["final_norm"] = jnp.ones((h,), dt)
+            if cfg.norm == "layernorm":
+                params["final_norm_b"] = jnp.zeros((h,), dt)
         if cfg.positional == "learned":
             params["pos_embed"] = init(k[16], (cfg.max_seq_len, h))
+        if cfg.embed_ln:
+            params["embed_ln_w"] = jnp.ones((h,), dt)
+            params["embed_ln_b"] = jnp.zeros((h,), dt)
+        if cfg.mlm_head:
+            params["mlm_transform_w"] = init(k[17], (h, h))
+            params["mlm_transform_b"] = jnp.zeros((h,), dt)
+            params["mlm_ln_w"] = jnp.ones((h,), dt)
+            params["mlm_ln_b"] = jnp.zeros((h,), dt)
+            params["mlm_bias"] = jnp.zeros((v,), dt)
         if not cfg.tie_embeddings:
             params["lm_head"] = init(k[9], (h, v))
         return params
@@ -321,12 +357,22 @@ class TransformerLM:
         specs = {
             "embed": P("model", None) if tp > 1 else P(None, None),
             "layers": layer,
-            "final_norm": P(None),
         }
-        if cfg.norm == "layernorm":
-            specs["final_norm_b"] = P(None)
+        if cfg.norm_scheme == "pre":
+            specs["final_norm"] = P(None)
+            if cfg.norm == "layernorm":
+                specs["final_norm_b"] = P(None)
         if cfg.positional == "learned":
             specs["pos_embed"] = P(None, None)
+        if cfg.embed_ln:
+            specs["embed_ln_w"] = P(None)
+            specs["embed_ln_b"] = P(None)
+        if cfg.mlm_head:
+            specs["mlm_transform_w"] = P(None, None)
+            specs["mlm_transform_b"] = P(None)
+            specs["mlm_ln_w"] = P(None)
+            specs["mlm_ln_b"] = P(None)
+            specs["mlm_bias"] = P(None)
         if not cfg.tie_embeddings:
             specs["lm_head"] = P(None, "model") if tp > 1 else P(None, None)
         return specs
@@ -358,8 +404,13 @@ class TransformerLM:
         cfg = self.cfg
         B, S, H = x.shape
         nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+        post = cfg.norm_scheme == "post"
 
-        hn = self._norm(x, lp["attn_norm"], lp.get("attn_norm_b"))
+        # post-LN (original BERT; reference kernel pre_layer_norm=False):
+        # the sublayer reads the raw residual stream and the norm lands
+        # AFTER the residual add
+        hn = x if post else self._norm(x, lp["attn_norm"],
+                                       lp.get("attn_norm_b"))
         q, k, v = qkv_proj(lp, hn)
         q = q.reshape(B, S, nh, hd).transpose(0, 2, 1, 3)
         k = k.reshape(B, S, nkv, hd).transpose(0, 2, 1, 3)
@@ -370,8 +421,11 @@ class TransformerLM:
         o = self._attention(q, k, v)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
         x = x + out_proj(lp, o)
+        if post:
+            x = self._norm(x, lp["attn_norm"], lp.get("attn_norm_b"))
 
-        hn = self._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+        hn = x if post else self._norm(x, lp["mlp_norm"],
+                                       lp.get("mlp_norm_b"))
         aux = jnp.zeros((), jnp.float32)
         if cfg.moe_num_experts > 0:
             from ..moe.sharded_moe import (moe_layer, moe_layer_dropless,
@@ -409,6 +463,8 @@ class TransformerLM:
         else:
             u = ffn_act(cfg)(hn @ lp["w_up"] + lp["b_up"])
             x = x + u @ lp["w_down"] + lp["b_down"]
+        if post:
+            x = self._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"))
         return x, aux
 
     def forward_hidden(self, params, input_ids):
@@ -416,6 +472,11 @@ class TransformerLM:
         x = params["embed"][input_ids]                    # [B, S, H] gather
         if cfg.positional == "learned":
             x = x + params["pos_embed"][: input_ids.shape[1]][None]
+        if "embed_ln_w" in params:
+            # BERT-family embedding LayerNorm (applied to the summed
+            # word+position embeddings; HF bert.embeddings.LayerNorm)
+            x = layer_norm(x, params["embed_ln_w"], params.get("embed_ln_b"),
+                           cfg.norm_eps)
         S = input_ids.shape[1]
         if cfg.positional == "rope":
             cos, sin = _rope_tables(cfg, S)
@@ -436,14 +497,35 @@ class TransformerLM:
         unroll = max(self.cfg.scan_unroll,
                      getattr(self, "scan_unroll_hint", 1))
         x, aux = jax.lax.scan(scan_fn, x, params["layers"], unroll=unroll)
-        x = self._norm(x, params["final_norm"], params.get("final_norm_b"))
+        if cfg.norm_scheme == "pre":
+            # post-LN has no final norm: the last layer's output LN is it
+            x = self._norm(x, params["final_norm"],
+                           params.get("final_norm_b"))
         return x, jnp.mean(aux)
+
+    def _head_inputs(self, params, x):
+        """(transformed hidden, head matrix, logit bias): the MLM prediction
+        head (HF cls.predictions: dense+gelu+LN+decoder bias) applies when
+        its params are present; otherwise the plain (tied) LM head."""
+        bias = None
+        if "mlm_transform_w" in params:
+            x = ffn_act(self.cfg)(
+                x @ params["mlm_transform_w"].astype(x.dtype)
+                + params["mlm_transform_b"].astype(x.dtype))
+            x = layer_norm(x, params["mlm_ln_w"], params.get("mlm_ln_b"),
+                           self.cfg.norm_eps)
+            bias = params.get("mlm_bias")
+        head = (params["embed"].T if self.cfg.tie_embeddings
+                else params["lm_head"])
+        return x, head, bias
 
     def forward_logits(self, params, input_ids):
         x, _ = self.forward_hidden(params, input_ids)
-        head = (params["embed"].T if self.cfg.tie_embeddings
-                else params["lm_head"])
-        return x @ head.astype(x.dtype)
+        x, head, bias = self._head_inputs(params, x)
+        logits = x @ head.astype(x.dtype)
+        if bias is not None:
+            logits = logits + bias.astype(logits.dtype)
+        return logits
 
     # -- pipeline-parallel forward (compiled 1F1B-style, runtime/pipe) ------
     def _apply_pipelined(self, params, batch, train: bool = True, rng=None):
@@ -619,13 +701,13 @@ class TransformerLM:
         if self.topology is not None and self.topology.axis_size("pipe") > 1:
             assert self.cfg.is_causal, \
                 "pipeline parallelism supports objective='causal_lm' only"
+            assert self.cfg.norm_scheme == "pre", \
+                "pipeline parallelism supports norm_scheme='pre' only"
             return self._apply_pipelined(params, batch, train=train, rng=rng)
         ids = batch["input_ids"]
         # shift AFTER the forward so the model sees the full (sp-divisible)
         # sequence length under sequence parallelism
         x, aux = self.forward_hidden(params, ids)
-        head = (params["embed"].T if self.cfg.tie_embeddings
-                else params["lm_head"])
         mask = batch.get("loss_mask")
         if self.cfg.objective == "mlm":
             # loss at the masked positions against the original tokens. A
@@ -635,10 +717,13 @@ class TransformerLM:
             assert mask is not None, \
                 "objective='mlm' requires batch['loss_mask'] (1 at masked " \
                 "positions)"
+            x, head, bias = self._head_inputs(params, x)
             total, count = _chunked_ce_loss(x, labels,
                                             mask.astype(jnp.float32), head,
-                                            self.cfg.loss_chunk)
+                                            self.cfg.loss_chunk, bias=bias)
         else:
+            head = (params["embed"].T if self.cfg.tie_embeddings
+                    else params["lm_head"])
             mask = (mask[:, 1:].astype(jnp.float32) if mask is not None
                     else jnp.ones(ids[:, 1:].shape, jnp.float32))
             total, count = _chunked_ce_loss(x[:, :-1], ids[:, 1:], mask,
@@ -658,6 +743,8 @@ class TransformerLM:
         assert cfg.is_causal, \
             "KV-cache generation requires objective='causal_lm' (the MLM " \
             "encoder family attends bidirectionally and does not decode)"
+        assert cfg.norm_scheme == "pre", \
+            "KV-cache generation supports norm_scheme='pre' only"
         shape = (cfg.num_layers, batch_size, cfg.kv_heads, max_len, cfg.head_dim)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
@@ -861,17 +948,19 @@ def opt_125m() -> TransformerConfig:
 
 
 def bert_base() -> TransformerConfig:
-    """BERT-base MLM encoder (the family behind the reference's BERT-era
-    training kernel csrc/transformer/ds_transformer_cuda.cpp and its
-    tests/unit/modeling.py fixture): bidirectional attention, post-LN is
-    NOT modeled (pre-LN only, like the reference kernel's pre_layer_norm
-    mode)."""
+    """BERT-base MLM encoder, faithful to the original (the family behind
+    the reference's BERT-era training kernel
+    csrc/transformer/ds_transformer_cuda.cpp and its tests/unit/modeling.py
+    fixture): post-LN residuals, embedding LayerNorm, MLM prediction head,
+    bidirectional attention."""
     return TransformerConfig(vocab_size=30522, hidden_size=768,
                              intermediate_size=3072, num_layers=12,
                              num_heads=12, max_seq_len=512,
-                             norm="layernorm", activation="gelu",
-                             positional="learned", attn_bias=True,
-                             tie_embeddings=True, objective="mlm")
+                             norm="layernorm", norm_eps=1e-12,
+                             activation="gelu", positional="learned",
+                             attn_bias=True, tie_embeddings=True,
+                             objective="mlm", norm_scheme="post",
+                             embed_ln=True, mlm_head=True)
 
 
 def tiny_test(vocab=256, hidden=128, layers=2, heads=4, seq=128) -> TransformerConfig:

@@ -14,9 +14,11 @@ Supported architectures (reference policy containers, and the reference's
 in-tree inference-v2 families inference/v2/model_implementations/
 {llama_v2,mistral,opt}): LlamaForCausalLM / MistralForCausalLM
 (RMSNorm+RoPE+SwiGLU+GQA, optional attention_bias), GPT2LMHeadModel
-(LayerNorm+learned positions+GELU+attn biases) and OPTForCausalLM
-(pre-LN LayerNorm+learned positions with the HF +2 offset+ReLU+biases).
-torch weights are consumed as numpy; torch never touches the device path.
+(LayerNorm+learned positions+GELU+attn biases), OPTForCausalLM
+(pre-LN LayerNorm+learned positions with the HF +2 offset+ReLU+biases)
+and BertForMaskedLM (post-LN encoder + embeddings LayerNorm + MLM
+prediction head, exact-erf gelu). torch weights are consumed as numpy;
+torch never touches the device path.
 """
 
 from typing import Any, Dict, Optional, Tuple
@@ -94,9 +96,38 @@ def config_from_hf(hf_config) -> TransformerConfig:
             tie_embeddings=getattr(hf_config, "tie_word_embeddings", True),
             attn_bias=True,
         )
+    if mt == "bert":
+        if getattr(hf_config, "position_embedding_type",
+                   "absolute") != "absolute":
+            raise ValueError(
+                f"BERT position_embedding_type "
+                f"{hf_config.position_embedding_type!r} is not supported; "
+                f"only 'absolute' learned positions convert")
+        # HF "gelu" is the exact erf form; our "gelu" is the tanh
+        # approximation (HF gelu_new) — map accordingly
+        act = {"gelu": "gelu_exact", "gelu_new": "gelu",
+               "relu": "relu"}.get(hf_config.hidden_act)
+        if act is None:
+            raise ValueError(
+                f"BERT hidden_act {hf_config.hidden_act!r} is not "
+                f"supported; supported: gelu, gelu_new, relu")
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            max_seq_len=hf_config.max_position_embeddings,
+            norm="layernorm", norm_eps=hf_config.layer_norm_eps,
+            activation=act,
+            positional="learned", attn_bias=True,
+            tie_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+            objective="mlm", norm_scheme="post", embed_ln=True,
+            mlm_head=True,
+        )
     raise ValueError(
         f"unsupported model_type '{mt}'; supported: llama, mistral, gpt2, "
-        f"opt (add a mapping here the way the reference adds policy "
+        f"opt, bert (add a mapping here the way the reference adds policy "
         f"containers)")
 
 
@@ -222,6 +253,65 @@ def _params_from_opt(sd, cfg: TransformerConfig) -> Dict[str, Any]:
     return params
 
 
+def _params_from_bert(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+    """BertForMaskedLM (post-LN encoder + cls.predictions MLM head). The
+    token-type-0 embedding row is folded into the position table — a
+    position-independent constant for single-segment inputs (token_type_ids
+    other than 0 are not representable)."""
+    L = cfg.num_layers
+    p = "bert.encoder.layer.{}."
+    layers = {
+        "wq": _stack(sd, p + "attention.self.query.weight", L, transpose=True),
+        "wk": _stack(sd, p + "attention.self.key.weight", L, transpose=True),
+        "wv": _stack(sd, p + "attention.self.value.weight", L, transpose=True),
+        "b_q": _stack(sd, p + "attention.self.query.bias", L),
+        "b_k": _stack(sd, p + "attention.self.key.bias", L),
+        "b_v": _stack(sd, p + "attention.self.value.bias", L),
+        "wo": _stack(sd, p + "attention.output.dense.weight", L,
+                     transpose=True),
+        "b_o": _stack(sd, p + "attention.output.dense.bias", L),
+        # post-LN: attention.output.LayerNorm lands AFTER the attn residual
+        "attn_norm": _stack(sd, p + "attention.output.LayerNorm.weight", L),
+        "attn_norm_b": _stack(sd, p + "attention.output.LayerNorm.bias", L),
+        "w_up": _stack(sd, p + "intermediate.dense.weight", L, transpose=True),
+        "b_up": _stack(sd, p + "intermediate.dense.bias", L),
+        "w_down": _stack(sd, p + "output.dense.weight", L, transpose=True),
+        "b_down": _stack(sd, p + "output.dense.bias", L),
+        "mlp_norm": _stack(sd, p + "output.LayerNorm.weight", L),
+        "mlp_norm_b": _stack(sd, p + "output.LayerNorm.bias", L),
+    }
+    pos = np.asarray(sd["bert.embeddings.position_embeddings.weight"],
+                     np.float32)
+    tok0 = np.asarray(sd["bert.embeddings.token_type_embeddings.weight"][0],
+                      np.float32)
+    out = {
+        "embed": np.ascontiguousarray(
+            sd["bert.embeddings.word_embeddings.weight"], np.float32),
+        "pos_embed": np.ascontiguousarray(pos + tok0[None], np.float32),
+        "embed_ln_w": np.ascontiguousarray(
+            sd["bert.embeddings.LayerNorm.weight"], np.float32),
+        "embed_ln_b": np.ascontiguousarray(
+            sd["bert.embeddings.LayerNorm.bias"], np.float32),
+        "layers": layers,
+        "mlm_transform_w": np.ascontiguousarray(
+            sd["cls.predictions.transform.dense.weight"].T, np.float32),
+        "mlm_transform_b": np.ascontiguousarray(
+            sd["cls.predictions.transform.dense.bias"], np.float32),
+        "mlm_ln_w": np.ascontiguousarray(
+            sd["cls.predictions.transform.LayerNorm.weight"], np.float32),
+        "mlm_ln_b": np.ascontiguousarray(
+            sd["cls.predictions.transform.LayerNorm.bias"], np.float32),
+        "mlm_bias": np.ascontiguousarray(
+            sd["cls.predictions.bias"], np.float32),
+    }
+    if not cfg.tie_embeddings:
+        # untied decoder: use the trained cls.predictions.decoder weights,
+        # not word_embeddings.T
+        out["lm_head"] = np.ascontiguousarray(
+            sd["cls.predictions.decoder.weight"].T, np.float32)
+    return out
+
+
 def params_from_hf(state_dict: Dict[str, Any],
                    cfg: TransformerConfig,
                    model_type: str = "llama") -> Dict[str, Any]:
@@ -234,6 +324,8 @@ def params_from_hf(state_dict: Dict[str, Any],
         return _params_from_gpt2(sd, cfg)
     if model_type == "opt":
         return _params_from_opt(sd, cfg)
+    if model_type == "bert":
+        return _params_from_bert(sd, cfg)
     raise ValueError(f"unsupported model_type '{model_type}'")
 
 

@@ -97,6 +97,29 @@ def test_opt_injection_matches_hf():
     _assert_logits_match(hf, ids)
 
 
+def test_bert_injection_matches_hf():
+    """BertForMaskedLM (post-LN encoder + embeddings LayerNorm + MLM
+    prediction head, exact-erf gelu): converted logits must match HF's."""
+    cfg = transformers.BertConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, hidden_act="gelu",
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-12)
+    torch.manual_seed(6)
+    hf = transformers.BertForMaskedLM(cfg).eval()
+    _randomize_biases(hf, seed=6)
+    ids_np = np.random.default_rng(6).integers(0, 96, (2, 11), dtype=np.int64)
+    model, params = load_hf_model(hf)
+    params = {k: jnp.asarray(v) if not isinstance(v, dict)
+              else {kk: jnp.asarray(vv) for kk, vv in v.items()}
+              for k, v in params.items()}
+    ours = np.asarray(model.forward_logits(params, jnp.asarray(ids_np)))
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(ids_np)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+
 def test_opt_post_ln_rejected():
     from deepspeed_tpu.module_inject import config_from_hf
     cfg = transformers.OPTConfig(
