@@ -16,9 +16,10 @@ in-tree inference-v2 families inference/v2/model_implementations/
 (RMSNorm+RoPE+SwiGLU+GQA, optional attention_bias), GPT2LMHeadModel
 (LayerNorm+learned positions+GELU+attn biases), OPTForCausalLM
 (pre-LN LayerNorm+learned positions with the HF +2 offset+ReLU+biases)
-and BertForMaskedLM (post-LN encoder + embeddings LayerNorm + MLM
-prediction head, exact-erf gelu). torch weights are consumed as numpy;
-torch never touches the device path.
+and the post-LN MLM encoders BertForMaskedLM / RobertaForMaskedLM
+(embeddings LayerNorm + MLM prediction head, exact-erf gelu; RoBERTa's
++2 position offset handled like OPT's). torch weights are consumed as
+numpy; torch never touches the device path.
 """
 
 from typing import Any, Dict, Optional, Tuple
@@ -125,10 +126,37 @@ def config_from_hf(hf_config) -> TransformerConfig:
             objective="mlm", norm_scheme="post", embed_ln=True,
             mlm_head=True,
         )
+    if mt == "roberta":
+        if getattr(hf_config, "position_embedding_type",
+                   "absolute") != "absolute":
+            raise ValueError(
+                f"RoBERTa position_embedding_type "
+                f"{hf_config.position_embedding_type!r} is not supported")
+        act = {"gelu": "gelu_exact", "gelu_new": "gelu",
+               "relu": "relu"}.get(hf_config.hidden_act)
+        if act is None:
+            raise ValueError(
+                f"RoBERTa hidden_act {hf_config.hidden_act!r} is not "
+                f"supported; supported: gelu, gelu_new, relu")
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            # the HF table has pad_token_id+1 (=2) offset rows, like OPT
+            max_seq_len=hf_config.max_position_embeddings - 2,
+            norm="layernorm", norm_eps=hf_config.layer_norm_eps,
+            activation=act,
+            positional="learned", attn_bias=True,
+            tie_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+            objective="mlm", norm_scheme="post", embed_ln=True,
+            mlm_head=True,
+        )
     raise ValueError(
         f"unsupported model_type '{mt}'; supported: llama, mistral, gpt2, "
-        f"opt, bert (add a mapping here the way the reference adds policy "
-        f"containers)")
+        f"opt, bert, roberta (add a mapping here the way the reference adds "
+        f"policy containers)")
 
 
 # ---------------------------------------------------------------------------
@@ -312,6 +340,60 @@ def _params_from_bert(sd, cfg: TransformerConfig) -> Dict[str, Any]:
     return out
 
 
+def _params_from_roberta(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+    """RobertaForMaskedLM: same post-LN encoder as BERT with roberta.*
+    prefixes, a +2 position offset (positions start at padding_idx+1,
+    like OPT) and an lm_head.{dense,layer_norm,bias} MLM head."""
+    L = cfg.num_layers
+    p = "roberta.encoder.layer.{}."
+    layers = {
+        "wq": _stack(sd, p + "attention.self.query.weight", L, transpose=True),
+        "wk": _stack(sd, p + "attention.self.key.weight", L, transpose=True),
+        "wv": _stack(sd, p + "attention.self.value.weight", L, transpose=True),
+        "b_q": _stack(sd, p + "attention.self.query.bias", L),
+        "b_k": _stack(sd, p + "attention.self.key.bias", L),
+        "b_v": _stack(sd, p + "attention.self.value.bias", L),
+        "wo": _stack(sd, p + "attention.output.dense.weight", L,
+                     transpose=True),
+        "b_o": _stack(sd, p + "attention.output.dense.bias", L),
+        "attn_norm": _stack(sd, p + "attention.output.LayerNorm.weight", L),
+        "attn_norm_b": _stack(sd, p + "attention.output.LayerNorm.bias", L),
+        "w_up": _stack(sd, p + "intermediate.dense.weight", L, transpose=True),
+        "b_up": _stack(sd, p + "intermediate.dense.bias", L),
+        "w_down": _stack(sd, p + "output.dense.weight", L, transpose=True),
+        "b_down": _stack(sd, p + "output.dense.bias", L),
+        "mlp_norm": _stack(sd, p + "output.LayerNorm.weight", L),
+        "mlp_norm_b": _stack(sd, p + "output.LayerNorm.bias", L),
+    }
+    pos = np.asarray(sd["roberta.embeddings.position_embeddings.weight"][2:],
+                     np.float32)
+    tok0 = np.asarray(
+        sd["roberta.embeddings.token_type_embeddings.weight"][0], np.float32)
+    out = {
+        "embed": np.ascontiguousarray(
+            sd["roberta.embeddings.word_embeddings.weight"], np.float32),
+        "pos_embed": np.ascontiguousarray(pos + tok0[None], np.float32),
+        "embed_ln_w": np.ascontiguousarray(
+            sd["roberta.embeddings.LayerNorm.weight"], np.float32),
+        "embed_ln_b": np.ascontiguousarray(
+            sd["roberta.embeddings.LayerNorm.bias"], np.float32),
+        "layers": layers,
+        "mlm_transform_w": np.ascontiguousarray(
+            sd["lm_head.dense.weight"].T, np.float32),
+        "mlm_transform_b": np.ascontiguousarray(
+            sd["lm_head.dense.bias"], np.float32),
+        "mlm_ln_w": np.ascontiguousarray(
+            sd["lm_head.layer_norm.weight"], np.float32),
+        "mlm_ln_b": np.ascontiguousarray(
+            sd["lm_head.layer_norm.bias"], np.float32),
+        "mlm_bias": np.ascontiguousarray(sd["lm_head.bias"], np.float32),
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head"] = np.ascontiguousarray(
+            sd["lm_head.decoder.weight"].T, np.float32)
+    return out
+
+
 def params_from_hf(state_dict: Dict[str, Any],
                    cfg: TransformerConfig,
                    model_type: str = "llama") -> Dict[str, Any]:
@@ -326,6 +408,8 @@ def params_from_hf(state_dict: Dict[str, Any],
         return _params_from_opt(sd, cfg)
     if model_type == "bert":
         return _params_from_bert(sd, cfg)
+    if model_type == "roberta":
+        return _params_from_roberta(sd, cfg)
     raise ValueError(f"unsupported model_type '{model_type}'")
 
 

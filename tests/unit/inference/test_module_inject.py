@@ -120,6 +120,30 @@ def test_bert_injection_matches_hf():
     np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
 
 
+def test_roberta_injection_matches_hf():
+    """RobertaForMaskedLM: post-LN encoder with the +2 position offset and
+    the lm_head MLM head. Inputs avoid pad_token_id=1 — HF's position ids
+    are pad-aware and only equal arange+2 for unpadded sequences."""
+    cfg = transformers.RobertaConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=66, hidden_act="gelu",
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-5, pad_token_id=1)
+    torch.manual_seed(7)
+    hf = transformers.RobertaForMaskedLM(cfg).eval()
+    _randomize_biases(hf, seed=7)
+    ids_np = np.random.default_rng(7).integers(2, 96, (2, 10), dtype=np.int64)
+    model, params = load_hf_model(hf)
+    params = {k: jnp.asarray(v) if not isinstance(v, dict)
+              else {kk: jnp.asarray(vv) for kk, vv in v.items()}
+              for k, v in params.items()}
+    ours = np.asarray(model.forward_logits(params, jnp.asarray(ids_np)))
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(ids_np)).logits.float().numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+
+
 def test_opt_post_ln_rejected():
     from deepspeed_tpu.module_inject import config_from_hf
     cfg = transformers.OPTConfig(
