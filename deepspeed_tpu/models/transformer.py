@@ -1,11 +1,12 @@
 """Transformer language-model family (functional, mesh-aware).
 
-This is the model zoo backbone: one configurable decoder-only transformer that
-instantiates the Llama/Mistral family (RMSNorm + rotary + SwiGLU + GQA) and
-the GPT-2/OPT family (LayerNorm + learned positions + GELU), replacing the
-reference's per-architecture implementations
-(inference/v2/model_implementations/{llama_v2,mistral,opt}/ and the
-HF-injection containers in module_inject/containers/*).
+This is the model zoo backbone: one configurable transformer that
+instantiates the Llama/Mistral family (RMSNorm + rotary + SwiGLU + GQA),
+the GPT-2/OPT family (LayerNorm + learned positions + GELU) and the
+BERT/RoBERTa MLM encoder family (post-LN, bidirectional attention, MLM
+prediction head), replacing the reference's per-architecture
+implementations (inference/v2/model_implementations/{llama_v2,mistral,opt}/
+and the HF-injection containers in module_inject/containers/*).
 
 TPU-first design:
   * layers are stacked and executed with lax.scan (one compiled layer body,
