@@ -16,10 +16,10 @@ in-tree inference-v2 families inference/v2/model_implementations/
 (RMSNorm+RoPE+SwiGLU+GQA, optional attention_bias), GPT2LMHeadModel
 (LayerNorm+learned positions+GELU+attn biases), OPTForCausalLM
 (pre-LN LayerNorm+learned positions with the HF +2 offset+ReLU+biases)
-and the post-LN MLM encoders BertForMaskedLM / RobertaForMaskedLM
-(embeddings LayerNorm + MLM prediction head, exact-erf gelu; RoBERTa's
-+2 position offset handled like OPT's). torch weights are consumed as
-numpy; torch never touches the device path.
+and the post-LN MLM encoders BertForMaskedLM / RobertaForMaskedLM /
+DistilBertForMaskedLM (embeddings LayerNorm + MLM prediction head,
+exact-erf gelu; RoBERTa's +2 position offset handled like OPT's). torch
+weights are consumed as numpy; torch never touches the device path.
 """
 
 from typing import Any, Dict, Optional, Tuple
@@ -153,10 +153,35 @@ def config_from_hf(hf_config) -> TransformerConfig:
             objective="mlm", norm_scheme="post", embed_ln=True,
             mlm_head=True,
         )
+    if mt == "distilbert":
+        if getattr(hf_config, "sinusoidal_pos_embds", False):
+            raise ValueError(
+                "DistilBERT sinusoidal_pos_embds=True is not supported; "
+                "only learned positions convert")
+        act = {"gelu": "gelu_exact", "relu": "relu"}.get(
+            hf_config.activation)
+        if act is None:
+            raise ValueError(
+                f"DistilBERT activation {hf_config.activation!r} is not "
+                f"supported; supported: gelu, relu")
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.dim,
+            intermediate_size=hf_config.hidden_dim,
+            num_layers=hf_config.n_layers,
+            num_heads=hf_config.n_heads,
+            max_seq_len=hf_config.max_position_embeddings,
+            norm="layernorm", norm_eps=1e-12,
+            activation=act,
+            positional="learned", attn_bias=True,
+            tie_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+            objective="mlm", norm_scheme="post", embed_ln=True,
+            mlm_head=True,
+        )
     raise ValueError(
         f"unsupported model_type '{mt}'; supported: llama, mistral, gpt2, "
-        f"opt, bert, roberta (add a mapping here the way the reference adds "
-        f"policy containers)")
+        f"opt, bert, roberta, distilbert (add a mapping here the way the "
+        f"reference adds policy containers)")
 
 
 # ---------------------------------------------------------------------------
@@ -394,6 +419,58 @@ def _params_from_roberta(sd, cfg: TransformerConfig) -> Dict[str, Any]:
     return out
 
 
+def _params_from_distilbert(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+    """DistilBertForMaskedLM: BERT-style post-LN encoder without token
+    types; MLM head = vocab_transform + vocab_layer_norm + vocab_projector
+    (tied to word embeddings)."""
+    L = cfg.num_layers
+    p = "distilbert.transformer.layer.{}."
+    layers = {
+        "wq": _stack(sd, p + "attention.q_lin.weight", L, transpose=True),
+        "wk": _stack(sd, p + "attention.k_lin.weight", L, transpose=True),
+        "wv": _stack(sd, p + "attention.v_lin.weight", L, transpose=True),
+        "b_q": _stack(sd, p + "attention.q_lin.bias", L),
+        "b_k": _stack(sd, p + "attention.k_lin.bias", L),
+        "b_v": _stack(sd, p + "attention.v_lin.bias", L),
+        "wo": _stack(sd, p + "attention.out_lin.weight", L, transpose=True),
+        "b_o": _stack(sd, p + "attention.out_lin.bias", L),
+        "attn_norm": _stack(sd, p + "sa_layer_norm.weight", L),
+        "attn_norm_b": _stack(sd, p + "sa_layer_norm.bias", L),
+        "w_up": _stack(sd, p + "ffn.lin1.weight", L, transpose=True),
+        "b_up": _stack(sd, p + "ffn.lin1.bias", L),
+        "w_down": _stack(sd, p + "ffn.lin2.weight", L, transpose=True),
+        "b_down": _stack(sd, p + "ffn.lin2.bias", L),
+        "mlp_norm": _stack(sd, p + "output_layer_norm.weight", L),
+        "mlp_norm_b": _stack(sd, p + "output_layer_norm.bias", L),
+    }
+    out = {
+        "embed": np.ascontiguousarray(
+            sd["distilbert.embeddings.word_embeddings.weight"], np.float32),
+        "pos_embed": np.ascontiguousarray(
+            sd["distilbert.embeddings.position_embeddings.weight"],
+            np.float32),
+        "embed_ln_w": np.ascontiguousarray(
+            sd["distilbert.embeddings.LayerNorm.weight"], np.float32),
+        "embed_ln_b": np.ascontiguousarray(
+            sd["distilbert.embeddings.LayerNorm.bias"], np.float32),
+        "layers": layers,
+        "mlm_transform_w": np.ascontiguousarray(
+            sd["vocab_transform.weight"].T, np.float32),
+        "mlm_transform_b": np.ascontiguousarray(
+            sd["vocab_transform.bias"], np.float32),
+        "mlm_ln_w": np.ascontiguousarray(
+            sd["vocab_layer_norm.weight"], np.float32),
+        "mlm_ln_b": np.ascontiguousarray(
+            sd["vocab_layer_norm.bias"], np.float32),
+        "mlm_bias": np.ascontiguousarray(
+            sd["vocab_projector.bias"], np.float32),
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head"] = np.ascontiguousarray(
+            sd["vocab_projector.weight"].T, np.float32)
+    return out
+
+
 def params_from_hf(state_dict: Dict[str, Any],
                    cfg: TransformerConfig,
                    model_type: str = "llama") -> Dict[str, Any]:
@@ -410,6 +487,8 @@ def params_from_hf(state_dict: Dict[str, Any],
         return _params_from_bert(sd, cfg)
     if model_type == "roberta":
         return _params_from_roberta(sd, cfg)
+    if model_type == "distilbert":
+        return _params_from_distilbert(sd, cfg)
     raise ValueError(f"unsupported model_type '{model_type}'")
 
 

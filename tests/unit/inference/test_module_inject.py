@@ -110,14 +110,7 @@ def test_bert_injection_matches_hf():
     hf = transformers.BertForMaskedLM(cfg).eval()
     _randomize_biases(hf, seed=6)
     ids_np = np.random.default_rng(6).integers(0, 96, (2, 11), dtype=np.int64)
-    model, params = load_hf_model(hf)
-    params = {k: jnp.asarray(v) if not isinstance(v, dict)
-              else {kk: jnp.asarray(vv) for kk, vv in v.items()}
-              for k, v in params.items()}
-    ours = np.asarray(model.forward_logits(params, jnp.asarray(ids_np)))
-    with torch.no_grad():
-        theirs = hf(torch.from_numpy(ids_np)).logits.float().numpy()
-    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+    _assert_logits_match(hf, ids_np)
 
 
 def test_roberta_injection_matches_hf():
@@ -134,14 +127,7 @@ def test_roberta_injection_matches_hf():
     hf = transformers.RobertaForMaskedLM(cfg).eval()
     _randomize_biases(hf, seed=7)
     ids_np = np.random.default_rng(7).integers(2, 96, (2, 10), dtype=np.int64)
-    model, params = load_hf_model(hf)
-    params = {k: jnp.asarray(v) if not isinstance(v, dict)
-              else {kk: jnp.asarray(vv) for kk, vv in v.items()}
-              for k, v in params.items()}
-    ours = np.asarray(model.forward_logits(params, jnp.asarray(ids_np)))
-    with torch.no_grad():
-        theirs = hf(torch.from_numpy(ids_np)).logits.float().numpy()
-    np.testing.assert_allclose(ours, theirs, rtol=2e-3, atol=2e-3)
+    _assert_logits_match(hf, ids_np)
 
 
 def test_opt_post_ln_rejected():
@@ -184,3 +170,33 @@ def test_unsupported_arch_raises():
 
     with pytest.raises(ValueError, match="unsupported"):
         config_from_hf(FakeCfg())
+
+
+def test_distilbert_injection_matches_hf():
+    """DistilBertForMaskedLM: BERT-style post-LN encoder without token
+    types; vocab_transform/vocab_layer_norm/vocab_projector MLM head."""
+    cfg = transformers.DistilBertConfig(
+        vocab_size=96, dim=32, hidden_dim=64, n_layers=2, n_heads=4,
+        max_position_embeddings=64, activation="gelu", dropout=0.0,
+        attention_dropout=0.0, sinusoidal_pos_embds=False)
+    torch.manual_seed(8)
+    hf = transformers.DistilBertForMaskedLM(cfg).eval()
+    _randomize_biases(hf, seed=8)
+    ids_np = np.random.default_rng(8).integers(0, 96, (2, 9), dtype=np.int64)
+    _assert_logits_match(hf, ids_np)
+
+
+def test_distilbert_untied_decoder_matches_hf():
+    """tie_word_embeddings=False must use the independent vocab_projector
+    weights, not word_embeddings.T (code-review r3: the converter once read
+    a nonexistent tie attribute and silently tied them)."""
+    cfg = transformers.DistilBertConfig(
+        vocab_size=96, dim=32, hidden_dim=64, n_layers=2, n_heads=4,
+        max_position_embeddings=64, activation="gelu", dropout=0.0,
+        attention_dropout=0.0, sinusoidal_pos_embds=False,
+        tie_word_embeddings=False)
+    torch.manual_seed(9)
+    hf = transformers.DistilBertForMaskedLM(cfg).eval()
+    _randomize_biases(hf, seed=9)
+    ids_np = np.random.default_rng(9).integers(0, 96, (1, 8), dtype=np.int64)
+    _assert_logits_match(hf, ids_np)
