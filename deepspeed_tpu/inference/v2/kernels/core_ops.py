@@ -1,0 +1,49 @@
+"""Inference v2 core ops — the reference's kernel surface as fused XLA.
+
+Reference counterpart: inference/v2/kernels/core_ops/ (bias_activations,
+gated_activations, blas_kernels, cuda_layer_norm, cuda_rms_norm — bound in
+core_ops.cpp). Those exist because torch eager launches one CUDA kernel per
+op; under jit XLA fuses each of these expressions into a single kernel, so
+the TPU implementation is the expression itself behind the same names. The
+norms additionally have real Pallas kernels (ops/norms.py) for the cases
+fusion cannot reach; attention-side kernels live in paged_attention.py and
+ops/flash_attention.py.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ....ops.norms import layer_norm, rms_norm  # noqa: F401 (re-export)
+
+_ACTS = {
+    "gelu": jax.nn.gelu,
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+    "identity": lambda x: x,
+}
+
+
+def bias_activation(x, bias=None, activation: str = "identity"):
+    """Fused bias add + activation (reference bias_activations kernel)."""
+    if bias is not None:
+        x = x + bias
+    return _ACTS[activation](x)
+
+
+def gated_activation(x, bias=None, activation: str = "silu"):
+    """Fused gated activation (reference gated_activations kernel):
+    x holds interleaved [gate, up] halves on the last dim —
+    act(gate) * up, the GEGLU/SwiGLU inference form."""
+    if bias is not None:
+        x = x + bias
+    gate, up = jnp.split(x, 2, axis=-1)
+    return _ACTS[activation](gate) * up
+
+
+def blas_linear(x, w, bias=None, out_dtype=None):
+    """GEMM + optional bias (reference blas_kernels wrapper): bf16 inputs
+    run the MXU at full rate with f32 accumulation."""
+    out = jnp.matmul(x, w, preferred_element_type=jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    return out.astype(out_dtype or x.dtype)

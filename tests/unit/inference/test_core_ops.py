@@ -1,0 +1,53 @@
+"""Inference v2 core-op surface (reference inference/v2/kernels/core_ops):
+numeric behavior of the fused XLA entry points."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.kernels.core_ops import (bias_activation,
+                                                         blas_linear,
+                                                         gated_activation,
+                                                         layer_norm,
+                                                         rms_norm)
+
+
+def test_bias_activation():
+    x = jnp.asarray([[-1.0, 0.0, 2.0]])
+    b = jnp.asarray([1.0, 1.0, 1.0])
+    np.testing.assert_allclose(
+        np.asarray(bias_activation(x, b, "relu")), [[0.0, 1.0, 3.0]])
+    np.testing.assert_allclose(
+        np.asarray(bias_activation(x, None, "identity")), np.asarray(x))
+
+
+def test_gated_activation_matches_swiglu():
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (4, 16), jnp.float32)
+    out = gated_activation(x, activation="silu")
+    gate, up = np.split(np.asarray(x), 2, axis=-1)
+    ref = gate / (1 + np.exp(-gate)) * up
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+
+def test_blas_linear_f32_accumulation():
+    rng = jax.random.PRNGKey(1)
+    x = jax.random.normal(rng, (8, 32), jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(2), (32, 16), jnp.bfloat16)
+    b = jax.random.normal(jax.random.PRNGKey(3), (16,), jnp.float32)
+    out = blas_linear(x, w, b)
+    assert out.dtype == jnp.bfloat16
+    ref = np.asarray(x, np.float32) @ np.asarray(w, np.float32) + np.asarray(b)
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_norm_reexports():
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 8), jnp.float32)
+    w = jnp.ones((8,))
+    out = rms_norm(x, w, 1e-6)
+    ref = np.asarray(x) / np.sqrt(
+        np.mean(np.asarray(x) ** 2, -1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+    assert layer_norm(x, w, None, 1e-6).shape == x.shape
