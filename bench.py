@@ -94,6 +94,37 @@ def _measure(cfg, micro, gas, steps, warmup, n_dev, zero_stage=None,
     return mfu, detail
 
 
+def build_trials(base):
+    """The on-chip mini-autotune ladder: (cfg, micro_batch, remat_policy)
+    tuples, most-promising first (the wall-clock budget truncates the
+    tail). Separated from main() so the construction is testable off-chip."""
+    import dataclasses
+
+    trials = []
+    for policy in ("save_dots_and_attn",
+                   "dots_with_no_batch_dims_saveable",
+                   "nothing_saveable"):
+        for use_flash in (True, False):
+            for micro in (16, 8):
+                trials.append((dataclasses.replace(
+                    base, use_flash=use_flash, flash_min_seq=2048),
+                    micro, policy))
+        # flash block-size variant (default auto is 256x512): bigger q
+        # blocks amortize the online-softmax bookkeeping further
+        trials.insert(2 if policy == "save_dots_and_attn" else len(trials),
+                      (dataclasses.replace(
+                          base, use_flash=True, flash_min_seq=2048,
+                          attn_block_q=512, attn_block_kv=512),
+                       16, policy))
+    # unchunked CE: skips the backward recompute of the [*, V] logits
+    # (~2HV per token, ~5% of step flops at vocab 32k) if the logits fit
+    # now that selective remat freed activation memory
+    trials.insert(3, (dataclasses.replace(
+        base, use_flash=True, flash_min_seq=2048, loss_chunk=0),
+        8, "save_dots_and_attn"))
+    return trials
+
+
 def main():
     import os
 
@@ -127,28 +158,7 @@ def main():
         # tag the flash forward re-runs in backward);
         # dots_with_no_batch_dims_saveable keeps matmul outputs only;
         # nothing_saveable is full per-layer recompute.
-        trials = []
-        for policy in ("save_dots_and_attn",
-                       "dots_with_no_batch_dims_saveable",
-                       "nothing_saveable"):
-            for use_flash in (True, False):
-                for micro in (16, 8):
-                    trials.append((dataclasses.replace(
-                        base, use_flash=use_flash, flash_min_seq=2048),
-                        micro, policy))
-            # flash block-size variant (default auto is 256x512): bigger q
-            # blocks amortize the online-softmax bookkeeping further
-            trials.insert(2 if policy == "save_dots_and_attn" else len(trials),
-                          (dataclasses.replace(
-                              base, use_flash=True, flash_min_seq=2048,
-                              attn_block_q=512, attn_block_kv=512),
-                           16, policy))
-        # unchunked CE: skips the backward recompute of the [*, V] logits
-        # (~2HV per token, ~5% of step flops at vocab 32k) if the logits fit
-        # now that selective remat freed activation memory
-        trials.insert(3, (dataclasses.replace(
-            base, use_flash=True, flash_min_seq=2048, loss_chunk=0),
-            8, "save_dots_and_attn"))
+        trials = build_trials(base)
         steps, warmup = 10, 2
     else:  # CPU smoke mode
         base = TransformerConfig(vocab_size=256, hidden_size=128,

@@ -1,0 +1,31 @@
+"""The bench mini-autotune ladder only ever CONSTRUCTS on a real chip;
+this pins its shape off-chip so edits can't silently break the autotune."""
+
+import sys
+
+
+def test_bench_trial_ladder_shape():
+    sys.path.insert(0, ".")
+    import bench
+    from deepspeed_tpu.models import TransformerConfig
+
+    base = TransformerConfig(vocab_size=32000, hidden_size=1024,
+                             intermediate_size=2816, num_layers=24,
+                             num_heads=8, max_seq_len=2048)
+    trials = bench.build_trials(base)
+    assert len(trials) == 16
+    # most promising first: selective remat + flash + biggest micro batch
+    cfg0, micro0, pol0 = trials[0]
+    assert (cfg0.use_flash, micro0, pol0) == (True, 16, "save_dots_and_attn")
+    # the block-size and unchunked-CE variants sit early in the ladder
+    assert any(t[0].attn_block_q == 512 for t in trials[:3])
+    assert any(t[0].loss_chunk == 0 for t in trials[:4])
+    # every policy gets at least one flash and one xla trial
+    for pol in ("save_dots_and_attn", "dots_with_no_batch_dims_saveable",
+                "nothing_saveable"):
+        mine = [t for t in trials if t[2] == pol]
+        assert any(t[0].use_flash for t in mine)
+        assert any(not t[0].use_flash for t in mine)
+    # ladder entries never mutate the base model geometry
+    assert all(t[0].hidden_size == base.hidden_size and
+               t[0].num_layers == base.num_layers for t in trials)
