@@ -20,6 +20,7 @@ adds:
 
 import json
 import os
+import re
 import shutil
 from typing import Any, Dict, Optional
 
@@ -28,6 +29,30 @@ import numpy as np
 from .state_checkpoint import SENTINEL_NONE, read_latest
 
 UNIVERSAL_SUBDIR = "zero_universal"
+
+# PipelineModule pipe-sharded storage stacks identical layers a..a+L-1 into
+# one [L, ...] tree under the key ``stack_{a:03d}`` (runtime/pipe/module.py)
+# — but WHICH runs stack depends on pp, so the universal format must not
+# contain stacked keys. Conversion splits them into canonical per-layer
+# fragments (``layer_{a+j:03d}/...``); loading re-stacks on demand when the
+# target topology's template asks for a stacked key.
+_STACK_COMPONENT = re.compile(r"stack_(\d+)")
+
+
+def _stacked_component(key: str):
+    """(component_index, first_layer) if the '/'-path contains a
+    PipelineModule stacked-storage component, else None."""
+    for idx, part in enumerate(key.split("/")):
+        m = _STACK_COMPONENT.fullmatch(part)
+        if m:
+            return idx, int(m.group(1))
+    return None
+
+
+def _per_layer_key(key: str, comp_idx: int, layer: int) -> str:
+    parts = key.split("/")
+    parts[comp_idx] = f"layer_{layer:03d}"
+    return "/".join(parts)
 
 
 def _native_ckpt_dir(path: str, tag: Optional[str] = None) -> Optional[str]:
@@ -58,13 +83,27 @@ def _from_native(ckpt_dir: str, output_dir: str) -> str:
     entry = manifest["tensors"].get("master_params")
     if entry in (None, SENTINEL_NONE):
         entry = manifest["tensors"]["params"]
+
+    def emit(key, arr, prefix, out):
+        """One fragment — splitting PipelineModule stacked storage into
+        canonical per-layer fragments so the universal dir is
+        pp-independent (the format's core promise)."""
+        stacked = _stacked_component(key)
+        if stacked is not None:
+            comp_idx, first = stacked
+            for j in range(arr.shape[0]):
+                emit(_per_layer_key(key, comp_idx, first + j), arr[j],
+                     prefix, out)
+            return
+        fname = f"{prefix}__{key.replace('/', '__')}.npy"
+        np.save(os.path.join(output_dir, fname), arr)
+        out[key] = {"file": fname, "shape": list(arr.shape),
+                    "dtype": str(arr.dtype)}
+
     out_entry: Dict[str, Any] = {}
     for key, info in entry.items():
         arr = np.load(os.path.join(ckpt_dir, info["file"])).astype(np.float32)
-        fname = f"param__{key.replace('/', '__')}.npy"
-        np.save(os.path.join(output_dir, fname), arr)
-        out_entry[key] = {"file": fname, "shape": list(arr.shape),
-                          "dtype": "float32"}
+        emit(key, arr, "param", out_entry)
     # optimizer moments ride along (reference ds_to_universal emits
     # exp_avg/exp_avg_sq fragments, ds_to_universal.py:254 area) so a
     # universal restore resumes optimization, not just weights. Original
@@ -74,10 +113,7 @@ def _from_native(ckpt_dir: str, output_dir: str) -> str:
     if opt not in (None, SENTINEL_NONE):
         for key, info in opt.items():
             arr = np.load(os.path.join(ckpt_dir, info["file"]))
-            fname = f"opt__{key.replace('/', '__')}.npy"
-            np.save(os.path.join(output_dir, fname), arr)
-            opt_entry[key] = {"file": fname, "shape": list(arr.shape),
-                              "dtype": str(arr.dtype)}
+            emit(key, arr, "opt", opt_entry)
     # the step counter MUST travel with the moments: Adam bias correction
     # divides by (1 - beta^step) — moments resumed at step 0 get amplified
     # ~1/(1-beta) on the first update. meta carries global_steps/lr state;
@@ -165,9 +201,25 @@ def load_universal_into_tree(universal_dir: str, template,
             .replace("'].", "/").replace("['", "").replace("']", "") \
             .replace(".", "/").replace("[", "/").replace("]", "")
         if key not in flat:
-            raise KeyError(f"universal checkpoint missing {key}; has "
-                           f"{sorted(flat)[:8]}...")
-        arr = flat[key]
+            # a pipe-stacked template key: re-stack the canonical
+            # per-layer fragments (the converse of _from_native's split)
+            stacked = _stacked_component(key)
+            if stacked is not None and hasattr(leaf, "shape"):
+                comp_idx, first = stacked
+                members = []
+                for j in range(leaf.shape[0]):
+                    lk = _per_layer_key(key, comp_idx, first + j)
+                    if lk not in flat:
+                        raise KeyError(
+                            f"universal checkpoint missing {lk} (for "
+                            f"stacked {key}); has {sorted(flat)[:8]}...")
+                    members.append(flat[lk])
+                arr = np.stack(members)
+            else:
+                raise KeyError(f"universal checkpoint missing {key}; has "
+                               f"{sorted(flat)[:8]}...")
+        else:
+            arr = flat[key]
         if hasattr(leaf, "dtype"):
             arr = arr.astype(leaf.dtype)
         leaves.append(arr)

@@ -28,20 +28,29 @@ from ..ops.quantizer import quantize_symmetric
 AxisNames = Union[str, Tuple[str, ...]]
 
 
-def shard_map_unchecked(f, mesh, in_specs, out_specs):
+def shard_map_unchecked(f, mesh, in_specs, out_specs, axis_names=None):
     """shard_map with the replication checker off: quantized collectives mix
     value-changing ops (round) with collectives, which the static
-    varying-mesh-axes analysis cannot see through."""
+    varying-mesh-axes analysis cannot see through.
+
+    axis_names: manual axes subset (partial-manual shard_map) — axes NOT
+    listed stay in auto/GSPMD mode, so e.g. tensor parallelism keeps its
+    compiler-inserted collectives inside the manual-DP program. None/empty
+    means fully manual.
+    """
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    manual = frozenset(axis_names) if axis_names else None
     try:
         from jax import shard_map as sm
-    except ImportError:  # older jax
+        kw = {"axis_names": manual} if manual else {}
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False, **kw)
+    except (ImportError, TypeError):  # older jax: auto= is the complement
         from jax.experimental.shard_map import shard_map as sm
-    try:
+        kw = ({"auto": frozenset(mesh.axis_names) - manual} if manual else {})
         return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_vma=False)
-    except TypeError:  # older keyword
-        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_rep=False)
+                  check_rep=False, **kw)
 
 
 def _axis_size(axes: AxisNames) -> jnp.ndarray:

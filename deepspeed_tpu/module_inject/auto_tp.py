@@ -72,7 +72,8 @@ def config_from_hf(hf_config) -> TransformerConfig:
         if not getattr(hf_config, "do_layer_norm_before", True):
             raise ValueError(
                 "OPT with do_layer_norm_before=False (OPT-350M) is post-LN; "
-                "the TransformerLM family is pre-LN only")
+                "post-LN is only supported for the MLM encoder family — "
+                "the causal decode/pipeline paths require pre-LN")
         if getattr(hf_config, "word_embed_proj_dim",
                    hf_config.hidden_size) != hf_config.hidden_size:
             raise ValueError(

@@ -42,6 +42,14 @@ class TopologyConfig:
     # MiCS (reference runtime/zero/mics.py:55): ZeRO states shard over a
     # sub-group of this size and replicate across groups; <=1 disables.
     mics_shard: int = 1
+    # ZeRO++ hpZ (reference partition_parameters.py:639 secondary tensors,
+    # zero/config.py:256-272): COMPUTE params keep a secondary partition
+    # within a group of this size (the fwd/bwd gather stays inside the
+    # group's fast links) while master/opt/grads shard over the full DP
+    # world; <=1 disables. Factors the data axis like MiCS but with the
+    # opposite replication: hpZ replicates params across groups, MiCS
+    # replicates optimizer states across groups.
+    hpz_shard: int = 1
 
 
 class MeshTopology:
@@ -67,12 +75,21 @@ class MeshTopology:
                 f"{n} devices not divisible by pipe*model*seq*expert={mp}")
         data = n // mp
         shard = 1
-        if topo.mics_shard and topo.mics_shard > 1:
-            if data % topo.mics_shard != 0:
+        if (topo.mics_shard and topo.mics_shard > 1
+                and topo.hpz_shard and topo.hpz_shard > 1):
+            raise ValueError(
+                "mics_shard_size and zero_hpz_partition_size both claim the "
+                "shard sub-axis with opposite replication semantics; enable "
+                "at most one")
+        group = max(topo.mics_shard or 1, topo.hpz_shard or 1)
+        if group > 1:
+            name = ("mics_shard_size" if topo.mics_shard > 1
+                    else "zero_hpz_partition_size")
+            if data % group != 0:
                 raise ValueError(
-                    f"mics_shard_size={topo.mics_shard} does not divide the "
+                    f"{name}={group} does not divide the "
                     f"data-parallel world of {data}")
-            shard = topo.mics_shard
+            shard = group
             data //= shard
         self.topo = topo
         self.sizes: Dict[str, int] = {
@@ -97,7 +114,17 @@ class MeshTopology:
 
     @property
     def mics_enabled(self) -> bool:
-        return self.sizes[SHARD_AXIS] > 1
+        return self.sizes[SHARD_AXIS] > 1 and self.topo.mics_shard > 1
+
+    @property
+    def hpz_enabled(self) -> bool:
+        return self.sizes[SHARD_AXIS] > 1 and self.topo.hpz_shard > 1
+
+    @property
+    def secondary_axes(self) -> Tuple[str, ...]:
+        """hpZ secondary-partition axes: compute params shard over only the
+        within-group sub-axis (fast links); master/grads span dp_axes."""
+        return (SHARD_AXIS,)
 
     @property
     def dp_axes(self) -> Tuple[str, ...]:
@@ -107,6 +134,8 @@ class MeshTopology:
         states replicate across the `data` (replica-group) axis, so XLA emits
         reduce-scatter within the group + all-reduce across groups, the MiCS
         comm pattern (reference runtime/zero/mics.py hierarchical collectives).
+        (hpZ also sizes the `shard` sub-axis, but its master/opt/grads span
+        the full world — only the compute-param placement narrows.)
         """
         if self.mics_enabled:
             return (SHARD_AXIS,)
@@ -156,6 +185,7 @@ def build_topology(config=None, devices=None, *, pipe=None, model=None, seq=None
             seq=seq or c.sequence_parallel_size,
             expert=expert or (c.moe.expert_parallel_size if c.moe.enabled else 1),
             mics_shard=max(c.zero_optimization.mics_shard_size, 1),
+            hpz_shard=max(c.zero_optimization.zero_hpz_partition_size, 1),
         )
     else:
         topo = TopologyConfig(pipe=pipe or 1, model=model or 1, seq=seq or 1,

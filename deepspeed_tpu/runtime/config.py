@@ -86,6 +86,18 @@ class ZeroConfig:
     def __post_init__(self):
         if self.stage not in (0, 1, 2, 3):
             raise ConfigError(f"zero_optimization.stage must be 0-3, got {self.stage}")
+        if self.zero_hpz_partition_size > 1 and self.stage != 3:
+            # hpZ is a stage-3 feature (secondary partition of the COMPUTE
+            # params; reference zero/config.py:256-272) — rejecting loudly
+            # beats silently no-op'ing the key
+            raise ConfigError(
+                f"zero_hpz_partition_size={self.zero_hpz_partition_size} "
+                f"requires zero stage 3 (got stage {self.stage})")
+        if self.zero_hpz_partition_size > 1 and self.mics_shard_size > 1:
+            raise ConfigError(
+                "zero_hpz_partition_size and mics_shard_size cannot be "
+                "combined: both partition over the shard sub-axis with "
+                "opposite replication semantics")
 
 
 @dataclass

@@ -56,6 +56,44 @@ def global_norm(tree):
     return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
 
 
+def unscale_clip_check(grads, inv, clip, fp16, frozen_mask=None):
+    """Shared gradient epilogue of every step variant: unscale by ``inv``
+    (1/(gas*loss_scale)), zero frozen leaves, global inf/nan check (on the
+    unclipped grads — clipping an inf produces nan and would hide it), and
+    grad-norm clipping. Returns (grads, finite, gnorm)."""
+    grads = jax.tree.map(lambda g: g * inv, grads)
+    if frozen_mask is not None:
+        # frozen leaves (reference requires_grad=False): zero their grads
+        # so moments/grad-norm stay clean
+        grads = jax.tree.map(
+            lambda g, f: jnp.zeros_like(g) if f else g, grads, frozen_mask)
+    finite = grads_finite(grads) if fp16 else jnp.asarray(True)
+    gnorm = global_norm(grads)
+    if clip and clip > 0:
+        factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+        grads = jax.tree.map(lambda g: g * factor, grads)
+    return grads, finite, gnorm
+
+
+def apply_update_with_skip(optimizer, target, grads, opt_state, step, lr,
+                           finite, frozen_mask=None):
+    """Optimizer update with the functional skip-step on overflow
+    (reference stage3.py:2018): non-finite grads leave target/opt/step
+    untouched; frozen leaves are restored (kills decoupled weight decay on
+    them too). Returns (new_target, new_opt, new_step)."""
+    new_target, new_opt = optimizer.apply(target, grads, opt_state,
+                                          step + 1, lr=lr)
+    if frozen_mask is not None:
+        new_target = jax.tree.map(
+            lambda n, o, f: o if f else n, new_target, target, frozen_mask)
+    new_target = jax.tree.map(
+        lambda n, o: jnp.where(finite, n, o), new_target, target)
+    new_opt = jax.tree.map(
+        lambda n, o: jnp.where(finite, n, o), new_opt, opt_state)
+    new_step = step + jnp.where(finite, 1, 0).astype(jnp.int32)
+    return new_target, new_opt, new_step
+
+
 class DeepSpeedTpuEngine:
     """Training engine over a device mesh.
 
@@ -208,7 +246,9 @@ class DeepSpeedTpuEngine:
         self.zero_plan: ZeroPlan = build_zero_plan(
             self.topology, self.zero_stage, shapes, base_specs,
             persistence_threshold=(zc.stage3_param_persistence_threshold
-                                   if self.zero_stage == 3 else 0))
+                                   if self.zero_stage == 3 else 0),
+            secondary_axes=(self.topology.secondary_axes
+                            if self.topology.hpz_enabled else None))
         # widen the layer-scan scheduling window so stage-3 param gathers
         # overlap the previous layer's compute (the scan iteration boundary
         # otherwise serializes them; see TransformerConfig.scan_unroll).
@@ -422,33 +462,12 @@ class DeepSpeedTpuEngine:
                 (grads, rng), losses = jax.lax.scan(micro_fn, (grads0, rng), batch)
                 loss = jnp.mean(losses)
                 inv = 1.0 / (gas * scale)
-            grads = jax.tree.map(lambda g: g * inv, grads)
-            if frozen_mask is not None:
-                # frozen leaves (reference requires_grad=False): zero their
-                # grads so moments/grad-norm stay clean; the post-update
-                # restore below also kills decoupled weight decay on them
-                grads = jax.tree.map(
-                    lambda g, f: jnp.zeros_like(g) if f else g,
-                    grads, frozen_mask)
-
-            finite = grads_finite(grads) if fp16 else jnp.asarray(True)
-            gnorm = global_norm(grads)
-            if clip and clip > 0:
-                factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-                grads = jax.tree.map(lambda g: g * factor, grads)
-
+            grads, finite, gnorm = unscale_clip_check(
+                grads, inv, clip, fp16, frozen_mask)
             target = master if has_master else params
-            new_target, new_opt = optimizer.apply(target, grads, opt_state,
-                                                  step + 1, lr=lr)
-            if frozen_mask is not None:
-                new_target = jax.tree.map(
-                    lambda n, o, f: o if f else n, new_target, target,
-                    frozen_mask)
-            # functional skip-step on overflow (reference stage3.py:2018)
-            new_target = jax.tree.map(
-                lambda n, o: jnp.where(finite, n, o), new_target, target)
-            new_opt = jax.tree.map(
-                lambda n, o: jnp.where(finite, n, o), new_opt, opt_state)
+            new_target, new_opt, new_step = apply_update_with_skip(
+                optimizer, target, grads, opt_state, step, lr, finite,
+                frozen_mask)
 
             if has_master:
                 new_master = new_target
@@ -463,7 +482,6 @@ class DeepSpeedTpuEngine:
                 new_scale_state = update_scale(scale_state, finite, scale_cfg)
             else:
                 new_scale_state = scale_state
-            new_step = step + jnp.where(finite, 1, 0).astype(jnp.int32)
             metrics = {
                 "loss": loss,
                 "grad_norm": gnorm,
@@ -667,13 +685,8 @@ class DeepSpeedTpuEngine:
             grads0 = constrain(grads0, grad_sh)
             (grads, rng), losses = jax.lax.scan(micro_fn, (grads0, rng), batch)
             loss = jnp.mean(losses)
-            grads = jax.tree.map(lambda g: g / (gas * scale), grads)
-
-            finite = grads_finite(grads) if fp16 else jnp.asarray(True)
-            gnorm = global_norm(grads)
-            if clip and clip > 0:
-                factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-                grads = jax.tree.map(lambda g: g * factor, grads)
+            grads, finite, gnorm = unscale_clip_check(
+                grads, 1.0 / (gas * scale), clip, fp16)
             grads = jax.tree.map(lambda g: g.astype(transfer_dtype), grads)
             new_scale_state = (update_scale(scale_state, finite, scale_cfg)
                                if fp16 else scale_state)
@@ -917,24 +930,31 @@ class DeepSpeedTpuEngine:
         return self._fwd_jit(self.params, self._model_rng, micro)
 
     def backward(self, loss=None):
-        """Compat: accumulate grads for the cached microbatch."""
+        """Compat: accumulate grads for the cached microbatch.
+
+        fp16: grads are of the SCALED loss (reference FP16_Optimizer
+        scales inside backward, fp16/loss_scaler.py:91); step() unscales
+        and overflow-checks at the GAS boundary.
+        """
         if not self._cached_batches:
             raise RuntimeError("backward() without forward()")
         batch = self._cached_batches.pop(0)
         sh = self.topology.batch_sharding()
         micro = jax.tree.map(lambda x: jax.device_put(np.asarray(x), sh), batch)
         if not hasattr(self, "_grad_jit"):
-            def gradfn(params, rng, m):
+            def gradfn(params, rng, scale, m):
                 def lf(p):
                     out = self.model.apply(p, m, train=True, rng=rng)
                     l, _ = _split_loss_aux(out)
-                    return l.astype(jnp.float32)
+                    return l.astype(jnp.float32) * scale
                 return jax.grad(lf)(params)
             self._grad_jit = jax.jit(
                 gradfn,
-                in_shardings=(self.zero_plan.param_sharding, None, None),
+                in_shardings=(self.zero_plan.param_sharding, None, None, None),
                 out_shardings=self.zero_plan.grad_sharding)
-        g = self._grad_jit(self.params, self._model_rng, micro)
+        scale = (self.scale_state["loss_scale"] if self.fp16_enabled
+                 else jnp.asarray(1.0, jnp.float32))
+        g = self._grad_jit(self.params, self._model_rng, scale, micro)
         if self._grad_buffer is None:
             self._grad_buffer = g
         else:
@@ -943,41 +963,58 @@ class DeepSpeedTpuEngine:
         self.micro_steps += 1
 
     def step(self):
-        """Compat: apply accumulated grads (at GAS boundary)."""
+        """Compat: apply accumulated grads (at GAS boundary).
+
+        Mirrors the train_batch path: unscale by gas*loss_scale, global
+        inf/nan check, functional skip-step on overflow, scale-state
+        update, and host bookkeeping (global_steps / lr_scheduler) gated
+        on the skip flag (reference stage3.py:2018).
+        """
         if self._grad_buffer is None:
             raise RuntimeError("step() without backward()")
         if not hasattr(self, "_apply_jit"):
             optimizer, lr_fn, gas = self.optimizer, self._lr_fn, self.gas
             has_master, compute_dtype = self.has_master, self.compute_dtype
             clip = self.config.gradient_clipping
+            fp16 = self.fp16_enabled
+            scale_cfg = self.scale_cfg
 
-            def apply(params, master, opt_state, step, grads):
-                grads = jax.tree.map(lambda g: g / gas, grads)
-                gnorm = global_norm(grads)
-                if clip and clip > 0:
-                    factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-                    grads = jax.tree.map(lambda g: g * factor, grads)
+            def apply(params, master, opt_state, scale_state, step, grads):
+                scale = (scale_state["loss_scale"] if fp16
+                         else jnp.asarray(1.0, jnp.float32))
+                grads, finite, _gnorm = unscale_clip_check(
+                    grads, 1.0 / (gas * scale), clip, fp16)
                 target = master if has_master else params
-                new_target, new_opt = optimizer.apply(target, grads, opt_state,
-                                                      step + 1, lr=lr_fn(step))
+                new_target, new_opt, new_step = apply_update_with_skip(
+                    optimizer, target, grads, opt_state, step, lr_fn(step),
+                    finite)
+                new_scale_state = (update_scale(scale_state, finite, scale_cfg)
+                                   if fp16 else scale_state)
+                skipped = (~finite).astype(jnp.int32)
                 if has_master:
-                    new_params = jax.tree.map(lambda x: x.astype(compute_dtype), new_target)
-                    return new_params, new_target, new_opt, step + 1
-                return new_target, None, new_opt, step + 1
+                    new_params = jax.tree.map(
+                        lambda x: x.astype(compute_dtype), new_target)
+                    return (new_params, new_target, new_opt, new_scale_state,
+                            new_step, skipped)
+                return (new_target, None, new_opt, new_scale_state, new_step,
+                        skipped)
 
             self._apply_jit = jax.jit(
                 apply,
                 out_shardings=(self.zero_plan.param_sharding,
                                self.zero_plan.master_sharding if self.has_master else None,
-                               None, None),
+                               None, None, None, None),
                 donate_argnums=(0, 1, 2))
-        (self.params, self.master_params, self.opt_state,
-         self._step_arr) = self._apply_jit(self.params, self.master_params,
-                                           self.opt_state, self._step_arr,
-                                           self._grad_buffer)
+        (self.params, self.master_params, self.opt_state, self.scale_state,
+         self._step_arr, skipped) = self._apply_jit(
+            self.params, self.master_params, self.opt_state, self.scale_state,
+            self._step_arr, self._grad_buffer)
         self._grad_buffer = None
-        self.global_steps += 1
-        self.lr_scheduler.step()
+        skipped = int(skipped)
+        self.skipped_steps += skipped
+        if not skipped:
+            self.global_steps += 1
+            self.lr_scheduler.step()
 
     def is_gradient_accumulation_boundary(self) -> bool:
         return self.micro_steps % self.gas == 0
@@ -1113,11 +1150,29 @@ class DeepSpeedTpuEngine:
                                             load_universal_into_tree)
         shapes = jax.eval_shape(self.model.init_params, jax.random.PRNGKey(0))
         host_tree = load_universal_into_tree(universal_dir, shapes)
+        extras = load_universal_extras(universal_dir)
+
+        def restore_scale_state():
+            # fp16 loss scale is a property of the WEIGHTS' magnitude —
+            # topology- and optimizer-independent — so it restores whenever
+            # the weights do (a reset scale would overflow-and-skip the
+            # first resumed steps). Runs only AFTER the weights are applied
+            # so a failed load can never leave the engine half-restored.
+            # Merge over the initialized dict: a manifest missing a key
+            # keeps the default instead of KeyError-ing later.
+            if self.scale_state is not None and extras.get("scale_state"):
+                restored = {
+                    k: jnp.asarray(v, self.scale_state[k].dtype)
+                    for k, v in extras["scale_state"].items()
+                    if k in self.scale_state}
+                self.scale_state = {**self.scale_state, **restored}
+
         if self.offload_device:
             leaves = [np.asarray(l, np.float32)
                       for l in jax.tree.leaves(host_tree)]
             self.host_opt.load_leaves(leaves, None)
             self._push_host_params(self.host_opt.current_bf16_leaves())
+            restore_scale_state()
             if has_universal_opt_state(universal_dir):
                 logger.warning(
                     "universal checkpoint carries optimizer state, but the "
@@ -1137,6 +1192,7 @@ class DeepSpeedTpuEngine:
                 lambda a, s: jax.device_put(
                     np.asarray(a).astype(self.compute_dtype), s.sharding),
                 host_tree, self.params)
+        restore_scale_state()
         if self.opt_state is not None and has_universal_opt_state(universal_dir):
             # moments ride the universal format too (reference emits
             # exp_avg/exp_avg_sq fragments): restore so the optimizer
@@ -1144,6 +1200,11 @@ class DeepSpeedTpuEngine:
             # tree / shapes) falls back to weights-only — and the fallback
             # must be ATOMIC: validate everything before mutating anything,
             # so a mismatch can never leave the engine half-restored.
+            # The step counter + schedule state travel WITH the moments as
+            # one unit: Adam bias correction at step 0 would amplify
+            # restored moments, and conversely fresh moments under a
+            # late-schedule LR would mis-train — and splitting them would
+            # break the host/device invariant global_steps == _step_arr.
             try:
                 opt_host = load_universal_into_tree(
                     universal_dir, self.opt_state, section="opt_state")
@@ -1161,13 +1222,11 @@ class DeepSpeedTpuEngine:
             except KeyError as exc:
                 logger.warning(
                     f"universal checkpoint optimizer state does not match "
-                    f"this optimizer ({exc}); restored weights only")
+                    f"this optimizer ({exc}); restored weights only — the "
+                    f"step counter and LR schedule restart at 0")
             else:
                 self.opt_state = new_opt
-                extras = load_universal_extras(universal_dir)
                 if extras.get("step") is not None:
-                    # the step counter must travel with the moments: Adam
-                    # bias correction at step 0 would amplify them
                     self._step_arr = jnp.asarray(extras["step"], jnp.int32)
                 meta = extras.get("meta", {})
                 if "global_steps" in meta:
@@ -1175,11 +1234,11 @@ class DeepSpeedTpuEngine:
                     self.skipped_steps = meta.get("skipped_steps", 0)
                     self._batches_seen = meta.get("batches_seen",
                                                   self.global_steps)
-                if self.scale_state is not None and extras.get("scale_state"):
-                    self.scale_state = {
-                        k: jnp.asarray(v, self.scale_state[k].dtype)
-                        for k, v in extras["scale_state"].items()
-                        if k in self.scale_state}
+                    if extras.get("step") is None:
+                        # older manifest without a step fragment: keep the
+                        # device counter in lockstep with the host counter
+                        self._step_arr = jnp.asarray(self.global_steps,
+                                                     jnp.int32)
                 if "lr_scheduler" in meta:
                     try:
                         self.lr_scheduler.load_state_dict(

@@ -17,11 +17,16 @@ Design departures from the reference, driven by XLA/SPMD:
   * One compiled program runs on every device; each stage executes its own
     contiguous layer slice via lax.switch on the pipe-axis index (the
     reference builds a different torch module per rank).
-  * Parameter STORAGE is replicated over the pipe axis (stage-sliced
-    storage would make the per-device param structure heterogeneous, which
-    SPMD cannot express); parameter-memory scaling comes from ZeRO sharding
-    over the data axes, which composes orthogonally. Compute is still
-    stage-local: only the owning stage's branch touches a layer.
+  * Parameter STORAGE: maximal runs of structurally identical LayerSpecs
+    whose balanced partition gives every stage an equal count are STACKED
+    into one [pp*k, ...] tree sharded over the pipe axis — each stage
+    stores only its own k layers, giving the per-stage parameter-memory
+    scaling of the reference's per-stage modules
+    (runtime/pipe/module.py:370) without heterogeneous SPMD structure.
+    Heterogeneous and tied layers stay replicated over pipe (SPMD cannot
+    express per-device structure); their memory scaling comes from ZeRO
+    sharding over the data axes, which composes orthogonally. Compute is
+    always stage-local: only the owning stage's branch touches a layer.
   * Inter-stage activations must share ONE shape/dtype (the reference
     pre-allocates fixed p2p buffers per num_pipe_buffers the same way,
     schedule.py:247). Stage 0 consumes the raw microbatch input directly.
@@ -168,43 +173,125 @@ class PipelineModule:
     def _param_key(self, i: int) -> str:
         return f"layer_{i:03d}"
 
+    def _stack_key(self, a: int) -> str:
+        return f"stack_{a:03d}"
+
+    def _pp(self) -> int:
+        if self.topology is None:
+            return 1
+        return self.topology.axis_size(PIPE_AXIS)
+
+    def _spec_identity(self, i: int):
+        """Comparable identity of layer i for stacking, or None if the
+        layer can never stack (tied, or an already-built object whose
+        construction we cannot verify)."""
+        s = self.specs[i]
+        if not isinstance(s, LayerSpec) or isinstance(s, TiedLayerSpec):
+            return None
+        return (s.typename, s.args, s.kwargs)
+
+    def _stack_plan(self, pp: int) -> Dict[int, tuple]:
+        """{run_start a: (a, b, k)} for every maximal run of identical
+        LayerSpecs [a, b) that the balanced partition splits into an EQUAL
+        count k per stage — those runs are stored stacked [pp*k, ...] and
+        sharded over the pipe axis (per-stage parameter-memory scaling,
+        reference pipe/module.py:370 per-stage modules)."""
+        if pp <= 1:
+            return {}
+        bounds = self.stage_bounds(pp)
+        n = len(self.specs)
+        plan: Dict[int, tuple] = {}
+        i = 0
+        while i < n:
+            ident = self._spec_identity(i)
+            if ident is None:
+                i += 1
+                continue
+            j = i + 1
+            while j < n:
+                try:
+                    same = self._spec_identity(j) == ident
+                except Exception:
+                    same = False
+                if not same:
+                    break
+                j += 1
+            counts = [max(0, min(j, bounds[s + 1]) - max(i, bounds[s]))
+                      for s in range(pp)]
+            k = counts[0]
+            if k > 0 and all(c == k for c in counts):
+                plan[i] = (i, j, k)
+            i = j
+        return plan
+
+    def _run_of(self, plan: Dict[int, tuple], i: int):
+        for a, (a0, b, k) in plan.items():
+            if a0 <= i < b:
+                return (a0, k)
+        return None
+
     def init_params(self, rng):
+        plan = self._stack_plan(self._pp())
         params: Dict[str, Any] = {}
         tied: Dict[str, Any] = {}
+        members: Dict[int, list] = {a: [] for a in plan}
         for i, layer in enumerate(self.layers):
             rng, sub = jax.random.split(rng)
             if i in self.tied_keys:
                 key = self.tied_keys[i]
                 if key not in tied:  # first occurrence owns the params
                     tied[key] = layer.init(sub)
+                continue
+            run = self._run_of(plan, i)
+            if run is not None:
+                members[run[0]].append(layer.init(sub))
             else:
                 params[self._param_key(i)] = layer.init(sub)
+        for a, ms in members.items():
+            params[self._stack_key(a)] = jax.tree.map(
+                lambda *xs: jnp.stack(xs), *ms)
         if tied:
             params["tied"] = tied
         return params
 
+    def _layer_spec_for(self, i: int, topo):
+        layer = self.layers[i]
+        if hasattr(layer, "partition_spec"):
+            return layer.partition_spec(topo)
+        tpl = jax.eval_shape(layer.init, jax.random.PRNGKey(0))
+        return jax.tree.map(lambda _: P(), tpl)
+
     def param_partition_specs(self, topo):
         """Per-layer TP specs if a layer provides them; otherwise
-        replicated. The pipe axis never appears: storage is replicated
-        over pipe by design (see module docstring)."""
-        def spec_for(i, layer):
-            if hasattr(layer, "partition_spec"):
-                return layer.partition_spec(topo)
-            tpl = jax.eval_shape(layer.init, jax.random.PRNGKey(0))
-            return jax.tree.map(lambda _: P(), tpl)
-
+        replicated. Stacked runs get the pipe axis on their leading
+        (layer) dim; everything else is replicated over pipe."""
+        plan = self._stack_plan(self._pp())
         specs: Dict[str, Any] = {}
         tied: Dict[str, Any] = {}
         for i, layer in enumerate(self.layers):
             if i in self.tied_keys:
                 key = self.tied_keys[i]
                 if key not in tied:
-                    tied[key] = spec_for(i, layer)
+                    tied[key] = self._layer_spec_for(i, topo)
+                continue
+            run = self._run_of(plan, i)
+            if run is not None:
+                if i == run[0]:  # representative member carries the spec
+                    specs[self._stack_key(i)] = jax.tree.map(
+                        lambda s: P(PIPE_AXIS, *s),
+                        self._layer_spec_for(i, topo))
             else:
-                specs[self._param_key(i)] = spec_for(i, layer)
+                specs[self._param_key(i)] = self._layer_spec_for(i, topo)
         if tied:
             specs["tied"] = tied
         return specs
+
+    def pipe_grad_reduce_mask(self, params):
+        """False for pipe-sharded (stacked) leaves — their local gradient
+        is already complete — True (psum over pipe) for replicated/tied
+        leaves (pipeline_1f1b pipe_reduce_mask)."""
+        return {k: jax.tree.map(lambda _: not k.startswith("stack_"), v)
+                for k, v in params.items()}
 
     # -- partitioning (reference _partition_layers, pipe/module.py:370) ----
     def _layer_weights(self) -> List[float]:
@@ -234,29 +321,48 @@ class PipelineModule:
             self._bounds = partition_balanced(self._layer_weights(), pp)
         return self._bounds
 
-    def _layer_params(self, params, i):
+    def _layer_params(self, params, i, plan=None, local_base=None):
+        """Params of layer i. For a stacked run member, index the stacked
+        leaf: with ``local_base`` (inside the pipeline, where the leaf is
+        this stage's local [k, ...] shard) the index is i - local_base;
+        otherwise the leaf is global [pp*k, ...] and the index is i - a."""
         if i in self.tied_keys:
             return params["tied"][self.tied_keys[i]]
+        run = self._run_of(plan, i) if plan else None
+        if run is not None:
+            a, _k = run
+            j = i - (local_base if local_base is not None else a)
+            return jax.tree.map(lambda t: t[j],
+                                params[self._stack_key(a)])
         return params[self._param_key(i)]
 
-    def _apply_layer(self, params, i, x):
+    def _apply_layer(self, params, i, x, plan=None, local_base=None):
         spec = self.specs[i]
+        p = self._layer_params(params, i, plan, local_base)
         if isinstance(spec, TiedLayerSpec) and spec.forward_fn is not None:
-            return spec.forward_fn(self._layer_params(params, i), x)
-        return self.layers[i].apply(self._layer_params(params, i), x)
+            return spec.forward_fn(p, x)
+        return self.layers[i].apply(p, x)
 
     def _stage_branches(self, pp: int):
         bounds = self.stage_bounds(pp)
+        # the plan follows STORAGE, which follows the topology at
+        # init_params time — not the pp argument (a caller building
+        # branches without a topology gets replicated-storage branches)
+        plan = self._stack_plan(self._pp())
 
-        def make_branch(lo, hi, is_first):
+        def make_branch(s, lo, hi, is_first):
             def branch(params, x_raw, h):
                 x = x_raw if is_first else h
                 for i in range(lo, hi):
-                    x = self._apply_layer(params, i, x)
+                    run = self._run_of(plan, i)
+                    # local shard of run (a,b,k) holds members
+                    # [a + s*k, a + (s+1)*k) — index relative to a + s*k
+                    base = (run[0] + s * run[1]) if run is not None else None
+                    x = self._apply_layer(params, i, x, plan, base)
                 return x
             return branch
 
-        return [make_branch(bounds[s], bounds[s + 1], s == 0)
+        return [make_branch(s, bounds[s], bounds[s + 1], s == 0)
                 for s in range(pp)]
 
     # -- execution ---------------------------------------------------------
@@ -283,10 +389,13 @@ class PipelineModule:
             # head) are ordinary layers in the list
             return self.loss_fn(out, dict(zip(rest_keys, largs)))
 
+        reduce_mask = self.pipe_grad_reduce_mask(params)
+
         def body(p, x_l, *rest_l):
             return pipeline_1f1b(branches, loss_fn, p, x_l, pp,
                                  h_spec=self.activation_spec,
-                                 loss_args=rest_l, dp_axes=dp_axes)
+                                 loss_args=rest_l, dp_axes=dp_axes,
+                                 pipe_reduce_mask=reduce_mask)
 
         sm = jax.shard_map(
             body, mesh=topo.mesh,
@@ -297,8 +406,9 @@ class PipelineModule:
 
     def apply(self, params, batch, train: bool = True, rng=None):
         """Loss without the pipeline schedule (eval / non-pp fallback):
-        every device runs the full layer stack — parameters are replicated
-        over pipe, so this is legal — with TP collectives intact."""
+        every device runs the full layer stack with TP collectives intact.
+        Pipe-sharded (stacked) runs are all-gathered over the pipe axis
+        first — eval is not the memory-critical path."""
         topo = self.topology
         x, rest_keys, rest = self._split_batch(batch)
         if self.input_ndim is not None and x.ndim == self.input_ndim:
@@ -319,12 +429,20 @@ class PipelineModule:
         batch_spec = P(None, bt)
         param_specs = self.param_partition_specs(topo)
         dp_axes = topo.dp_axes
+        plan = self._stack_plan(self._pp())
 
         def body(p, x_l, *rest_l):
+            if plan:
+                p = {k: (jax.tree.map(
+                        lambda t: jax.lax.all_gather(t, PIPE_AXIS, axis=0,
+                                                     tiled=True), v)
+                         if k.startswith("stack_") else v)
+                     for k, v in p.items()}
+
             def one(m):
                 h = x_l[m]
                 for i in range(len(self.layers)):
-                    h = self._apply_layer(p, i, h)
+                    h = self._apply_layer(p, i, h, plan)
                 return self.loss_fn(h, dict(zip(rest_keys,
                                                 (r[m] for r in rest_l))))
             M = x_l.shape[0]

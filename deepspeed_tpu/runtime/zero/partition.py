@@ -104,13 +104,18 @@ def build_zero_plan(topo: MeshTopology,
                     stage: int,
                     param_shapes,
                     base_specs=None,
-                    persistence_threshold: int = 0) -> ZeroPlan:
+                    persistence_threshold: int = 0,
+                    secondary_axes=None) -> ZeroPlan:
     """Construct the sharding plan for a given ZeRO stage.
 
     `param_shapes`: pytree of jax.ShapeDtypeStruct (or arrays).
     `base_specs`: optional pytree of PartitionSpec carrying TP/EP placement
     (the reference takes TP from an external mpu, engine.py:94; here the model
     supplies specs and ZeRO composes with them).
+    `secondary_axes`: ZeRO++ hpZ (reference partition_parameters.py:639
+    secondary tensors): stage-3 COMPUTE params shard over these axes only
+    (the within-group sub-axis) while master/opt/grads keep the full
+    `dp_axes` shard — the fwd/bwd gather then stays inside the group.
     """
     mesh = topo.mesh
     zero_axes = topo.dp_axes
@@ -119,10 +124,12 @@ def build_zero_plan(topo: MeshTopology,
     if base_specs is None:
         base_specs = jax.tree.map(lambda _: P(), param_shapes)
 
-    def spec_of(threshold):
+    def spec_of(threshold, axes=None):
+        axes = axes if axes is not None else zero_axes
+
         def fn(leaf, base):
             shape = leaf.shape if hasattr(leaf, "shape") else tuple(leaf)
-            return add_zero_axes(shape, base, zero_axes, zero_size,
+            return add_zero_axes(shape, base, axes, zero_size,
                                  threshold=threshold, axis_sizes=topo.sizes)
         return fn
 
@@ -130,8 +137,9 @@ def build_zero_plan(topo: MeshTopology,
     # stage-3 *compute* params below the persistence threshold stay gathered
     # (parameter_offload.py persistent params) — their master is still sharded.
     opt_specs = jax.tree.map(spec_of(0), param_shapes, base_specs)
-    param3_specs = jax.tree.map(spec_of(persistence_threshold), param_shapes,
-                                base_specs)
+    param3_specs = jax.tree.map(
+        spec_of(persistence_threshold, axes=secondary_axes), param_shapes,
+        base_specs)
 
     def ns(spec_tree):
         return jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,

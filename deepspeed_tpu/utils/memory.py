@@ -7,6 +7,7 @@ absent on the CPU test backend) plus host RSS via ``resource``.
 """
 
 import resource
+import sys
 from typing import Optional
 
 import jax
@@ -41,7 +42,9 @@ def see_memory_usage(message: str, force: bool = False,
     used = stats.get("bytes_in_use", 0) / gib
     peak = stats.get("peak_bytes_in_use", 0) / gib
     limit = stats.get("bytes_limit", 0) / gib
-    host_rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024 ** 2
+    # ru_maxrss is KiB on Linux but bytes on macOS
+    rss_div = 1024 ** 3 if sys.platform == "darwin" else 1024 ** 2
+    host_rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / rss_div
     out = {"device_used_gb": round(used, 3),
            "device_peak_gb": round(peak, 3),
            "device_limit_gb": round(limit, 3),

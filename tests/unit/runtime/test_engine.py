@@ -167,6 +167,42 @@ def test_fp16_overflow_keeps_host_and_device_steps_in_sync():
     assert engine.global_steps >= 1
 
 
+def test_fp16_overflow_compat_path():
+    """The forward/backward/step compat path must honor fp16 loss scaling
+    the same way train_batch does: backward() scales the loss (reference
+    FP16_Optimizer.backward, fp16/loss_scaler.py:91), step() overflow-checks
+    and a skipped step advances neither global_steps nor the scheduler."""
+    cfg = base_config(micro=2, gas=1, stage=0, dtype="fp16", lr=1e-2)
+    cfg["fp16"].update({"initial_scale_power": 32, "hysteresis": 1})
+    cfg["scheduler"] = {"type": "WarmupLR",
+                        "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-2,
+                                   "warmup_num_steps": 100}}
+    model = SimpleModel(hidden_dim=HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    batch = random_batches(1, gm, HIDDEN)[0]
+    sched_before = engine.lr_scheduler.state_dict()
+    params_before = np.asarray(
+        jax.device_get(jax.tree.leaves(engine.params)[0]), np.float32).copy()
+    engine.forward(batch)
+    engine.backward()
+    engine.step()
+    # scale 2^32 overflowed fp16 grads: step skipped, nothing advanced
+    assert engine.skipped_steps >= 1
+    assert engine.global_steps == int(engine._step_arr) == 0
+    assert engine.lr_scheduler.state_dict() == sched_before
+    params_after = np.asarray(
+        jax.device_get(jax.tree.leaves(engine.params)[0]), np.float32)
+    np.testing.assert_array_equal(params_before, params_after)
+    # the scale halved; subsequent finite steps advance both counters
+    for _ in range(30):
+        engine.forward(batch)
+        engine.backward()
+        engine.step()
+        assert engine.global_steps == int(engine._step_arr)
+    assert engine.global_steps >= 1
+
+
 def test_frozen_params_not_updated():
     """SimpleFrozenModel (reference simple_model.py:37): frozen leaves stay
     bit-identical through training — gradient updates AND decoupled weight
