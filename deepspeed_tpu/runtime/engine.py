@@ -363,10 +363,14 @@ class DeepSpeedTpuEngine:
         zpp_g = zc.zero_quantized_gradients and self.zero_stage >= 2
         use_zeropp = zpp_w or zpp_g
         if use_zeropp:
-            for ax in ("model", "seq", "expert", "pipe"):
+            # tensor parallelism composes (the quantized-collective program
+            # is manual over the DP axes only; GSPMD keeps inserting the TP
+            # collectives on the auto "model" axis). seq/expert/pipe would
+            # need manual programs of their own inside the shard_map.
+            for ax in ("seq", "expert", "pipe"):
                 assert self.topology.axis_size(ax) == 1, \
-                    f"ZeRO++ quantized collectives require pure data " \
-                    f"parallelism (got {ax} size {self.topology.axis_size(ax)})"
+                    f"ZeRO++ quantized collectives compose with dp/tp only " \
+                    f"(got {ax} size {self.topology.axis_size(ax)})"
             zeropp_grad_fn = self._make_zeropp_grad_fn(zpp_w, zpp_g)
 
         pipeline_mode = self.topology.axis_size("pipe") > 1
@@ -551,6 +555,14 @@ class DeepSpeedTpuEngine:
         plan = self.zero_plan
         stage3 = self.zero_stage == 3
         model = self.model
+        # hpZ (reference partition_parameters.py:639 secondary tensors):
+        # params are stored secondary-sharded (within-group axis only), so
+        # the fwd/bwd gather traverses the group's fast links; gradients
+        # still reduce over the full DP world (group mean in the gather's
+        # VJP, then a cross-group mean in finalize).
+        hpz = stage3 and self.topology.hpz_enabled
+        gather_axes = self.topology.secondary_axes if hpz else axes
+        cross_group_axes = tuple(a for a in axes if a not in gather_axes)
 
         param_specs = jax.tree.map(lambda ns: ns.spec, plan.param_sharding)
         grad_specs = jax.tree.map(lambda ns: ns.spec, plan.grad_sharding)
@@ -567,7 +579,7 @@ class DeepSpeedTpuEngine:
         grad_dims = jax.tree.map(dim_of, grad_specs)
         identity = lambda x: x  # noqa: E731
         gather_fns = jax.tree.map(
-            lambda d: (make_zero3_gather(d, axes, fwd_quantized=zpp_w,
+            lambda d: (make_zero3_gather(d, gather_axes, fwd_quantized=zpp_w,
                                          bwd_quantized=zpp_g)
                        if stage3 and d >= 0 else identity),
             param_dims)
@@ -603,10 +615,22 @@ class DeepSpeedTpuEngine:
                                                 batch_l)
 
             def finalize(g, gd, pd):
+                # pd >= 0 MUST be checked before gd < 0: under hpZ a dim
+                # can divide the small group but not the full world
+                # (pd >= 0, gd < 0), and its cotangent was already
+                # reduce-scattered over the shard axis by the gather's VJP
+                # — a pmean over that axis would average different shard
+                # halves into corrupt gradients
+                if stage3 and pd >= 0:
+                    # the gather's VJP reduced over gather_axes; hpZ still
+                    # owes the cross-group mean (grads stay
+                    # secondary-sharded, replicated across groups — the
+                    # engine re-shards them onto the full-world grad spec)
+                    if hpz and cross_group_axes:
+                        return jax.lax.pmean(g, cross_group_axes)
+                    return g
                 if gd < 0:  # grad stays replicated: plain mean-allreduce
                     return jax.lax.pmean(g, axes)
-                if stage3 and pd >= 0:  # already reduced by the gather's VJP
-                    return g
                 if zpp_g:
                     return all_to_all_quant_reduce(g, gd, axes, mean=True)
                 return reduce_scatter_leaf(g, gd, axes, mean=True)
@@ -615,11 +639,42 @@ class DeepSpeedTpuEngine:
             loss = jax.lax.pmean(jnp.mean(losses), axes)
             return grads, loss
 
+        # grads of hpZ-sharded params leave the program secondary-sharded
+        out_grad_specs = grad_specs
+        if hpz:
+            out_grad_specs = jax.tree.map(
+                lambda gs, ps, pd: ps if pd >= 0 else gs,
+                grad_specs, param_specs, param_dims)
+
+        # tensor parallelism rides the AUTO axes: the program is manual over
+        # the DP axes only, and specs mention only those (GSPMD keeps the
+        # "model"-axis collectives inside model.apply)
+        tp = self.topology.axis_size("model") > 1
+        manual = tuple(axes)
+
+        def strip_auto(spec):
+            if not tp:
+                return spec
+            out = []
+            for e in spec:
+                ents = e if isinstance(e, tuple) else (e,)
+                kept = tuple(a for a in ents if a in manual)
+                out.append(kept if len(kept) > 1 else
+                           (kept[0] if kept else None))
+            return P(*out)
+
+        if tp:
+            param_specs_in = jax.tree.map(strip_auto, param_specs)
+            out_grad_specs = jax.tree.map(strip_auto, out_grad_specs)
+        else:
+            param_specs_in = param_specs
+
         bt = self.topology.batch_axes
         return shard_map_unchecked(
             body, mesh=mesh,
-            in_specs=(param_specs, P(), P(None, bt), P()),
-            out_specs=(grad_specs, P()))
+            in_specs=(param_specs_in, P(), P(None, bt), P()),
+            out_specs=(out_grad_specs, P()),
+            axis_names=manual if tp else None)
 
     def _build_offload_step(self):
         """Grad-only device program for ZeRO-Offload: the optimizer runs on
