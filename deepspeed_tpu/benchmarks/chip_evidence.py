@@ -6,8 +6,13 @@ beyond bench.py's MFU record, and writes one JSON per item:
   * serving_bench at batch >= 8 (paged-vs-dense tokens/sec)    -> serving.json
   * flash parity + measured flash/XLA crossover                 -> flash.json
   * ZeRO-3 train-step overlap report (async pairs, exposed frac)-> overlap.json
+  * collective micro-bench (latency/algbw/busbw per op+size)    -> comm.json
 
-Usage:  python -m deepspeed_tpu.benchmarks.chip_evidence --out artifacts/r3
+One successful device init yields the full evidence set (VERDICT r3 #9:
+the chip is the scarcest resource in this loop — capture everything in one
+visit, even if the next round's chip is flaky).
+
+Usage:  python -m deepspeed_tpu.benchmarks.chip_evidence --out artifacts/r4
 """
 
 import argparse
@@ -22,6 +27,7 @@ def main(argv=None):
     p.add_argument("--skip-serving", action="store_true")
     p.add_argument("--skip-flash", action="store_true")
     p.add_argument("--skip-overlap", action="store_true")
+    p.add_argument("--skip-comm", action="store_true")
     args = p.parse_args(argv)
     os.makedirs(args.out, exist_ok=True)
 
@@ -105,6 +111,22 @@ def main(argv=None):
             json.dump(rec, fh, indent=2)
         results["overlap"] = rec
         print("overlap:", rec)
+
+    if not args.skip_comm:
+        from . import comm_bench
+
+        try:
+            # single-chip: a degenerate 1-device axis still records the
+            # op latencies (real multi-chip numbers need a pod slice)
+            rows = comm_bench.main(["--maxsize", "22", "--trials", "10"])
+            rec = {"rows": rows}
+        except Exception as exc:  # evidence collection must not abort
+            rec = {"error": repr(exc)[:300]}
+        with open(os.path.join(args.out, "comm.json"), "w") as fh:
+            json.dump(rec, fh, indent=2)
+        results["comm"] = {"rows": len(rec.get("rows", []))} \
+            if "rows" in rec else rec
+        print("comm:", results["comm"])
 
     print(json.dumps({"chip_evidence": results.get("backend"),
                       "written_to": args.out}))

@@ -41,8 +41,13 @@ class InferenceEngineV2:
         self.config = config
         self.model = model
         cfg: TransformerConfig = model.cfg
-        assert cfg.moe_num_experts == 0, \
-            "ragged engine: MoE models not yet supported"
+        if cfg.moe_num_experts > 0:
+            # served via the dropless sorted-token grouped GEMM
+            # (paged_model._moe_mlp); routing-parity with training needs
+            # top-k <= 2 (the conventions implemented there)
+            assert cfg.moe_top_k <= 2, \
+                f"ragged engine serves top-1/top-2 MoE only " \
+                f"(got moe_top_k={cfg.moe_top_k})"
         sm = config.state_manager
         if sm.max_seq_len > cfg.max_seq_len:
             sm.max_seq_len = cfg.max_seq_len

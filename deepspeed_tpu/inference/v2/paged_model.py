@@ -78,11 +78,48 @@ def _rotate(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray):
 
 
 def _mlp(cfg, lp, x):
+    if cfg.moe_num_experts > 0:
+        return _moe_mlp(cfg, lp, x)
     if cfg.activation == "swiglu":
         return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
     from ...models.transformer import ffn_act
     u = ffn_act(cfg)(x @ lp["w_up"] + lp["b_up"])
     return u @ lp["w_down"] + lp["b_down"]
+
+
+def _moe_mlp(cfg, lp, x):
+    """Routed-expert MLP for serving (reference v2 serves Mixtral-class
+    MoE, inference/v2/model_implementations/): dropless sorted-token
+    grouped GEMM via jax.lax.ragged_dot — no [T,E,C] capacity tensor, no
+    token drops (dropping tokens at inference corrupts outputs), ep=1.
+
+    Routing matches the training graph so serving is parity-testable
+    against the same weights: top-1 uses the raw gate probability
+    (sharded_moe.top1gating g1); top-2 renormalizes over the pair
+    (top2gating g1/g2 normalization, the Mixtral convention).
+    """
+    from ...moe.sharded_moe import dropless_topk_dispatch
+
+    orig_shape = x.shape
+    H = orig_shape[-1]
+    xt = x.reshape(-1, H)
+    gate_w = lp["moe_gate_w"]
+    E = gate_w.shape[-1]
+    k = cfg.moe_top_k
+    logits = xt.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(gates, k)                    # [T, k]
+    if k > 1:
+        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    experts = (lp["e_gate"], lp["e_up"], lp["e_down"])
+    out = dropless_topk_dispatch(xt, topi, topv, experts, E)
+    if cfg.moe_use_residual:
+        from ...moe.sharded_moe import residual_moe_combine
+        dense = (jax.nn.silu(xt @ lp["res_gate"])
+                 * (xt @ lp["res_up"])) @ lp["res_down"]
+        out = residual_moe_combine(xt, out, dense, lp["res_coef_w"],
+                                   lp["res_coef_b"])
+    return out.reshape(orig_shape)
 
 
 def _logits(cfg, params, x):

@@ -12,6 +12,7 @@ from .transformer import (  # noqa: F401
     llama2_7b,
     llama2_13b,
     mistral_7b,
+    mixtral_8x7b,
     opt_125m,
     opt_1_3b,
     tiny_test,

@@ -231,6 +231,11 @@ def _chunked_ce_loss(x, targets, mask, head, chunk: int, bias=None):
 class TransformerLM:
     """Functional decoder-only LM implementing the engine model protocol."""
 
+    # pp x ep composes: _layer dispatches experts with the explicit
+    # static-capacity all-to-all (moe_layer_manual) inside the manual
+    # pipeline program
+    supports_pp_ep = True
+
     def __init__(self, cfg: TransformerConfig):
         self.cfg = cfg
         self.topology = None  # set by the engine (set_topology) for shard_map
@@ -430,6 +435,7 @@ class TransformerLM:
         aux = jnp.zeros((), jnp.float32)
         if cfg.moe_num_experts > 0:
             from ..moe.sharded_moe import (moe_layer, moe_layer_dropless,
+                                           moe_layer_manual,
                                            residual_moe_combine)
 
             def expert_fn(p, xe):
@@ -444,6 +450,17 @@ class TransformerLM:
                         f"(got moe_top_k={cfg.moe_top_k})")
                 moe_out, aux = moe_layer_dropless(
                     hn, lp["moe_gate_w"], experts, topo=self.topology)
+            elif (getattr(self, "_inside_manual_pipe", False)
+                  and self.topology.axis_size("expert") > 1):
+                # pp x ep: inside the manual 1F1B shard_map GSPMD cannot
+                # insert the expert collective — dispatch with the
+                # explicit static-capacity all-to-all; expert params are
+                # already the local [E/ep, ...] slice
+                moe_out, aux = moe_layer_manual(
+                    hn, lp["moe_gate_w"], experts, expert_fn,
+                    ep_axis="expert", top_k=cfg.moe_top_k,
+                    capacity_factor=cfg.moe_capacity_factor,
+                    min_capacity=cfg.moe_min_capacity)
             else:
                 moe_out, aux = moe_layer(
                     hn, lp["moe_gate_w"], experts,
@@ -604,9 +621,13 @@ class TransformerLM:
             return jax.lax.pmean(loss, dp_axes)
 
         args = (params, ids) + ((mask,) if mask is not None else ())
-        return jax.shard_map(body, mesh=topo.mesh,
-                             in_specs=(param_specs, ids_spec) + mask_specs,
-                             out_specs=P(), check_vma=False)(*args)
+        self._inside_manual_pipe = True
+        try:
+            return jax.shard_map(body, mesh=topo.mesh,
+                                 in_specs=(param_specs, ids_spec) + mask_specs,
+                                 out_specs=P(), check_vma=False)(*args)
+        finally:
+            self._inside_manual_pipe = False
 
     def loss_and_grads(self, params, batch, rng=None):
         """(loss, grads) through the bounded-memory 1F1B pipeline
@@ -681,17 +702,47 @@ class TransformerLM:
             b_local = ids_l.shape[1]
             h_spec = jax.ShapeDtypeStruct((b_local, S, cfg.hidden_size),
                                           p["embed"].dtype)
-            return pipeline_1f1b(
+            loss, grads = pipeline_1f1b(
                 stage_fn, loss_fn, p, ids_l, pp, h_spec=h_spec,
-                loss_args=(ids_l,) + tuple(mask_l), dp_axes=dp_axes,
+                loss_args=(ids_l,) + tuple(mask_l), dp_axes=(),
                 pipe_reduce_mask=reduce_mask, stage_aux=moe)
+            # data-parallel reduction, per leaf: skip any axis the leaf is
+            # SHARDED on (under pp x ep the expert-sharded weights hold
+            # different experts across the expert axis — a pmean over it
+            # would average distinct experts into garbage). A leaf sharded
+            # on a dp axis accumulated a SUM over that axis's group (the
+            # a2a routed every group member's tokens through it), so the
+            # mean still owes a 1/size division for those axes.
+            loss = jax.lax.pmean(loss, dp_axes)
+
+            def dp_reduce(g, spec):
+                used = {a for e in spec
+                        for a in (e if isinstance(e, tuple) else (e,))
+                        if a is not None}
+                axes_r = tuple(a for a in dp_axes if a not in used)
+                if axes_r:
+                    g = jax.lax.pmean(g, axes_r)
+                denom = 1
+                for a in dp_axes:
+                    if a in used:
+                        denom *= topo.axis_size(a)
+                return g / denom if denom > 1 else g
+
+            grads = jax.tree.map(dp_reduce, grads, param_specs)
+            return loss, grads
 
         args = (params, ids) + ((mask,) if mask is not None else ())
         grad_specs = param_specs
-        return jax.shard_map(body, mesh=topo.mesh,
-                             in_specs=(param_specs, ids_spec) + mask_specs,
-                             out_specs=(P(), grad_specs),
-                             check_vma=False)(*args)
+        # _layer switches MoE to the explicit-all-to-all dispatch while the
+        # fully-manual pipeline program traces (pp x ep)
+        self._inside_manual_pipe = True
+        try:
+            return jax.shard_map(body, mesh=topo.mesh,
+                                 in_specs=(param_specs, ids_spec) + mask_specs,
+                                 out_specs=(P(), grad_specs),
+                                 check_vma=False)(*args)
+        finally:
+            self._inside_manual_pipe = False
 
     def apply(self, params, batch, train: bool = True, rng=None):
         """Loss for one batch. objective="causal_lm": next-token loss on
@@ -920,6 +971,18 @@ def mistral_7b() -> TransformerConfig:
     return TransformerConfig(vocab_size=32000, hidden_size=4096,
                              intermediate_size=14336, num_layers=32,
                              num_heads=32, num_kv_heads=8, max_seq_len=8192)
+
+
+def mixtral_8x7b() -> TransformerConfig:
+    """Mixtral-8x7B: the Mixtral-class sparse-MoE family the reference's
+    v2 engine serves (inference/v2/model_implementations/mixtral/): 8
+    experts, top-2 routing, Mistral attention geometry, 32k context with
+    rope_theta=1e6 (the values the released weights were trained with)."""
+    return TransformerConfig(vocab_size=32000, hidden_size=4096,
+                             intermediate_size=14336, num_layers=32,
+                             num_heads=32, num_kv_heads=8, max_seq_len=32768,
+                             rope_theta=1e6,
+                             moe_num_experts=8, moe_top_k=2)
 
 
 def gpt2_small() -> TransformerConfig:

@@ -113,6 +113,17 @@ def top2gating(logits, capacity_factor: float = 1.0, min_capacity: int = 4,
     return aux_loss, combine, dispatch
 
 
+def _gate_and_dispatch(xt, gate_w, top_k, capacity_factor, min_capacity,
+                       noisy_gate_policy, rng):
+    """Shared gating prologue of every capacity-routed MoE variant: fp32
+    router logits + top-1/top-2 gating. Returns (aux, combine, dispatch)."""
+    logits = (xt.astype(jnp.float32) @ gate_w.astype(jnp.float32))
+    if top_k == 1:
+        return top1gating(logits, capacity_factor, min_capacity,
+                          noisy_gate_policy, rng)
+    return top2gating(logits, capacity_factor, min_capacity, rng)
+
+
 def moe_layer(x, gate_w, expert_params, expert_fn, topo=None,
               top_k: int = 1, capacity_factor: float = 1.0,
               min_capacity: int = 4, rng=None,
@@ -126,15 +137,10 @@ def moe_layer(x, gate_w, expert_params, expert_fn, topo=None,
     Returns (output [B,S,H], aux_loss scalar).
     """
     B, S, H = x.shape
-    T = B * S
-    xt = x.reshape(T, H)
-    logits = (xt.astype(jnp.float32) @ gate_w.astype(jnp.float32))
-    if top_k == 1:
-        aux, combine, dispatch = top1gating(logits, capacity_factor,
-                                            min_capacity, noisy_gate_policy, rng)
-    else:
-        aux, combine, dispatch = top2gating(logits, capacity_factor,
-                                            min_capacity, rng)
+    xt = x.reshape(B * S, H)
+    aux, combine, dispatch = _gate_and_dispatch(
+        xt, gate_w, top_k, capacity_factor, min_capacity, noisy_gate_policy,
+        rng)
 
     # dispatch: [T,E,C] x [T,H] -> [E,C,H]   (the all-to-all happens here when
     # E is sharded over the expert axis and T over the data axes)
@@ -151,6 +157,53 @@ def moe_layer(x, gate_w, expert_params, expert_fn, topo=None,
     return out.reshape(B, S, H), aux.astype(jnp.float32)
 
 
+def moe_layer_manual(x, gate_w, expert_params_local, expert_fn,
+                     ep_axis: str = "expert",
+                     top_k: int = 1, capacity_factor: float = 1.0,
+                     min_capacity: int = 4, rng=None,
+                     noisy_gate_policy: Optional[str] = None
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Expert-parallel MoE with an EXPLICIT all-to-all dispatch, for use
+    inside a manual shard_map program — the compiled 1F1B pipeline, where
+    GSPMD cannot insert the expert collective (the reference's _AllToAll
+    autograd op, sharded_moe.py:95, done by hand the same way).
+
+    x: the device-LOCAL [B, S, H] token block (the expert axis is a batch
+    axis, so every expert peer holds different tokens);
+    gate_w: [H, E_global] (replicated over the expert axis);
+    expert_params_local: pytree with leading LOCAL expert dim [E/ep, ...].
+
+    Dispatch: capacity-pad locally to [E, C, H], all_to_all the per-owner
+    blocks over `ep_axis`, run the local experts on [E/ep, ep*C, H], and
+    all_to_all back before the combine. All shapes are static (capacity
+    routing), which is what makes this legal inside the compiled pipeline.
+    """
+    B, S, H = x.shape
+    ep = jax.lax.axis_size(ep_axis)
+    xt = x.reshape(B * S, H)
+    E = gate_w.shape[-1]
+    assert E % ep == 0, f"num_experts {E} not divisible by ep {ep}"
+    aux, combine, dispatch = _gate_and_dispatch(
+        xt, gate_w, top_k, capacity_factor, min_capacity, noisy_gate_policy,
+        rng)
+
+    xe = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), xt)  # [E, C, H]
+    C = xe.shape[1]
+    e_loc = E // ep
+    # block o = my tokens for peer o's experts -> peer o; received block p =
+    # peer p's tokens for MY experts
+    xr = jax.lax.all_to_all(xe, ep_axis, split_axis=0, concat_axis=0,
+                            tiled=True)                    # [ep*e_loc, C, H]
+    xr = xr.reshape(ep, e_loc, C, H).transpose(1, 0, 2, 3) \
+           .reshape(e_loc, ep * C, H)
+    ye = jax.vmap(expert_fn)(expert_params_local, xr)      # [e_loc, ep*C, H]
+    ye = ye.reshape(e_loc, ep, C, H).transpose(1, 0, 2, 3).reshape(E, C, H)
+    ye = jax.lax.all_to_all(ye, ep_axis, split_axis=0, concat_axis=0,
+                            tiled=True)                    # back to senders
+    out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), ye)
+    return out.reshape(B, S, H), aux.astype(jnp.float32)
+
+
 def ragged_swiglu_experts(expert_params, xs, group_sizes):
     """SwiGLU expert stack as grouped GEMMs over token groups.
 
@@ -163,6 +216,25 @@ def ragged_swiglu_experts(expert_params, xs, group_sizes):
     g = jax.lax.ragged_dot(xs, wg, group_sizes)
     u = jax.lax.ragged_dot(xs, wu, group_sizes)
     return jax.lax.ragged_dot(jax.nn.silu(g) * u, wd, group_sizes)
+
+
+def dropless_topk_dispatch(xt, topi, topv, expert_params, num_experts: int,
+                           ragged_expert_fn=None):
+    """Sorted-token grouped-GEMM core shared by the training dropless MoE
+    and the v2 serving path (_moe_mlp): route every (token, choice) row to
+    its expert with one argsort + `jax.lax.ragged_dot`, unsort, and weight
+    by the gate value. xt: [T, H]; topi/topv: [T, k]. Returns [T, H]."""
+    T, H = xt.shape
+    k = topi.shape[-1]
+    idx = topi.reshape(-1)                       # [T*k], token-major
+    order = jnp.argsort(idx)                     # stable
+    xs = xt[order // k]                          # row t*k+j <-> (token t, j)
+    group_sizes = jnp.bincount(idx, length=num_experts).astype(jnp.int32)
+    fn = ragged_expert_fn or ragged_swiglu_experts
+    ys = fn(expert_params, xs, group_sizes)      # [T*k, H]
+    ys = jnp.zeros_like(ys).at[order].set(ys)    # unsort
+    return jnp.sum(ys.reshape(T, k, H) * topv[..., None].astype(ys.dtype),
+                   axis=1)
 
 
 def moe_layer_dropless(x, gate_w, expert_params, ragged_expert_fn=None,
@@ -198,14 +270,9 @@ def moe_layer_dropless(x, gate_w, expert_params, ragged_expert_fn=None,
     ce = jnp.mean(_one_hot(idx, E), axis=0)
     aux = jnp.sum(me * ce) * E
 
-    order = jnp.argsort(idx)                                    # stable
-    xs = xt[order]
-    group_sizes = jnp.bincount(idx, length=E).astype(jnp.int32)
-    fn = ragged_expert_fn or ragged_swiglu_experts
-    ys = fn(expert_params, xs, group_sizes)                     # [T, H]
-    ys = jnp.zeros_like(ys).at[order].set(ys)                   # unsort
     gate_p = jnp.take_along_axis(gates, idx[:, None], axis=-1)  # [T, 1]
-    out = ys * gate_p.astype(ys.dtype)
+    out = dropless_topk_dispatch(xt, idx[:, None], gate_p, expert_params, E,
+                                 ragged_expert_fn)
     return out.reshape(B, S, H), aux.astype(jnp.float32)
 
 

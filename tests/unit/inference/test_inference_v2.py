@@ -304,3 +304,64 @@ def test_v2_tensor_parallel_matches_single():
 
     np.testing.assert_allclose(out[2][0], out[1][0], rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(out[2][1], out[1][1], rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_moe_paged_matches_dense(top_k):
+    """MoE serving (VERDICT r3 #8): prefill + paged decode through the
+    dropless grouped-GEMM expert path must match the dense forward on the
+    same weights. capacity_factor = E in the dense reference so no token
+    drops there either — routing then agrees exactly."""
+    cfg = _tiny_cfg(moe_num_experts=4, moe_top_k=top_k,
+                    moe_capacity_factor=4.0, moe_min_capacity=4)
+    model = TransformerLM(cfg)
+    params = jax.tree.map(lambda x: x.astype(jnp.float32),
+                          model.init_params(jax.random.PRNGKey(1)))
+    engine = _v2_engine(model, params)
+    prompt = list(range(3, 12))
+    l0 = engine.put([1], [prompt])
+    l1 = engine.put([1], [[40]])
+    full = jnp.asarray(np.array(prompt + [40])[None])
+    ref = np.asarray(model.forward_logits(params, full))
+    np.testing.assert_allclose(l0[0], ref[0, len(prompt) - 1], rtol=2e-4,
+                               atol=2e-4)
+    np.testing.assert_allclose(l1[0], ref[0, len(prompt)], rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_moe_residual_paged_matches_dense():
+    """PR-MoE (residual) serving: routed output mixed with the dense MLP
+    through the learned coefficient head, matching training semantics."""
+    cfg = _tiny_cfg(moe_num_experts=4, moe_use_residual=True,
+                    moe_capacity_factor=4.0, moe_min_capacity=4)
+    model = TransformerLM(cfg)
+    params = jax.tree.map(lambda x: x.astype(jnp.float32),
+                          model.init_params(jax.random.PRNGKey(2)))
+    engine = _v2_engine(model, params)
+    prompt = list(range(5, 14))
+    l0 = engine.put([1], [prompt])
+    ref = np.asarray(model.forward_logits(
+        params, jnp.asarray(np.array(prompt)[None])))
+    np.testing.assert_allclose(l0[0], ref[0, -1], rtol=2e-4, atol=2e-4)
+
+
+def test_mixtral_class_preset_generates():
+    """A Mixtral-class MoE preset (scaled down) generates end-to-end
+    through InferenceEngineV2 (reference
+    inference/v2/model_implementations/mixtral/)."""
+    import dataclasses
+    from deepspeed_tpu.models import mixtral_8x7b
+
+    cfg = dataclasses.replace(mixtral_8x7b(), vocab_size=128, hidden_size=64,
+                              intermediate_size=128, num_layers=2,
+                              num_heads=4, num_kv_heads=2, max_seq_len=128,
+                              use_flash=False, remat=False)
+    assert cfg.moe_num_experts == 8 and cfg.moe_top_k == 2
+    model = TransformerLM(cfg)
+    params = jax.tree.map(lambda x: x.astype(jnp.float32),
+                          model.init_params(jax.random.PRNGKey(0)))
+    engine = _v2_engine(model, params)
+    prompts = [[3, 5, 7], [11, 13]]
+    outs = engine.generate(prompts, max_new_tokens=5)
+    assert len(outs) == 2
+    assert all(len(o) == len(p) + 5 for o, p in zip(outs, prompts))
