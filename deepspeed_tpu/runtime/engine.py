@@ -1233,17 +1233,65 @@ class DeepSpeedTpuEngine:
                     if k in self.scale_state}
                 self.scale_state = {**self.scale_state, **restored}
 
+        def restore_step_meta():
+            # step counter + schedule travel with the moments as one unit
+            # (Adam bias correction; host/device step invariant) — same
+            # coupling as the device path below
+            if extras.get("step") is not None:
+                self._step_arr = jnp.asarray(extras["step"], jnp.int32)
+            meta = extras.get("meta", {})
+            if "global_steps" in meta:
+                self.global_steps = meta["global_steps"]
+                self.skipped_steps = meta.get("skipped_steps", 0)
+                self._batches_seen = meta.get("batches_seen",
+                                              self.global_steps)
+                if extras.get("step") is None:
+                    self._step_arr = jnp.asarray(self.global_steps,
+                                                 jnp.int32)
+            if "lr_scheduler" in meta:
+                try:
+                    self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+                except Exception as exc:
+                    logger.warning(f"lr scheduler state not restored: {exc}")
+
         if self.offload_device:
             leaves = [np.asarray(l, np.float32)
                       for l in jax.tree.leaves(host_tree)]
-            self.host_opt.load_leaves(leaves, None)
+            opt_leaves = None
+            if has_universal_opt_state(universal_dir):
+                # host-optimizer moments restore from the universal format:
+                # validate the whole section before mutating anything
+                unflat = partial(jax.tree_util.tree_unflatten,
+                                 self._param_treedef)
+                _, opt_tpl = self.host_opt.template_leaves()
+                opt_tpl_tree = {k: unflat(v) for k, v in opt_tpl.items()}
+                try:
+                    opt_host = load_universal_into_tree(
+                        universal_dir, opt_tpl_tree, section="opt_state")
+                    opt_leaves = {
+                        k: [np.asarray(l, np.float32)
+                            for l in jax.tree.leaves(v)]
+                        for k, v in opt_host.items()}
+                    # validate EVERY leaf shape before load_leaves mutates
+                    # host state (the device path's atomicity rule):
+                    # load_universal_into_tree checks paths, not shapes
+                    for k, tpl in opt_tpl.items():
+                        for got, want in zip(opt_leaves[k], tpl):
+                            if got.shape != want.shape:
+                                raise KeyError(
+                                    f"opt-state shape mismatch for {k}: "
+                                    f"{got.shape} vs {want.shape}")
+                except KeyError as exc:
+                    logger.warning(
+                        f"universal checkpoint optimizer state does not "
+                        f"match the host optimizer ({exc}); restored "
+                        f"weights only — step counter and LR schedule "
+                        f"restart at 0")
+            self.host_opt.load_leaves(leaves, opt_leaves)
             self._push_host_params(self.host_opt.current_bf16_leaves())
             restore_scale_state()
-            if has_universal_opt_state(universal_dir):
-                logger.warning(
-                    "universal checkpoint carries optimizer state, but the "
-                    "offload engine restored weights only (host-optimizer "
-                    "state restore from universal format not implemented)")
+            if opt_leaves is not None:
+                restore_step_meta()
             return
         if self.has_master:
             self.master_params = jax.tree.map(
@@ -1292,26 +1340,7 @@ class DeepSpeedTpuEngine:
                     f"step counter and LR schedule restart at 0")
             else:
                 self.opt_state = new_opt
-                if extras.get("step") is not None:
-                    self._step_arr = jnp.asarray(extras["step"], jnp.int32)
-                meta = extras.get("meta", {})
-                if "global_steps" in meta:
-                    self.global_steps = meta["global_steps"]
-                    self.skipped_steps = meta.get("skipped_steps", 0)
-                    self._batches_seen = meta.get("batches_seen",
-                                                  self.global_steps)
-                    if extras.get("step") is None:
-                        # older manifest without a step fragment: keep the
-                        # device counter in lockstep with the host counter
-                        self._step_arr = jnp.asarray(self.global_steps,
-                                                     jnp.int32)
-                if "lr_scheduler" in meta:
-                    try:
-                        self.lr_scheduler.load_state_dict(
-                            meta["lr_scheduler"])
-                    except Exception as exc:
-                        logger.warning(
-                            f"lr scheduler state not restored: {exc}")
+                restore_step_meta()
         log_dist(f"loaded universal checkpoint from {universal_dir}", ranks=[0])
 
     # ------------------------------------------------------------------
