@@ -233,11 +233,14 @@ def main():
     prof_dir = os.environ.get("DS_TPU_BENCH_PROFILE",
                               "profiles/bench_trace" if on_tpu else "")
     try:
+        # phase breakdown costs a second AOT compile + eval-step compiles
+        # (~80s cold on chip); only spend it if the trial ladder left room
+        phases_ok = (time.perf_counter() - t_start) < budget_s * 0.8
         z3_mfu, z3_detail = _measure(cfg, micro, 1, max(steps // 2, 3),
                                      warmup, n_dev, zero_stage=3,
                                      remat_policy=policy,
                                      profile_dir=prof_dir or None,
-                                     phases=True)
+                                     phases=phases_ok)
         detail["zero3_mfu"] = round(z3_mfu * 100, 2)
         detail["zero3_tokens_per_sec_per_chip"] = \
             z3_detail["tokens_per_sec_per_chip"]

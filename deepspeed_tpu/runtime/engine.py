@@ -1268,7 +1268,7 @@ class DeepSpeedTpuEngine:
                 try:
                     opt_host = load_universal_into_tree(
                         universal_dir, opt_tpl_tree, section="opt_state")
-                    opt_leaves = {
+                    candidate = {
                         k: [np.asarray(l, np.float32)
                             for l in jax.tree.leaves(v)]
                         for k, v in opt_host.items()}
@@ -1276,11 +1276,12 @@ class DeepSpeedTpuEngine:
                     # host state (the device path's atomicity rule):
                     # load_universal_into_tree checks paths, not shapes
                     for k, tpl in opt_tpl.items():
-                        for got, want in zip(opt_leaves[k], tpl):
+                        for got, want in zip(candidate[k], tpl):
                             if got.shape != want.shape:
                                 raise KeyError(
                                     f"opt-state shape mismatch for {k}: "
                                     f"{got.shape} vs {want.shape}")
+                    opt_leaves = candidate  # only after full validation
                 except KeyError as exc:
                     logger.warning(
                         f"universal checkpoint optimizer state does not "

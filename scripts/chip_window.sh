@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# One-shot chip-window evidence capture (VERDICT r3 #9: the chip is the
+# scarcest resource — one healthy device init must yield the full evidence
+# set). Run whenever a TPU probe succeeds:
+#
+#   bash scripts/chip_window.sh [round_tag]
+#
+# Produces, in-tree:
+#   BENCH_<tag>_early.json        bench.py MFU record (with zero3 + phases)
+#   artifacts/<tag>/serving.json  paged-vs-dense tokens/sec at batch>=8
+#   artifacts/<tag>/flash.json    flash parity + measured crossover
+#   artifacts/<tag>/overlap.json  ZeRO-3 exposed-collective report
+#   artifacts/<tag>/comm.json     collective micro-bench
+#   profiles/bench_trace/         jax.profiler trace of the zero3 step
+# and commits them.
+set -u
+TAG="${1:-r04}"
+cd "$(dirname "$0")/.."
+
+echo "== chip window capture ($TAG) =="
+set -o pipefail
+DS_TPU_BENCH_BUDGET="${DS_TPU_BENCH_BUDGET:-900}" \
+    timeout 1500 python bench.py | tee "BENCH_${TAG}_early.json.tmp"
+rc=$?
+# keep only the final line, and only if the bench succeeded AND the line
+# is valid JSON (a crash/timeout must not be committed as evidence)
+tail -n 1 "BENCH_${TAG}_early.json.tmp" > "BENCH_${TAG}_early.json.cand"
+rm -f "BENCH_${TAG}_early.json.tmp"
+if [ "$rc" -eq 0 ] && python -c "import json,sys; json.load(open(sys.argv[1]))" \
+        "BENCH_${TAG}_early.json.cand" 2>/dev/null; then
+    mv "BENCH_${TAG}_early.json.cand" "BENCH_${TAG}_early.json"
+else
+    echo "bench.py failed (rc=$rc) or emitted no JSON; NOT recording"
+    rm -f "BENCH_${TAG}_early.json.cand"
+fi
+
+timeout 1500 python -m deepspeed_tpu.benchmarks.chip_evidence \
+    --out "artifacts/${TAG}" || echo "chip_evidence failed (continuing)"
+
+git add -f "BENCH_${TAG}_early.json" "artifacts/${TAG}" profiles 2>/dev/null
+git commit -m "Chip-window evidence capture (${TAG}): bench + serving + flash + overlap + comm" \
+    || echo "nothing to commit"
+echo "== done =="

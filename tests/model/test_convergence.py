@@ -47,3 +47,68 @@ def test_small_lm_learns_synthetic_language():
     # Require real learning on UNSEEN sequences, not just a downward tick.
     assert first > 3.0, first
     assert last < 1.0, (first, last)
+
+
+def test_moe_lm_learns_with_expert_parallel():
+    """Expert-parallel MoE LM (ep=2 x dp=4) learns the synthetic rule —
+    convergence through the gating/dispatch path, not just loss ticking
+    (reference tests/model convergence tier, MoE flavor)."""
+    cfg = TransformerConfig(vocab_size=64, hidden_size=64,
+                            intermediate_size=128, num_layers=2, num_heads=4,
+                            max_seq_len=32, use_flash=False, remat=False,
+                            moe_num_experts=4, moe_capacity_factor=2.0)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=TransformerLM(cfg),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+                "scheduler": {"type": "WarmupLR",
+                              "params": {"warmup_num_steps": 10}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 1},
+                "moe": {"enabled": True, "num_experts": 4,
+                        "expert_parallel_size": 2},
+                "steps_per_print": 10 ** 9})
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(1)
+    first = last = None
+    for step in range(60):
+        ids = _synthetic_language(rng, gm, 32, 64)
+        loss = float(engine.train_batch(
+            batch={"input_ids": ids.reshape(1, gm, 32)}))
+        if first is None:
+            first = loss
+        last = loss
+    assert first > 3.0, first
+    assert last < 1.2, (first, last)
+
+
+def test_pipelined_lm_learns():
+    """The compiled 1F1B pipeline (pp=2 x dp=4, ZeRO-1) learns the
+    synthetic rule — convergence through the pipe-sharded stacked-layer
+    storage and the pipeline gradient program."""
+    cfg = TransformerConfig(vocab_size=64, hidden_size=64,
+                            intermediate_size=128, num_layers=2, num_heads=4,
+                            max_seq_len=32, use_flash=False, remat=False)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=TransformerLM(cfg),
+        config={"train_micro_batch_size_per_gpu": 4,
+                "gradient_accumulation_steps": 4,
+                "optimizer": {"type": "adamw", "params": {"lr": 3e-3}},
+                "scheduler": {"type": "WarmupLR",
+                              "params": {"warmup_num_steps": 10}},
+                "bf16": {"enabled": True},
+                "pipeline": {"stages": 2},
+                "zero_optimization": {"stage": 1},
+                "steps_per_print": 10 ** 9})
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(2)
+    first = last = None
+    for step in range(70):
+        ids = _synthetic_language(rng, gm * 4, 32, 64)
+        loss = float(engine.train_batch(
+            batch={"input_ids": ids.reshape(4, gm, 32)}))
+        if first is None:
+            first = loss
+        last = loss
+    assert first > 3.0, first
+    assert last < 1.2, (first, last)
