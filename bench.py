@@ -148,6 +148,17 @@ def build_trials(base):
     trials.insert(3, (dataclasses.replace(
         base, use_flash=True, flash_min_seq=2048, loss_chunk=0),
         8, "save_dots_and_attn"))
+    # long-sequence variant: seq 4096 raises the attention-flops fraction
+    # where the flash kernel beats XLA hardest; MFU stays comparable (the
+    # metric normalizes by model flops at the measured seq)
+    trials.insert(4, (dataclasses.replace(
+        base, max_seq_len=4096, use_flash=True, flash_min_seq=2048),
+        4, "save_dots_and_attn"))
+    # tall-q flash blocks: fewer online-softmax rescales per row
+    trials.insert(5, (dataclasses.replace(
+        base, use_flash=True, flash_min_seq=2048,
+        attn_block_q=1024, attn_block_kv=512),
+        16, "save_dots_and_attn"))
     return trials
 
 
@@ -246,6 +257,8 @@ def main():
             z3_detail["tokens_per_sec_per_chip"]
         if "phase_breakdown" in z3_detail:
             detail["zero3_phase_breakdown"] = z3_detail["phase_breakdown"]
+        elif not phases_ok:  # a truncated record must say so
+            detail["zero3_phase_breakdown"] = {"skipped": "budget"}
         if prof_dir:
             detail["profile_trace"] = prof_dir
     except Exception as exc:

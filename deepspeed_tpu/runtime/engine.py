@@ -167,6 +167,16 @@ class DeepSpeedTpuEngine:
         off_cfg = self.config.zero_optimization.offload_optimizer
         self.offload_device = off_cfg.device if off_cfg.device != "none" else None
         self.host_opt = None
+        # offload_param (ZeRO-Infinity parameter spill) needs per-layer
+        # host->device weight streaming inside the compiled step — not
+        # built yet, and a silent no-op would misreport memory headroom:
+        # reject loudly (the hpZ dead-key rule). offload_optimizer works.
+        if self.config.zero_optimization.offload_param.device not in (
+                "none", None, ""):
+            raise NotImplementedError(
+                "zero_optimization.offload_param is not implemented "
+                "(parameter streaming from host memory inside the jitted "
+                "step); offload_optimizer (cpu/nvme host optimizer) is")
 
         # --- activation checkpointing config (reference engine.py:902
         # _configure_checkpointing -> checkpointing.configure)
@@ -945,6 +955,17 @@ class DeepSpeedTpuEngine:
             if fp_cfg.enabled and self.global_steps == fp_cfg.profile_step:
                 self._run_flops_profiler(dev_batch)
         self.tput_timer.stop(global_step=True)
+        if getattr(self.config, "wall_clock_breakdown", False) and \
+                self._batches_seen % self.config.steps_per_print == 0:
+            # one fused jitted step: fwd/bwd/opt split isn't separable at
+            # runtime (bench.py's zero3 phase_breakdown reports it from
+            # the eval step + HLO); the wall-clock series here mirrors the
+            # reference's step timing logs (engine.py:2180-2190)
+            dur = self.tput_timer.last_duration or 0.0
+            log_dist(
+                f"time: train_batch={dur * 1e3:.1f}ms "
+                f"samples/s={self.train_batch_size / dur if dur else 0:.1f}",
+                ranks=[0])
         # print cadence runs on batches seen (global_steps stalls on skips);
         # every skipped batch is logged so overflows are visible
         if skipped or self._batches_seen % self.config.steps_per_print == 0:
@@ -1103,9 +1124,25 @@ class DeepSpeedTpuEngine:
     # ------------------------------------------------------------------
     # Checkpointing (reference engine.py:2982 save / :2653 load)
     # ------------------------------------------------------------------
+    def _join_pending_saves(self):
+        """Commit barrier for async checkpoint writes (reference
+        NebulaCheckpointEngine commit semantics): the next save/load/exit
+        waits for in-flight background writes, and a failed write raises
+        HERE instead of vanishing on the worker thread."""
+        for t in getattr(self, "_pending_saves", ()):
+            t.join()
+        self._pending_saves = []
+        errors = getattr(self, "_async_save_errors", [])
+        if errors:
+            self._async_save_errors = []
+            raise RuntimeError(
+                f"async checkpoint write failed: {errors[0]!r}") \
+                from errors[0]
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
         from ..checkpoint.state_checkpoint import save_state
+        self._join_pending_saves()
         tag = tag or f"global_step{self.global_steps}"
         if self.offload_device:
             unflat = partial(jax.tree_util.tree_unflatten, self._param_treedef)
@@ -1130,6 +1167,37 @@ class DeepSpeedTpuEngine:
             "zero_stage": self.zero_stage,
             "dp_world_size": self.ds_config.dp_world_size,
         }
+        if self.config.checkpoint.async_save:
+            # snapshot to host NOW: device buffers may be donated by the
+            # next train step, and host-offload leaves are VIEWS of the
+            # live optimizer buffers (offload.py get_all_leaves), so numpy
+            # leaves must be deep-copied before device_get (a no-op on
+            # numpy) passes them through
+            import threading
+
+            state_snap = jax.tree.map(
+                lambda x: np.array(x) if isinstance(x, np.ndarray) else x,
+                state)
+            host_state = jax.device_get(state_snap)
+            errors = self._async_save_errors = getattr(
+                self, "_async_save_errors", [])
+
+            def write():
+                try:
+                    save_state(save_dir, tag, host_state, meta,
+                               save_latest=save_latest)
+                except Exception as exc:  # surfaced at the commit barrier
+                    errors.append(exc)
+
+            # non-daemon: a normal interpreter exit waits for the write
+            # instead of killing it mid-flight (a 'save final model then
+            # exit' script must not lose its checkpoint)
+            t = threading.Thread(target=write, daemon=False)
+            t.start()
+            self._pending_saves = getattr(self, "_pending_saves", []) + [t]
+            log_dist(f"async checkpoint started -> {save_dir}/{tag}",
+                     ranks=[0])
+            return True
         save_state(save_dir, tag, state, meta, save_latest=save_latest)
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
         return True
@@ -1137,6 +1205,7 @@ class DeepSpeedTpuEngine:
     def load_checkpoint(self, load_dir, tag=None, load_optimizer_states=True,
                         load_lr_scheduler_states=True, **_kw):
         from ..checkpoint.state_checkpoint import load_state, read_latest
+        self._join_pending_saves()
         tag = tag or read_latest(load_dir)
         if tag is None:
             return None, {}
@@ -1347,6 +1416,7 @@ class DeepSpeedTpuEngine:
     # ------------------------------------------------------------------
     def destroy(self):
         """Release host-side resources (reference engine.py destroy)."""
+        self._join_pending_saves()
         if self.host_opt is not None:
             self.host_opt.close()
             self.host_opt = None

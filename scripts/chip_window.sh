@@ -37,7 +37,12 @@ fi
 timeout 1500 python -m deepspeed_tpu.benchmarks.chip_evidence \
     --out "artifacts/${TAG}" || echo "chip_evidence failed (continuing)"
 
-git add -f "BENCH_${TAG}_early.json" "artifacts/${TAG}" profiles 2>/dev/null
+# stage each evidence path independently: git add is all-or-nothing on a
+# missing pathspec, and a failed bench must not drop the serving/flash
+# evidence that DID get written
+for path in "BENCH_${TAG}_early.json" "artifacts/${TAG}" profiles; do
+    [ -e "$path" ] && git add -f "$path"
+done
 git commit -m "Chip-window evidence capture (${TAG}): bench + serving + flash + overlap + comm" \
     || echo "nothing to commit"
 echo "== done =="

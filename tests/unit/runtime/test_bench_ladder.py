@@ -13,19 +13,23 @@ def test_bench_trial_ladder_shape():
                              intermediate_size=2816, num_layers=24,
                              num_heads=8, max_seq_len=2048)
     trials = bench.build_trials(base)
-    assert len(trials) == 16
+    assert len(trials) == 18
     # most promising first: selective remat + flash + biggest micro batch
     cfg0, micro0, pol0 = trials[0]
     assert (cfg0.use_flash, micro0, pol0) == (True, 16, "save_dots_and_attn")
     # the block-size and unchunked-CE variants sit early in the ladder
     assert any(t[0].attn_block_q == 512 for t in trials[:3])
     assert any(t[0].loss_chunk == 0 for t in trials[:4])
+    # round-4 additions: long-seq and tall-q flash variants, early
+    assert any(t[0].max_seq_len == 4096 for t in trials[:6])
+    assert any(t[0].attn_block_q == 1024 for t in trials[:6])
     # every policy gets at least one flash and one xla trial
     for pol in ("save_dots_and_attn", "dots_with_no_batch_dims_saveable",
                 "nothing_saveable"):
         mine = [t for t in trials if t[2] == pol]
         assert any(t[0].use_flash for t in mine)
         assert any(not t[0].use_flash for t in mine)
-    # ladder entries never mutate the base model geometry
+    # ladder entries never mutate the base model geometry (the long-seq
+    # variant changes max_seq_len only; MFU normalizes by measured seq)
     assert all(t[0].hidden_size == base.hidden_size and
                t[0].num_layers == base.num_layers for t in trials)

@@ -271,3 +271,45 @@ def test_zero3_unroll_hint_only_with_real_gathers():
     engine1, _, _, _ = deepspeed_tpu.initialize(
         model=TransformerLM(cfg_m), config=cfg, topology=single)
     assert engine1.model.scan_unroll_hint == 1  # dp=1: nothing to overlap
+
+
+def test_async_checkpoint_save():
+    """checkpoint.async_save: save_checkpoint returns immediately (file IO
+    on a background thread), the commit barrier joins before the next
+    save/load, and the written checkpoint resumes bit-exactly."""
+    import tempfile
+
+    cfg = base_config(micro=2, stage=2, dtype="bf16", lr=1e-2)
+    cfg["checkpoint"] = {"async_save": True}
+    model = SimpleModel(hidden_dim=HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    gb = make_global_batch(random_batches(1, gm, HIDDEN), 1, gm)
+    for _ in range(3):
+        engine.train_batch(batch=gb)
+    with tempfile.TemporaryDirectory() as d:
+        engine.save_checkpoint(d, tag="t")
+        assert len(engine._pending_saves) == 1
+        # training continues while the write is in flight (donated device
+        # buffers must not corrupt the host snapshot)
+        next_loss = engine.train_batch(batch=gb)
+        # the commit barrier belongs to the WRITER: another engine/process
+        # must only read after the writer's barrier (destroy/next save)
+        engine._join_pending_saves()
+        engine2, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(
+            hidden_dim=HIDDEN), config=cfg)
+        engine2.load_checkpoint(d, tag="t")
+        resumed = engine2.train_batch(batch=gb)
+        assert resumed == next_loss
+        engine.destroy()
+        assert engine._pending_saves == []
+
+
+def test_offload_param_rejected_loudly():
+    """zero_optimization.offload_param must raise, not silently no-op
+    (the hpZ dead-key rule)."""
+    cfg = base_config(micro=2, stage=3)
+    cfg["zero_optimization"]["offload_param"] = {"device": "cpu"}
+    with pytest.raises(NotImplementedError, match="offload_param"):
+        deepspeed_tpu.initialize(model=SimpleModel(hidden_dim=HIDDEN),
+                                 config=cfg)

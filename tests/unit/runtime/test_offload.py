@@ -165,3 +165,68 @@ def test_offload_universal_restores_optimizer_state(tmp_path):
     l_cont = engine.train_batch(batch=batch)
     l_resumed = engine2.train_batch(batch=batch)
     np.testing.assert_allclose(l_resumed, l_cont, rtol=1e-6)
+
+
+def test_async_save_with_offload_snapshots_host_state(tmp_path, monkeypatch):
+    """async_save + cpu offload: the host-optimizer leaves are VIEWS of
+    live buffers that opt.step mutates in place — the async snapshot must
+    deep-copy them, or training during the in-flight write tears the
+    checkpoint. The writer is gated so the mutation deterministically
+    happens while the write is pending."""
+    import threading
+
+    import deepspeed_tpu.checkpoint.state_checkpoint as sc
+
+    orig = sc.save_state
+    gate = threading.Event()
+
+    def delayed(*a, **kw):
+        assert gate.wait(timeout=30)
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(sc, "save_state", delayed)
+
+    cfg = base_config(micro=2, stage=2, dtype="bf16", lr=1e-2)
+    cfg["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
+    cfg["checkpoint"] = {"async_save": True}
+    engine, _ = _train(cfg, steps=2)
+    master_at_save = [l.copy() for l in engine.host_opt.get_master_leaves()]
+    engine.save_checkpoint(str(tmp_path / "ck"), tag="t")
+    # mutate the live host buffers while the write is blocked
+    micro = engine.micro_batch_size * engine.ds_config.dp_world_size
+    b = random_batches(1, micro * engine.gas, HIDDEN, seed=11)[0]
+    batch = {k: v.reshape(engine.gas, micro, HIDDEN) for k, v in b.items()}
+    engine.train_batch(batch=batch)
+    changed = any(
+        not np.allclose(a, b) for a, b in
+        zip(master_at_save, engine.host_opt.get_master_leaves()))
+    assert changed  # the step really moved the live buffers
+    gate.set()
+    engine._join_pending_saves()
+
+    cfg2 = base_config(micro=2, stage=2, dtype="bf16", lr=1e-2)
+    cfg2["zero_optimization"]["offload_optimizer"] = {"device": "cpu"}
+    engine2, _ = _train(cfg2, steps=1, seed=99)
+    engine2.load_checkpoint(str(tmp_path / "ck"), tag="t")
+    for a, b in zip(master_at_save, engine2.host_opt.get_master_leaves()):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_async_save_failure_raises_at_barrier(tmp_path, monkeypatch):
+    """A failed background write must raise at the commit barrier, not
+    vanish on the worker thread."""
+    import deepspeed_tpu.checkpoint.state_checkpoint as sc
+
+    def boom(*a, **kw):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(sc, "save_state", boom)
+    cfg = base_config(micro=2, stage=2, dtype="bf16", lr=1e-2)
+    cfg["checkpoint"] = {"async_save": True}
+    import pytest as _pytest
+    from tests.unit.simple_model import SimpleModel as _SM
+    engine, _, _, _ = deepspeed_tpu.initialize(model=_SM(hidden_dim=HIDDEN),
+                                               config=cfg)
+    engine.save_checkpoint(str(tmp_path / "ck"), tag="t")
+    with _pytest.raises(RuntimeError, match="async checkpoint"):
+        engine._join_pending_saves()
