@@ -39,23 +39,37 @@ def _np(t) -> np.ndarray:
 # ---------------------------------------------------------------------------
 # Config mapping (reference containers read the same HF config fields)
 # ---------------------------------------------------------------------------
+def _llama_family_config(hf_config, **extra) -> TransformerConfig:
+    """Shared llama/mistral/mixtral geometry (rmsnorm + rope + swiglu)."""
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+        max_seq_len=getattr(hf_config, "max_position_embeddings", 2048),
+        norm="rmsnorm", norm_eps=hf_config.rms_norm_eps,
+        activation="swiglu", positional="rope",
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        attn_bias=getattr(hf_config, "attention_bias", False),
+        **extra,
+    )
+
+
 def config_from_hf(hf_config) -> TransformerConfig:
     mt = getattr(hf_config, "model_type", "llama")
+    if mt == "mixtral":
+        # Mixtral-class sparse MoE (reference
+        # inference/v2/model_implementations/mixtral/): Mistral attention
+        # geometry + top-k routed experts
+        return _llama_family_config(
+            hf_config,
+            moe_num_experts=hf_config.num_local_experts,
+            moe_top_k=hf_config.num_experts_per_tok)
     if mt in ("llama", "mistral"):
-        return TransformerConfig(
-            vocab_size=hf_config.vocab_size,
-            hidden_size=hf_config.hidden_size,
-            intermediate_size=hf_config.intermediate_size,
-            num_layers=hf_config.num_hidden_layers,
-            num_heads=hf_config.num_attention_heads,
-            num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
-            max_seq_len=getattr(hf_config, "max_position_embeddings", 2048),
-            norm="rmsnorm", norm_eps=hf_config.rms_norm_eps,
-            activation="swiglu", positional="rope",
-            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
-            tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
-            attn_bias=getattr(hf_config, "attention_bias", False),
-        )
+        return _llama_family_config(hf_config)
     if mt == "gpt2":
         return TransformerConfig(
             vocab_size=hf_config.vocab_size,
@@ -180,9 +194,9 @@ def config_from_hf(hf_config) -> TransformerConfig:
             mlm_head=True,
         )
     raise ValueError(
-        f"unsupported model_type '{mt}'; supported: llama, mistral, gpt2, "
-        f"opt, bert, roberta, distilbert (add a mapping here the way the "
-        f"reference adds policy containers)")
+        f"unsupported model_type '{mt}'; supported: llama, mistral, "
+        f"mixtral, gpt2, opt, bert, roberta, distilbert (add a mapping "
+        f"here the way the reference adds policy containers)")
 
 
 # ---------------------------------------------------------------------------
@@ -195,9 +209,10 @@ def _stack(sd: Dict[str, np.ndarray], fmt: str, L: int,
     return np.ascontiguousarray(out, np.float32)
 
 
-def _params_from_llama(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+def _llama_family_attn_layers(sd, cfg: TransformerConfig,
+                              p: str) -> Dict[str, np.ndarray]:
+    """The llama/mistral/mixtral shared attention + norm sub-mapping."""
     L = cfg.num_layers
-    p = "model.layers.{}."
     layers = {
         "attn_norm": _stack(sd, p + "input_layernorm.weight", L),
         "wq": _stack(sd, p + "self_attn.q_proj.weight", L, transpose=True),
@@ -205,15 +220,17 @@ def _params_from_llama(sd, cfg: TransformerConfig) -> Dict[str, Any]:
         "wv": _stack(sd, p + "self_attn.v_proj.weight", L, transpose=True),
         "wo": _stack(sd, p + "self_attn.o_proj.weight", L, transpose=True),
         "mlp_norm": _stack(sd, p + "post_attention_layernorm.weight", L),
-        "w_gate": _stack(sd, p + "mlp.gate_proj.weight", L, transpose=True),
-        "w_up": _stack(sd, p + "mlp.up_proj.weight", L, transpose=True),
-        "w_down": _stack(sd, p + "mlp.down_proj.weight", L, transpose=True),
     }
     if cfg.attn_bias:
         layers["b_q"] = _stack(sd, p + "self_attn.q_proj.bias", L)
         layers["b_k"] = _stack(sd, p + "self_attn.k_proj.bias", L)
         layers["b_v"] = _stack(sd, p + "self_attn.v_proj.bias", L)
         layers["b_o"] = _stack(sd, p + "self_attn.o_proj.bias", L)
+    return layers
+
+
+def _llama_family_top(sd, cfg: TransformerConfig,
+                      layers: Dict[str, np.ndarray]) -> Dict[str, Any]:
     params = {
         "embed": np.ascontiguousarray(sd["model.embed_tokens.weight"],
                                       np.float32),
@@ -225,6 +242,42 @@ def _params_from_llama(sd, cfg: TransformerConfig) -> Dict[str, Any]:
         params["lm_head"] = np.ascontiguousarray(sd["lm_head.weight"].T,
                                                  np.float32)
     return params
+
+
+def _params_from_llama(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+    L = cfg.num_layers
+    p = "model.layers.{}."
+    layers = _llama_family_attn_layers(sd, cfg, p)
+    layers.update({
+        "w_gate": _stack(sd, p + "mlp.gate_proj.weight", L, transpose=True),
+        "w_up": _stack(sd, p + "mlp.up_proj.weight", L, transpose=True),
+        "w_down": _stack(sd, p + "mlp.down_proj.weight", L, transpose=True),
+    })
+    return _llama_family_top(sd, cfg, layers)
+
+
+def _params_from_mixtral(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+    """HF Mixtral: llama/mistral attention + block_sparse_moe experts
+    (w1=gate, w3=up, w2=down per expert; gate.weight is the router)."""
+    L, E = cfg.num_layers, cfg.moe_num_experts
+    p = "model.layers.{}."
+
+    def expert_stack(proj: str) -> np.ndarray:
+        fmt = p + "block_sparse_moe.experts.{}." + proj + ".weight"
+        out = np.stack([
+            np.stack([sd[fmt.format(i, e)].T for e in range(E)])
+            for i in range(L)])
+        return np.ascontiguousarray(out, np.float32)
+
+    layers = _llama_family_attn_layers(sd, cfg, p)
+    layers.update({
+        "moe_gate_w": _stack(sd, p + "block_sparse_moe.gate.weight", L,
+                             transpose=True),
+        "e_gate": expert_stack("w1"),   # [L, E, H, F]
+        "e_up": expert_stack("w3"),     # [L, E, H, F]
+        "e_down": expert_stack("w2"),   # [L, E, F, H]
+    })
+    return _llama_family_top(sd, cfg, layers)
 
 
 def _params_from_gpt2(sd, cfg: TransformerConfig) -> Dict[str, Any]:
@@ -480,6 +533,8 @@ def params_from_hf(state_dict: Dict[str, Any],
     sd = {k: _np(v) for k, v in state_dict.items()}
     if model_type in ("llama", "mistral"):
         return _params_from_llama(sd, cfg)
+    if model_type == "mixtral":
+        return _params_from_mixtral(sd, cfg)
     if model_type == "gpt2":
         return _params_from_gpt2(sd, cfg)
     if model_type == "opt":

@@ -323,8 +323,33 @@ class DeepSpeedTpuConfig:
     train_steps: Optional[int] = None
 
 
+def _contains_auto(node) -> bool:
+    if isinstance(node, str):
+        return node == AUTO
+    if isinstance(node, (list, tuple)):
+        return any(_contains_auto(v) for v in node)
+    return False
+
+
+def _scrub_auto(node):
+    """Drop every ``"auto"`` value recursively: HF-style configs ship
+    ``"auto"`` for fields the integration layer would fill (reference
+    __init__.py add_config_arguments / HF Trainer contract); here a
+    dropped key falls back to the field's default, which is the same
+    resolution standalone DeepSpeed applies. A list-valued field with an
+    ``"auto"`` element (e.g. ``betas: ["auto", "auto"]``) is auto as a
+    whole: the key is dropped."""
+    if isinstance(node, dict):
+        return {k: _scrub_auto(v) for k, v in node.items()
+                if not (isinstance(v, str) and v == AUTO)
+                and not (isinstance(v, (list, tuple)) and _contains_auto(v))}
+    if isinstance(node, (list, tuple)):
+        return type(node)(_scrub_auto(v) for v in node)
+    return node
+
+
 def _coerce_optional_blocks(raw: Dict[str, Any]) -> Dict[str, Any]:
-    raw = dict(raw)
+    raw = _scrub_auto(raw)
     for key, cls in (("optimizer", OptimizerConfig), ("scheduler", SchedulerConfig)):
         if isinstance(raw.get(key), dict):
             raw[key] = hydrate(cls, raw[key], path=f"{key}.")
@@ -363,9 +388,12 @@ class DeepSpeedConfig:
 
     def _resolve_batch_sizes(self):
         c = self.cfg
-        tb = None if c.train_batch_size in (None, AUTO) else int(c.train_batch_size)
-        mb = None if c.train_micro_batch_size_per_gpu in (None, AUTO) else int(c.train_micro_batch_size_per_gpu)
-        gas = None if c.gradient_accumulation_steps in (None, AUTO) else int(c.gradient_accumulation_steps)
+        # "auto" was scrubbed to the field default (None) at ingestion
+        tb = None if c.train_batch_size is None else int(c.train_batch_size)
+        mb = (None if c.train_micro_batch_size_per_gpu is None
+              else int(c.train_micro_batch_size_per_gpu))
+        gas = (None if c.gradient_accumulation_steps is None
+               else int(c.gradient_accumulation_steps))
         dp = self.dp_world_size
         if tb is not None and mb is not None and gas is None:
             gas, rem = divmod(tb, mb * dp)

@@ -3,7 +3,9 @@
 Mirrors the role of the reference's ``runtime/config_utils.py``
 (``DeepSpeedConfigModel``, pydantic-based) with plain dataclasses: each config
 block is declared as a dataclass and hydrated from a (possibly partial) dict,
-with unknown-key detection and "auto" value support.
+with unknown-key detection. "auto" values are scrubbed to the
+field defaults at ingestion (config.py _scrub_auto), the same
+resolution standalone DeepSpeed applies.
 """
 
 import dataclasses
@@ -23,7 +25,9 @@ def hydrate(cls: Type[T], data: Optional[Dict[str, Any]], path: str = "") -> T:
     """Build dataclass `cls` from dict `data`, recursing into nested dataclasses.
 
     Unknown keys raise ConfigError (matching the reference's strict pydantic
-    models); values equal to "auto" are kept as-is for later resolution.
+    models). "auto" values never reach here when coming through
+    DeepSpeedConfig: its ingestion scrubs them to the field defaults
+    (config.py _scrub_auto).
     """
     data = dict(data or {})
     kwargs = {}
@@ -71,6 +75,3 @@ def as_dict(obj) -> Dict[str, Any]:
 class DtypeConfig:
     enabled: bool = False
 
-
-def resolve_auto(value, default):
-    return default if value == AUTO else value

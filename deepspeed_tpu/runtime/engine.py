@@ -1416,10 +1416,12 @@ class DeepSpeedTpuEngine:
     # ------------------------------------------------------------------
     def destroy(self):
         """Release host-side resources (reference engine.py destroy)."""
-        self._join_pending_saves()
-        if self.host_opt is not None:
-            self.host_opt.close()
-            self.host_opt = None
+        try:
+            self._join_pending_saves()  # may raise a failed async write
+        finally:
+            if self.host_opt is not None:
+                self.host_opt.close()
+                self.host_opt = None
 
     def train(self, mode: bool = True):
         return self

@@ -1,0 +1,77 @@
+"""Train a layer-list model through the compiled 1F1B pipeline.
+
+Shows the reference PipelineModule surface (LayerSpec/TiedLayerSpec,
+partition_method) on the TPU-native engine: identical LayerSpec runs are
+automatically stored pipe-sharded (each stage holds only its own layers).
+
+  python examples/train_pipeline.py --cpu-mesh 8 --stages 4
+"""
+
+import argparse
+import os
+import sys
+
+# run in-tree without installation
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--cpu-mesh", type=int, default=0)
+    p.add_argument("--stages", type=int, default=4)
+    p.add_argument("--steps", type=int, default=20)
+    args = p.parse_args()
+
+    if args.cpu_mesh:
+        import os
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   f" --xla_force_host_platform_device_count={args.cpu_mesh}")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu import LayerSpec, PipelineModule
+
+    HID = 64
+
+    class Block:
+        def __init__(self, d):
+            self.d = d
+
+        def init(self, rng):
+            return {"w": jax.random.normal(rng, (self.d, self.d),
+                                           jnp.float32) * 0.1}
+
+        def apply(self, p, x):
+            return jax.nn.tanh(x @ p["w"]) + x
+
+    model = PipelineModule(
+        [LayerSpec(Block, HID) for _ in range(8)],
+        loss_fn=lambda out, b: jnp.mean(
+            (out - b["y"].astype(jnp.float32)) ** 2),
+        partition_method="uniform", input_ndim=2)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "gradient_accumulation_steps": 4,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                "pipeline": {"stages": args.stages},
+                "zero_optimization": {"stage": 1},
+                "steps_per_print": 5})
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((engine.gas, gm, HID)).astype(np.float32)
+    y = rng.standard_normal((engine.gas, gm, HID)).astype(np.float32)
+    for _ in range(args.steps):
+        loss = engine.train_batch(batch={"x": x, "y": y})
+    w = engine.params["stack_000"]["w"]
+    frac = w.addressable_shards[0].data.nbytes / w.nbytes
+    print(f"final loss {loss:.4f}; stacked params pipe-sharded: each device "
+          f"holds {frac:.0%} of the layer stack")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Train a small causal LM with ZeRO-3 (+ optional ZeRO++/hpZ) end to end.
+
+Runs anywhere: on a TPU slice this uses the real chips; elsewhere pass
+--cpu-mesh N to simulate N devices on CPU (the same SPMD partitioning).
+
+  python examples/train_zero3.py --cpu-mesh 8 --steps 30
+  python examples/train_zero3.py --cpu-mesh 8 --hpz 2 --qwz   # ZeRO++ flavor
+"""
+
+import argparse
+import os
+import sys
+
+# run in-tree without installation
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--cpu-mesh", type=int, default=0,
+                   help="simulate N CPU devices (0 = use real devices)")
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--micro", type=int, default=2)
+    p.add_argument("--gas", type=int, default=1)
+    p.add_argument("--hpz", type=int, default=1,
+                   help="ZeRO++ hpZ secondary partition size")
+    p.add_argument("--qwz", action="store_true",
+                   help="ZeRO++ int8 quantized weight gather")
+    args = p.parse_args()
+
+    if args.cpu_mesh:
+        import os
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   f" --xla_force_host_platform_device_count={args.cpu_mesh}")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=256, hidden_size=128,
+                            intermediate_size=256, num_layers=4, num_heads=8,
+                            max_seq_len=128)
+    config = {
+        "train_micro_batch_size_per_gpu": args.micro,
+        "gradient_accumulation_steps": args.gas,
+        "optimizer": {"type": "adamw", "params": {"lr": 3e-4}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {
+            "stage": 3,
+            "stage3_param_persistence_threshold": 0,
+            "zero_hpz_partition_size": args.hpz,
+            "zero_quantized_weights": args.qwz,
+        },
+        "steps_per_print": 10,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=TransformerLM(cfg),
+                                               config=config)
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(0)
+    for step in range(args.steps):
+        batch = {"input_ids": rng.integers(
+            0, cfg.vocab_size, (engine.gas, gm, cfg.max_seq_len),
+            dtype=np.int64)}
+        loss = engine.train_batch(batch=batch)
+    engine.save_checkpoint("/tmp/example_zero3_ckpt")
+    print(f"final loss {loss:.4f}; checkpoint saved; "
+          f"mesh {engine.topology.sizes}")
+
+
+if __name__ == "__main__":
+    main()

@@ -200,3 +200,50 @@ def test_distilbert_untied_decoder_matches_hf():
     _randomize_biases(hf, seed=9)
     ids_np = np.random.default_rng(9).integers(0, 96, (1, 8), dtype=np.int64)
     _assert_logits_match(hf, ids_np)
+
+
+def test_mixtral_injection_matches_hf_serving():
+    """HF Mixtral (sparse top-2 MoE) conversion: the ragged v2 engine's
+    prefill logits must match the HF torch forward — the serving path's
+    softmax->top-k->renormalize routing is exactly Mixtral's (reference
+    inference/v2/model_implementations/mixtral/)."""
+    import jax
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+
+    cfg = transformers.MixtralConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False)
+    torch.manual_seed(3)
+    hf = transformers.MixtralForCausalLM(cfg).eval()
+    model, params = load_hf_model(hf)
+    assert model.cfg.moe_num_experts == 4 and model.cfg.moe_top_k == 2
+    params = {k: jnp.asarray(v) if not isinstance(v, dict)
+              else {kk: jnp.asarray(vv) for kk, vv in v.items()}
+              for k, v in params.items()}
+
+    import dataclasses
+    model.cfg = dataclasses.replace(model.cfg, use_flash=False, remat=False)
+    engine = InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_tracked_sequences=2, max_seq_len=64, num_blocks=9,
+                block_size=16),
+            dtype="float32", prefill_bucket=16), params=params)
+    prompt = np.array([5, 9, 17, 3, 21, 40, 2], np.int64)
+    ours = engine.put([1], [prompt])
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(prompt[None])).logits.float().numpy()
+    np.testing.assert_allclose(ours[0], theirs[0, -1], rtol=2e-3, atol=2e-3)
+    # and a decode step
+    ours2 = engine.put([1], [[11]])
+    with torch.no_grad():
+        theirs2 = hf(torch.from_numpy(
+            np.concatenate([prompt, [11]])[None])).logits.float().numpy()
+    np.testing.assert_allclose(ours2[0], theirs2[0, -1], rtol=2e-3, atol=2e-3)

@@ -1,0 +1,25 @@
+"""The in-tree examples must actually run (the reference points users at
+DeepSpeedExamples; ours ship in-tree and are smoke-tested)."""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[3]
+
+
+@pytest.mark.parametrize("cmd", [
+    ["examples/train_zero3.py", "--cpu-mesh", "4", "--steps", "3"],
+    ["examples/train_zero3.py", "--cpu-mesh", "4", "--steps", "2",
+     "--hpz", "2", "--qwz"],
+    ["examples/train_pipeline.py", "--cpu-mesh", "4", "--stages", "2",
+     "--steps", "2"],
+    ["examples/serve_ragged.py", "--cpu", "--new-tokens", "3"],
+    ["examples/serve_ragged.py", "--cpu", "--moe", "--new-tokens", "3"],
+])
+def test_example_runs(cmd):
+    r = subprocess.run([sys.executable] + cmd, cwd=REPO,
+                       capture_output=True, text=True, timeout=560)
+    assert r.returncode == 0, r.stderr[-1500:]

@@ -145,3 +145,36 @@ def test_north_star_7b_fits_v5e_64():
     assert z3["total_bytes"] < 0.2 * hbm        # ~1.7 GB/chip: plenty left
     z0 = estimate_zero_memory(n, stage=0, dp=64)
     assert z0["total_bytes"] > hbm              # 112 GB: ZeRO is mandatory
+
+
+def test_hf_style_auto_values_resolve_to_defaults():
+    """HF integrations ship configs full of "auto" strings (reference
+    __init__.py add_config_arguments / HF Trainer contract): every "auto"
+    must resolve to the field default instead of leaking a string into
+    numeric fields."""
+    import numpy as np
+    import deepspeed_tpu
+    from tests.unit.simple_model import SimpleModel
+
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": "auto",
+        "train_batch_size": "auto",
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": "auto", "weight_decay": "auto"}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": "auto"}},
+        "zero_optimization": {"stage": 2, "reduce_bucket_size": "auto",
+                              "allgather_bucket_size": "auto"},
+        "fp16": {"enabled": False, "loss_scale": "auto"},
+        "bf16": {"enabled": "auto"},
+        "gradient_clipping": "auto",
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=32), config=cfg)
+    assert engine.gas == 1                      # auto -> default
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.standard_normal((1, gm, 32)).astype("f4"),
+             "y": rng.standard_normal((1, gm, 32)).astype("f4")}
+    assert np.isfinite(engine.train_batch(batch=batch))
