@@ -41,6 +41,18 @@ def _np(t) -> np.ndarray:
 # ---------------------------------------------------------------------------
 def _llama_family_config(hf_config, **extra) -> TransformerConfig:
     """Shared llama/mistral/mixtral geometry (rmsnorm + rope + swiglu)."""
+    max_seq = getattr(hf_config, "max_position_embeddings", 2048)
+    # Mistral-family sliding-window attention is not implemented; within
+    # the window full attention is IDENTICAL, so cap the sequence length
+    # there rather than silently diverging from HF beyond it
+    window = getattr(hf_config, "sliding_window", None)
+    if window is not None and window < max_seq:
+        logger.warning(
+            f"sliding_window={window} < max_position_embeddings={max_seq}: "
+            f"capping max_seq_len to the window (full attention matches "
+            f"HF exactly within it; sliding-window masking is not "
+            f"implemented)")
+        max_seq = window
     return TransformerConfig(
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,
@@ -48,7 +60,7 @@ def _llama_family_config(hf_config, **extra) -> TransformerConfig:
         num_layers=hf_config.num_hidden_layers,
         num_heads=hf_config.num_attention_heads,
         num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
-        max_seq_len=getattr(hf_config, "max_position_embeddings", 2048),
+        max_seq_len=max_seq,
         norm="rmsnorm", norm_eps=hf_config.rms_norm_eps,
         activation="swiglu", positional="rope",
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),

@@ -247,3 +247,17 @@ def test_mixtral_injection_matches_hf_serving():
         theirs2 = hf(torch.from_numpy(
             np.concatenate([prompt, [11]])[None])).logits.float().numpy()
     np.testing.assert_allclose(ours2[0], theirs2[0, -1], rtol=2e-3, atol=2e-3)
+
+
+def test_mistral_sliding_window_caps_seq_len():
+    """Sliding-window attention is not implemented: the conversion caps
+    max_seq_len at the window (full attention is exact within it) instead
+    of silently diverging from HF beyond it."""
+    from deepspeed_tpu.module_inject.auto_tp import config_from_hf
+
+    cfg = transformers.MistralConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=8192, sliding_window=64, rms_norm_eps=1e-5)
+    ours = config_from_hf(cfg)
+    assert ours.max_seq_len == 64
