@@ -178,6 +178,30 @@ class DeepSpeedTpuEngine:
                 "(parameter streaming from host memory inside the jitted "
                 "step); offload_optimizer (cpu/nvme host optimizer) is")
 
+        # --- legacy seqlen curriculum (reference engine.py
+        # curriculum_seqlen + curriculum_scheduler): train_batch truncates
+        # the batch's sequence axis to the scheduled difficulty. Coarse
+        # difficulty_step recommended on TPU (one recompile per distinct
+        # seqlen — truncate_seqlen docstring).
+        self.curriculum = None
+        cl = self.config.curriculum_learning
+        if isinstance(cl, dict) and cl.get("enabled"):
+            from .config import ConfigError
+            missing = [k for k in ("min_difficulty", "max_difficulty")
+                       if k not in cl]
+            if missing:
+                raise ConfigError(
+                    f"curriculum_learning requires {missing} (plus "
+                    f"schedule_config for fixed_linear/fixed_root)")
+            from .data_pipeline.curriculum_scheduler import \
+                CurriculumScheduler
+            # optional scoping of which batch fields get truncated
+            # (default: every field with a longer trailing axis)
+            self._curriculum_keys = cl.get("truncate_keys")
+            self.curriculum = CurriculumScheduler(
+                {k: v for k, v in cl.items()
+                 if k not in ("enabled", "truncate_keys")})
+
         # --- activation checkpointing config (reference engine.py:902
         # _configure_checkpointing -> checkpointing.configure)
         from .activation_checkpointing import checkpointing as ds_ckpt
@@ -931,6 +955,21 @@ class DeepSpeedTpuEngine:
                 data_iter = self.training_dataloader
             micro_batches = [next(data_iter) for _ in range(self.gas)]
             batch = jax.tree.map(lambda *xs: np.stack(xs), *micro_batches)
+        if self.curriculum is not None:
+            if isinstance(batch, dict):
+                from .data_pipeline import truncate_seqlen
+                seqlen = self.curriculum.update_difficulty(
+                    self.global_steps + 1)
+                batch = truncate_seqlen(batch, seqlen,
+                                        keys=self._curriculum_keys)
+            elif not getattr(self, "_curriculum_warned", False):
+                # loud, not silent (the dead-key audit's rule): curriculum
+                # truncation needs named fields to know what to slice
+                self._curriculum_warned = True
+                logger.warning(
+                    "curriculum_learning is enabled but the batch is not a "
+                    "dict of named fields; seqlen truncation is SKIPPED — "
+                    "feed dict batches (or disable the curriculum block)")
         dev_batch = self._shard_batch(batch)
         self.tput_timer.start()
         if self.offload_device:

@@ -59,8 +59,10 @@ INERT_BY_DESIGN = {
     "fast_init": "zero.Init equivalent is eval_shape + sharded init always",
     "num_microbatches": "gradient_accumulation_steps is the one knob",
     "seed_layers": "data-routing RNG derives from the engine seed",
-    "curriculum_learning": "legacy alias; data_efficiency module is the API",
-    "data_efficiency": "consumed by data_pipeline via its own config dicts",
+    "data_efficiency": "data_sampling/random-LTD are library components "
+                       "(DeepSpeedDataSampler, RandomLTD layer) a model "
+                       "opts into; engine-level seqlen curriculum is the "
+                       "curriculum_learning block",
     "data_types": "precision comes from the fp16/bf16 blocks",
     # aio/checkpoint knobs owned by the C++ layer's own defaults
     "buffer_count": "AIO thread pool sizes its own staging buffers",

@@ -163,3 +163,48 @@ def test_data_analyzer_map_reduce(tmp_path):
     import json, os
     man = json.load(open(os.path.join(tmp_path, "manifest.json")))
     assert man["num_samples"] == 32 and "rarity" in man["metrics"]
+
+
+def test_curriculum_learning_wired_into_engine():
+    """The legacy curriculum_learning config block drives per-step seqlen
+    truncation inside train_batch (reference engine curriculum_seqlen):
+    early steps see min_difficulty tokens, late steps the full sequence."""
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+    cfg_m = TransformerConfig(vocab_size=64, hidden_size=32,
+                              intermediate_size=64, num_layers=2,
+                              num_heads=4, max_seq_len=32,
+                              use_flash=False, remat=False)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "curriculum_learning": {
+            "enabled": True, "curriculum_type": "fixed_linear",
+            "min_difficulty": 8, "max_difficulty": 32,
+            "schedule_config": {"total_curriculum_step": 4,
+                                "difficulty_step": 8}},
+        "steps_per_print": 10**9,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=TransformerLM(cfg_m),
+                                               config=config)
+    assert engine.curriculum is not None
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 64, (1, gm, 32), dtype=np.int64)}
+
+    seen = []
+    orig = engine._shard_batch
+
+    def spy(b):
+        seen.append(b["input_ids"].shape[-1])
+        return orig(b)
+
+    engine._shard_batch = spy
+    for _ in range(6):
+        engine.train_batch(batch=batch)
+    # step 1 -> 8 tokens (min); by total_curriculum_step the full 32
+    assert seen[0] == 8, seen
+    assert seen[-1] == 32, seen
+    assert seen == sorted(seen)  # difficulty only grows
