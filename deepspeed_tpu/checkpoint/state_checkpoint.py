@@ -18,12 +18,37 @@ Multi-host: sharded arrays are gathered via multihost allgather before process
 
 import json
 import os
+import re
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 
 SENTINEL_NONE = "__none__"
+
+# PipelineModule pipe-sharded storage stacks identical layers a..a+L-1 into
+# one [L, ...] tree under ``stack_{a:03d}`` (runtime/pipe/module.py) — but
+# WHICH runs stack depends on pp, so checkpoints must not contain stacked
+# keys: saves split them into canonical per-layer fragments
+# (``layer_{a+j:03d}/...``) and loads re-stack on demand. This keeps the
+# native format's promise: any topology loads any checkpoint.
+_STACK_COMPONENT = re.compile(r"stack_(\d+)")
+
+
+def stacked_component(key: str):
+    """(component_index, first_layer) if the '/'-path contains a
+    PipelineModule stacked-storage component, else None."""
+    for idx, part in enumerate(key.split("/")):
+        m = _STACK_COMPONENT.fullmatch(part)
+        if m:
+            return idx, int(m.group(1))
+    return None
+
+
+def per_layer_key(key: str, comp_idx: int, layer: int) -> str:
+    parts = key.split("/")
+    parts[comp_idx] = f"layer_{layer:03d}"
+    return "/".join(parts)
 
 
 def _leaf_paths(tree):
@@ -69,13 +94,23 @@ def save_state(save_dir: str, tag: str, state: Dict[str, Any],
             continue
         leaves, _ = _leaf_paths(subtree)
         entries = {}
-        for key, leaf in leaves:
-            arr = _fetch(leaf)
-            fname = f"{name}__{key.replace('/', '__')}.npy" if key else f"{name}.npy"
+
+        def emit(key, arr):
+            stacked = stacked_component(key) if key else None
+            if stacked is not None:
+                comp_idx, first = stacked
+                for j in range(arr.shape[0]):
+                    emit(per_layer_key(key, comp_idx, first + j), arr[j])
+                return
+            fname = (f"{name}__{key.replace('/', '__')}.npy" if key
+                     else f"{name}.npy")
             if is_writer:
                 np.save(os.path.join(ckpt_dir, fname), arr)
             entries[key] = {"file": fname, "shape": list(arr.shape),
                             "dtype": str(arr.dtype)}
+
+        for key, leaf in leaves:
+            emit(key, _fetch(leaf))
         manifest["tensors"][name] = entries
     if is_writer:
         with open(os.path.join(ckpt_dir, "manifest.json"), "w") as fh:
@@ -83,6 +118,30 @@ def save_state(save_dir: str, tag: str, state: Dict[str, Any],
         if save_latest:
             with open(os.path.join(save_dir, "latest"), "w") as fh:
                 fh.write(tag)
+
+
+def _load_fragment(entry: Dict[str, Any], ckpt_dir: str, key: str,
+                   leaf) -> np.ndarray:
+    """One leaf from its fragment(s): direct hit, or — for a pipe-stacked
+    template key — re-stack the canonical per-layer fragments (the
+    converse of save_state's split). Old checkpoints that still carry
+    stacked keys load via the direct hit."""
+    info = entry.get(key)
+    if info is not None:
+        return np.load(os.path.join(ckpt_dir, info["file"]))
+    stacked = stacked_component(key)
+    if stacked is not None and hasattr(leaf, "shape"):
+        comp_idx, first = stacked
+        members = []
+        for j in range(leaf.shape[0]):
+            lk = per_layer_key(key, comp_idx, first + j)
+            li = entry.get(lk)
+            if li is None:
+                raise KeyError(f"checkpoint missing tensor {lk} "
+                               f"(for stacked {key})")
+            members.append(np.load(os.path.join(ckpt_dir, li["file"])))
+        return np.stack(members)
+    raise KeyError(f"checkpoint missing tensor {key}")
 
 
 def read_latest(load_dir: str) -> Optional[str]:
@@ -115,11 +174,8 @@ def load_params_for_inference(path: str, model, dtype, param_sharding=None):
     sharding_leaves = (jax.tree.leaves(param_sharding)
                       if param_sharding is not None else [None] * len(leaves))
     new_leaves = []
-    for (key, _leaf), sh in zip(leaves, sharding_leaves):
-        info = entry.get(key)
-        if info is None:
-            raise KeyError(f"checkpoint missing param {key}")
-        arr = np.load(os.path.join(ckpt_dir, info["file"])).astype(dtype)
+    for (key, leaf), sh in zip(leaves, sharding_leaves):
+        arr = _load_fragment(entry, ckpt_dir, key, leaf).astype(dtype)
         new_leaves.append(jax.device_put(arr, sh) if sh is not None
                           else jax.numpy.asarray(arr))
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
@@ -142,10 +198,7 @@ def load_state(load_dir: str, tag: str, template: Dict[str, Any],
         leaves, treedef = _leaf_paths(subtree)
         new_leaves = []
         for key, leaf in leaves:
-            info = entry.get(key)
-            if info is None:
-                raise KeyError(f"checkpoint missing tensor {name}/{key}")
-            arr = np.load(os.path.join(ckpt_dir, info["file"]))
+            arr = _load_fragment(entry, ckpt_dir, key, leaf)
             if isinstance(leaf, np.ndarray):
                 # host-resident leaf (e.g. ZeRO-Offload state): stay on host
                 new_leaves.append(arr.astype(leaf.dtype))

@@ -20,39 +20,18 @@ adds:
 
 import json
 import os
-import re
 import shutil
 from typing import Any, Dict, Optional
 
 import numpy as np
 
-from .state_checkpoint import SENTINEL_NONE, read_latest
+# stacked-storage split/re-stack (PipelineModule pipe-sharded params) is
+# shared with the native format: both stores are canonical per-layer
+from .state_checkpoint import (SENTINEL_NONE, read_latest,
+                               per_layer_key as _per_layer_key,
+                               stacked_component as _stacked_component)
 
 UNIVERSAL_SUBDIR = "zero_universal"
-
-# PipelineModule pipe-sharded storage stacks identical layers a..a+L-1 into
-# one [L, ...] tree under the key ``stack_{a:03d}`` (runtime/pipe/module.py)
-# — but WHICH runs stack depends on pp, so the universal format must not
-# contain stacked keys. Conversion splits them into canonical per-layer
-# fragments (``layer_{a+j:03d}/...``); loading re-stacks on demand when the
-# target topology's template asks for a stacked key.
-_STACK_COMPONENT = re.compile(r"stack_(\d+)")
-
-
-def _stacked_component(key: str):
-    """(component_index, first_layer) if the '/'-path contains a
-    PipelineModule stacked-storage component, else None."""
-    for idx, part in enumerate(key.split("/")):
-        m = _STACK_COMPONENT.fullmatch(part)
-        if m:
-            return idx, int(m.group(1))
-    return None
-
-
-def _per_layer_key(key: str, comp_idx: int, layer: int) -> str:
-    parts = key.split("/")
-    parts[comp_idx] = f"layer_{layer:03d}"
-    return "/".join(parts)
 
 
 def _native_ckpt_dir(path: str, tag: Optional[str] = None) -> Optional[str]:

@@ -1104,16 +1104,19 @@ class DeepSpeedTpuEngine:
             clip = self.config.gradient_clipping
             fp16 = self.fp16_enabled
             scale_cfg = self.scale_cfg
+            # frozen leaves (requires_grad=False) hold on this path too
+            fm = getattr(self.model, "frozen_mask", None)
+            frozen_mask = fm() if callable(fm) else fm
 
             def apply(params, master, opt_state, scale_state, step, grads):
                 scale = (scale_state["loss_scale"] if fp16
                          else jnp.asarray(1.0, jnp.float32))
                 grads, finite, _gnorm = unscale_clip_check(
-                    grads, 1.0 / (gas * scale), clip, fp16)
+                    grads, 1.0 / (gas * scale), clip, fp16, frozen_mask)
                 target = master if has_master else params
                 new_target, new_opt, new_step = apply_update_with_skip(
                     optimizer, target, grads, opt_state, step, lr_fn(step),
-                    finite)
+                    finite, frozen_mask)
                 new_scale_state = (update_scale(scale_state, finite, scale_cfg)
                                    if fp16 else scale_state)
                 skipped = (~finite).astype(jnp.int32)

@@ -235,3 +235,67 @@ def test_universal_across_pipeline_topologies(tmp_path):
     np.testing.assert_allclose(w4b, w4, rtol=1e-6)
     # both resumed engines keep training finitely
     assert np.isfinite(eng4b.train_batch(batch=batch))
+
+
+def test_native_checkpoint_across_pipeline_topologies(tmp_path):
+    """The NATIVE format keeps its 'any topology loads any checkpoint'
+    promise for pipe-stacked storage too: saves split stacked leaves into
+    canonical per-layer fragments, loads re-stack — pp=4 <-> pp=1 via
+    plain save_checkpoint/load_checkpoint, no universal conversion."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu import LayerSpec, PipelineModule
+
+    class Lin:
+        def __init__(self, d):
+            self.d = d
+
+        def init(self, rng):
+            return {"w": jax.random.normal(rng, (self.d, self.d),
+                                           jnp.float32) * 0.2}
+
+        def apply(self, p, x):
+            return jax.nn.tanh(x @ p["w"])
+
+    def mse(out, b):
+        return jnp.mean((out - b["y"].astype(jnp.float32)) ** 2)
+
+    def make_engine(pp):
+        pm = PipelineModule([LayerSpec(Lin, HIDDEN) for _ in range(8)], mse,
+                            partition_method="uniform", input_ndim=2)
+        cfg = {"train_micro_batch_size_per_gpu": 4 if pp > 1 else 1,
+               "gradient_accumulation_steps": 4,
+               "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+               "pipeline": {"stages": pp},
+               "zero_optimization": {"stage": 0},
+               "steps_per_print": 100}
+        engine, _, _, _ = deepspeed_tpu.initialize(model=pm, config=cfg)
+        return engine
+
+    eng4 = make_engine(pp=4)
+    assert "stack_000" in eng4.params
+    gm = eng4.micro_batch_size * eng4.ds_config.dp_world_size
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.standard_normal((4, gm, HIDDEN)).astype(np.float32),
+             "y": rng.standard_normal((4, gm, HIDDEN)).astype(np.float32)}
+    eng4.train_batch(batch=batch)
+    eng4.save_checkpoint(str(tmp_path / "ck"), tag="t")
+
+    # pp=4 (stacked) -> pp=1 (unstacked) through the NATIVE loader
+    eng1 = make_engine(pp=1)
+    eng1.load_checkpoint(str(tmp_path / "ck"), tag="t")
+    w4 = np.asarray(jax.device_get(eng4.params["stack_000"]["w"]), np.float32)
+    for j in range(8):
+        w1 = np.asarray(jax.device_get(
+            eng1.params[f"layer_{j:03d}"]["w"]), np.float32)
+        np.testing.assert_allclose(w1, w4[j], rtol=1e-6)
+    assert eng1.global_steps == eng4.global_steps
+
+    # and back: pp=1 save -> pp=4 stacked load
+    eng1.save_checkpoint(str(tmp_path / "ck1"), tag="t")
+    eng4b = make_engine(pp=4)
+    eng4b.load_checkpoint(str(tmp_path / "ck1"), tag="t")
+    w4b = np.asarray(jax.device_get(eng4b.params["stack_000"]["w"]),
+                     np.float32)
+    np.testing.assert_allclose(w4b, w4, rtol=1e-6)
+    assert np.isfinite(eng4b.train_batch(batch=batch))

@@ -313,3 +313,32 @@ def test_offload_param_rejected_loudly():
     with pytest.raises(NotImplementedError, match="offload_param"):
         deepspeed_tpu.initialize(model=SimpleModel(hidden_dim=HIDDEN),
                                  config=cfg)
+
+
+def test_frozen_params_hold_on_compat_path():
+    """forward/backward/step must honor frozen_mask like train_batch does
+    (gradient updates AND decoupled weight decay both skip frozen leaves)."""
+    from tests.unit.simple_model import SimpleFrozenModel
+
+    cfg = base_config(micro=2, gas=1, stage=0, lr=1e-2)
+    cfg["optimizer"]["params"]["weight_decay"] = 0.1
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleFrozenModel(hidden_dim=32), config=cfg)
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.standard_normal((gm, 32)).astype("f4"),
+             "y": rng.standard_normal((gm, 32)).astype("f4")}
+    frozen0 = np.asarray(jax.device_get(engine.params["layer_0"]["w"]),
+                         np.float32).copy()
+    train0 = np.asarray(jax.device_get(engine.params["layer_1"]["w"]),
+                        np.float32).copy()
+    for _ in range(3):
+        engine.forward(batch)
+        engine.backward()
+        engine.step()
+    frozen1 = np.asarray(jax.device_get(engine.params["layer_0"]["w"]),
+                         np.float32)
+    train1 = np.asarray(jax.device_get(engine.params["layer_1"]["w"]),
+                        np.float32)
+    np.testing.assert_array_equal(frozen0, frozen1)
+    assert not np.allclose(train0, train1)
