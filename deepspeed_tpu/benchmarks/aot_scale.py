@@ -1,0 +1,206 @@
+"""Chip-free scale proofs: AOT compilation against TPU topology descriptions.
+
+The libtpu compiler is a host library — ``jax.experimental.topologies`` can
+describe a full v5e-64 pod slice and ``jit(...).lower(...).compile()`` runs
+the REAL TPU compilation pipeline (SPMD partitioner, async collective fusion,
+latency-hiding scheduler, memory assignment) with no device attached. Two
+proofs ride on that:
+
+1. **ZeRO-3 overlap at dp=8** (VERDICT r4 Next #2): compile the engine's
+   actual jitted train step for a v5e 8-chip slice at stage 0 vs stage 3 and
+   measure how many parameter all-gathers the TPU backend covers with async
+   collective fusion chains (its equivalent of the reference's dedicated
+   __allgather_stream, reference runtime/zero/stage3.py:1151). Artifact:
+   ``artifacts/overlap_dp8.json``.
+
+2. **The Llama-2-7B / v5e-64 north star fits** (VERDICT r4 Next #3): compile
+   the real 7B config under ZeRO-3 (and ZeRO-3+hpZ) on a v5e:8x8 topology and
+   read per-chip argument+temp bytes out of the executable's memory analysis;
+   assert they clear the 16 GB HBM of a v5e chip. Artifact:
+   ``artifacts/flagship_7b_v5e64.json``.
+
+Run: ``python -m deepspeed_tpu.benchmarks.aot_scale --out artifacts``.
+"""
+
+import argparse
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+V5E_HBM_BYTES = 16 * 1024 ** 3  # 16 GiB per v5e chip
+
+
+def _require_cpu_backend():
+    import jax
+    # AOT topology compiles need no device, but tracing creates host
+    # constants; pin CPU so a dead TPU tunnel can't hang us.
+    jax.config.update("jax_platforms", "cpu")
+    cache = os.environ.get("DS_TPU_COMPILE_CACHE",
+                           os.path.expanduser("~/.cache/ds_tpu_xla"))
+    os.makedirs(cache, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def build_abstract_engine(model_cfg, ds_cfg: Dict[str, Any],
+                          topology_name: str = "v5e:2x4",
+                          topo_cfg=None, seed: int = 0):
+    """Engine over a TPU topology mesh with ShapeDtypeStruct state (nothing
+    executes; only lower_train_step is usable). Returns (engine, batch)."""
+    import jax
+    from jax.experimental import topologies
+
+    from ..models import TransformerLM
+    from ..parallel.topology import MeshTopology, TopologyConfig
+    from ..runtime.config import DeepSpeedConfig
+    from ..runtime.engine import DeepSpeedTpuEngine
+
+    _require_cpu_backend()
+    desc = topologies.get_topology_desc(topology_name, platform="tpu")
+    topo = MeshTopology(topo_cfg or TopologyConfig(), devices=desc.devices)
+    config = DeepSpeedConfig(dict(ds_cfg), world_size=len(desc.devices))
+    engine = DeepSpeedTpuEngine(TransformerLM(model_cfg), config,
+                                topology=topo, seed=seed, abstract_init=True)
+    gas = config.gradient_accumulation_steps
+    gm = config.train_micro_batch_size_per_gpu * config.dp_world_size
+    batch = {"input_ids": np.zeros((gas, gm, model_cfg.max_seq_len),
+                                   dtype=np.int64)}
+    return engine, batch
+
+
+def _mem_record(compiled) -> Dict[str, Any]:
+    ma = compiled.memory_analysis()
+    rec = {k: int(getattr(ma, k)) for k in
+           ("argument_size_in_bytes", "output_size_in_bytes",
+            "temp_size_in_bytes", "alias_size_in_bytes",
+            "generated_code_size_in_bytes") if hasattr(ma, k)}
+    # donated inputs alias outputs, so peak live state is arguments + temps
+    rec["peak_bytes_per_chip"] = (rec.get("argument_size_in_bytes", 0)
+                                  + rec.get("temp_size_in_bytes", 0)
+                                  + rec.get("generated_code_size_in_bytes", 0))
+    rec["peak_gib_per_chip"] = round(rec["peak_bytes_per_chip"] / 1024 ** 3, 3)
+    return rec
+
+
+def overlap_dp8(model_cfg=None, out_dir: Optional[str] = None,
+                topology_name: str = "v5e:2x4") -> Dict[str, Any]:
+    """Stage-0 vs stage-3 async-collective coverage on an 8-chip v5e slice.
+
+    Three compiles: stage 0 (baseline — only gradient all-reduces), stage 3
+    as the production step runs it (layer scan, unroll hint 2), and stage 3
+    with the layer scan fully unrolled — the maximal scheduling window,
+    where every per-layer parameter gather is visible to async collective
+    fusion at once. The headline metric is the unrolled variant's
+    ``param_gather_exposed_fraction``: the share of matmul-feeding
+    all-gathers the TPU backend failed to cover with an async chain."""
+    from ..utils.xla_profile import tpu_overlap_report_from_compiled
+
+    if model_cfg is None:
+        from ..models import TransformerConfig
+        # the bench flagship proxy's geometry (374M class), full seq
+        model_cfg = TransformerConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=24, num_heads=8, num_kv_heads=8, max_seq_len=2048)
+    record: Dict[str, Any] = {"topology": topology_name,
+                              "num_layers": model_cfg.num_layers}
+    variants = (("stage0", 0, False), ("stage3_scan", 3, False),
+                ("stage3_unrolled", 3, True))
+    for name, stage, unroll in variants:
+        engine, batch = build_abstract_engine(
+            model_cfg,
+            {"train_micro_batch_size_per_gpu": 1,
+             "bf16": {"enabled": True},
+             "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "zero_optimization": {
+                 "stage": stage, "overlap_comm": True,
+                 # reference default (zero/config.py): small params stay
+                 # persistent/replicated — no per-norm gathers
+                 "stage3_param_persistence_threshold": 100000},
+             "steps_per_print": 10 ** 9},
+            topology_name=topology_name)
+        if unroll:
+            engine.model.scan_unroll_hint = model_cfg.num_layers
+        compiled = engine.lower_train_step(batch)
+        rep = tpu_overlap_report_from_compiled(compiled)
+        record[name] = dict(rep.to_dict(), memory=_mem_record(compiled))
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "overlap_dp8.json"), "w") as fh:
+            json.dump(record, fh, indent=1)
+    return record
+
+
+def flagship_7b_fit(out_dir: Optional[str] = None,
+                    topology_name: str = "v5e:8x8",
+                    hbm_bytes: int = V5E_HBM_BYTES,
+                    variants=("zero3", "zero3_hpz8")) -> Dict[str, Any]:
+    """AOT-compile Llama-2-7B ZeRO-3 (and +hpZ) training on v5e-64; report
+    per-chip memory against the 16 GiB HBM budget."""
+    from ..models import llama2_7b
+    from ..parallel.topology import TopologyConfig
+
+    cfg = llama2_7b()
+    record: Dict[str, Any] = {
+        "topology": topology_name,
+        "model": "llama2_7b",
+        "model_params": int(cfg.param_count())
+        if hasattr(cfg, "param_count") else None,
+        "hbm_bytes_per_chip": int(hbm_bytes),
+    }
+    all_variants = {
+        "zero3": TopologyConfig(),
+        # hpZ: params keep a secondary partition inside an 8-chip group
+        # (one v5e host's worth of fast links) while master/opt shard dp=64
+        "zero3_hpz8": TopologyConfig(hpz_shard=8),
+    }
+    for name in variants:
+        topo_cfg = all_variants[name]
+        engine, batch = build_abstract_engine(
+            cfg,
+            {"train_micro_batch_size_per_gpu": 1,
+             "bf16": {"enabled": True},
+             "optimizer": {"type": "adamw", "params": {"lr": 3e-4}},
+             "zero_optimization": dict(
+                 {"stage": 3, "overlap_comm": True,
+                  "stage3_param_persistence_threshold": 0},
+                 **({"zero_hpz_partition_size": 8}
+                    if topo_cfg.hpz_shard > 1 else {})),
+             "steps_per_print": 10 ** 9},
+            topology_name=topology_name, topo_cfg=topo_cfg)
+        compiled = engine.lower_train_step(batch)
+        mem = _mem_record(compiled)
+        mem["fits_hbm"] = bool(mem["peak_bytes_per_chip"] < hbm_bytes)
+        record[name] = mem
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "flagship_7b_v5e64.json"), "w") as fh:
+            json.dump(record, fh, indent=1)
+    return record
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="artifacts")
+    ap.add_argument("--skip-overlap", action="store_true")
+    ap.add_argument("--skip-7b", action="store_true")
+    args = ap.parse_args(argv)
+    if not args.skip_overlap:
+        rec = overlap_dp8(out_dir=args.out)
+        u = rec["stage3_unrolled"]
+        print(json.dumps({"overlap_dp8": {
+            "param_gather_exposed_fraction":
+                u["param_gather_exposed_fraction"],
+            "exposed_bytes_fraction": u["exposed_bytes_fraction"],
+            "async_chains": u["async_chains"]}}))
+    if not args.skip_7b:
+        rec = flagship_7b_fit(out_dir=args.out)
+        print(json.dumps({"flagship_7b_v5e64": {
+            k: v["peak_gib_per_chip"] for k, v in rec.items()
+            if isinstance(v, dict) and "peak_gib_per_chip" in v}}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

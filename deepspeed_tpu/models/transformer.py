@@ -86,6 +86,14 @@ class TransformerConfig:
     moe_use_residual: bool = False
     # drop_tokens=False equivalent: ragged_dot grouped GEMM, ep=1 only
     moe_dropless: bool = False
+    # router noise policy (reference moe/layer.py noisy_gate_policy).
+    # Currently every non-None value is rejected in __post_init__ (the
+    # scanned layer body threads no per-layer rng yet); the field exists —
+    # and is forwarded identically to BOTH the GSPMD and manual-pipeline
+    # MoE branches — so that when rng support lands, the two routing paths
+    # cannot silently diverge. Use deepspeed_tpu.moe.layer.MoE for noisy
+    # gating today.
+    moe_noisy_gate_policy: Optional[str] = None
 
     # training objective: "causal_lm" (next-token, causal attention) or
     # "mlm" (BERT-family masked-LM: bidirectional attention, loss at the
@@ -119,6 +127,15 @@ class TransformerConfig:
                 f"{self.norm_scheme!r}")
         if self.norm_scheme == "post" and self.moe_num_experts > 0:
             raise NotImplementedError("post-LN + MoE is not supported")
+        if self.moe_noisy_gate_policy is not None:
+            # RSample needs an rng threaded through the scanned layer body,
+            # which neither the GSPMD nor the manual-pipeline MoE branch
+            # has; accepting it silently would make routing diverge between
+            # the two branches the moment one gained rng support.
+            raise NotImplementedError(
+                "moe_noisy_gate_policy is not wired into the in-tree "
+                "transformer (use deepspeed_tpu.moe.layer.MoE, which "
+                f"supports it); got {self.moe_noisy_gate_policy!r}")
 
     @property
     def is_causal(self) -> bool:
@@ -235,6 +252,17 @@ class TransformerLM:
     # static-capacity all-to-all (moe_layer_manual) inside the manual
     # pipeline program
     supports_pp_ep = True
+    # offload_param streams this subtree from pinned_host per scan
+    # iteration (forward_hidden); everything else (embed/head/norm) stays
+    # in HBM — it is touched outside the layer loop
+    param_offload_keys = ("layers",)
+
+    @property
+    def supports_param_offload(self) -> bool:
+        # without remat the scan saves every streamed layer as a device
+        # residual for backward, silently voiding the memory bound the
+        # offload exists for — refuse so the engine rejects loudly
+        return bool(self.cfg.remat)
 
     def __init__(self, cfg: TransformerConfig):
         self.cfg = cfg
@@ -460,13 +488,15 @@ class TransformerLM:
                     hn, lp["moe_gate_w"], experts, expert_fn,
                     ep_axis="expert", top_k=cfg.moe_top_k,
                     capacity_factor=cfg.moe_capacity_factor,
-                    min_capacity=cfg.moe_min_capacity)
+                    min_capacity=cfg.moe_min_capacity,
+                    noisy_gate_policy=cfg.moe_noisy_gate_policy)
             else:
                 moe_out, aux = moe_layer(
                     hn, lp["moe_gate_w"], experts,
                     expert_fn, self.topology, top_k=cfg.moe_top_k,
                     capacity_factor=cfg.moe_capacity_factor,
-                    min_capacity=cfg.moe_min_capacity)
+                    min_capacity=cfg.moe_min_capacity,
+                    noisy_gate_policy=cfg.moe_noisy_gate_policy)
             if cfg.moe_use_residual:
                 dense = (jax.nn.silu(hn @ lp["res_gate"])
                          * (hn @ lp["res_up"])) @ lp["res_down"]
@@ -504,6 +534,20 @@ class TransformerLM:
             cos = sin = jnp.zeros((S, 1), x.dtype)
 
         body = self._layer
+        if getattr(self, "stream_params_from_host", False):
+            # ZeRO-Infinity param offload (engine.param_offload): the layer
+            # stack is STORED in pinned_host; pull only this iteration's
+            # slice into HBM. Placed INSIDE the remat boundary so the saved
+            # residuals are the host slices, not device copies — backward
+            # re-fetches each layer exactly like the reference's param
+            # swapper (swap_tensor/partitioned_param_swapper.py:36).
+            inner = body
+
+            def body(h, lp, cos, sin, _inner=inner):
+                lp = jax.tree.map(
+                    lambda a: jax.device_put(a, jax.memory.Space.Device), lp)
+                return _inner(h, lp, cos, sin)
+
         if cfg.remat:
             from ..runtime.activation_checkpointing import checkpointing as ds_ckpt
             body = ds_ckpt.checkpoint_wrapper(body)

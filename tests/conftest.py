@@ -34,6 +34,28 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "examples: heavyweight in-tree example subprocess smokes "
+        "(separate tier; run with -m examples or DS_TPU_RUN_EXAMPLES=1)")
+
+
+def pytest_collection_modifyitems(config, items):
+    # The example smokes are the suite's long pole (subprocess + cold XLA
+    # compile each). Keep the default tier fast; run the examples tier with
+    # `pytest -m examples` or DS_TPU_RUN_EXAMPLES=1.
+    if os.environ.get("DS_TPU_RUN_EXAMPLES") == "1":
+        return
+    if "examples" in (config.getoption("-m") or ""):
+        return
+    skip = pytest.mark.skip(
+        reason="examples tier: run with -m examples or DS_TPU_RUN_EXAMPLES=1")
+    for item in items:
+        if "examples" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()

@@ -1,0 +1,67 @@
+"""Chip-free scale proofs (VERDICT r4 Next #2/#3).
+
+AOT compilation against ``jax.experimental.topologies`` TPU descriptions runs
+the real TPU compiler pipeline (SPMD partitioner, async collective fusion,
+memory assignment) with no device attached, so these tests pin:
+
+1. the ZeRO-3 step's parameter all-gathers are async-chained (the TPU
+   equivalent of the reference's dedicated __allgather_stream,
+   reference runtime/zero/stage3.py:1151), and
+2. the north-star config — Llama-2-7B under ZeRO-3 on a v5e-64 slice
+   (BASELINE.json) — actually fits per-chip HBM. A code change that makes
+   7B stop fitting fails here, not on the pod.
+"""
+
+import pytest
+
+from deepspeed_tpu.benchmarks import aot_scale
+from deepspeed_tpu.models import TransformerConfig
+from deepspeed_tpu.utils.xla_profile import tpu_overlap_report_from_compiled
+
+
+def _topologies_available():
+    try:
+        from jax.experimental import topologies
+        topologies.get_topology_desc("v5e:2x4", platform="tpu")
+        return True
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not _topologies_available(),
+    reason="libtpu topology descriptions unavailable on this host")
+
+
+def test_zero3_param_gathers_async_chained():
+    """Every per-layer weight gather in the unrolled ZeRO-3 step gets an
+    async collective fusion chain; the exposed remainder of the hot path
+    stays under 10% (VERDICT r4 Next #2 done-bar)."""
+    cfg = TransformerConfig(vocab_size=2048, hidden_size=256,
+                            intermediate_size=512, num_layers=4, num_heads=4,
+                            max_seq_len=128, use_flash=False)
+    engine, batch = aot_scale.build_abstract_engine(
+        cfg,
+        {"train_micro_batch_size_per_gpu": 1, "bf16": {"enabled": True},
+         "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+         "zero_optimization": {"stage": 3, "overlap_comm": True,
+                               "stage3_param_persistence_threshold": 0},
+         "steps_per_print": 10 ** 9})
+    engine.model.scan_unroll_hint = cfg.num_layers
+    rep = tpu_overlap_report_from_compiled(engine.lower_train_step(batch))
+    # >= fwd+bwd gathers for each layer's fused weight set
+    assert rep.chains >= 2 * cfg.num_layers, rep.summary()
+    assert rep.async_channels.get("all-gather", 0) >= 2 * cfg.num_layers
+    assert rep.param_gather_exposed_fraction < 0.1, rep.summary()
+
+
+def test_flagship_7b_fits_v5e64():
+    """Llama-2-7B, ZeRO-3, dp=64 on a v5e:8x8 topology: per-chip
+    params+optimizer+activations clear the 16 GiB HBM budget."""
+    rec = aot_scale.flagship_7b_fit(out_dir=None, variants=("zero3",))
+    mem = rec["zero3"]
+    assert mem["fits_hbm"], mem
+    # the state actually shards: per-chip arguments must be a small
+    # fraction of the ~84 GB a replicated fp32+moments 7B would need
+    assert mem["argument_size_in_bytes"] < 4 * 1024 ** 3, mem
+    assert mem["peak_gib_per_chip"] < 16.0, mem

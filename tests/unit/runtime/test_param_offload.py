@@ -1,0 +1,125 @@
+"""ZeRO-Infinity parameter offload, host tier (VERDICT r4 Next #4).
+
+Reference: runtime/swap_tensor/partitioned_param_swapper.py:36 (params
+themselves stream from CPU/NVMe) and runtime/zero/parameter_offload.py:201
+(fetch hooks). TPU-native design: the compute-param layer stack is STORED in
+pinned_host memory; each scan iteration device_puts only its slice into HBM
+inside the remat boundary, so backward re-fetches per layer the same way the
+reference's swapper re-reads params for the backward pass.
+"""
+
+import numpy as np
+import pytest
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+from deepspeed_tpu.runtime.config import ConfigError
+
+
+def _cfg(**kw):
+    base = dict(vocab_size=128, hidden_size=64, intermediate_size=128,
+                num_layers=4, num_heads=4, max_seq_len=64,
+                use_flash=False, remat=True)
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def _engine(model_cfg, zero_extra=None, config_extra=None):
+    zconf = {"stage": 3, "stage3_param_persistence_threshold": 0}
+    zconf.update(zero_extra or {})
+    config = {"train_micro_batch_size_per_gpu": 1,
+              "bf16": {"enabled": True},
+              "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+              "zero_optimization": zconf, "steps_per_print": 10 ** 9}
+    config.update(config_extra or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=TransformerLM(model_cfg),
+                                               config=config)
+    return engine
+
+
+def _batch(cfg, seed=0):
+    return {"input_ids": np.random.default_rng(seed).integers(
+        0, cfg.vocab_size, (1, 8, cfg.max_seq_len), dtype=np.int64)}
+
+
+def test_param_offload_loss_parity_and_placement():
+    """offload_param {device: cpu} trains bit-identically to no-offload,
+    the layer stack lives in pinned_host (and stays there across steps),
+    and the off-loop params (embed/head) stay in HBM."""
+    cfg = _cfg()
+    losses = {}
+    for off in (False, True):
+        engine = _engine(cfg, {"offload_param": {"device": "cpu"}}
+                         if off else None)
+        losses[off] = [float(engine.train_batch(batch=_batch(cfg)))
+                       for _ in range(3)]
+        if off:
+            kinds = set(jax.tree.leaves(jax.tree.map(
+                lambda x: x.sharding.memory_kind, engine.params["layers"])))
+            assert kinds == {"pinned_host"}, kinds
+            assert engine.params["embed"].sharding.memory_kind == "device"
+            # eval path streams too
+            ev = float(engine.eval_batch(batch=_batch(cfg)))
+            assert np.isfinite(ev)
+    np.testing.assert_allclose(losses[True], losses[False], rtol=2e-5)
+
+
+def test_param_offload_device_resident_bytes_bounded():
+    """Device-resident compute-param STORAGE under offload is only the
+    off-loop leaves (embed/head/final norm) — the layer stack's bytes sit
+    in host memory (~(L-1)/L of a deep model's total is off-HBM)."""
+    cfg = _cfg(num_layers=8)
+    engine = _engine(cfg, {"offload_param": {"device": "cpu"}})
+    dev = sum(x.nbytes for x in jax.tree.leaves(engine.params)
+              if x.sharding.memory_kind == "device")
+    host = sum(x.nbytes for x in jax.tree.leaves(engine.params)
+               if x.sharding.memory_kind == "pinned_host")
+    layer_bytes = sum(x.nbytes for x in jax.tree.leaves(
+        engine.params["layers"]))
+    assert host == layer_bytes
+    # embed dominates the residue in this tiny config; the layer stack
+    # itself contributes ZERO device-resident storage
+    assert dev == sum(x.nbytes for x in jax.tree.leaves(engine.params)
+                      ) - layer_bytes
+
+
+def test_param_offload_composes_with_offload_optimizer():
+    """Full ZeRO-Infinity: master+moments on host (C++ optimizer),
+    compute params in pinned_host, device only sees streamed layers."""
+    cfg = _cfg()
+    engine = _engine(cfg, {"offload_param": {"device": "cpu"},
+                           "offload_optimizer": {"device": "cpu"}})
+    ls = [float(engine.train_batch(batch=_batch(cfg))) for _ in range(3)]
+    assert ls[-1] < ls[0]
+    kinds = set(jax.tree.leaves(jax.tree.map(
+        lambda x: x.sharding.memory_kind, engine.params["layers"])))
+    assert kinds == {"pinned_host"}
+
+
+def test_param_offload_checkpoint_roundtrip(tmp_path):
+    cfg = _cfg()
+    engine = _engine(cfg, {"offload_param": {"device": "cpu"}})
+    l0 = float(engine.train_batch(batch=_batch(cfg)))
+    engine.save_checkpoint(str(tmp_path), tag="t")
+    engine2 = _engine(cfg, {"offload_param": {"device": "cpu"}})
+    engine2.load_checkpoint(str(tmp_path), tag="t")
+    kinds = set(jax.tree.leaves(jax.tree.map(
+        lambda x: x.sharding.memory_kind, engine2.params["layers"])))
+    assert kinds == {"pinned_host"}
+    # restored engine continues where the donor would
+    l1a = float(engine.train_batch(batch=_batch(cfg, seed=1)))
+    l1b = float(engine2.train_batch(batch=_batch(cfg, seed=1)))
+    np.testing.assert_allclose(l1a, l1b, rtol=1e-6)
+
+
+def test_param_offload_rejects():
+    cfg = _cfg()
+    with pytest.raises(NotImplementedError, match="nvme"):
+        _engine(cfg, {"offload_param": {"device": "nvme",
+                                        "nvme_path": "/tmp"}})
+    with pytest.raises(ConfigError, match="stage 3"):
+        _engine(cfg, {"stage": 2, "offload_param": {"device": "cpu"}})
+    # a model without remat voids the memory bound -> loud reject
+    with pytest.raises(NotImplementedError, match="supports_param_offload"):
+        _engine(_cfg(remat=False), {"offload_param": {"device": "cpu"}})
