@@ -102,9 +102,39 @@ def argparse_suppress():
 
 
 def init_inference(model=None, config=None, **kwargs):
-    """Reference deepspeed/__init__.py:269 — inference engine entry."""
+    """Reference deepspeed/__init__.py:269 — inference engine entry.
+
+    Accepts either a native functional model (init_params/apply protocol)
+    or an HF torch module (GPT-2/OPT/Llama/Mistral/Mixtral/BERT families),
+    which is converted in place of the reference's kernel injection
+    (module_inject/replace_module.py). ``use_ragged=True`` routes to the
+    FastGen-class v2 paged engine (reference inference/v2/engine_v2.py:89
+    build_hf_engine) instead of the v1 KV-cache engine.
+    """
     from .inference.engine import InferenceEngine
     from .inference.config import DeepSpeedInferenceConfig
 
     cfg = DeepSpeedInferenceConfig.from_dict_or_kwargs(config, kwargs)
-    return InferenceEngine(model, cfg)
+    params = None
+    if (model is not None and hasattr(model, "state_dict")
+            and not hasattr(model, "init_params")):
+        # torch nn.Module (HF transformer): convert weights + architecture
+        from .module_inject import load_hf_model
+        model, params = load_hf_model(model)
+    if cfg.use_ragged:
+        if cfg.checkpoint or cfg.quant_bits:
+            # silently serving random weights (checkpoint) or unquantized
+            # weights (quant_bits) would be worse than refusing
+            raise NotImplementedError(
+                "use_ragged=True does not take 'checkpoint' or "
+                "'quant_bits' yet; pass an HF model or explicit params "
+                "(v1 path supports both keys)")
+        from .inference.v2 import (InferenceEngineV2,
+                                   RaggedInferenceEngineConfig)
+        rdict = dict(cfg.ragged or {})
+        rdict.setdefault("dtype", cfg.dtype)
+        rdict.setdefault("tensor_parallel_size", cfg.tensor_parallel.tp_size)
+        return InferenceEngineV2(model,
+                                 RaggedInferenceEngineConfig.from_dict(rdict),
+                                 params=params)
+    return InferenceEngine(model, cfg, params=params)

@@ -29,6 +29,10 @@ class DeepSpeedInferenceConfig:
     checkpoint: Optional[str] = None
     quant_bits: Optional[int] = None     # 8/4 weight-only quant (WOQ)
     seed: int = 0
+    # FastGen: route init_inference to the v2 ragged/paged engine
+    # (reference serves v2 through mii.serve; here it is one flag away)
+    use_ragged: bool = False
+    ragged: Optional[Dict[str, Any]] = None  # RaggedInferenceEngineConfig
 
     @classmethod
     def from_dict_or_kwargs(cls, config: Optional[Dict[str, Any]], kwargs):

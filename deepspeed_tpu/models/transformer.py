@@ -476,8 +476,23 @@ class TransformerLM:
                     raise NotImplementedError(
                         "moe_dropless supports top-1 routing only "
                         f"(got moe_top_k={cfg.moe_top_k})")
-                moe_out, aux = moe_layer_dropless(
-                    hn, lp["moe_gate_w"], experts, topo=self.topology)
+                if getattr(self, "_inside_manual_pipe", False) and \
+                        self.topology.axis_size("expert") > 1:
+                    raise NotImplementedError(
+                        "dropless MoE is not supported inside the manual "
+                        "pipeline program with ep>1 (use capacity routing "
+                        "for pp x ep)")
+                if (self.topology is not None
+                        and self.topology.axis_size("expert") > 1):
+                    from ..moe.sharded_moe import moe_layer_dropless_ep
+                    # ep>1: worst-case static capacity (C=T) dispatch —
+                    # see moe_layer_dropless_ep for the memory trade
+                    moe_out, aux = moe_layer_dropless_ep(
+                        hn, lp["moe_gate_w"], experts, expert_fn,
+                        self.topology)
+                else:
+                    moe_out, aux = moe_layer_dropless(
+                        hn, lp["moe_gate_w"], experts, topo=self.topology)
             elif (getattr(self, "_inside_manual_pipe", False)
                   and self.topology.axis_size("expert") > 1):
                 # pp x ep: inside the manual 1F1B shard_map GSPMD cannot

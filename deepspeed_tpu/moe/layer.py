@@ -116,9 +116,22 @@ class MoE:
         experts = (params["e_gate"], params["e_up"], params["e_down"])
         cf = self.capacity_factor if train else self.eval_capacity_factor
         if not self.drop_tokens:
-            out, aux = moe_layer_dropless(
-                x, params["gate_w"], experts, topo=topo, rng=rng,
-                noisy_gate_policy=self.noisy_gate_policy if train else None)
+            if topo is not None and topo.axis_size("expert") > 1:
+                # ep>1: worst-case static-capacity dispatch (C=T) — the
+                # XLA equivalent of the reference's dynamic-capacity
+                # allreduce (sharded_moe.py:214-218); memory trade
+                # documented on moe_layer_dropless_ep
+                from .sharded_moe import moe_layer_dropless_ep
+                out, aux = moe_layer_dropless_ep(
+                    x, params["gate_w"], experts, self._expert_fn, topo,
+                    rng=rng,
+                    noisy_gate_policy=(self.noisy_gate_policy
+                                       if train else None))
+            else:
+                out, aux = moe_layer_dropless(
+                    x, params["gate_w"], experts, topo=topo, rng=rng,
+                    noisy_gate_policy=(self.noisy_gate_policy
+                                       if train else None))
         else:
             out, aux = moe_layer(
                 x, params["gate_w"], experts, self._expert_fn, topo,

@@ -245,15 +245,20 @@ def moe_layer_dropless(x, gate_w, expert_params, ragged_expert_fn=None,
     `jax.lax.ragged_dot` grouped GEMM — no token is ever dropped and no
     [T, E, C] dispatch tensor is built.
 
-    Expert parameters must be device-local (ep=1): ragged groups have
-    data-dependent sizes, which cannot cross a static SPMD all-to-all —
-    the same reason the reference only composes dropless with pure DP.
+    Expert parameters must be device-local (ep=1) on THIS path: ragged
+    groups have data-dependent sizes, which cannot cross a static SPMD
+    all-to-all. The reference composes dropless with EP by all-reducing a
+    dynamic capacity at runtime (reference sharded_moe.py:214-218) —
+    torch can reshape to a step-dependent capacity, XLA cannot. The
+    static-shape equivalent is ``moe_layer_dropless_ep`` below: worst-case
+    capacity C=T compiled in, memory traded for droplessness.
     """
     if topo is not None and topo.axis_size("expert") > 1:
         raise NotImplementedError(
-            "dropless MoE composes with data parallelism only (expert axis "
+            "ragged dropless MoE needs device-local experts (expert axis "
             "must be 1): ragged group sizes are data-dependent and cannot "
-            "ride a static expert all-to-all")
+            "ride a static expert all-to-all. For ep>1 use "
+            "moe_layer_dropless_ep (worst-case static capacity).")
     B, S, H = x.shape
     T = B * S
     E = gate_w.shape[-1]
@@ -274,6 +279,29 @@ def moe_layer_dropless(x, gate_w, expert_params, ragged_expert_fn=None,
     out = dropless_topk_dispatch(xt, idx[:, None], gate_p, expert_params, E,
                                  ragged_expert_fn)
     return out.reshape(B, S, H), aux.astype(jnp.float32)
+
+
+def moe_layer_dropless_ep(x, gate_w, expert_params, expert_fn, topo,
+                          rng=None, noisy_gate_policy: Optional[str] = None
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Dropless top-1 MoE UNDER expert parallelism (reference
+    drop_tokens=False with ep>1). The reference sizes its dispatch buffers
+    with a runtime all-reduced max capacity (sharded_moe.py:214-218);
+    XLA's static shapes can't — so the worst case, C = T (every token to
+    one expert), is compiled in and the standard einsum dispatch + GSPMD
+    expert all-to-all runs over it. Semantically dropless: capacity can
+    never bind.
+
+    MEMORY TRADE (read before using): the dispatch/combine tensors are
+    [T, E, T] — quadratic in local tokens. Fine for modest T per device
+    (the routed block after dp/sp sharding), ruinous for long sequences;
+    prefer capacity routing or ep=1 ragged dropless there.
+    """
+    E = gate_w.shape[-1]
+    # capacity_factor = E makes _capacity == ceil(T/E * E) == T
+    return moe_layer(x, gate_w, expert_params, expert_fn, topo,
+                     top_k=1, capacity_factor=float(E), min_capacity=1,
+                     rng=rng, noisy_gate_policy=noisy_gate_policy)
 
 
 def residual_moe_combine(x, moe_out, mlp_out, coef_w, coef_b=None):

@@ -261,3 +261,52 @@ def test_mistral_sliding_window_caps_seq_len():
         max_position_embeddings=8192, sliding_window=64, rms_norm_eps=1e-5)
     ours = config_from_hf(cfg)
     assert ours.max_seq_len == 64
+
+
+def test_init_inference_hf_to_v2_greedy_matches_hf():
+    """The one-call user path (VERDICT r4 Next #9): HF torch model ->
+    deepspeed_tpu.init_inference(use_ragged=True) -> paged v2 serving,
+    greedy decode matching HF generate token-for-token for 20 tokens.
+    Reference: inference/v2 engine_factory build_hf_engine."""
+    import deepspeed_tpu
+
+    cfg = transformers.GPT2Config(vocab_size=96, n_positions=64, n_embd=32,
+                                  n_layer=2, n_head=4)
+    torch.manual_seed(7)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    engine = deepspeed_tpu.init_inference(
+        hf, dtype="float32", use_ragged=True,
+        ragged={"state_manager": {"max_tracked_sequences": 2,
+                                  "max_seq_len": 64, "num_blocks": 9,
+                                  "block_size": 16},
+                "prefill_bucket": 16})
+    prompt = np.array([5, 9, 17, 3, 21, 40, 2], np.int64)
+    logits = engine.put([1], [prompt])
+    toks = [int(np.argmax(logits[0]))]
+    for _ in range(19):
+        logits = engine.put([1], [[toks[-1]]])
+        toks.append(int(np.argmax(logits[0])))
+    with torch.no_grad():
+        ref = hf.generate(torch.from_numpy(prompt[None]), max_new_tokens=20,
+                          do_sample=False, pad_token_id=0)
+    assert toks == ref[0, len(prompt):].tolist()
+
+
+def test_init_inference_hf_v1_entry():
+    """init_inference also auto-converts HF modules on the v1 path."""
+    import deepspeed_tpu
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        attention_bias=False)
+    torch.manual_seed(3)
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    eng = deepspeed_tpu.init_inference(hf, dtype="float32")
+    out = eng.generate(np.array([[3, 5, 7]]), max_new_tokens=4,
+                       temperature=0.0)
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor([[3, 5, 7]]), max_new_tokens=4,
+                          do_sample=False)
+    np.testing.assert_array_equal(out, ref.numpy())

@@ -22,6 +22,8 @@ pytestmark = pytest.mark.examples
      "--steps", "2"],
     ["examples/serve_ragged.py", "--cpu", "--new-tokens", "3"],
     ["examples/serve_ragged.py", "--cpu", "--moe", "--new-tokens", "3"],
+    ["examples/serve_hf.py", "--cpu", "--layers", "2", "--hidden", "64",
+     "--heads", "4", "--new-tokens", "6"],
 ])
 def test_example_runs(cmd):
     # Tight cap: a hung example must cost minutes, not the 46-min worst case

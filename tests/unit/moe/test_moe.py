@@ -312,14 +312,22 @@ def test_dropless_matches_capacity_mode_when_nothing_drops():
     np.testing.assert_allclose(float(aux_dl), float(aux_cap), rtol=1e-6)
 
 
-def test_dropless_model_trains_and_rejects_ep():
-    engine, losses = _moe_engine({"moe_dropless": True}, {})
+def test_dropless_model_trains_and_ep_parity():
+    """Dropless at ep=1 rides the ragged grouped GEMM; at ep>1 it takes
+    the worst-case static-capacity dispatch (moe_layer_dropless_ep, the
+    XLA analogue of the reference's dynamic-capacity allreduce,
+    sharded_moe.py:214-218). Same data, same losses."""
+    engine, losses = _moe_engine({"moe_dropless": True},
+                                 {"zero_optimization": {"stage": 1}})
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
-    with pytest.raises(NotImplementedError, match="expert axis"):
-        _moe_engine({"moe_dropless": True},
-                    {"moe": {"enabled": True, "num_experts": 4,
-                             "expert_parallel_size": 2}})
+    _, losses_ep = _moe_engine({"moe_dropless": True},
+                               {"moe": {"enabled": True, "num_experts": 4,
+                                        "expert_parallel_size": 2},
+                                "zero_optimization": {"stage": 1}})
+    np.testing.assert_allclose(np.asarray(losses_ep, dtype=np.float64),
+                               np.asarray(losses, dtype=np.float64),
+                               rtol=2e-4, atol=2e-4)
 
 
 def test_moe_class_facade_matches_functional():

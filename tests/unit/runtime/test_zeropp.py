@@ -294,3 +294,37 @@ def test_hpz_qwz_group_divisible_leaf_gradients():
     assert loss < 5.0, f"bias never learned (loss {loss}); hpZ finalize " \
                        f"averaged shard halves"
     assert b[0] > 2.5 and b[5] < -2.5, b
+
+
+def test_zeropp_composes_with_sequence_parallel():
+    """qwZ/qgZ at sp=2 (VERDICT r4 Next #5): the quantized-collective
+    shard_map is manual over the DP axes only, and the Ulysses seq-axis
+    collectives ride the auto axes exactly like tp. Training must track the
+    unquantized sp=2 run within the int8 transport budget. Reference runs
+    qwZ/qgZ under whatever mpu topology is active (stage3.py:1226)."""
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=128, hidden_size=64,
+                            intermediate_size=128, num_layers=2, num_heads=4,
+                            max_seq_len=64, use_flash=False, remat=False)
+    losses = {}
+    for quant in (False, True):
+        z = {"stage": 3, "stage3_param_persistence_threshold": 0}
+        if quant:
+            z.update({"zero_quantized_weights": True,
+                      "zero_quantized_gradients": True})
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=TransformerLM(cfg),
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "bf16": {"enabled": True},
+                    "sequence_parallel_size": 2,
+                    "optimizer": {"type": "adamw", "params": {"lr": 1e-2}},
+                    "zero_optimization": z, "steps_per_print": 10 ** 9})
+        assert engine.topology.sizes["seq"] == 2
+        gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+        batch = {"input_ids": np.random.default_rng(0).integers(
+            0, 128, (1, gm, 64), dtype=np.int64)}
+        losses[quant] = [float(engine.train_batch(batch=batch))
+                         for _ in range(4)]
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=0.05, atol=2e-2)
