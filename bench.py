@@ -300,6 +300,31 @@ def main():
         detail["note"] = ("JAX_PLATFORMS requested a TPU but device init "
                           "failed or hung; this is a CPU smoke number, not "
                           "a chip measurement")
+        # the chip-free scale proofs (AOT-compiled against real v5e
+        # topologies with the local libtpu compiler; see
+        # benchmarks/aot_scale.py) still hold — surface the committed
+        # artifact numbers so the record carries the round's perf evidence
+        import pathlib
+        art = pathlib.Path(__file__).parent / "artifacts"
+        try:
+            fit = json.load(open(art / "flagship_7b_v5e64.json"))
+            detail["aot_7b_v5e64_fit"] = {
+                k: {"peak_gib_per_chip": v["peak_gib_per_chip"],
+                    "fits_hbm": v["fits_hbm"]}
+                for k, v in fit.items()
+                if isinstance(v, dict) and "peak_gib_per_chip" in v}
+        except Exception:
+            pass
+        try:
+            ov = json.load(open(art / "overlap_dp8.json"))
+            u = ov.get("stage3_unrolled", {})
+            detail["aot_zero3_overlap_dp8"] = {
+                "async_chains": u.get("async_chains"),
+                "param_gather_exposed_fraction":
+                    u.get("param_gather_exposed_fraction"),
+                "exposed_bytes_fraction": u.get("exposed_bytes_fraction")}
+        except Exception:
+            pass
     result = {
         "metric": "train_mfu_llama_flagship",
         "value": round(mfu * 100, 2),

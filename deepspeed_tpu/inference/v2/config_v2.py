@@ -26,6 +26,10 @@ class RaggedInferenceEngineConfig:
     state_manager: DSStateManagerConfig = field(
         default_factory=DSStateManagerConfig)
     tensor_parallel_size: int = 1
+    # expert parallelism for MoE serving: experts shard over an "expert"
+    # mesh axis (reference v2 ships per-arch sharding helpers,
+    # model_implementations/*/; here it is one mesh axis away)
+    expert_parallel_size: int = 1
     dtype: str = "bfloat16"
     prefill_bucket: int = 64                 # prompt lengths pad to multiples
     use_paged_kernel: bool = True            # Pallas decode attention kernel

@@ -56,7 +56,15 @@ class InferenceEngineV2:
 
         from ...parallel.topology import build_topology
         tp = config.tensor_parallel_size
-        self.topology = build_topology(model=tp, devices=jax.devices()[:tp])
+        ep = config.expert_parallel_size
+        if ep > 1:
+            assert cfg.moe_num_experts > 0, \
+                "expert_parallel_size > 1 requires an MoE model"
+            assert cfg.moe_num_experts % ep == 0, \
+                f"num experts {cfg.moe_num_experts} not divisible by " \
+                f"expert_parallel_size {ep}"
+        self.topology = build_topology(model=tp, expert=ep,
+                                       devices=jax.devices()[:tp * ep])
         self.mesh = self.topology.mesh
         if hasattr(model, "set_topology"):
             model.set_topology(self.topology)
@@ -86,24 +94,26 @@ class InferenceEngineV2:
         # GSPMD-partitionable, so sharded-param (tp>1) serving keeps the
         # jnp paths, which the partitioner splits over the head axis (same
         # gate as the v1 decode kernel, models/transformer.py)
-        use_kernel = config.use_paged_kernel and tp == 1
+        use_kernel = config.use_paged_kernel and tp == 1 and ep == 1
+        topo = self.topology if ep > 1 else None
         self._decode_jit = jax.jit(
             lambda p, t, pos, bt, c, a: paged_decode(
                 cfg, p, t, pos, bt, c, a, sm.block_size,
-                use_kernel=use_kernel),
+                use_kernel=use_kernel, topo=topo),
             donate_argnums=(4,))
         self._prefill_jit = jax.jit(
             lambda p, ids, n, c, b, o: paged_prefill(
                 cfg, p, ids, n, c, b, o,
-                use_kernel=use_kernel),
+                use_kernel=use_kernel, topo=topo),
             donate_argnums=(3,))
         self._continue_jit = jax.jit(
             lambda p, ids, s, n, c, b, o, t: paged_continue(
-                cfg, p, ids, s, n, c, b, o, t, sm.block_size),
+                cfg, p, ids, s, n, c, b, o, t, sm.block_size, topo=topo),
             donate_argnums=(4,))
         log_dist(
             f"ragged inference engine: blocks={sm.num_blocks}x"
-            f"{sm.block_size} max_seqs={sm.max_tracked_sequences} tp={tp}",
+            f"{sm.block_size} max_seqs={sm.max_tracked_sequences} tp={tp}"
+            f" ep={ep}",
             ranks=[0])
 
     # ------------------------------------------------------------------

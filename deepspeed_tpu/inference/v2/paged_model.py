@@ -77,9 +77,9 @@ def _rotate(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray):
                            axis=-1).astype(x.dtype)
 
 
-def _mlp(cfg, lp, x):
+def _mlp(cfg, lp, x, topo=None):
     if cfg.moe_num_experts > 0:
-        return _moe_mlp(cfg, lp, x)
+        return _moe_mlp(cfg, lp, x, topo)
     if cfg.activation == "swiglu":
         return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
     from ...models.transformer import ffn_act
@@ -87,7 +87,7 @@ def _mlp(cfg, lp, x):
     return u @ lp["w_down"] + lp["b_down"]
 
 
-def _moe_mlp(cfg, lp, x):
+def _moe_mlp(cfg, lp, x, topo=None):
     """Routed-expert MLP for serving (reference v2 serves Mixtral-class
     MoE, inference/v2/model_implementations/): dropless sorted-token
     grouped GEMM via jax.lax.ragged_dot — no [T,E,C] capacity tensor, no
@@ -106,13 +106,32 @@ def _moe_mlp(cfg, lp, x):
     gate_w = lp["moe_gate_w"]
     E = gate_w.shape[-1]
     k = cfg.moe_top_k
-    logits = xt.astype(jnp.float32) @ gate_w.astype(jnp.float32)
-    gates = jax.nn.softmax(logits, axis=-1)
-    topv, topi = jax.lax.top_k(gates, k)                    # [T, k]
-    if k > 1:
-        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
-    experts = (lp["e_gate"], lp["e_up"], lp["e_down"])
-    out = dropless_topk_dispatch(xt, topi, topv, experts, E)
+    if topo is not None and topo.axis_size("expert") > 1:
+        # expert-parallel serving: experts live sharded over the "expert"
+        # axis, so the ragged grouped GEMM (device-local experts) cannot
+        # run — route through the worst-case-capacity dropless dispatch
+        # (serving must never drop a token) and let GSPMD insert the
+        # expert all-to-all. Same gating math as training's moe_layer, so
+        # ep>1 == ep=1 logits (parity-tested). Quadratic-dispatch regime
+        # (long prefill chunks) is rejected loudly by the helper.
+        from ...moe.sharded_moe import moe_layer_dropless_ep
+
+        def expert_fn(p, xe):
+            g_, u_, d_ = p
+            return (jax.nn.silu(xe @ g_) * (xe @ u_)) @ d_
+
+        out3, _aux = moe_layer_dropless_ep(
+            xt[None], gate_w, (lp["e_gate"], lp["e_up"], lp["e_down"]),
+            expert_fn, topo, top_k=k)
+        out = out3[0]
+    else:
+        logits = xt.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+        gates = jax.nn.softmax(logits, axis=-1)
+        topv, topi = jax.lax.top_k(gates, k)                # [T, k]
+        if k > 1:
+            topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+        experts = (lp["e_gate"], lp["e_up"], lp["e_down"])
+        out = dropless_topk_dispatch(xt, topi, topv, experts, E)
     if cfg.moe_use_residual:
         from ...moe.sharded_moe import residual_moe_combine
         dense = (jax.nn.silu(xt @ lp["res_gate"])
@@ -133,7 +152,7 @@ def _logits(cfg, params, x):
 def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
                   prompt_len: jnp.ndarray, cache: Dict[str, jnp.ndarray],
                   block_ids: jnp.ndarray, offsets: jnp.ndarray,
-                  use_kernel: bool = True
+                  use_kernel: bool = True, topo=None
                   ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """ids [1, C] (padded prompt); prompt_len scalar; block_ids/offsets [C]
     map chunk position -> (cache block, slot) with padding -> null block.
@@ -194,7 +213,7 @@ def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
             o = jnp.einsum("hqk,khd->qhd", probs, vf).reshape(C, nh * hd)
         x = x + out_proj(lp, o)
         hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
-        x = x + _mlp(cfg, lp, hn)
+        x = x + _mlp(cfg, lp, hn, topo)
         return (x, kc, vc), None
 
     (x, kc, vc), _ = jax.lax.scan(
@@ -212,7 +231,7 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
                    start_pos: jnp.ndarray, n_new: jnp.ndarray,
                    cache: Dict[str, jnp.ndarray], block_ids: jnp.ndarray,
                    offsets: jnp.ndarray, block_table: jnp.ndarray,
-                   block_size: int
+                   block_size: int, topo=None
                    ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Multi-token continuation of ONE existing sequence in a single pass
     (the reference's chunked prefill over ragged atoms,
@@ -265,7 +284,7 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
         o = jnp.einsum("hqc,chd->qhd", probs, vpages).reshape(C, nh * hd)
         x = x + out_proj(lp, o)
         hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
-        x = x + _mlp(cfg, lp, hn)
+        x = x + _mlp(cfg, lp, hn, topo)
         return (x, kc, vc), None
 
     (x, kc, vc), _ = jax.lax.scan(
@@ -282,7 +301,7 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
 def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
                  pos: jnp.ndarray, block_tables: jnp.ndarray,
                  cache: Dict[str, jnp.ndarray], active: jnp.ndarray,
-                 block_size: int, use_kernel: bool = True
+                 block_size: int, use_kernel: bool = True, topo=None
                  ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """toks/pos/active [N]; block_tables [N, MB]. One token per sequence;
     returns ([N, V] logits, cache). Inactive rows write to the null block
@@ -334,7 +353,7 @@ def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
             o = jnp.einsum("nhc,nchd->nhd", probs, vpages).reshape(N, nh * hd)
         x = x + out_proj(lp, o)
         hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
-        x = x + _mlp(cfg, lp, hn)
+        x = x + _mlp(cfg, lp, hn, topo)
         return (x, kc, vc), None
 
     (x, kc, vc), _ = jax.lax.scan(

@@ -282,25 +282,38 @@ def moe_layer_dropless(x, gate_w, expert_params, ragged_expert_fn=None,
 
 
 def moe_layer_dropless_ep(x, gate_w, expert_params, expert_fn, topo,
-                          rng=None, noisy_gate_policy: Optional[str] = None
+                          top_k: int = 1, rng=None,
+                          noisy_gate_policy: Optional[str] = None,
+                          max_dispatch_elems: int = 1 << 28
                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Dropless top-1 MoE UNDER expert parallelism (reference
+    """Dropless top-1/top-2 MoE UNDER expert parallelism (reference
     drop_tokens=False with ep>1). The reference sizes its dispatch buffers
     with a runtime all-reduced max capacity (sharded_moe.py:214-218);
-    XLA's static shapes can't — so the worst case, C = T (every token to
-    one expert), is compiled in and the standard einsum dispatch + GSPMD
-    expert all-to-all runs over it. Semantically dropless: capacity can
-    never bind.
+    XLA's static shapes can't — so the worst case (C = T for top-1, 2T for
+    top-2: ``capacity_factor=E`` through ``_capacity``, whose top-2 branch
+    doubles it) is compiled in and the standard einsum dispatch + GSPMD
+    expert all-to-all runs over it. Semantically dropless: per-expert load
+    can never exceed that capacity, so it never binds.
 
     MEMORY TRADE (read before using): the dispatch/combine tensors are
-    [T, E, T] — quadratic in local tokens. Fine for modest T per device
-    (the routed block after dp/sp sharding), ruinous for long sequences;
-    prefer capacity routing or ep=1 ragged dropless there.
+    [T, E, k*T] — quadratic in local tokens. Fine for modest T (decode
+    batches, short prefill chunks, the routed block after dp/sp sharding),
+    ruinous for long sequences — ``max_dispatch_elems`` rejects that
+    regime loudly instead of OOMing; prefer capacity routing or ep=1
+    ragged dropless there.
     """
+    B, S, _ = x.shape
+    T = B * S
     E = gate_w.shape[-1]
+    if T * E * (top_k * T) > max_dispatch_elems:
+        raise NotImplementedError(
+            f"dropless-under-ep worst-case dispatch is [T,E,k*T] = "
+            f"[{T},{E},{top_k * T}] (> {max_dispatch_elems} elements): "
+            f"quadratic in tokens. Chunk the sequence (smaller prefill "
+            f"bucket), use capacity routing, or serve with ep=1.")
     # capacity_factor = E makes _capacity == ceil(T/E * E) == T
     return moe_layer(x, gate_w, expert_params, expert_fn, topo,
-                     top_k=1, capacity_factor=float(E), min_capacity=1,
+                     top_k=top_k, capacity_factor=float(E), min_capacity=1,
                      rng=rng, noisy_gate_policy=noisy_gate_policy)
 
 
