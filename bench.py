@@ -117,6 +117,15 @@ def _measure(cfg, micro, gas, steps, warmup, n_dev, zero_stage=None,
     }
     if phases:
         detail["phase_breakdown"] = extra_phases
+    # free this trial's device state NOW: the ladder runs many configs in
+    # one process and leaked buffers/compiled-executable constants starved
+    # the later zero3/large-proxy phases into RESOURCE_EXHAUSTED on the
+    # 16 GB chip (r05 first capture)
+    engine.destroy()
+    del engine, model, batch
+    import gc
+    gc.collect()
+    jax.clear_caches()
     return mfu, detail
 
 

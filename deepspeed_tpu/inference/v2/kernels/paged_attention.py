@@ -8,11 +8,18 @@ block from HBM into VMEM exactly once — no [N, max_ctx, ...] gather is ever
 materialized (the jnp fallback in paged_model.py does materialize it, which
 is why this kernel is the serving hot path).
 
-Grid (N, kv_heads, max_blocks): TPU grids run sequentially over the last
-axis, so online-softmax state for one (sequence, kv head) lives in VMEM
-scratch across its page steps. GQA handled by blocking queries per kv head
-(group = nh // kvh rows). Pages past a sequence's length are skipped via
-pl.when; position masking handles the partial last page.
+Grid (N, max_blocks): TPU grids run sequentially over the last axis, so
+online-softmax state for one sequence lives in VMEM scratch across its
+page steps. Each page step loads the block's K/V for ALL kv heads at once
+— the (block_size, kv_heads, head_dim) tile equals the array's trailing
+dims, which is what the Mosaic lowering requires (blocks must tile to
+(8, 128) or cover the dimension; a per-head (1, bs, 1, hd) block does
+not, and fails to lower on real TPU even though interpret mode accepts
+it — r05 chip capture). GQA is a static Python loop over kv heads inside
+the kernel (kv_heads is a compile-time constant), each head updating its
+own rows of the flat (nh, ...) softmax scratch. Pages past a sequence's
+length are skipped via pl.when; position masking handles the partial
+last page.
 """
 
 import functools
@@ -30,9 +37,9 @@ def _interpret() -> bool:
 
 
 def _kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-            acc_sc, m_sc, l_sc, *, bs, n_pages, scale):
+            acc_sc, m_sc, l_sc, *, bs, n_pages, scale, kvh, group):
     n = pl.program_id(0)
-    j = pl.program_id(2)
+    j = pl.program_id(1)
 
     @pl.when(j == 0)
     def _init():
@@ -44,30 +51,37 @@ def _kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(j * bs < length)
     def _body():
-        q = q_ref[0, 0].astype(jnp.float32)           # (group, hd)
-        k = k_ref[0, :, 0].astype(jnp.float32)        # (bs, hd)
-        v = v_ref[0, :, 0].astype(jnp.float32)        # (bs, hd)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(pos < length, s, NEG_INF)
-        m_prev = m_sc[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m_prev - m_new)
-        l_sc[:] = jnp.broadcast_to(
-            l_sc[:, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
-            l_sc.shape)
-        acc_sc[:] = acc_sc[:] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_sc[:] = jnp.broadcast_to(m_new, m_sc.shape)
+        k_all = k_ref[0].astype(jnp.float32)          # (bs, kvh, hd)
+        v_all = v_ref[0].astype(jnp.float32)
+        pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (group, bs), 1)
+        for h in range(kvh):                          # static unroll (GQA)
+            rows = slice(h * group, (h + 1) * group)
+            q = q_ref[0, h].astype(jnp.float32)       # (group, hd)
+            k = k_all[:, h, :]                        # (bs, hd)
+            v = v_all[:, h, :]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            s = s * scale
+            s = jnp.where(pos < length, s, NEG_INF)
+            m_prev = m_sc[rows, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_prev - m_new)
+            l_sc[rows] = jnp.broadcast_to(
+                l_sc[rows, :1] * corr + jnp.sum(p, axis=1, keepdims=True),
+                (group, l_sc.shape[1]))
+            acc_sc[rows] = acc_sc[rows] * corr + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_sc[rows] = jnp.broadcast_to(m_new, (group, m_sc.shape[1]))
 
     @pl.when(j == n_pages - 1)
     def _finish():
-        l = l_sc[:, :1]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+        for h in range(kvh):                          # static unroll
+            rows = slice(h * group, (h + 1) * group)
+            l = l_sc[rows, :1]
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, h] = (acc_sc[rows] / l_safe).astype(o_ref.dtype)
 
 
 def paged_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
@@ -83,24 +97,25 @@ def paged_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
     scale = 1.0 / (hd ** 0.5)
     q4 = q.reshape(N, kvh, group, hd)
 
-    kernel = functools.partial(_kernel, bs=bs, n_pages=MB, scale=scale)
+    kernel = functools.partial(_kernel, bs=bs, n_pages=MB, scale=scale,
+                               kvh=kvh, group=group)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(N, kvh, MB),
+        grid=(N, MB),
         in_specs=[
-            pl.BlockSpec((1, 1, group, hd),
-                         lambda n, h, j, bt, ln: (n, h, 0, 0)),
-            pl.BlockSpec((1, bs, 1, hd),
-                         lambda n, h, j, bt, ln: (bt[n, j], 0, h, 0)),
-            pl.BlockSpec((1, bs, 1, hd),
-                         lambda n, h, j, bt, ln: (bt[n, j], 0, h, 0)),
+            pl.BlockSpec((1, kvh, group, hd),
+                         lambda n, j, bt, ln: (n, 0, 0, 0)),
+            pl.BlockSpec((1, bs, kvh, hd),
+                         lambda n, j, bt, ln: (bt[n, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, kvh, hd),
+                         lambda n, j, bt, ln: (bt[n, j], 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, group, hd),
-                               lambda n, h, j, bt, ln: (n, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, kvh, group, hd),
+                               lambda n, j, bt, ln: (n, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((group, hd), jnp.float32),
-            pltpu.VMEM((group, 128), jnp.float32),
-            pltpu.VMEM((group, 128), jnp.float32),
+            pltpu.VMEM((kvh * group, hd), jnp.float32),
+            pltpu.VMEM((kvh * group, 128), jnp.float32),
+            pltpu.VMEM((kvh * group, 128), jnp.float32),
         ],
     )
     out = pl.pallas_call(

@@ -145,6 +145,23 @@ class MeshTopology:
         return axes
 
     @property
+    def zero_shard_axes(self) -> Tuple[str, ...]:
+        """Axes ZeRO STORAGE may span, including sequence parallelism.
+
+        The reference treats sequence-parallel ranks as data-parallel ranks
+        for ZeRO partitioning (Ulysses composes with ZeRO-3 by sharding
+        model state across the combined dp x sp ranks — sequence only
+        changes gradient averaging, stage3.py:1181; blog
+        blogs/deepspeed-ulysses). In GSPMD terms sharding specs are pure
+        placement, so extending the storage shard over "seq" is
+        semantically free and divides master/opt/param state by sp as
+        well — the enabler for long-context x large-model configs."""
+        axes = self.dp_axes
+        if self.sizes[SEQ_AXIS] > 1:
+            axes = axes + (SEQ_AXIS,)
+        return axes
+
+    @property
     def dp_world_size(self) -> int:
         return (self.sizes[DATA_AXIS] * self.sizes[SHARD_AXIS]
                 * self.sizes[EXPERT_AXIS])

@@ -183,17 +183,19 @@ class DeepSpeedTpuEngine:
         # and each scan iteration device_puts only its layer slice into
         # HBM — XLA's host offloader overlaps the H2D copies with the
         # previous layer's compute, the same double-buffering the
-        # reference's param swapper does by hand. Host tier only; nvme
-        # param spill keeps the loud reject (dead-key rule).
+        # reference's param swapper does by hand. The nvme tier
+        # (full ZeRO-Infinity parameter spill) runs the dedicated
+        # per-layer executor instead (runtime/zero/infinity.py).
         self.param_offload = False
+        self.param_offload_nvme = False
+        self._infinity = None
         po_device = self.config.zero_optimization.offload_param.device
         if po_device not in ("none", None, ""):
             from .config import ConfigError
-            if po_device != "cpu":
-                raise NotImplementedError(
-                    "zero_optimization.offload_param supports device 'cpu' "
-                    "(host-RAM parameter streaming); nvme parameter spill "
-                    f"is not implemented (got {po_device!r})")
+            if po_device not in ("cpu", "nvme"):
+                raise ConfigError(
+                    "zero_optimization.offload_param.device must be "
+                    f"'cpu' or 'nvme' (got {po_device!r})")
             if self.zero_stage != 3:
                 raise ConfigError(
                     "offload_param requires ZeRO stage 3 (reference "
@@ -209,7 +211,11 @@ class DeepSpeedTpuEngine:
                     "stack from host memory (supports_param_offload; "
                     "TransformerLM with remat=True does). This model does "
                     "not declare it.")
-            self.param_offload = True
+            if po_device == "nvme":
+                self._check_infinity_supported()
+                self.param_offload_nvme = True
+            else:
+                self.param_offload = True
         # assigned unconditionally so re-initializing with the same model
         # object cannot leak a stale streaming flag (scan_unroll_hint rule)
         model.stream_params_from_host = self.param_offload
@@ -279,7 +285,10 @@ class DeepSpeedTpuEngine:
                 raise NotImplementedError(
                     "frozen_mask is not supported with ZeRO-Offload or "
                     "1-bit optimizers yet; use the standard optimizer path")
-        if self.offload_device:
+        if self.param_offload_nvme:
+            # the per-layer executor owns its own jitted programs
+            self._batch_sharding_fn = self._default_batch_sharding_fn()
+        elif self.offload_device:
             self._build_offload_step()
         elif self.onebit_mode:
             from .fp16.onebit import build_train_step_for
@@ -317,6 +326,44 @@ class DeepSpeedTpuEngine:
             return self.model.param_partition_specs(self.topology)
         return None
 
+    def _check_infinity_supported(self):
+        """Gate for offload_param.device='nvme' (the per-layer streamed
+        executor, runtime/zero/infinity.py). Loud rejects, not silent
+        fallbacks, for every unsupported composition (dead-key rule)."""
+        from .config import ConfigError
+        po = self.config.zero_optimization.offload_param
+        if not po.nvme_path:
+            raise ConfigError(
+                "offload_param.device='nvme' requires "
+                "offload_param.nvme_path")
+        if self.fp16_enabled:
+            raise NotImplementedError(
+                "offload_param nvme requires bf16/fp32 compute (fp16 loss "
+                "scaling is not threaded through the per-layer executor)")
+        if self.onebit_mode:
+            raise NotImplementedError(
+                "offload_param nvme x 1-bit optimizers is not supported")
+        cfg = getattr(self.model, "cfg", None)
+        if cfg is None or not cfg.is_causal or cfg.norm_scheme != "pre":
+            raise NotImplementedError(
+                "offload_param nvme supports causal-LM pre-LN models "
+                "(the same surface as the 1F1B pipeline)")
+        if getattr(cfg, "moe_num_experts", 0) > 0:
+            raise NotImplementedError(
+                "offload_param nvme x MoE is not supported (capacity "
+                "routing needs the full layer stack resident)")
+        for ax in ("seq", "expert"):
+            if self.topology.axis_size(ax) > 1:
+                raise NotImplementedError(
+                    f"offload_param nvme does not compose with the "
+                    f"'{ax}' mesh axis (dp x tp only)")
+        zc = self.config.zero_optimization
+        if (zc.zero_quantized_weights or zc.zero_quantized_gradients
+                or zc.zero_hpz_partition_size > 1 or zc.mics_shard_size > 1):
+            raise NotImplementedError(
+                "offload_param nvme composes with plain ZeRO-3 only "
+                "(no ZeRO++ / MiCS)")
+
     def _host_param_sharding(self, param_sh):
         """Compute-param storage shardings with the model's offloadable
         subtrees (param_offload_keys, default the scanned layer stack)
@@ -342,12 +389,26 @@ class DeepSpeedTpuEngine:
         shapes = jax.eval_shape(self.model.init_params, rng)
         base_specs = self._base_specs()
         zc = self.config.zero_optimization
+        # Ulysses x ZeRO (reference stage3.py:1181: sp ranks are dp ranks
+        # to ZeRO): the standard auto-SPMD step shards model state over
+        # the seq axis too. Manual-program modes (ZeRO++, 1-bit, offload,
+        # pipeline, hpZ/MiCS) keep the dp-only shard they were built for.
+        include_seq = (
+            self.topology.axis_size("seq") > 1 and self.zero_stage >= 1
+            and not (self.onebit_mode or self.offload_device
+                     or self.param_offload_nvme
+                     or self.topology.axis_size("pipe") > 1
+                     or self.topology.hpz_enabled
+                     or self.topology.mics_enabled
+                     or zc.zero_quantized_weights
+                     or zc.zero_quantized_gradients))
         self.zero_plan: ZeroPlan = build_zero_plan(
             self.topology, self.zero_stage, shapes, base_specs,
             persistence_threshold=(zc.stage3_param_persistence_threshold
                                    if self.zero_stage == 3 else 0),
             secondary_axes=(self.topology.secondary_axes
-                            if self.topology.hpz_enabled else None))
+                            if self.topology.hpz_enabled else None),
+            include_seq_axis=include_seq)
         # widen the layer-scan scheduling window so stage-3 param gathers
         # overlap the previous layer's compute (the scan iteration boundary
         # otherwise serializes them; see TransformerConfig.scan_unroll).
@@ -375,7 +436,8 @@ class DeepSpeedTpuEngine:
         param_sh = self.param_storage_sharding
 
         if self._abstract_init:
-            if self.offload_device or self.onebit_mode:
+            if self.offload_device or self.onebit_mode \
+                    or self.param_offload_nvme:
                 raise NotImplementedError(
                     "abstract_init supports the standard jitted step only")
             sds = jax.ShapeDtypeStruct
@@ -416,6 +478,15 @@ class DeepSpeedTpuEngine:
             key_shape = jax.eval_shape(jax.random.PRNGKey, 0)
             self._model_rng = sds(key_shape.shape, key_shape.dtype,
                                   sharding=repl)
+            return
+
+        if self.param_offload_nvme:
+            self._init_infinity_state(rng)
+            self.param_count = int(sum(np.prod(l.shape)
+                                       for l in jax.tree.leaves(shapes)))
+            self._step_arr = jnp.asarray(0, jnp.int32)
+            self._model_rng = jax.random.PRNGKey(seed + 1)
+            self.scale_state = None
             return
 
         if self.offload_device:
@@ -459,6 +530,35 @@ class DeepSpeedTpuEngine:
         self.param_count = int(sum(np.prod(l.shape) for l in jax.tree.leaves(shapes)))
         self._step_arr = jnp.asarray(0, jnp.int32)
         self._model_rng = jax.random.PRNGKey(seed + 1)
+
+    def _init_infinity_state(self, rng):
+        """ZeRO-Infinity parameter tier: layer params + optimizer state on
+        NVMe, per-layer streamed executor (reference
+        swap_tensor/partitioned_param_swapper.py:36)."""
+        from .zero.infinity import InfinityParamEngine
+
+        opt_cfg = self.config.optimizer
+        po = self.config.zero_optimization.offload_param
+        oo = self.config.zero_optimization.offload_optimizer
+        aio = self.config.aio
+        fm = getattr(self.model, "frozen_mask", None)
+        if (fm() if callable(fm) else fm) is not None:
+            raise NotImplementedError(
+                "frozen_mask is not supported with offload_param nvme")
+        self._infinity = InfinityParamEngine(
+            self.model, self.topology, rng,
+            opt_name=opt_cfg.type, opt_params=opt_cfg.params,
+            param_nvme_path=po.nvme_path,
+            optim_device=("nvme" if self.offload_device == "nvme"
+                          else "cpu"),
+            optim_nvme_path=(oo.nvme_path
+                             if self.offload_device == "nvme" else None),
+            aio_block_size=aio.block_size, aio_threads=aio.thread_count,
+            gas=self.gas, clip=self.config.gradient_clipping,
+            compute_dtype=self.compute_dtype)
+        self.params = None
+        self.master_params = None
+        self.opt_state = None
 
     def _init_offload_state(self, rng, param_sh):
         """ZeRO-Offload init: fp32 master + moments as host numpy, device
@@ -1033,6 +1133,17 @@ class DeepSpeedTpuEngine:
 
         return batch_spec
 
+    def _train_batch_infinity(self, dev_batch):
+        """ZeRO-Infinity nvme-param batch: the per-layer executor streams
+        params from disk, accumulates host grads, and runs the C++ host
+        optimizer (runtime/zero/infinity.py)."""
+        step_no = int(self._step_arr) + 1
+        lr = float(self._lr_fn(jnp.asarray(step_no - 1, jnp.int32)))
+        metrics = self._infinity.train_batch(dev_batch, step_no, lr)
+        self._step_arr = jnp.asarray(step_no, jnp.int32)
+        metrics["lr"] = lr
+        return metrics
+
     def _train_batch_offloaded(self, dev_batch):
         grads, self.scale_state, self._model_rng, metrics = self._grad_step(
             self.params, self.scale_state, self._step_arr, self._model_rng,
@@ -1117,7 +1228,7 @@ class DeepSpeedTpuEngine:
     def lower_train_step(self, batch):
         """AOT-compile the train step for analysis (HLO text, overlap
         report, cost) without executing it. Returns the jax Compiled."""
-        if self.offload_device or self.onebit_mode:
+        if self.offload_device or self.onebit_mode or self.param_offload_nvme:
             raise NotImplementedError(
                 "lower_train_step supports the standard jitted step only "
                 "(offload runs a host optimizer; onebit builds its own step)")
@@ -1171,7 +1282,9 @@ class DeepSpeedTpuEngine:
                     "feed dict batches (or disable the curriculum block)")
         dev_batch = self._shard_batch(batch)
         self.tput_timer.start()
-        if self.offload_device:
+        if self.param_offload_nvme:
+            metrics = self._train_batch_infinity(dev_batch)
+        elif self.offload_device:
             metrics = self._train_batch_offloaded(dev_batch)
         else:
             (self.params, self.master_params, self.opt_state, self.scale_state,
@@ -1228,6 +1341,8 @@ class DeepSpeedTpuEngine:
             micro_batches = [next(data_iter) for _ in range(self.gas)]
             batch = jax.tree.map(lambda *xs: np.stack(xs), *micro_batches)
         dev_batch = self._shard_batch(batch)
+        if self.param_offload_nvme:
+            return self._infinity.eval_batch(dev_batch)
         return float(self._eval_step(self.params, self._model_rng, dev_batch))
 
     # --- torch-style forward/backward/step compatibility shims ------------
@@ -1238,6 +1353,10 @@ class DeepSpeedTpuEngine:
                 "forward/backward/step are not supported in pipeline mode; "
                 "use train_batch/eval_batch (same restriction as the "
                 "reference PipelineEngine)")
+        if self.param_offload_nvme:
+            raise RuntimeError(
+                "forward/backward/step are not supported with "
+                "offload_param nvme; use train_batch/eval_batch")
         self._cached_batches.append(batch)
         return self._forward_loss(batch)
 
@@ -1387,7 +1506,14 @@ class DeepSpeedTpuEngine:
         from ..checkpoint.state_checkpoint import save_state
         self._join_pending_saves()
         tag = tag or f"global_step{self.global_steps}"
-        if self.offload_device:
+        params_tree = self.params
+        if self.param_offload_nvme:
+            # one sweep over the NVMe optim files; bf16 params recast
+            # from the same masters (no separate param-file sweep)
+            master_tree, opt_tree = self._infinity.full_master_and_state()
+            cdt = self._infinity._np_cdtype
+            params_tree = jax.tree.map(lambda m: m.astype(cdt), master_tree)
+        elif self.offload_device:
             unflat = partial(jax.tree_util.tree_unflatten, self._param_treedef)
             master_leaves, state_leaves = self.host_opt.get_all_leaves()
             master_tree = unflat(master_leaves)
@@ -1395,7 +1521,7 @@ class DeepSpeedTpuEngine:
         else:
             master_tree, opt_tree = self.master_params, self.opt_state
         state = {
-            "params": self.params,
+            "params": params_tree,
             "master_params": master_tree,
             "opt_state": opt_tree,
             "scale_state": self.scale_state,
@@ -1460,7 +1586,9 @@ class DeepSpeedTpuEngine:
         tag = tag or read_latest(load_dir)
         if tag is None:
             return None, {}
-        if self.offload_device:
+        if self.param_offload_nvme:
+            master_tpl, opt_tpl = self._infinity.template_tree()
+        elif self.offload_device:
             unflat = partial(jax.tree_util.tree_unflatten, self._param_treedef)
             master_tpl_leaves, opt_tpl_leaves = self.host_opt.template_leaves()
             master_tpl = unflat(master_tpl_leaves)
@@ -1483,8 +1611,14 @@ class DeepSpeedTpuEngine:
         }
         state, meta = load_state(load_dir, tag, template, shardings, self.mesh,
                                  self.zero_plan)
-        self.params = state["params"]
-        if self.offload_device:
+        if self.param_offload_nvme:
+            # params regenerate from the restored masters; self.params
+            # stays None (the layer stack lives on NVMe, not in HBM)
+            self._infinity.load_full(
+                state["master_params"],
+                state["opt_state"] if load_optimizer_states else None)
+        elif self.offload_device:
+            self.params = state["params"]
             master_leaves = [np.asarray(l, np.float32)
                              for l in jax.tree.leaves(state["master_params"])]
             opt_leaves = None
@@ -1495,6 +1629,7 @@ class DeepSpeedTpuEngine:
             self.host_opt.load_leaves(master_leaves, opt_leaves)
             self._push_host_params(self.host_opt.current_bf16_leaves())
         else:
+            self.params = state["params"]
             self.master_params = state["master_params"]
             if load_optimizer_states:
                 self.opt_state = state["opt_state"]
@@ -1513,6 +1648,12 @@ class DeepSpeedTpuEngine:
         (reference engine.py:3395). Works for every stage — sharded arrays
         are gathered on fetch."""
         from ..checkpoint.state_checkpoint import _fetch, _leaf_paths
+        if self.param_offload_nvme:
+            master, _ = self._infinity.full_master_and_state()
+            cdt = self._infinity._np_cdtype
+            leaves, _td = _leaf_paths(master)
+            return {key: np.asarray(leaf).astype(cdt)
+                    for key, leaf in leaves}
         leaves, _ = _leaf_paths(self.params)
         return {key: np.asarray(_fetch(leaf)) for key, leaf in leaves}
 
@@ -1674,6 +1815,19 @@ class DeepSpeedTpuEngine:
             if self.host_opt is not None:
                 self.host_opt.close()
                 self.host_opt = None
+            if self._infinity is not None:
+                self._infinity.close()
+                self._infinity = None
+            # drop device state so HBM frees immediately (a bench/driver
+            # process may build several engines back to back)
+            self.params = None
+            self.master_params = None
+            self.opt_state = None
+            self.scale_state = None
+            for attr in ("_train_step", "_grad_step", "_eval_step",
+                         "_fwd_jit", "_grad_jit"):
+                if hasattr(self, attr):
+                    setattr(self, attr, None)
 
     def train(self, mode: bool = True):
         return self

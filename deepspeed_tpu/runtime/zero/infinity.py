@@ -1,0 +1,607 @@
+"""ZeRO-Infinity NVMe parameter tier: per-layer streamed execution.
+
+TPU-native analogue of the reference's parameter swapper
+(``swap_tensor/partitioned_param_swapper.py:36`` — fp16 params live on
+NVMe, are async-read into pinned host buffers and shipped to device right
+before a submodule runs, then released) and of the stage-3 module hooks
+that drive it (``runtime/zero/parameter_offload.py:201``).
+
+The reference can hook arbitrary eager submodules; under XLA the
+equivalent design is an explicit **per-layer executor**: one jitted
+single-layer forward, one jitted single-layer VJP, and jitted stem/crown
+(embedding / loss-head) programs. The Python driver walks the layer
+stack, double-buffering NVMe reads through the AIO C++ library
+(``csrc/aio/async_io.cpp``) so layer ``i+1``'s disk read overlaps layer
+``i``'s device compute — the same overlap the reference gets from its
+swap-out/swap-in streams. Backward re-fetches each layer in reverse
+order and recomputes its forward inside ``jax.vjp`` (layer-granularity
+rematerialization), so device HBM never holds more than one layer's
+parameters plus the boundary activations.
+
+Storage layout under ``offload_param.nvme_path``:
+
+* ``layer_{i:05d}.params`` — the layer's compute-dtype (bf16) leaves,
+  concatenated (read twice per microbatch: forward + backward).
+* ``layer_{i:05d}.optim``  — fp32 ``[master | moment0 | moment1 ...]``
+  per leaf, concatenated (read+written once per optimizer sweep, with
+  the reference's PipelinedOptimizerSwapper-style read-ahead). With
+  ``offload_optimizer.device != "nvme"`` this state stays in host RAM
+  instead (ZeRO-Offload params-on-NVMe, states-in-RAM).
+
+Persistent (non-layer) parameters — embeddings, final norm, untied LM
+head — stay device-resident with host-RAM fp32 master/moments, mirroring
+the reference's ``stage3_param_persistence_threshold`` behavior for
+small tensors. Gradients accumulate in host fp32 buffers across the
+gradient-accumulation loop, matching the reference's CPU-resident
+partitioned gradients under Infinity.
+
+Restrictions (all rejected loudly at engine init): causal-LM pre-LN
+models only (same surface as the 1F1B pipeline), bf16/fp32 compute (no
+fp16 loss scaling), no MoE / pipeline / sequence / expert axes, no
+1-bit optimizers or compression. dp x tp meshes are supported — each
+streamed layer is ``device_put`` with its tensor-parallel sharding.
+"""
+
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...ops.cpu_optimizers import build_host_optimizer
+from ...utils.logging import logger
+
+
+def _np_dtype(jnp_dtype):
+    import ml_dtypes
+    if jnp_dtype == jnp.bfloat16:
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(jnp_dtype)
+
+
+class _LayerFileStream:
+    """Double-buffered AIO reader over per-layer files of equal size.
+
+    A slot's buffer is only rewritten after (a) its AIO read completed and
+    (b) any in-flight host->device transfer sourced from it finished
+    (``note_transfer`` + ``block_until_ready`` guard) — device_put from a
+    numpy view does not promise the host memory is consumed by return on
+    an async backend."""
+
+    def __init__(self, aio, paths: List[str], nbytes: int, dtype):
+        self.aio = aio
+        self.paths = paths
+        self.bufs = [np.zeros(nbytes // dtype.itemsize, dtype)
+                     for _ in range(2)]
+        self._pending: Dict[int, int] = {}   # layer idx -> aio req id
+        self._slot_of: Dict[int, int] = {}   # layer idx -> buffer slot
+        self._transfer: Dict[int, Any] = {}  # slot -> device tree in flight
+
+    def note_transfer(self, i: int, dev_tree):
+        self._transfer[self._slot_of[i]] = dev_tree
+
+    def _claim_slot(self, i: int, keep: Optional[int]) -> Optional[int]:
+        used = set(self._slot_of.values())
+        free = [s for s in (0, 1) if s not in used]
+        if free:
+            slot = free[0]
+        else:  # evict a layer that isn't the caller's pinned one
+            victim = next((k for k in self._slot_of
+                           if k != keep and k not in self._pending), None)
+            if victim is None:
+                victim = next((k for k in self._pending if k != keep), None)
+                if victim is None:
+                    return None   # both slots pinned; caller falls back
+                self.aio.wait(self._pending.pop(victim))
+            slot = self._slot_of.pop(victim)
+        t = self._transfer.pop(slot, None)
+        if t is not None:
+            # the buffer may still be feeding an async H2D copy
+            jax.block_until_ready(t)
+        self._slot_of[i] = slot
+        return slot
+
+    def prefetch(self, i: int, keep: Optional[int] = None):
+        if i < 0 or i >= len(self.paths) or i in self._pending \
+                or i in self._slot_of:
+            return
+        slot = self._claim_slot(i, keep)
+        if slot is not None:
+            self._pending[i] = self.aio.pread(self.paths[i], self.bufs[slot])
+
+    def get(self, i: int, prefetch_next: Optional[int] = None) -> np.ndarray:
+        if i in self._pending:
+            self.aio.wait(self._pending.pop(i))
+        elif i not in self._slot_of:
+            slot = self._claim_slot(i, keep=None)
+            assert slot is not None, "layer stream: no free buffer slot"
+            self.aio.sync_pread(self.paths[i], self.bufs[slot])
+        buf = self.bufs[self._slot_of[i]]
+        if prefetch_next is not None:
+            self.prefetch(prefetch_next, keep=i)
+        return buf
+
+    def invalidate(self):
+        """Drop all cached/ready layers (files were rewritten)."""
+        for i, req in list(self._pending.items()):
+            self.aio.wait(req)
+        self._pending.clear()
+        self._slot_of.clear()
+
+
+class InfinityParamEngine:
+    """Owns NVMe parameter + optimizer storage and the per-layer step.
+
+    Built by DeepSpeedEngine when ``offload_param.device == "nvme"``.
+    """
+
+    _instance_counter = 0
+
+    def __init__(self, model, topology, rng, *, opt_name: str,
+                 opt_params: Dict[str, Any], param_nvme_path: str,
+                 optim_device: str, optim_nvme_path: Optional[str],
+                 aio_block_size: int, aio_threads: int, gas: int,
+                 clip: float, compute_dtype=jnp.bfloat16):
+        from ...ops.aio import AsyncIOHandle
+        from .offload import _leaf_names
+
+        self.model = model
+        self.cfg = model.cfg
+        self.topology = topology
+        self.gas = gas
+        self.clip = clip
+        self.compute_dtype = compute_dtype
+        self._np_cdtype = _np_dtype(compute_dtype)
+        self.L = self.cfg.num_layers
+        self.opt = build_host_optimizer(opt_name, opt_params)
+        self.state_keys = self.opt.state_keys()
+        self._n_fields = 1 + len(self.state_keys)
+        self.optim_on_nvme = optim_device == "nvme"
+
+        InfinityParamEngine._instance_counter += 1
+        self.param_dir = os.path.join(
+            param_nvme_path, "ds_tpu_param_swap",
+            f"pid{os.getpid()}_{InfinityParamEngine._instance_counter}")
+        os.makedirs(self.param_dir, exist_ok=True)
+        self.optim_dir = self.param_dir if not optim_nvme_path else \
+            os.path.join(optim_nvme_path, "ds_tpu_param_swap",
+                         f"pid{os.getpid()}_"
+                         f"{InfinityParamEngine._instance_counter}_optim")
+        if self.optim_on_nvme:
+            os.makedirs(self.optim_dir, exist_ok=True)
+        self.aio = AsyncIOHandle(aio_block_size, aio_threads)
+
+        # ---- initial full tree on host (fp32), then split + spill ----
+        cpu0 = jax.local_devices(backend="cpu")[0]
+        with jax.default_device(cpu0):
+            full = model.init_params(rng)
+        # owned writable buffers: the C++ optimizer updates through the
+        # raw pointer (np.asarray of a jax array can be a read-only view)
+        full = jax.tree.map(lambda x: np.array(x, np.float32, copy=True),
+                            full)
+        layers = full.pop("layers")
+        self.persist_tree_np = full                      # fp32 masters
+        self.persist_names = _leaf_names(full)
+        self.persist_leaves = jax.tree.leaves(full)
+        _, self.persist_treedef = jax.tree_util.tree_flatten(full)
+        self.persist_state = [[np.zeros(m.shape, np.float32)
+                               for _ in self.state_keys]
+                              for m in self.persist_leaves]
+
+        layer_leaves, self.layer_treedef = jax.tree_util.tree_flatten(layers)
+        self.layer_shapes = [l.shape[1:] for l in layer_leaves]   # minus L
+        self.layer_sizes = [int(np.prod(s)) for s in self.layer_shapes]
+        self.layer_elems = int(sum(self.layer_sizes))
+        self.param_files = [os.path.join(self.param_dir,
+                                         f"layer_{i:05d}.params")
+                            for i in range(self.L)]
+        self.optim_files = [os.path.join(self.optim_dir,
+                                         f"layer_{i:05d}.optim")
+                            for i in range(self.L)]
+        # one layer at a time so peak host RAM stays O(one layer)
+        pbuf = np.zeros(self.layer_elems, self._np_cdtype)
+        obuf = np.zeros(self.layer_elems * self._n_fields, np.float32)
+        self._optim_ram: List[Optional[np.ndarray]] = [None] * self.L
+        for i in range(self.L):
+            off = 0
+            ooff = 0
+            for leaf, sz in zip(layer_leaves, self.layer_sizes):
+                flat = leaf[i].ravel()
+                pbuf[off:off + sz] = flat.astype(self._np_cdtype)
+                obuf[ooff:ooff + sz] = flat
+                obuf[ooff + sz:ooff + sz * self._n_fields] = 0.0
+                off += sz
+                ooff += sz * self._n_fields
+            self.aio.sync_pwrite(self.param_files[i], pbuf)
+            if self.optim_on_nvme:
+                self.aio.sync_pwrite(self.optim_files[i], obuf)
+            else:
+                self._optim_ram[i] = obuf.copy()
+        del full, layers, layer_leaves
+        param_gb = self.layer_elems * self.L * pbuf.itemsize / 1e9
+        logger.info(
+            f"ZeRO-Infinity: {self.L} layer param files on NVMe at "
+            f"{self.param_dir} ({param_gb:.2f} GB bf16); optimizer state "
+            f"{'on NVMe' if self.optim_on_nvme else 'in host RAM'}")
+
+        # ---- working buffers ----
+        self._pstream = _LayerFileStream(
+            self.aio, self.param_files, self.layer_elems * pbuf.itemsize,
+            self._np_cdtype)
+        self.grad_acc = [np.zeros(self.layer_elems, np.float32)
+                         for _ in range(self.L)]
+        self.persist_grad_acc = [np.zeros(m.shape, np.float32)
+                                 for m in self.persist_leaves]
+        self._obufs = [np.zeros(self.layer_elems * self._n_fields,
+                                np.float32) for _ in range(2)]
+
+        # ---- shardings + device-resident persistent params ----
+        mesh = topology.mesh
+        base = model.param_partition_specs(topology) \
+            if hasattr(model, "param_partition_specs") else None
+        lspecs = (base or {}).get("layers", {})
+        # strip the leading stacked-L axis entry from each layer spec
+        self.layer_sharding = jax.tree_util.tree_unflatten(
+            self.layer_treedef,
+            [NamedSharding(mesh, P(*(tuple(lspecs[k])[1:]
+                                     if isinstance(lspecs, dict)
+                                     and k in lspecs else ())))
+             for k in self._layer_keys()])
+        self.persist_sharding = jax.tree.map(
+            lambda _: NamedSharding(mesh, P()), self.persist_tree_np)
+        if base:
+            for k, spec in base.items():
+                if k != "layers" and k in self.persist_sharding:
+                    self.persist_sharding[k] = NamedSharding(mesh, spec)
+        self._push_persist()
+        self._build_fns()
+        self._rope_cache: Dict[int, Any] = {}
+
+    # ------------------------------------------------------------------
+    def _layer_keys(self):
+        # flatten order of the layers dict (sorted keys for dict pytrees)
+        dummy = jax.tree_util.tree_unflatten(
+            self.layer_treedef, list(range(len(self.layer_sizes))))
+        keys = [k for k, _ in sorted(dummy.items())]
+        return keys
+
+    def _push_persist(self):
+        tree = jax.tree_util.tree_unflatten(
+            self.persist_treedef,
+            [l.astype(self._np_cdtype) for l in self.persist_leaves])
+        self.pp_dev = jax.tree.map(jax.device_put, tree,
+                                   self.persist_sharding)
+
+    def _layer_tree_from(self, buf: np.ndarray):
+        views, off = [], 0
+        for shape, sz in zip(self.layer_shapes, self.layer_sizes):
+            views.append(buf[off:off + sz].reshape(shape))
+            off += sz
+        return jax.tree_util.tree_unflatten(self.layer_treedef, views)
+
+    def _fetch_layer(self, i: int, prefetch: Optional[int]):
+        buf = self._pstream.get(i, prefetch)
+        tree = self._layer_tree_from(buf)
+        dev = jax.tree.map(jax.device_put, tree, self.layer_sharding)
+        # guard the host buffer against reuse while the H2D copy is in
+        # flight (released by the stream before the slot is rewritten)
+        self._pstream.note_transfer(i, dev)
+        return dev
+
+    # ------------------------------------------------------------------
+    # jitted programs (stem / layer fwd / layer vjp / crown vjp)
+    # ------------------------------------------------------------------
+    def _build_fns(self):
+        model, cfg = self.model, self.cfg
+        from ...models.transformer import (_chunked_ce_loss, _rope_tables,
+                                           layer_norm)
+
+        def stem(pp, ids):
+            x = pp["embed"][ids]
+            if cfg.positional == "learned":
+                x = x + pp["pos_embed"][:ids.shape[1]].astype(x.dtype)
+            if cfg.embed_ln:
+                x = layer_norm(x, pp["embed_ln_w"], pp.get("embed_ln_b"),
+                               cfg.norm_eps)
+            return x
+
+        def crown(pp, x, ids, mask):
+            x = model._norm(x, pp["final_norm"], pp.get("final_norm_b"))
+            head = (pp["embed"].T if cfg.tie_embeddings else pp["lm_head"])
+            m = (mask[:, 1:].astype(jnp.float32) if mask is not None
+                 else jnp.ones(ids[:, 1:].shape, jnp.float32))
+            total, count = _chunked_ce_loss(x[:, :-1], ids[:, 1:], m, head,
+                                            cfg.loss_chunk)
+            return (total / jnp.maximum(count, 1.0)).astype(jnp.float32)
+
+        def layer_fwd(lp, x, cos, sin):
+            return model._layer(x, lp, cos, sin)[0]
+
+        def layer_bwd(lp, h_in, cos, sin, dh):
+            _, pull = jax.vjp(
+                lambda lp_, h_: layer_fwd(lp_, h_, cos, sin), lp, h_in)
+            dlp, dh_in = pull(dh)
+            return dh_in, dlp
+
+        def crown_vjp(pp, x, ids, mask):
+            (loss), (dpp, dx) = jax.value_and_grad(
+                crown, argnums=(0, 1))(pp, x, ids, mask)
+            return loss, dpp, dx
+
+        def stem_vjp(pp, ids, dx):
+            _, pull = jax.vjp(lambda pp_: stem(pp_, ids), pp)
+            return pull(dx)[0]
+
+        # NB: no donation on the forward hidden state — every layer input
+        # is kept in `acts` for the backward sweep
+        self._stem = jax.jit(stem)
+        self._layer_fwd = jax.jit(layer_fwd)
+        self._layer_bwd = jax.jit(layer_bwd, donate_argnums=(4,))
+        self._crown_vjp = jax.jit(crown_vjp)
+        self._crown_loss = jax.jit(crown)
+        self._stem_vjp = jax.jit(stem_vjp)
+        self._rope_tables = _rope_tables
+
+    def _rope(self, S: int):
+        if S not in self._rope_cache:
+            cdt = self.compute_dtype
+            if self.cfg.positional == "rope":
+                cos, sin = self._rope_tables(self.cfg, S)
+            else:  # unused by _layer; mirror forward_hidden's placeholders
+                cos = sin = jnp.zeros((S, 1), cdt)
+            self._rope_cache[S] = (jnp.asarray(cos, cdt),
+                                   jnp.asarray(sin, cdt))
+        return self._rope_cache[S]
+
+    # ------------------------------------------------------------------
+    # one full train batch (gas microbatches + optimizer sweep)
+    # ------------------------------------------------------------------
+    def train_batch(self, dev_batch, step: int, lr: float) -> Dict[str, Any]:
+        L, gas = self.L, self.gas
+        losses = []
+        for g in self.grad_acc:
+            g.fill(0.0)
+        for g in self.persist_grad_acc:
+            g.fill(0.0)
+
+        for m in range(gas):
+            micro = jax.tree.map(lambda x: x[m], dev_batch)
+            ids = micro["input_ids"]
+            mask = micro.get("loss_mask")
+            cos, sin = self._rope(ids.shape[1])
+            # ---- forward sweep (disk read i+1 overlaps layer i) ----
+            h = self._stem(self.pp_dev, ids)
+            acts = [h]
+            for i in range(L):
+                lp = self._fetch_layer(i, i + 1 if i + 1 < L else None)
+                h = self._layer_fwd(lp, h, cos, sin)
+                acts.append(h)
+            loss, dpp_c, dh = self._crown_vjp(self.pp_dev, acts[-1],
+                                              ids, mask)
+            losses.append(loss)
+            self._acc_persist(dpp_c)
+            # ---- backward sweep (reverse stream; vjp recomputes fwd) ----
+            for i in range(L - 1, -1, -1):
+                lp = self._fetch_layer(i, i - 1 if i > 0 else None)
+                dh, dlp = self._layer_bwd(lp, acts[i], cos, sin, dh)
+                self._acc_layer_grads(i, dlp)
+            acts.clear()
+            self._acc_persist(self._stem_vjp(self.pp_dev, ids, dh))
+
+        # ---- grad scale (1/gas), global norm, clip factor ----
+        inv = 1.0 / gas
+        sq = 0.0
+        for g in self.grad_acc:
+            g *= inv
+            sq += float(np.dot(g, g))
+        for g in self.persist_grad_acc:
+            g *= inv
+            sq += float(np.dot(g.ravel(), g.ravel()))
+        gnorm = float(np.sqrt(sq))
+        if self.clip and self.clip > 0 and gnorm > self.clip:
+            factor = self.clip / (gnorm + 1e-6)
+            for g in self.grad_acc:
+                g *= factor
+            for g in self.persist_grad_acc:
+                g *= factor
+
+        self._optimizer_sweep(step, lr)
+        loss_mean = float(np.mean([float(l) for l in losses]))
+        return {"loss": loss_mean, "grad_norm": gnorm,
+                "skipped": 0}
+
+    def _acc_layer_grads(self, i: int, dlp):
+        leaves = jax.tree.leaves(dlp)
+        buf, off = self.grad_acc[i], 0
+        for leaf, sz in zip(leaves, self.layer_sizes):
+            buf[off:off + sz] += np.asarray(leaf, np.float32).ravel()
+            off += sz
+
+    def _acc_persist(self, dpp):
+        for acc, leaf in zip(self.persist_grad_acc, jax.tree.leaves(dpp)):
+            acc += np.asarray(leaf, np.float32).reshape(acc.shape)
+
+    # ------------------------------------------------------------------
+    def _optimizer_sweep(self, step: int, lr: float):
+        """Per-layer update with PipelinedOptimizerSwapper-style overlap:
+        layer i+1's optim-state read and layer i-1's writeback ride the AIO
+        threads while layer i runs the C++ CPU kernel."""
+        L = self.L
+        pbuf = np.zeros(self.layer_elems, self._np_cdtype)
+        reads = [None, None]
+        pending_write = None
+        if self.optim_on_nvme:
+            reads[0] = self.aio.pread(self.optim_files[0], self._obufs[0])
+        for i in range(L):
+            if self.optim_on_nvme:
+                cur = self._obufs[i % 2]
+                if i + 1 < L:
+                    if pending_write is not None:
+                        self.aio.wait(pending_write)
+                        pending_write = None
+                    reads[(i + 1) % 2] = self.aio.pread(
+                        self.optim_files[i + 1], self._obufs[(i + 1) % 2])
+                self.aio.wait(reads[i % 2])
+            else:
+                cur = self._optim_ram[i]
+            grads, ooff, poff = self.grad_acc[i], 0, 0
+            for sz in self.layer_sizes:
+                master = cur[ooff:ooff + sz]
+                moments = [cur[ooff + (1 + k) * sz:ooff + (2 + k) * sz]
+                           for k in range(len(self.state_keys))]
+                self.opt.step(step, master, grads[poff:poff + sz],
+                              *moments, lr=lr)
+                pbuf[poff:poff + sz] = master.astype(self._np_cdtype)
+                ooff += sz * self._n_fields
+                poff += sz
+            if self.optim_on_nvme:
+                pending_write = self.aio.pwrite(self.optim_files[i], cur)
+            self.aio.sync_pwrite(self.param_files[i], pbuf)
+        if pending_write is not None:
+            self.aio.wait(pending_write)
+        # any buffered layers predate the rewrite: drop them
+        self._pstream.invalidate()
+        # persistent (device-resident) params: plain host update
+        for j, m in enumerate(self.persist_leaves):
+            self.opt.step(step, m.ravel(), self.persist_grad_acc[j].ravel(),
+                          *[s.ravel() for s in self.persist_state[j]], lr=lr)
+        self._push_persist()
+
+    # ------------------------------------------------------------------
+    def eval_batch(self, dev_batch) -> float:
+        losses = []
+        for m in range(self.gas):
+            micro = jax.tree.map(lambda x: x[m], dev_batch)
+            ids = micro["input_ids"]
+            cos, sin = self._rope(ids.shape[1])
+            h = self._stem(self.pp_dev, ids)
+            for i in range(self.L):
+                lp = self._fetch_layer(i, i + 1 if i + 1 < self.L else None)
+                # no donation: eval reuses the jitted fwd, fresh h each layer
+                h = self._layer_fwd(lp, h, cos, sin)
+            losses.append(float(self._crown_loss(
+                self.pp_dev, h, ids, micro.get("loss_mask"))))
+        return float(np.mean(losses))
+
+    # ------------------------------------------------------------------
+    # checkpoint interop (full-tree views, original init_params order)
+    # ------------------------------------------------------------------
+    def _read_optim(self, i: int) -> np.ndarray:
+        if self.optim_on_nvme:
+            buf = np.empty(self.layer_elems * self._n_fields, np.float32)
+            self.aio.sync_pread(self.optim_files[i], buf)
+            return buf
+        return self._optim_ram[i]
+
+    def full_master_and_state(self):
+        """(master_tree fp32, {state_key: tree}) with 'layers' re-stacked."""
+        stacked_m = [np.empty((self.L,) + s, np.float32)
+                     for s in self.layer_shapes]
+        stacked_s = {k: [np.empty((self.L,) + s, np.float32)
+                         for s in self.layer_shapes]
+                     for k in self.state_keys}
+        for i in range(self.L):
+            buf = self._read_optim(i)
+            ooff = 0
+            for j, (shape, sz) in enumerate(zip(self.layer_shapes,
+                                                self.layer_sizes)):
+                stacked_m[j][i] = buf[ooff:ooff + sz].reshape(shape)
+                for k_idx, key in enumerate(self.state_keys):
+                    stacked_s[key][j][i] = \
+                        buf[ooff + (1 + k_idx) * sz:
+                            ooff + (2 + k_idx) * sz].reshape(shape)
+                ooff += sz * self._n_fields
+        unflat_l = lambda ls: jax.tree_util.tree_unflatten(
+            self.layer_treedef, ls)
+        master = dict(jax.tree_util.tree_unflatten(
+            self.persist_treedef, [m.copy() for m in self.persist_leaves]))
+        master["layers"] = unflat_l(stacked_m)
+        state = {}
+        for k_idx, key in enumerate(self.state_keys):
+            t = dict(jax.tree_util.tree_unflatten(
+                self.persist_treedef,
+                [s[k_idx].copy() for s in self.persist_state]))
+            t["layers"] = unflat_l(stacked_s[key])
+            state[key] = t
+        return master, state
+
+    def template_tree(self):
+        master, state = None, None
+        stacked = [np.empty((self.L,) + s, np.float32)
+                   for s in self.layer_shapes]
+        t = dict(jax.tree_util.tree_unflatten(
+            self.persist_treedef,
+            [np.empty(m.shape, np.float32) for m in self.persist_leaves]))
+        t["layers"] = jax.tree_util.tree_unflatten(self.layer_treedef,
+                                                   stacked)
+        master = t
+        state = {k: jax.tree.map(np.empty_like, t) for k in self.state_keys}
+        return master, state
+
+    def load_full(self, master_tree, state_trees: Optional[Dict[str, Any]]):
+        """Restore master (and moments if given) into NVMe/RAM storage and
+        refresh both the bf16 param files and the device persistents."""
+        m = dict(master_tree)
+        layers = m.pop("layers")
+        for j, leaf in enumerate(jax.tree.leaves(m)):
+            np.copyto(self.persist_leaves[j],
+                      np.asarray(leaf, np.float32).reshape(
+                          self.persist_leaves[j].shape))
+        s_layers = None
+        if state_trees is not None:
+            s_layers = {}
+            for key, tree in state_trees.items():
+                tt = dict(tree)
+                s_layers[key] = tt.pop("layers")
+                for j, leaf in enumerate(jax.tree.leaves(tt)):
+                    k_idx = self.state_keys.index(key)
+                    np.copyto(self.persist_state[j][k_idx],
+                              np.asarray(leaf, np.float32).reshape(
+                                  self.persist_state[j][k_idx].shape))
+        layer_leaves = jax.tree.leaves(layers)
+        s_leaves = {k: jax.tree.leaves(v)
+                    for k, v in (s_layers or {}).items()}
+        pbuf = np.zeros(self.layer_elems, self._np_cdtype)
+        for i in range(self.L):
+            buf = self._read_optim(i) if state_trees is None else \
+                np.zeros(self.layer_elems * self._n_fields, np.float32)
+            ooff = poff = 0
+            for j, sz in enumerate(self.layer_sizes):
+                flat = np.asarray(layer_leaves[j][i], np.float32).ravel()
+                buf[ooff:ooff + sz] = flat
+                pbuf[poff:poff + sz] = flat.astype(self._np_cdtype)
+                if state_trees is not None:
+                    for k_idx, key in enumerate(self.state_keys):
+                        buf[ooff + (1 + k_idx) * sz:
+                            ooff + (2 + k_idx) * sz] = \
+                            np.asarray(s_leaves[key][j][i],
+                                       np.float32).ravel()
+                ooff += sz * self._n_fields
+                poff += sz
+            if self.optim_on_nvme:
+                self.aio.sync_pwrite(self.optim_files[i], buf)
+            else:
+                self._optim_ram[i] = buf
+            self.aio.sync_pwrite(self.param_files[i], pbuf)
+        self._pstream.invalidate()
+        self._push_persist()
+
+    # ------------------------------------------------------------------
+    def device_param_bytes(self) -> int:
+        """Bytes of parameters resident in device memory (persistents
+        only — the layer stack lives on NVMe). For tests/telemetry."""
+        return int(sum(np.prod(l.shape) * self._np_cdtype.itemsize
+                       for l in self.persist_leaves))
+
+    def close(self):
+        if self.aio is not None:
+            self.aio.close()
+            self.aio = None
+            import shutil
+            shutil.rmtree(self.param_dir, ignore_errors=True)
+            if self.optim_on_nvme:
+                shutil.rmtree(self.optim_dir, ignore_errors=True)
+        if getattr(self.opt, "destroy", None):
+            self.opt.destroy()

@@ -105,7 +105,8 @@ def build_zero_plan(topo: MeshTopology,
                     param_shapes,
                     base_specs=None,
                     persistence_threshold: int = 0,
-                    secondary_axes=None) -> ZeroPlan:
+                    secondary_axes=None,
+                    include_seq_axis: bool = False) -> ZeroPlan:
     """Construct the sharding plan for a given ZeRO stage.
 
     `param_shapes`: pytree of jax.ShapeDtypeStruct (or arrays).
@@ -116,10 +117,16 @@ def build_zero_plan(topo: MeshTopology,
     secondary tensors): stage-3 COMPUTE params shard over these axes only
     (the within-group sub-axis) while master/opt/grads keep the full
     `dp_axes` shard — the fwd/bwd gather then stays inside the group.
+    `include_seq_axis`: shard model state over the "seq" axis too — the
+    reference's Ulysses x ZeRO composition (sp ranks ARE dp ranks to ZeRO,
+    stage3.py:1181); engine enables it for the standard auto-SPMD step.
     """
     mesh = topo.mesh
-    zero_axes = topo.dp_axes
+    zero_axes = (topo.zero_shard_axes if include_seq_axis
+                 else topo.dp_axes)
     zero_size = topo.dp_world_size
+    if include_seq_axis:
+        zero_size *= topo.axis_size("seq")
 
     if base_specs is None:
         base_specs = jax.tree.map(lambda _: P(), param_shapes)

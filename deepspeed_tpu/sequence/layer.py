@@ -49,7 +49,8 @@ def _inner_attention(q, k, v, causal, use_flash, block_q, block_kv, sp_size,
 
     if sp_size > 1 and impl == "ring":
         from .ring_attention import ring_attention
-        return ring_attention(q, k, v, SEQ_AXIS, causal=causal, scale=scale)
+        return ring_attention(q, k, v, SEQ_AXIS, causal=causal, scale=scale,
+                              q_chunk=block_q, kv_chunk=block_kv)
 
     if sp_size > 1:
         # Ulysses: heads -> heads/sp, seq/sp -> seq
