@@ -280,7 +280,8 @@ def main():
         try:
             big = dataclasses.replace(
                 base, hidden_size=1536, intermediate_size=4096,
-                num_heads=12, use_flash=True, flash_min_seq=2048)
+                num_heads=12, num_kv_heads=4, use_flash=True,
+                flash_min_seq=2048)
             b_mfu, b_detail = _measure(big, 8, 1, max(steps // 2, 3),
                                        warmup, n_dev, remat_policy=policy)
             detail["large_proxy_mfu"] = round(b_mfu * 100, 2)

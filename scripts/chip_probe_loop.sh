@@ -15,7 +15,14 @@ TAG="${1:-r05}"
 PROBE_INTERVAL="${PROBE_INTERVAL:-900}"
 PROBE_TIMEOUT="${PROBE_TIMEOUT:-150}"
 MAX_HOURS="${MAX_HOURS:-11}"
+# WINDOW_SCRIPT: what to fire on a healthy probe (default: the full
+# first-visit evidence capture). SUCCESS_FILE: must exist AND be newer
+# than loop start to stop looping (a stale committed capture from an
+# earlier window must not count as this window's success).
+WINDOW_SCRIPT="${WINDOW_SCRIPT:-scripts/chip_window.sh}"
+SUCCESS_FILE="${SUCCESS_FILE:-BENCH_${TAG}_early.json}"
 cd "$(dirname "$0")/.."
+START_STAMP=$(mktemp)
 
 deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
 attempt=0
@@ -27,10 +34,11 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     kind=$(timeout "$PROBE_TIMEOUT" python -c \
         "import jax; print(jax.devices()[0].device_kind)" 2>/dev/null | tail -n 1)
     if [ -n "$kind" ] && ! printf '%s' "$kind" | grep -qi cpu; then
-        echo "[chip_probe_loop] chip ALIVE (device_kind=${kind}); firing chip_window.sh ${TAG}"
-        bash scripts/chip_window.sh "$TAG"
-        if [ -e "BENCH_${TAG}_early.json" ]; then
+        echo "[chip_probe_loop] chip ALIVE (device_kind=${kind}); firing ${WINDOW_SCRIPT} ${TAG}"
+        bash "$WINDOW_SCRIPT" "$TAG"
+        if [ -e "$SUCCESS_FILE" ] && [ "$SUCCESS_FILE" -nt "$START_STAMP" ]; then
             echo "[chip_probe_loop] evidence captured; exiting"
+            rm -f "$START_STAMP"
             exit 0
         fi
         echo "[chip_probe_loop] capture incomplete (bench missing); will keep probing"
@@ -40,4 +48,5 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     sleep "$PROBE_INTERVAL"
 done
 echo "[chip_probe_loop] gave up after ${MAX_HOURS}h"
+rm -f "$START_STAMP"
 exit 1

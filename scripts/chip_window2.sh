@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Second-visit chip-window capture (r05): everything the first visit
+# (chip_window.sh) either missed or that landed after it —
+#   BENCH_r05b_early.json            bench re-run (large-proxy GQA fix)
+#   artifacts/r05/paged_kernel_chip.json  DMA vs pipelined paged kernel
+#   artifacts/r05/serving_profile.json    decode-step cost breakdown
+#   artifacts/r05/serving2.json           serving bench w/ DMA kernel +
+#                                         sliced decode tables
+#   artifacts/r05/mfu_hunt.json           extended MFU ladder
+# Run when a TPU probe succeeds:  bash scripts/chip_window2.sh
+set -u
+cd "$(dirname "$0")/.."
+echo "== chip window 2 capture =="
+
+DS_TPU_BENCH_BUDGET="${DS_TPU_BENCH_BUDGET:-600}" \
+    timeout 1200 python bench.py > /tmp/bench_r05b.out 2>/dev/null
+rc=$?
+tail -n 1 /tmp/bench_r05b.out > BENCH_r05b_early.json.cand
+if [ "$rc" -eq 0 ] && python -c \
+        "import json,sys; json.load(open(sys.argv[1]))" \
+        BENCH_r05b_early.json.cand 2>/dev/null; then
+    mv BENCH_r05b_early.json.cand BENCH_r05b_early.json
+else
+    echo "bench rc=$rc / no JSON; not recording"
+    rm -f BENCH_r05b_early.json.cand
+fi
+
+timeout 420 python scripts/paged_kernel_chip.py || echo "kernel test failed"
+timeout 600 python scripts/serving_profile.py || echo "serving profile failed"
+timeout 600 python -m deepspeed_tpu.benchmarks.serving_bench --batch 8 \
+    --prompt 128 --new 64 > /tmp/serving2.out 2>/dev/null \
+    && tail -n 1 /tmp/serving2.out > artifacts/r05/serving2.json \
+    || echo "serving2 failed"
+timeout 1200 python scripts/mfu_hunt.py --steps 8 --budget 900 \
+    || echo "mfu_hunt failed"
+
+for path in BENCH_r05b_early.json artifacts/r05; do
+    [ -e "$path" ] && git add -f "$path"
+done
+git commit -m "Chip-window 2 evidence (r05): paged DMA kernel, serving profile, bench re-run, MFU hunt" \
+    || echo "nothing to commit"
+echo "== done =="
