@@ -208,7 +208,9 @@ def paged_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((N, kvh, group, hd), q.dtype),
-        interpret=_interpret(),
+        # never interpret: the early return above already routed interpret
+        # mode to the pipelined variant (the DMA protocol wedges there)
+        interpret=False,
     )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
       q4, k_cache, v_cache)
     return out.reshape(N, nh, hd)

@@ -23,6 +23,7 @@ WINDOW_SCRIPT="${WINDOW_SCRIPT:-scripts/chip_window.sh}"
 SUCCESS_FILE="${SUCCESS_FILE:-BENCH_${TAG}_early.json}"
 cd "$(dirname "$0")/.."
 START_STAMP=$(mktemp)
+trap 'rm -f "$START_STAMP"' EXIT
 
 deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
 attempt=0

@@ -70,11 +70,37 @@ def main():
             base, use_flash=True, flash_min_seq=2048, loss_chunk=0), 24, P),
     ]
 
+    outp = pathlib.Path(args.out)
+    outp.parent.mkdir(parents=True, exist_ok=True)
+    prior_runs = []
+    if outp.exists():  # chip windows are scarce: accumulate, don't clobber
+        try:
+            prior = json.loads(outp.read_text())
+            prior_runs = (prior.get("prior_runs", [])
+                          + [{k: prior[k] for k in ("ranked", "device")
+                              if k in prior}])
+        except Exception:
+            pass
+
     results = []
+
+    def flush():
+        # written after EVERY trial: an outer `timeout` (chip_window2.sh)
+        # killing a long trial must not lose the completed measurements
+        ranked = sorted((r for r in results if "mfu_pct" in r),
+                        key=lambda r: -r["mfu_pct"])
+        out = {"ranked": ranked, "all": results,
+               "device": str(jax.devices()[0].device_kind)}
+        if prior_runs:
+            out["prior_runs"] = prior_runs
+        outp.write_text(json.dumps(out, indent=1))
+        return ranked
+
     t0 = time.perf_counter()
     for label, cfg, micro, policy in trials:
         if time.perf_counter() - t0 > args.budget:
             results.append({"label": label, "skipped": "budget"})
+            flush()
             continue
         try:
             mfu, detail = _measure(cfg, micro, 1, args.steps, 2,
@@ -87,23 +113,10 @@ def main():
         except Exception as exc:
             row = {"label": label, "error": repr(exc)[:200]}
         results.append(row)
+        flush()
         print(json.dumps(row), flush=True)
 
-    ranked = sorted((r for r in results if "mfu_pct" in r),
-                    key=lambda r: -r["mfu_pct"])
-    out = {"ranked": ranked, "all": results,
-           "device": str(jax.devices()[0].device_kind)}
-    outp = pathlib.Path(args.out)
-    outp.parent.mkdir(parents=True, exist_ok=True)
-    if outp.exists():  # chip windows are scarce: accumulate, don't clobber
-        try:
-            prior = json.loads(outp.read_text())
-            out["prior_runs"] = (prior.get("prior_runs", [])
-                                 + [{k: prior[k] for k in ("ranked", "device")
-                                     if k in prior}])
-        except Exception:
-            pass
-    outp.write_text(json.dumps(out, indent=1))
+    ranked = flush()
     print(json.dumps({"best": ranked[0] if ranked else None,
                       "out": str(outp)}))
     return 0

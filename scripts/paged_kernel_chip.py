@@ -70,8 +70,9 @@ def main():
     run_case("short_ctx_wide_table", 8, 4, 4, 64, 4096, 64, 16, 192)
     # long context, table fully used
     run_case("full_table", 8, 4, 4, 64, 4096, 64, 16, 1024)
-    # GQA llama-ish decode shape
-    run_case("gqa_llama", 16, 8, 8, 128, 2048, 64, 32, 512)
+    # GQA decode shape (group=4): exercises the q head-grouping and the
+    # per-head rows slicing the MHA cases cannot
+    run_case("gqa_llama", 16, 8, 2, 128, 2048, 64, 32, 512)
 
     outp = pathlib.Path("artifacts/r05/paged_kernel_chip.json")
     outp.parent.mkdir(parents=True, exist_ok=True)

@@ -467,3 +467,43 @@ def test_moe_serving_tp_x_ep():
                                rtol=2e-4, atol=2e-4)
     np.testing.assert_allclose(e2.put([1], [[25]]), ref1,
                                rtol=2e-4, atol=2e-4)
+
+
+def test_decode_table_sliced_to_used_pages():
+    """_decode_batch slices the block table to the power-of-two bucket of
+    pages actually in use (the decode program's cost scales with table
+    width — r05 chip capture), widening as the context grows."""
+    cfg = _tiny_cfg(max_seq_len=128)  # block_size 16 -> 8 pages max
+    model = TransformerLM(cfg)
+    eng = InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_tracked_sequences=2, max_seq_len=128, num_blocks=17,
+                block_size=16),
+            dtype="float32", prefill_bucket=16))
+    widths = []
+    inner = eng._decode_jit
+
+    def spy(p, t, pos, bt, c, a):
+        widths.append(bt.shape[1])
+        return inner(p, t, pos, bt, c, a)
+
+    eng._decode_jit = spy
+    out = eng.generate([list(range(4, 14))], max_new_tokens=30)[0]
+    assert len(out) == 40
+    # 10-token prompt: decode positions 10..39 span pages 1->3 of 8;
+    # width must start at 1, grow through 2 to 4, and never hit 8
+    assert widths[0] == 1 and widths[-1] == 4
+    assert set(widths) == {1, 2, 4}
+
+    # parity: the same generation through a fresh engine with the spy
+    # removed (full-width tables would be used only if slicing were off)
+    eng2 = InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_tracked_sequences=2, max_seq_len=128, num_blocks=17,
+                block_size=16),
+            dtype="float32", prefill_bucket=16),
+        params=eng.params)
+    out2 = eng2.generate([list(range(4, 14))], max_new_tokens=30)[0]
+    np.testing.assert_array_equal(out, out2)
