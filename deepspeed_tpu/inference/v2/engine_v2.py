@@ -101,6 +101,17 @@ class InferenceEngineV2:
                 cfg, p, t, pos, bt, c, a, sm.block_size,
                 use_kernel=use_kernel, topo=topo),
             donate_argnums=(4,))
+
+        def _decode_tok(p, t, pos, bt, c, a):
+            # greedy variant for the generate() hot loop: argmax on device
+            # so the per-token host transfer is [N] int32, not [N, vocab]
+            # (the reference's sampler also runs device-side)
+            logits, c = paged_decode(cfg, p, t, pos, bt, c, a,
+                                     sm.block_size, use_kernel=use_kernel,
+                                     topo=topo)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), c
+
+        self._decode_tok_jit = jax.jit(_decode_tok, donate_argnums=(4,))
         self._prefill_jit = jax.jit(
             lambda p, ids, n, c, b, o: paged_prefill(
                 cfg, p, ids, n, c, b, o,
@@ -214,8 +225,7 @@ class InferenceEngineV2:
         return self._pow2_bucket(
             count, self.state_manager.config.max_tracked_sequences)
 
-    def _decode_batch(self, uids: List[int],
-                      tokens: List[int]) -> Dict[int, np.ndarray]:
+    def _build_decode_inputs(self, uids: List[int], tokens: List[int]):
         sm = self.state_manager
         N = self._decode_bucket(len(uids))
         MB = sm.max_blocks_per_seq
@@ -237,15 +247,33 @@ class InferenceEngineV2:
         # materializes [N, MB*bs, ...]), so a 128-token sequence in a
         # 2048-token-wide table would pay 16x the bandwidth.
         tables = tables[:, :self._pow2_bucket(used_pages, MB)]
-        logits, self.kv_cache = self._decode_jit(
-            self.params, jnp.asarray(toks), jnp.asarray(pos),
-            jnp.asarray(tables), self.kv_cache, jnp.asarray(active))
-        logits = np.asarray(logits)
+        return (jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables),
+                jnp.asarray(active))
+
+    def _decode_common(self, uids: List[int], tokens: List[int], jit_fn,
+                       extract) -> Dict[int, object]:
+        sm = self.state_manager
+        toks, pos, tables, active = self._build_decode_inputs(uids, tokens)
+        vals, self.kv_cache = jit_fn(
+            self.params, toks, pos, tables, self.kv_cache, active)
+        vals = np.asarray(vals)
         out = {}
         for i, uid in enumerate(uids):
             sm.seqs[uid].seen_tokens += 1
-            out[uid] = logits[i]
+            out[uid] = extract(vals, i)
         return out
+
+    def _decode_batch(self, uids: List[int],
+                      tokens: List[int]) -> Dict[int, np.ndarray]:
+        return self._decode_common(uids, tokens, self._decode_jit,
+                                   lambda v, i: v[i])
+
+    def _decode_batch_greedy(self, uids: List[int],
+                             tokens: List[int]) -> Dict[int, int]:
+        """Greedy decode step returning next TOKENS (device argmax): the
+        generate() hot loop's [N] int transfer instead of [N, vocab]."""
+        return self._decode_common(uids, tokens, self._decode_tok_jit,
+                                   lambda v, i: int(v[i]))
 
     def put(self, batch_uids: Sequence[int],
             batch_tokens: Sequence[Iterable[int]]) -> np.ndarray:
@@ -292,32 +320,41 @@ class InferenceEngineV2:
                  eos_token_id: Optional[int] = None) -> List[np.ndarray]:
         uids = list(uids) if uids is not None else list(range(len(prompts)))
         outs: List[List[int]] = [list(map(int, p)) for p in prompts]
-        logits = self.put(uids, prompts)
-        live = set(uids)
         row_of = {uid: i for i, uid in enumerate(uids)}
-        for _ in range(max_new_tokens):
-            nxt = np.argmax(logits, axis=-1)
-            step_uids, step_toks = [], []
-            for i, uid in enumerate(uids):
+        # prompts go through put() (prefill); the greedy continuation loop
+        # then stays in token space — argmax runs on device and only [N]
+        # int32s cross to host per step (put()'s [N, vocab] logits are the
+        # API for external schedulers, not the hot loop)
+        logits = self.put(uids, prompts)
+        cur = {uid: int(t) for uid, t in
+               zip(uids, np.argmax(logits, axis=-1))}
+        live = set(uids)
+        cap = self.state_manager.config.max_tracked_sequences
+        for step in range(max_new_tokens):
+            step_uids = []
+            for uid in uids:
                 if uid not in live:
                     continue
-                tok = int(nxt[i])
-                outs[i].append(tok)
+                tok = cur[uid]
+                outs[row_of[uid]].append(tok)
                 if eos_token_id is not None and tok == eos_token_id:
                     live.discard(uid)
                 else:
                     step_uids.append(uid)
-                    step_toks.append([tok])
-            if not step_uids:
+            if not step_uids or step == max_new_tokens - 1:
                 break
-            step_logits = self.put(step_uids, step_toks)
-            # re-expand to the original uid order (O(n) via the row map;
-            # the old uids.index() scan was O(n^2), round-2 Weak #6)
-            expanded = np.zeros((len(uids), step_logits.shape[-1]),
-                                step_logits.dtype)
-            for j, uid in enumerate(step_uids):
-                expanded[row_of[uid]] = step_logits[j]
-            logits = expanded
+            # same guard put() applies: generating past max_seq_len (or a
+            # drained block pool) must raise the schedulability error, not
+            # silently overrun or crash inside table assembly
+            if not self.can_schedule(step_uids, [1] * len(step_uids)):
+                raise RuntimeError(
+                    "batch not schedulable (KV blocks / sequence budget); "
+                    "check can_schedule()/query() before put()")
+            cur = {}
+            for i in range(0, len(step_uids), cap):
+                chunk = step_uids[i:i + cap]
+                cur.update(self._decode_batch_greedy(
+                    chunk, [outs[row_of[u]][-1] for u in chunk]))
         for uid in uids:
             self.flush(uid)
         return [np.asarray(o) for o in outs]

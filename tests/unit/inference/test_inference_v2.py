@@ -482,13 +482,13 @@ def test_decode_table_sliced_to_used_pages():
                 block_size=16),
             dtype="float32", prefill_bucket=16))
     widths = []
-    inner = eng._decode_jit
+    inner = eng._decode_tok_jit  # generate()'s greedy hot loop
 
     def spy(p, t, pos, bt, c, a):
         widths.append(bt.shape[1])
         return inner(p, t, pos, bt, c, a)
 
-    eng._decode_jit = spy
+    eng._decode_tok_jit = spy
     out = eng.generate([list(range(4, 14))], max_new_tokens=30)[0]
     assert len(out) == 40
     # 10-token prompt: decode positions 10..39 span pages 1->3 of 8;
@@ -507,3 +507,19 @@ def test_decode_table_sliced_to_used_pages():
         params=eng.params)
     out2 = eng2.generate([list(range(4, 14))], max_new_tokens=30)[0]
     np.testing.assert_array_equal(out, out2)
+
+
+def test_generate_raises_past_max_seq_len():
+    """The greedy hot loop must keep put()'s schedulability guard: asking
+    for more tokens than max_seq_len raises the same RuntimeError instead
+    of silently overrunning the configured limit (review r05)."""
+    cfg = _tiny_cfg(max_seq_len=128)
+    model = TransformerLM(cfg)
+    eng = InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_tracked_sequences=2, max_seq_len=24, num_blocks=9,
+                block_size=16),
+            dtype="float32", prefill_bucket=16))
+    with pytest.raises(RuntimeError, match="not schedulable"):
+        eng.generate([list(range(4, 14))], max_new_tokens=20)
