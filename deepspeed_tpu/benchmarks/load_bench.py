@@ -1,0 +1,128 @@
+"""Serving-under-load benchmark: Dynamic SplitFuse vs whole-prompt fusion.
+
+The reference's FastGen headline (blogs/deepspeed-fastgen/README.md:139:
+up to 2.3x effective throughput, ~2x lower p95 per-token latency vs
+vLLM-style scheduling) comes from the SplitFuse policy, not the kernels.
+This benchmark isolates exactly that variable: the same ragged engine,
+the same Poisson arrival trace, the same instrumentation, driven by
+
+  * splitfuse — DynamicSplitFuseScheduler with a bounded token budget
+    and chunked prompts, vs
+  * fused    — the same scheduler machinery with chunk=inf (whole
+    prompts join a step as one piece: the Orca-style baseline whose
+    long prompts stall running decodes).
+
+Prints ONE JSON line. Usage:
+  python -m deepspeed_tpu.benchmarks.load_bench [--requests 48]
+         [--rate 8.0] [--budget 128] [--chunk 32] [--new 32]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def run_trace(engine, arrivals, prompts, new_tokens, budget, chunk):
+    from ..inference.v2.scheduler import DynamicSplitFuseScheduler
+
+    sched = DynamicSplitFuseScheduler(engine, token_budget=budget,
+                                      chunk=chunk)
+    t0 = time.perf_counter()
+    i = 0
+    while sched.pending() or i < len(prompts):
+        now = time.perf_counter() - t0
+        while i < len(prompts) and arrivals[i] <= now:
+            sched.submit(i, prompts[i], max_new_tokens=new_tokens)
+            i += 1
+        if not sched.pending():
+            time.sleep(min(arrivals[i] - now, 0.05))
+            continue
+        sched.step()
+    makespan = time.perf_counter() - t0
+    m = sched.metrics()
+    ttft = np.array([v["ttft_s"] for v in m.values()])
+    total = np.array([v["total_s"] for v in m.values()])
+    gen = sum(v["new_tokens"] for v in m.values())
+    per_tok = np.array([
+        (v["total_s"] - v["ttft_s"]) / max(v["new_tokens"] - 1, 1)
+        for v in m.values()])
+    return {
+        "throughput_tok_s": round(gen / makespan, 2),
+        "makespan_s": round(makespan, 3),
+        "ttft_p50_ms": round(float(np.percentile(ttft, 50)) * 1e3, 1),
+        "ttft_p95_ms": round(float(np.percentile(ttft, 95)) * 1e3, 1),
+        "tpot_p50_ms": round(float(np.percentile(per_tok, 50)) * 1e3, 1),
+        "tpot_p95_ms": round(float(np.percentile(per_tok, 95)) * 1e3, 1),
+        "steps": sched.steps,
+        "completed": len(m),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ds_tpu_load_bench")
+    p.add_argument("--requests", type=int, default=48)
+    p.add_argument("--rate", type=float, default=8.0,
+                   help="mean request arrivals per second (Poisson)")
+    p.add_argument("--budget", type=int, default=128)
+    p.add_argument("--chunk", type=int, default=32)
+    p.add_argument("--new", type=int, default=32)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--hidden", type=int, default=256)
+    args = p.parse_args(argv)
+
+    import jax
+
+    from .serving_bench import build_model
+    from ..inference.v2.engine_v2 import InferenceEngineV2
+
+    model = build_model(args.layers, args.hidden)
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    # bimodal prompt mix: mostly short, a tail of long prompts — the
+    # workload shape where decode stalls behind long prefills
+    lens = np.where(rng.random(args.requests) < 0.75,
+                    rng.integers(16, 64, args.requests),
+                    rng.integers(192, 512, args.requests))
+    prompts = [list(map(int, rng.integers(1, 2047, n))) for n in lens]
+    arrivals = np.cumsum(rng.exponential(1.0 / args.rate, args.requests))
+
+    def fresh_engine():
+        return InferenceEngineV2(model, {
+            "dtype": "bfloat16",
+            "state_manager": {"max_tracked_sequences": 32,
+                              "max_ragged_batch_size": 2048,
+                              "max_seq_len": 1024,
+                              "num_blocks": 4096},
+        }, params=params)
+
+    # warmup both scheduling modes on a tiny trace (compile cache)
+    for b, c in ((args.budget, args.chunk), (2048, 10 ** 9)):
+        run_trace(fresh_engine(), [0.0, 0.0], prompts[:2], 4, b, c)
+
+    splitfuse = run_trace(fresh_engine(), arrivals, prompts, args.new,
+                          args.budget, args.chunk)
+    fused = run_trace(fresh_engine(), arrivals, prompts, args.new,
+                      2048, 10 ** 9)
+
+    print(json.dumps({
+        "metric": "serving_load_splitfuse",
+        "backend": jax.default_backend(),
+        "requests": args.requests, "rate_rps": args.rate,
+        "budget": args.budget, "chunk": args.chunk,
+        "new_tokens": args.new,
+        "splitfuse": splitfuse,
+        "fused_baseline": fused,
+        "throughput_ratio": round(
+            splitfuse["throughput_tok_s"]
+            / max(fused["throughput_tok_s"], 1e-9), 3),
+        "ttft_p95_ratio": round(
+            fused["ttft_p95_ms"] / max(splitfuse["ttft_p95_ms"], 1e-9), 3),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

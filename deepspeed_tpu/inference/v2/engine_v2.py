@@ -325,36 +325,39 @@ class InferenceEngineV2:
         # then stays in token space — argmax runs on device and only [N]
         # int32s cross to host per step (put()'s [N, vocab] logits are the
         # API for external schedulers, not the hot loop)
-        logits = self.put(uids, prompts)
-        cur = {uid: int(t) for uid, t in
-               zip(uids, np.argmax(logits, axis=-1))}
-        live = set(uids)
-        cap = self.state_manager.config.max_tracked_sequences
-        for step in range(max_new_tokens):
-            step_uids = []
+        try:
+            logits = self.put(uids, prompts)
+            cur = {uid: int(t) for uid, t in
+                   zip(uids, np.argmax(logits, axis=-1))}
+            live = set(uids)
+            for step in range(max_new_tokens):
+                step_uids = []
+                for uid in uids:
+                    if uid not in live:
+                        continue
+                    tok = cur[uid]
+                    outs[row_of[uid]].append(tok)
+                    if eos_token_id is not None and tok == eos_token_id:
+                        live.discard(uid)
+                    else:
+                        step_uids.append(uid)
+                if not step_uids or step == max_new_tokens - 1:
+                    break
+                # same guard put() applies: generating past max_seq_len
+                # (or a drained block pool) must raise, not silently
+                # overrun or crash inside table assembly
+                if not self.can_schedule(step_uids, [1] * len(step_uids)):
+                    raise RuntimeError(
+                        "generation not schedulable: prompt + generated "
+                        "tokens exceed max_seq_len or the free KV block "
+                        "pool; lower max_new_tokens or raise the limits")
+                # every step_uid is already tracked, so the batch can
+                # never exceed max_tracked_sequences — one call suffices
+                cur = self._decode_batch_greedy(
+                    step_uids, [outs[row_of[u]][-1] for u in step_uids])
+        finally:
+            # flush even on the schedulability raise: a long-lived engine
+            # must not leak this call's KV blocks / sequence slots
             for uid in uids:
-                if uid not in live:
-                    continue
-                tok = cur[uid]
-                outs[row_of[uid]].append(tok)
-                if eos_token_id is not None and tok == eos_token_id:
-                    live.discard(uid)
-                else:
-                    step_uids.append(uid)
-            if not step_uids or step == max_new_tokens - 1:
-                break
-            # same guard put() applies: generating past max_seq_len (or a
-            # drained block pool) must raise the schedulability error, not
-            # silently overrun or crash inside table assembly
-            if not self.can_schedule(step_uids, [1] * len(step_uids)):
-                raise RuntimeError(
-                    "batch not schedulable (KV blocks / sequence budget); "
-                    "check can_schedule()/query() before put()")
-            cur = {}
-            for i in range(0, len(step_uids), cap):
-                chunk = step_uids[i:i + cap]
-                cur.update(self._decode_batch_greedy(
-                    chunk, [outs[row_of[u]][-1] for u in chunk]))
-        for uid in uids:
-            self.flush(uid)
+                self.flush(uid)
         return [np.asarray(o) for o in outs]

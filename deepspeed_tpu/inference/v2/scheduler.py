@@ -1,0 +1,224 @@
+"""Dynamic SplitFuse scheduler over the ragged v2 engine.
+
+Reference: DeepSpeed-FastGen's Dynamic SplitFuse strategy
+(blogs/deepspeed-fastgen/README.md §3: long prompts are decomposed into
+chunks scheduled across forward passes, short prompts composed to fill a
+target token budget, and decodes are never stalled behind a long
+prefill). The reference implements the policy in the MII serving layer on
+top of ``InferenceEngineV2.put``; here it sits directly on the TPU-native
+engine (engine_v2.py), whose put() already routes the pieces to bucketed
+compiled programs: first prompt chunk -> paged_prefill, later chunks ->
+the fused paged_continue pass, single tokens -> the batched paged_decode.
+
+TPU-first consequence of the same "schedule a token budget, not
+sequences" insight: every (bucketed) token count is one precompiled XLA
+program, so a consistent per-step budget also maximizes compiled-program
+reuse — the scheduler is what keeps serving out of the retrace/recompile
+tail on TPU, the role CUDA-graph capture plays in the reference.
+
+Usage:
+    sched = DynamicSplitFuseScheduler(engine, token_budget=256)
+    sched.submit(uid, prompt_tokens, max_new_tokens=64)
+    while sched.pending():
+        sched.step()
+    outs = sched.results()   # {uid: np.ndarray of prompt+generated tokens}
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class _Request:
+    uid: int
+    prompt: List[int]
+    max_new_tokens: int
+    eos_token_id: Optional[int]
+    submit_t: float
+    prefill_sent: int = 0            # prompt tokens handed to the engine
+    generated: List[int] = field(default_factory=list)
+    next_token: Optional[int] = None  # pending decode input
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+
+    @property
+    def prefill_done(self) -> bool:
+        return self.prefill_sent >= len(self.prompt)
+
+    @property
+    def done(self) -> bool:
+        return self.finish_t is not None
+
+
+class DynamicSplitFuseScheduler:
+    """Composes each engine step from (a) every running decode and (b) as
+    many prompt-chunk tokens as fit in the remaining token budget —
+    FastGen's two behaviors: long prompts split across steps, short
+    prompts/chunks fused with generation so forward sizes stay uniform."""
+
+    def __init__(self, engine, token_budget: Optional[int] = None,
+                 chunk: Optional[int] = None, clock=time.perf_counter):
+        self.engine = engine
+        sm = engine.state_manager.config
+        self.token_budget = min(token_budget or sm.max_ragged_batch_size,
+                                sm.max_ragged_batch_size)
+        # chunks align to the prefill bucket so every split hits an
+        # already-compiled program size
+        self.chunk = chunk or engine.config.prefill_bucket
+        self.clock = clock
+        self._queue: List[_Request] = []     # waiting for prefill budget
+        self._running: List[_Request] = []   # prefill done, decoding
+        self._all: Dict[int, _Request] = {}
+        self.steps = 0
+
+    # ------------------------------------------------------------------
+    def submit(self, uid: int, prompt: Sequence[int], max_new_tokens: int,
+               eos_token_id: Optional[int] = None) -> None:
+        assert uid not in self._all, f"uid {uid} already submitted"
+        req = _Request(uid, list(map(int, prompt)), max_new_tokens,
+                       eos_token_id, self.clock())
+        self._all[uid] = req
+        self._queue.append(req)
+
+    def pending(self) -> bool:
+        return bool(self._queue or self._running)
+
+    # ------------------------------------------------------------------
+    def _finish(self, req: _Request) -> None:
+        req.finish_t = self.clock()
+        self.engine.flush(req.uid)
+        if req in self._running:
+            self._running.remove(req)
+
+    def step(self) -> int:
+        """One composed engine step; returns the number of tokens run."""
+        uids: List[int] = []
+        toks: List[List[int]] = []
+        decode_reqs: List[_Request] = []
+        budget = self.token_budget
+
+        # (a) decodes first: generation is never stalled behind prefill.
+        # Round-robin rotation so a budget smaller than the running set
+        # starves nobody (the skipped tail leads the next step).
+        for req in list(self._running):
+            if budget <= 0:
+                break
+            uids.append(req.uid)
+            toks.append([req.next_token])
+            decode_reqs.append(req)
+            budget -= 1
+        if decode_reqs and len(decode_reqs) < len(self._running):
+            k = len(decode_reqs)
+            self._running = self._running[k:] + self._running[:k]
+
+        # (b) fill the remainder with prompt chunks (FIFO, chunk-aligned;
+        # the final or budget-tail chunk may be smaller — bucketed compile
+        # sizes absorb fragments)
+        for req in list(self._queue):
+            if budget <= 0:
+                break
+            left = len(req.prompt) - req.prefill_sent
+            take = min(left, budget, max(self.chunk, 1))
+            piece = req.prompt[req.prefill_sent:req.prefill_sent + take]
+            # whole-batch check: decodes already composed + chunks so far
+            # + this piece (a decode crossing a page boundary can itself
+            # need a fresh KV block)
+            if not self.engine.can_schedule(
+                    uids + [req.uid], [len(t) for t in toks] + [take]):
+                break  # KV pool full: wait for a running seq to finish
+            uids.append(req.uid)
+            toks.append(piece)
+            req.prefill_sent += take
+            budget -= take
+
+        if uids and not self.engine.can_schedule(
+                uids, [len(t) for t in toks]):
+            raise RuntimeError(
+                "running decodes alone exceed the KV pool; shrink the "
+                "admitted set (lower max_tracked_sequences) or add blocks")
+
+        if not uids:
+            if self._queue and not self._running:
+                # pool dry with nothing draining it. Two cases:
+                sm = self.engine.state_manager
+                head = self._queue[0]
+                bs = sm.block_size
+                need = -(-(len(head.prompt) + head.max_new_tokens) // bs)
+                if need > sm.config.num_blocks - 1:  # block 0 is the null
+                    raise RuntimeError(
+                        f"request uid={head.uid} cannot be scheduled: "
+                        f"{len(head.prompt)}+{head.max_new_tokens} tokens "
+                        f"need {need} KV blocks, pool has "
+                        f"{sm.config.num_blocks - 1}")
+                # mutual exhaustion: several long prompts were admitted
+                # concurrently and none can finish prefill. Evict the
+                # most recently admitted partial prefill (free its
+                # blocks, restart it later) so the head makes progress.
+                for req in reversed(self._queue[1:]):
+                    if req.prefill_sent > 0:
+                        self.engine.flush(req.uid)
+                        req.prefill_sent = 0
+                        return 0
+                raise RuntimeError(
+                    f"request uid={head.uid} cannot be scheduled: KV "
+                    f"pool exhausted with no running sequences to drain")
+            return 0
+
+        logits = self.engine.put(uids, toks)
+        self.steps += 1
+        now = self.clock()
+        nxt = np.argmax(np.asarray(logits), axis=-1)
+
+        for i, uid in enumerate(uids):
+            req = self._all[uid]
+            if req in decode_reqs:
+                self._emit(req, int(nxt[i]))
+            elif req.prefill_done:
+                # final prompt chunk: its last-token logits yield the
+                # first generated token (TTFT is measured here)
+                req.first_token_t = now
+                self._queue.remove(req)
+                if req.max_new_tokens <= 0:
+                    self._finish(req)
+                else:
+                    self._running.append(req)
+                    self._emit(req, int(nxt[i]))
+            # else: mid-prompt chunk — logits ignored
+        return sum(len(t) for t in toks)
+
+    def _emit(self, req: _Request, tok: int) -> None:
+        """Record a produced token; finish or queue it as the next decode
+        input. Matches generate(): eos is included in the output, and the
+        final emitted token is never fed back (no wasted forward)."""
+        req.generated.append(tok)
+        if ((req.eos_token_id is not None and tok == req.eos_token_id)
+                or len(req.generated) >= req.max_new_tokens):
+            self._finish(req)
+        else:
+            req.next_token = tok
+
+    # ------------------------------------------------------------------
+    def run(self, max_steps: int = 10 ** 6) -> None:
+        while self.pending() and max_steps > 0:
+            self.step()
+            max_steps -= 1
+
+    def results(self) -> Dict[int, np.ndarray]:
+        return {uid: np.asarray(r.prompt + r.generated)
+                for uid, r in self._all.items() if r.done}
+
+    def metrics(self) -> Dict[int, Dict[str, float]]:
+        """Per-request latency bookkeeping (TTFT / total / tokens)."""
+        out = {}
+        for uid, r in self._all.items():
+            if not r.done:
+                continue
+            out[uid] = {
+                "ttft_s": (r.first_token_t or r.finish_t) - r.submit_t,
+                "total_s": r.finish_t - r.submit_t,
+                "new_tokens": len(r.generated),
+            }
+        return out

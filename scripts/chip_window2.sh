@@ -31,6 +31,10 @@ timeout 600 python -m deepspeed_tpu.benchmarks.serving_bench --batch 8 \
     --prompt 128 --new 64 > /tmp/serving2.out 2>/dev/null \
     && tail -n 1 /tmp/serving2.out > artifacts/r05/serving2.json \
     || echo "serving2 failed"
+timeout 600 python -m deepspeed_tpu.benchmarks.load_bench --requests 48 \
+    --rate 16 > /tmp/load_bench.out 2>/dev/null \
+    && tail -n 1 /tmp/load_bench.out > artifacts/r05/load_splitfuse.json \
+    || echo "load_bench failed"
 timeout 1200 python scripts/mfu_hunt.py --steps 8 --budget 900 \
     || echo "mfu_hunt failed"
 

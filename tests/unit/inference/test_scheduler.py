@@ -1,0 +1,170 @@
+"""Dynamic SplitFuse scheduler tests.
+
+Reference behavior mirrored: blogs/deepspeed-fastgen/README.md §3 — long
+prompts split across forward passes, short prompts fused with running
+decodes, uniform token budget per step, decodes never stalled."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+from deepspeed_tpu.inference.v2.scheduler import DynamicSplitFuseScheduler
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    cfg = TransformerConfig(vocab_size=128, hidden_size=64,
+                            intermediate_size=128, num_layers=2,
+                            num_heads=4, num_kv_heads=2, max_seq_len=256,
+                            remat=False, use_flash=False)
+    model = TransformerLM(cfg)
+    params = jax.tree.map(lambda x: x.astype(jnp.float32),
+                          model.init_params(jax.random.PRNGKey(0)))
+    return model, params
+
+
+def _engine(model, params, **sm_kw):
+    sm = dict(max_tracked_sequences=8, max_seq_len=256, num_blocks=65,
+              block_size=16, max_ragged_batch_size=512)
+    sm.update(sm_kw)
+    return InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(**sm), dtype="float32",
+            prefill_bucket=16), params=params)
+
+
+def test_splitfuse_matches_generate(model_and_params):
+    """Chunked, budget-composed scheduling must produce exactly the
+    greedy tokens generate() produces — scheduling changes composition,
+    never results."""
+    model, params = model_and_params
+    rng = np.random.default_rng(0)
+    prompts = [list(map(int, rng.integers(1, 127, n)))
+               for n in (70, 9, 33, 17)]
+
+    ref = _engine(model, params).generate(prompts, max_new_tokens=8)
+
+    eng = _engine(model, params)
+    sched = DynamicSplitFuseScheduler(eng, token_budget=32, chunk=16)
+    for i, p in enumerate(prompts):
+        sched.submit(i, p, max_new_tokens=8)
+    sched.run()
+    outs = sched.results()
+    assert set(outs) == set(range(len(prompts)))
+    for i in range(len(prompts)):
+        np.testing.assert_array_equal(outs[i], ref[i])
+
+
+def test_splitfuse_budget_and_no_decode_stall(model_and_params):
+    """Every composed step stays within the token budget, and a running
+    decode appears in EVERY step while a long prompt is being split."""
+    model, params = model_and_params
+    eng = _engine(model, params)
+    sched = DynamicSplitFuseScheduler(eng, token_budget=24, chunk=16)
+
+    sizes, decode_present = [], []
+    orig_put = eng.put
+
+    def spy(uids, toks):
+        sizes.append(sum(len(t) for t in toks))
+        decode_present.append(any(len(t) == 1 for t in toks))
+        return orig_put(uids, toks)
+
+    eng.put = spy
+    sched.submit(0, list(range(1, 10)), max_new_tokens=20)   # short
+    sched.run(max_steps=3)            # request 0 prefills + starts decode
+    long_prompt = list(map(int, np.random.default_rng(1).integers(
+        1, 127, 120)))
+    sched.submit(1, long_prompt, max_new_tokens=4)           # 120 tokens
+    sched.run()
+
+    assert max(sizes) <= 24
+    # the long prompt needs ceil(120/16)+ steps; request 0 must keep
+    # decoding through every one of them (no stall)
+    split_steps = [d for s, d in zip(sizes, decode_present) if s > 16]
+    assert split_steps and all(split_steps)
+    assert len(sched.results()) == 2
+
+
+def test_splitfuse_eos_and_metrics(model_and_params):
+    model, params = model_and_params
+    eng = _engine(model, params)
+    ref_out = _engine(model, params).generate(
+        [list(range(1, 12))], max_new_tokens=30)[0]
+    # pick the 3rd generated token as eos so the run stops early
+    eos = int(ref_out[11 + 2])
+    sched = DynamicSplitFuseScheduler(eng, token_budget=64)
+    sched.submit(5, list(range(1, 12)), max_new_tokens=30, eos_token_id=eos)
+    sched.run()
+    out = sched.results()[5]
+    assert out[-1] == eos and len(out) <= 11 + 3
+    m = sched.metrics()[5]
+    assert 0 <= m["ttft_s"] <= m["total_s"]
+    assert m["new_tokens"] == len(out) - 11
+
+
+def test_splitfuse_rejects_oversized_prompt(model_and_params):
+    model, params = model_and_params
+    eng = _engine(model, params, num_blocks=5)   # 4 usable blocks = 64 toks
+    sched = DynamicSplitFuseScheduler(eng, token_budget=512)
+    sched.submit(0, list(range(1, 100)), max_new_tokens=4)
+    with pytest.raises(RuntimeError, match="cannot be scheduled|schedulable"):
+        sched.run(max_steps=50)
+
+
+def test_splitfuse_mutual_exhaustion_evicts_and_completes(model_and_params):
+    """Two long prompts admitted concurrently into a pool neither can
+    finish in must NOT deadlock: the later partial prefill is evicted
+    (blocks freed, restarted) so the head completes, then the other."""
+    model, params = model_and_params
+    # 8 usable blocks = 128 tokens; two 100-token prompts (7 blocks each)
+    eng = _engine(model, params, num_blocks=9)
+    rng = np.random.default_rng(2)
+    p0 = list(map(int, rng.integers(1, 127, 100)))
+    p1 = list(map(int, rng.integers(1, 127, 100)))
+    sched = DynamicSplitFuseScheduler(eng, token_budget=64, chunk=16)
+    sched.submit(0, p0, max_new_tokens=4)
+    sched.submit(1, p1, max_new_tokens=4)
+    sched.run(max_steps=200)
+    outs = sched.results()
+    assert set(outs) == {0, 1}
+    ref = _engine(model, params).generate([p0, p1], max_new_tokens=4)
+    np.testing.assert_array_equal(outs[0], ref[0])
+    np.testing.assert_array_equal(outs[1], ref[1])
+
+
+def test_splitfuse_decode_rotation_starves_nobody(model_and_params):
+    """token_budget smaller than the running set must round-robin the
+    decodes, not pin the head requests."""
+    model, params = model_and_params
+    eng = _engine(model, params)
+    sched = DynamicSplitFuseScheduler(eng, token_budget=2, chunk=16)
+    prompts = [list(range(1, 6 + i)) for i in range(4)]
+    for i, p in enumerate(prompts):
+        sched.submit(i, p, max_new_tokens=5)
+    sched.run(max_steps=300)
+    outs = sched.results()
+    assert set(outs) == {0, 1, 2, 3}
+    ref = _engine(model, params).generate(prompts, max_new_tokens=5)
+    for i in range(4):
+        np.testing.assert_array_equal(outs[i], ref[i])
+
+
+def test_generate_flushes_on_schedulability_raise(model_and_params):
+    """After generate() raises mid-loop, the engine must have zero leaked
+    sequences/blocks and serve the next call normally."""
+    model, params = model_and_params
+    eng = _engine(model, params, max_seq_len=24, num_blocks=9,
+                  block_size=16)
+    with pytest.raises(RuntimeError, match="not schedulable"):
+        eng.generate([list(range(4, 14))], max_new_tokens=20)
+    assert eng.state_manager.tracked_sequences() == 0
+    assert eng.state_manager.free_blocks() == 8
+    out = eng.generate([list(range(4, 14))], max_new_tokens=4)[0]
+    assert len(out) == 14
