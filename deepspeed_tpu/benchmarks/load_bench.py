@@ -25,7 +25,8 @@ import time
 import numpy as np
 
 
-def run_trace(engine, arrivals, prompts, new_tokens, budget, chunk):
+def run_trace(engine, arrivals, prompts, new_tokens, budget, chunk,
+              uid_base=0):
     from ..inference.v2.scheduler import DynamicSplitFuseScheduler
 
     sched = DynamicSplitFuseScheduler(engine, token_budget=budget,
@@ -35,7 +36,8 @@ def run_trace(engine, arrivals, prompts, new_tokens, budget, chunk):
     while sched.pending() or i < len(prompts):
         now = time.perf_counter() - t0
         while i < len(prompts) and arrivals[i] <= now:
-            sched.submit(i, prompts[i], max_new_tokens=new_tokens)
+            sched.submit(uid_base + i, prompts[i],
+                         max_new_tokens=new_tokens)
             i += 1
         if not sched.pending():
             time.sleep(min(arrivals[i] - now, 0.05))
@@ -98,13 +100,18 @@ def main(argv=None) -> int:
                               "num_blocks": 4096},
         }, params=params)
 
-    # warmup both scheduling modes on a tiny trace (compile cache)
-    for b, c in ((args.budget, args.chunk), (2048, 10 ** 9)):
-        run_trace(fresh_engine(), [0.0, 0.0], prompts[:2], 4, b, c)
+    # warm the SAME engine instances the measurement uses with the SAME
+    # trace: jit caches are per engine object and per bucket size, so
+    # anything less leaves first-hit compiles inside the timers
+    eng_sf, eng_fused = fresh_engine(), fresh_engine()
+    run_trace(eng_sf, arrivals, prompts, args.new,
+              args.budget, args.chunk, uid_base=10 ** 6)
+    run_trace(eng_fused, arrivals, prompts, args.new,
+              2048, 10 ** 9, uid_base=10 ** 6)
 
-    splitfuse = run_trace(fresh_engine(), arrivals, prompts, args.new,
+    splitfuse = run_trace(eng_sf, arrivals, prompts, args.new,
                           args.budget, args.chunk)
-    fused = run_trace(fresh_engine(), arrivals, prompts, args.new,
+    fused = run_trace(eng_fused, arrivals, prompts, args.new,
                       2048, 10 ** 9)
 
     print(json.dumps({

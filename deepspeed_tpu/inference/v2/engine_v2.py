@@ -41,13 +41,16 @@ class InferenceEngineV2:
         self.config = config
         self.model = model
         cfg: TransformerConfig = model.cfg
-        if cfg.moe_num_experts > 0:
-            # served via the dropless sorted-token grouped GEMM
-            # (paged_model._moe_mlp); routing-parity with training needs
-            # top-k <= 2 (the conventions implemented there)
+        if cfg.moe_num_experts > 0 and config.expert_parallel_size > 1:
+            # ep>1 serving routes through the worst-case-capacity einsum
+            # dispatch (moe_layer_dropless_ep -> moe_layer), whose gating
+            # implements the training top-1/top-2 conventions only. ep=1
+            # serving uses the k-generic sorted-token grouped GEMM
+            # (dropless_topk_dispatch) with renormalized top-k weights —
+            # the Mixtral/Qwen-MoE/DBRX convention — so any k serves.
             assert cfg.moe_top_k <= 2, \
-                f"ragged engine serves top-1/top-2 MoE only " \
-                f"(got moe_top_k={cfg.moe_top_k})"
+                f"expert-parallel serving is top-1/top-2 only " \
+                f"(got moe_top_k={cfg.moe_top_k}); serve top-k>2 at ep=1"
         sm = config.state_manager
         if sm.max_seq_len > cfg.max_seq_len:
             sm.max_seq_len = cfg.max_seq_len

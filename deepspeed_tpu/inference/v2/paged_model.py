@@ -95,8 +95,10 @@ def _moe_mlp(cfg, lp, x, topo=None):
 
     Routing matches the training graph so serving is parity-testable
     against the same weights: top-1 uses the raw gate probability
-    (sharded_moe.top1gating g1); top-2 renormalizes over the pair
-    (top2gating g1/g2 normalization, the Mixtral convention).
+    (sharded_moe.top1gating g1); top-k>=2 renormalizes over the chosen
+    set (top2gating's g1/g2 normalization; for k>2 the same convention
+    is the Mixtral/Qwen-MoE/DBRX one — serving-only, training gates are
+    top-1/top-2).
     """
     from ...moe.sharded_moe import dropless_topk_dispatch
 

@@ -93,6 +93,17 @@ class DynamicSplitFuseScheduler:
         if req in self._running:
             self._running.remove(req)
 
+    def _evict_partial_prefill(self, exclude=()) -> bool:
+        """Free the KV blocks of the most recently admitted partial
+        prefill (it restarts from token 0 later). The recovery move when
+        the pool is exhausted by work that cannot finish."""
+        for req in reversed(self._queue):
+            if req.prefill_sent > 0 and req.uid not in exclude:
+                self.engine.flush(req.uid)
+                req.prefill_sent = 0
+                return True
+        return False
+
     def step(self) -> int:
         """One composed engine step; returns the number of tokens run."""
         uids: List[int] = []
@@ -117,9 +128,17 @@ class DynamicSplitFuseScheduler:
         # (b) fill the remainder with prompt chunks (FIFO, chunk-aligned;
         # the final or budget-tail chunk may be smaller — bucketed compile
         # sizes absorb fragments)
+        sm = self.engine.state_manager
+        new_admitted = 0  # can_schedule checks each uid against the
+        # CURRENT tracked count; new uids admitted into the same batch
+        # must be counted here or put() raises mid-batch
         for req in list(self._queue):
             if budget <= 0:
                 break
+            if req.prefill_sent == 0:
+                if (sm.tracked_sequences() + new_admitted
+                        >= sm.config.max_tracked_sequences):
+                    break  # sequence slots full: wait for a finish
             left = len(req.prompt) - req.prefill_sent
             take = min(left, budget, max(self.chunk, 1))
             piece = req.prompt[req.prefill_sent:req.prefill_sent + take]
@@ -129,6 +148,8 @@ class DynamicSplitFuseScheduler:
             if not self.engine.can_schedule(
                     uids + [req.uid], [len(t) for t in toks] + [take]):
                 break  # KV pool full: wait for a running seq to finish
+            if req.prefill_sent == 0:
+                new_admitted += 1
             uids.append(req.uid)
             toks.append(piece)
             req.prefill_sent += take
@@ -136,6 +157,10 @@ class DynamicSplitFuseScheduler:
 
         if uids and not self.engine.can_schedule(
                 uids, [len(t) for t in toks]):
+            # decodes alone over the pool: free blocks held by a queued
+            # partial prefill before declaring the config impossible
+            if self._evict_partial_prefill(exclude=set(uids)):
+                return 0
             raise RuntimeError(
                 "running decodes alone exceed the KV pool; shrink the "
                 "admitted set (lower max_tracked_sequences) or add blocks")
@@ -143,10 +168,12 @@ class DynamicSplitFuseScheduler:
         if not uids:
             if self._queue and not self._running:
                 # pool dry with nothing draining it. Two cases:
-                sm = self.engine.state_manager
                 head = self._queue[0]
                 bs = sm.block_size
-                need = -(-(len(head.prompt) + head.max_new_tokens) // bs)
+                # the final emitted token is never fed back (_emit), so a
+                # request writes prompt + max(new-1, 0) KV slots total
+                total = len(head.prompt) + max(head.max_new_tokens - 1, 0)
+                need = -(-total // bs)
                 if need > sm.config.num_blocks - 1:  # block 0 is the null
                     raise RuntimeError(
                         f"request uid={head.uid} cannot be scheduled: "
@@ -154,18 +181,24 @@ class DynamicSplitFuseScheduler:
                         f"need {need} KV blocks, pool has "
                         f"{sm.config.num_blocks - 1}")
                 # mutual exhaustion: several long prompts were admitted
-                # concurrently and none can finish prefill. Evict the
-                # most recently admitted partial prefill (free its
-                # blocks, restart it later) so the head makes progress.
-                for req in reversed(self._queue[1:]):
-                    if req.prefill_sent > 0:
-                        self.engine.flush(req.uid)
-                        req.prefill_sent = 0
-                        return 0
+                # concurrently and none can finish prefill — free the
+                # most recent partial so the head makes progress.
+                if self._evict_partial_prefill(exclude={head.uid}):
+                    return 0
                 raise RuntimeError(
                     f"request uid={head.uid} cannot be scheduled: KV "
                     f"pool exhausted with no running sequences to drain")
             return 0
+
+        if not any(len(t) > 1 for t in toks) and decode_reqs:
+            # pure-decode step: device argmax, [N] int32 to host instead
+            # of [N, vocab] logits (same fast path generate() uses)
+            nxt_map = self.engine._decode_batch_greedy(
+                uids, [t[0] for t in toks])
+            self.steps += 1
+            for req in decode_reqs:
+                self._emit(req, nxt_map[req.uid])
+            return len(uids)
 
         logits = self.engine.put(uids, toks)
         self.steps += 1

@@ -168,3 +168,20 @@ def test_generate_flushes_on_schedulability_raise(model_and_params):
     assert eng.state_manager.free_blocks() == 8
     out = eng.generate([list(range(4, 14))], max_new_tokens=4)[0]
     assert len(out) == 14
+
+
+def test_splitfuse_respects_tracked_sequence_cap(model_and_params):
+    """Admitting several FRESH prompts into one step must count the new
+    uids against max_tracked_sequences together, not one at a time."""
+    model, params = model_and_params
+    eng = _engine(model, params, max_tracked_sequences=2)
+    sched = DynamicSplitFuseScheduler(eng, token_budget=256, chunk=16)
+    prompts = [list(range(1, 8 + i)) for i in range(3)]
+    for i, p in enumerate(prompts):
+        sched.submit(i, p, max_new_tokens=4)
+    sched.run(max_steps=100)
+    outs = sched.results()
+    assert set(outs) == {0, 1, 2}
+    ref = _engine(model, params).generate(prompts, max_new_tokens=4)
+    for i in range(3):
+        np.testing.assert_array_equal(outs[i], ref[i])
