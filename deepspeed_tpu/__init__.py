@@ -24,6 +24,7 @@ from .runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader  # noqa: F4
 from .parallel.topology import MeshTopology, TopologyConfig, build_topology  # noqa: F401
 from .runtime.pipe import LayerSpec, PipelineModule, TiedLayerSpec  # noqa: F401
 from .sequence.layer import DistributedAttention  # noqa: F401 (reference deepspeed/__init__.py:38)
+from .pipeline import ServePipeline, pipeline  # noqa: F401 (MII-style front end)
 from .utils.logging import log_dist, logger  # noqa: F401
 
 
@@ -101,7 +102,7 @@ def argparse_suppress():
     return argparse.SUPPRESS
 
 
-def init_inference(model=None, config=None, **kwargs):
+def init_inference(model=None, config=None, params=None, **kwargs):
     """Reference deepspeed/__init__.py:269 — inference engine entry.
 
     Accepts either a native functional model (init_params/apply protocol)
@@ -109,13 +110,14 @@ def init_inference(model=None, config=None, **kwargs):
     which is converted in place of the reference's kernel injection
     (module_inject/replace_module.py). ``use_ragged=True`` routes to the
     FastGen-class v2 paged engine (reference inference/v2/engine_v2.py:89
-    build_hf_engine) instead of the v1 KV-cache engine.
+    build_hf_engine) instead of the v1 KV-cache engine. ``params``
+    supplies trained weights for a native model (HF modules carry their
+    own state_dict).
     """
     from .inference.engine import InferenceEngine
     from .inference.config import DeepSpeedInferenceConfig
 
     cfg = DeepSpeedInferenceConfig.from_dict_or_kwargs(config, kwargs)
-    params = None
     if (model is not None and hasattr(model, "state_dict")
             and not hasattr(model, "init_params")):
         # torch nn.Module (HF transformer): convert weights + architecture

@@ -190,9 +190,13 @@ class DynamicSplitFuseScheduler:
                     f"pool exhausted with no running sequences to drain")
             return 0
 
-        if not any(len(t) > 1 for t in toks) and decode_reqs:
+        if decode_reqs and len(decode_reqs) == len(uids):
             # pure-decode step: device argmax, [N] int32 to host instead
-            # of [N, vocab] logits (same fast path generate() uses)
+            # of [N, vocab] logits (same fast path generate() uses).
+            # Gated on EVERY piece being a decode — a 1-token final
+            # prompt chunk also has len(t) == 1 but needs the put()
+            # path's prefill-completion handling
+            assert all(len(t) == 1 for t in toks)
             nxt_map = self.engine._decode_batch_greedy(
                 uids, [t[0] for t in toks])
             self.steps += 1

@@ -1,0 +1,101 @@
+"""MII-style one-call serving pipeline.
+
+The reference's serving front end (DeepSpeed-MII) wraps FastGen as
+``pipe = mii.pipeline(model); pipe(prompts, max_new_tokens=...)``; this is
+the TPU-native equivalent over the ragged v2 engine + Dynamic SplitFuse
+scheduler. ``pipeline()`` accepts a native functional model, an HF torch
+module (converted via module_inject like init_inference), or an HF hub
+name (needs network/cache); the returned callable takes prompts as
+strings (with a tokenizer) or token-id lists (without) and runs the whole
+batch through one SplitFuse schedule.
+
+    pipe = deepspeed_tpu.pipeline(model, tokenizer)
+    texts = pipe(["a prompt", "another"], max_new_tokens=64)
+"""
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class ServePipeline:
+    def __init__(self, engine, tokenizer=None,
+                 token_budget: Optional[int] = None,
+                 chunk: Optional[int] = None):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.token_budget = token_budget
+        self.chunk = chunk
+        self._uid = 0
+
+    def __call__(self, prompts, max_new_tokens: int = 64,
+                 eos_token_id: Optional[int] = None,
+                 return_full_text: bool = False):
+        """prompts: str | Sequence[str] (tokenizer required) or
+        Sequence[Sequence[int]]. Returns decoded strings when a tokenizer
+        is present, else token-id arrays; generated-only by default."""
+        from .inference.v2.scheduler import DynamicSplitFuseScheduler
+
+        single = isinstance(prompts, str)
+        if single:
+            prompts = [prompts]
+        if prompts and isinstance(prompts[0], str):
+            assert self.tokenizer is not None, \
+                "string prompts need a tokenizer; pass token-id lists " \
+                "or pipeline(..., tokenizer=...)"
+            ids = [self._encode(p) for p in prompts]
+        else:
+            ids = [list(map(int, p)) for p in prompts]
+        if eos_token_id is None and self.tokenizer is not None:
+            eos_token_id = getattr(self.tokenizer, "eos_token_id", None)
+
+        sched = DynamicSplitFuseScheduler(self.engine,
+                                          token_budget=self.token_budget,
+                                          chunk=self.chunk)
+        uids = []
+        for p in ids:
+            uid = self._uid = self._uid + 1
+            sched.submit(uid, p, max_new_tokens=max_new_tokens,
+                         eos_token_id=eos_token_id)
+            uids.append(uid)
+        sched.run()
+        res = sched.results()
+        outs = []
+        for uid, p in zip(uids, ids):
+            toks = res[uid] if return_full_text else res[uid][len(p):]
+            outs.append(self._decode(toks) if self.tokenizer is not None
+                        else np.asarray(toks))
+        return outs[0] if single else outs
+
+    # -- tokenizer adapters (HF tokenizers and anything encode/decode) --
+    def _encode(self, text: str):
+        tk = self.tokenizer
+        if hasattr(tk, "encode"):
+            return list(map(int, tk.encode(text)))
+        return list(map(int, tk(text)["input_ids"]))
+
+    def _decode(self, toks):
+        return self.tokenizer.decode(list(map(int, toks)))
+
+
+def pipeline(model=None, tokenizer=None, config=None, params=None,
+             token_budget: Optional[int] = None,
+             chunk: Optional[int] = None, **kwargs) -> ServePipeline:
+    """Build a ServePipeline. ``model`` may be a native functional model
+    (pass trained weights via ``params``), an HF torch module, or an HF
+    hub name string (resolved via transformers, which needs network or a
+    local cache)."""
+    from . import init_inference
+
+    if isinstance(model, str):
+        import transformers
+        name = model
+        model = transformers.AutoModelForCausalLM.from_pretrained(name)
+        if tokenizer is None:
+            tokenizer = transformers.AutoTokenizer.from_pretrained(name)
+    cfg = dict(config or {})
+    cfg["use_ragged"] = True
+    engine = init_inference(model=model, config=cfg, params=params,
+                            **kwargs)
+    return ServePipeline(engine, tokenizer=tokenizer,
+                         token_budget=token_budget, chunk=chunk)

@@ -185,3 +185,29 @@ def test_splitfuse_respects_tracked_sequence_cap(model_and_params):
     ref = _engine(model, params).generate(prompts, max_new_tokens=4)
     for i in range(3):
         np.testing.assert_array_equal(outs[i], ref[i])
+
+
+def test_splitfuse_one_token_final_chunk_with_running_decode(
+        model_and_params):
+    """A final prompt chunk of length 1 composed alongside running
+    decodes must go through the prefill-completion path, not be mistaken
+    for a decode (review r05: the fast path dropped its first token and
+    stranded the request)."""
+    model, params = model_and_params
+    eng = _engine(model, params)
+    sched = DynamicSplitFuseScheduler(eng, token_budget=64, chunk=16)
+    sched.submit(0, list(range(1, 9)), max_new_tokens=12)
+    sched.run(max_steps=3)                 # request 0 is now decoding
+    p1 = list(range(1, 19))                # 18 = 16 + 2? no: final chunk 2
+    p2 = list(range(1, 18))                # 17 = 16 + 1 -> 1-token chunk
+    sched.submit(1, p1, max_new_tokens=4)
+    sched.submit(2, p2, max_new_tokens=4)
+    sched.run(max_steps=100)
+    outs = sched.results()
+    assert set(outs) == {0, 1, 2}
+    ref = _engine(model, params).generate(
+        [list(range(1, 9)), p1, p2], max_new_tokens=None or 12)
+    np.testing.assert_array_equal(outs[0], ref[0])
+    ref2 = _engine(model, params).generate([p1, p2], max_new_tokens=4)
+    np.testing.assert_array_equal(outs[1], ref2[0])
+    np.testing.assert_array_equal(outs[2], ref2[1])
