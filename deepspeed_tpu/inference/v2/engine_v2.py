@@ -115,6 +115,18 @@ class InferenceEngineV2:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), c
 
         self._decode_tok_jit = jax.jit(_decode_tok, donate_argnums=(4,))
+
+        def _decode_sample(p, t, pos, bt, c, a, rng, temp, topp):
+            # sampling variant (FastGen temperature/top-p): the sampler
+            # runs device-side too, still an [N] int32 host transfer
+            from .sampling import sample_tokens
+            logits, c = paged_decode(cfg, p, t, pos, bt, c, a,
+                                     sm.block_size, use_kernel=use_kernel,
+                                     topo=topo)
+            return sample_tokens(logits, rng, temp, topp), c
+
+        self._decode_sample_jit = jax.jit(_decode_sample,
+                                          donate_argnums=(4,))
         self._prefill_jit = jax.jit(
             lambda p, ids, n, c, b, o: paged_prefill(
                 cfg, p, ids, n, c, b, o,
@@ -278,6 +290,19 @@ class InferenceEngineV2:
         return self._decode_common(uids, tokens, self._decode_tok_jit,
                                    lambda v, i: int(v[i]))
 
+    def _decode_batch_sample(self, uids: List[int], tokens: List[int],
+                             rng, temperature: float,
+                             top_p: float) -> Dict[int, int]:
+        """Sampled decode step (device-side temperature/top-p)."""
+        N = self._decode_bucket(len(uids))
+        temp = jnp.full((N,), temperature, jnp.float32)
+        topp = jnp.full((N,), top_p, jnp.float32)
+        return self._decode_common(
+            uids, tokens,
+            lambda p, t, pos, bt, c, a: self._decode_sample_jit(
+                p, t, pos, bt, c, a, rng, temp, topp),
+            lambda v, i: int(v[i]))
+
     def put(self, batch_uids: Sequence[int],
             batch_tokens: Sequence[Iterable[int]]) -> np.ndarray:
         """Reference engine_v2.put: returns [len(batch_uids), vocab] logits
@@ -320,18 +345,32 @@ class InferenceEngineV2:
     # convenience: serve-style generation over the ragged engine
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int,
                  uids: Optional[Sequence[int]] = None,
-                 eos_token_id: Optional[int] = None) -> List[np.ndarray]:
+                 eos_token_id: Optional[int] = None,
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 seed: int = 0) -> List[np.ndarray]:
+        """Greedy by default; temperature > 0 samples with nucleus top_p
+        (FastGen's sampling surface), deterministic for a given seed."""
         uids = list(uids) if uids is not None else list(range(len(prompts)))
         outs: List[List[int]] = [list(map(int, p)) for p in prompts]
         row_of = {uid: i for i, uid in enumerate(uids)}
-        # prompts go through put() (prefill); the greedy continuation loop
-        # then stays in token space — argmax runs on device and only [N]
-        # int32s cross to host per step (put()'s [N, vocab] logits are the
-        # API for external schedulers, not the hot loop)
+        sampling = temperature > 0.0
+        base_rng = jax.random.PRNGKey(seed) if sampling else None
+        # prompts go through put() (prefill); the continuation loop then
+        # stays in token space — argmax/sampler runs on device and only
+        # [N] int32s cross to host per step (put()'s [N, vocab] logits
+        # are the API for external schedulers, not the hot loop)
         try:
             logits = self.put(uids, prompts)
-            cur = {uid: int(t) for uid, t in
-                   zip(uids, np.argmax(logits, axis=-1))}
+            if sampling:
+                from .sampling import sample_tokens
+                first = np.asarray(sample_tokens(
+                    jnp.asarray(logits), jax.random.fold_in(base_rng, 0),
+                    jnp.full((len(uids),), temperature, jnp.float32),
+                    jnp.full((len(uids),), top_p, jnp.float32)))
+                cur = {uid: int(t) for uid, t in zip(uids, first)}
+            else:
+                cur = {uid: int(t) for uid, t in
+                       zip(uids, np.argmax(logits, axis=-1))}
             live = set(uids)
             for step in range(max_new_tokens):
                 step_uids = []
@@ -356,8 +395,14 @@ class InferenceEngineV2:
                         "pool; lower max_new_tokens or raise the limits")
                 # every step_uid is already tracked, so the batch can
                 # never exceed max_tracked_sequences — one call suffices
-                cur = self._decode_batch_greedy(
-                    step_uids, [outs[row_of[u]][-1] for u in step_uids])
+                feed = [outs[row_of[u]][-1] for u in step_uids]
+                if sampling:
+                    cur = self._decode_batch_sample(
+                        step_uids, feed,
+                        jax.random.fold_in(base_rng, step + 1),
+                        temperature, top_p)
+                else:
+                    cur = self._decode_batch_greedy(step_uids, feed)
         finally:
             # flush even on the schedulability raise: a long-lived engine
             # must not leak this call's KV blocks / sequence slots

@@ -1,0 +1,70 @@
+"""Token sampling for the v2 serving stack.
+
+Reference parity: FastGen serves temperature / top-p sampling (the MII
+layer's SamplingParams over inference/v2 logits). Two implementations of
+the same math so both call sites are testable against each other:
+
+* ``sample_tokens`` — jitted device-side batch sampler used by
+  ``InferenceEngineV2.generate`` (one rng, uniform params per call).
+  Rows with temperature<=0 take the argmax.
+* ``host_sample`` — numpy twin used by the SplitFuse scheduler, where
+  every request carries its own (temperature, top_p, seed) and sampling
+  happens on the host from put()'s logits.
+
+Top-p (nucleus): sort descending, keep the smallest prefix whose
+cumulative probability reaches ``top_p`` (the first token always
+survives), renormalize, sample.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _topp_mask_sorted(sorted_logits, top_p):
+    """Mask (to NEG_INF) the tail of descending-sorted logits whose
+    cumulative softmax probability lies past top_p. top_p broadcasts
+    [N] -> rows; values <= 0 clamp to keep-only-the-top-token (the limit
+    behavior — all-masked rows would crash the host twin and sample
+    uniform garbage on device)."""
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    # exclusive cumsum: a token is kept while the mass BEFORE it is
+    # still below top_p — the first token survives any top_p > 0
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    keep = cum_before < jnp.maximum(top_p, 1e-9)[..., None]
+    return jnp.where(keep, sorted_logits, NEG_INF)
+
+
+def sample_tokens(logits: jnp.ndarray, rng, temperature: jnp.ndarray,
+                  top_p: jnp.ndarray) -> jnp.ndarray:
+    """logits [N, V]; temperature/top_p [N] (0 temperature = greedy).
+    Returns [N] int32 tokens. Jit-friendly (no data-dependent shapes)."""
+    greedy = temperature <= 0.0
+    scaled = logits / jnp.maximum(temperature, 1e-6)[..., None]
+    order = jnp.argsort(-scaled, axis=-1)
+    sorted_logits = jnp.take_along_axis(scaled, order, axis=-1)
+    masked = _topp_mask_sorted(sorted_logits, top_p)
+    pick = jax.random.categorical(rng, masked, axis=-1)      # [N] sorted-idx
+    sampled = jnp.take_along_axis(order, pick[..., None], axis=-1)[..., 0]
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1),
+                     sampled).astype(jnp.int32)
+
+
+def host_sample(logits: np.ndarray, rng: np.random.Generator,
+                temperature: float, top_p: float) -> int:
+    """One row, host-side: same temperature/top-p math as sample_tokens
+    (tested equivalent) with a per-request numpy Generator."""
+    if temperature <= 0.0:
+        return int(np.argmax(logits))
+    scaled = logits.astype(np.float64) / max(temperature, 1e-6)
+    order = np.argsort(-scaled)
+    s = scaled[order]
+    p = np.exp(s - s.max())
+    p /= p.sum()
+    cum_before = np.cumsum(p) - p
+    keep = cum_before < max(top_p, 1e-9)  # <=0 clamps to top-token-only
+    p = np.where(keep, p, 0.0)
+    p /= p.sum()
+    return int(order[rng.choice(len(p), p=p)])

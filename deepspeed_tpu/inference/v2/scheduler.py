@@ -38,11 +38,19 @@ class _Request:
     max_new_tokens: int
     eos_token_id: Optional[int]
     submit_t: float
+    temperature: float = 0.0         # 0 = greedy
+    top_p: float = 1.0
+    rng: Optional[np.random.Generator] = None
     prefill_sent: int = 0            # prompt tokens handed to the engine
     generated: List[int] = field(default_factory=list)
     next_token: Optional[int] = None  # pending decode input
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
+
+    def pick(self, logits_row: np.ndarray) -> int:
+        from .sampling import host_sample
+        return host_sample(logits_row, self.rng, self.temperature,
+                           self.top_p)
 
     @property
     def prefill_done(self) -> bool:
@@ -76,10 +84,19 @@ class DynamicSplitFuseScheduler:
 
     # ------------------------------------------------------------------
     def submit(self, uid: int, prompt: Sequence[int], max_new_tokens: int,
-               eos_token_id: Optional[int] = None) -> None:
+               eos_token_id: Optional[int] = None,
+               temperature: float = 0.0, top_p: float = 1.0,
+               seed: Optional[int] = None) -> None:
+        """temperature/top_p/seed are PER REQUEST (the MII SamplingParams
+        surface): mixed greedy and sampled requests compose into the same
+        steps; a SEEDED request's tokens are deterministic (independent
+        of batch composition — the rng is per request), an unseeded one
+        draws fresh OS entropy."""
         assert uid not in self._all, f"uid {uid} already submitted"
         req = _Request(uid, list(map(int, prompt)), max_new_tokens,
-                       eos_token_id, self.clock())
+                       eos_token_id, self.clock(),
+                       temperature=temperature, top_p=top_p,
+                       rng=np.random.default_rng(seed))
         self._all[uid] = req
         self._queue.append(req)
 
@@ -190,12 +207,14 @@ class DynamicSplitFuseScheduler:
                     f"pool exhausted with no running sequences to drain")
             return 0
 
-        if decode_reqs and len(decode_reqs) == len(uids):
-            # pure-decode step: device argmax, [N] int32 to host instead
-            # of [N, vocab] logits (same fast path generate() uses).
-            # Gated on EVERY piece being a decode — a 1-token final
-            # prompt chunk also has len(t) == 1 but needs the put()
-            # path's prefill-completion handling
+        if (decode_reqs and len(decode_reqs) == len(uids)
+                and all(r.temperature <= 0.0 for r in decode_reqs)):
+            # pure-GREEDY-decode step: device argmax, [N] int32 to host
+            # instead of [N, vocab] logits (same fast path generate()
+            # uses). Gated on EVERY piece being a decode — a 1-token
+            # final prompt chunk also has len(t) == 1 but needs the
+            # put() path's prefill-completion handling — and on greedy
+            # rows only (sampled requests draw from host rngs).
             assert all(len(t) == 1 for t in toks)
             nxt_map = self.engine._decode_batch_greedy(
                 uids, [t[0] for t in toks])
@@ -204,15 +223,14 @@ class DynamicSplitFuseScheduler:
                 self._emit(req, nxt_map[req.uid])
             return len(uids)
 
-        logits = self.engine.put(uids, toks)
+        logits = np.asarray(self.engine.put(uids, toks))
         self.steps += 1
         now = self.clock()
-        nxt = np.argmax(np.asarray(logits), axis=-1)
 
         for i, uid in enumerate(uids):
             req = self._all[uid]
             if req in decode_reqs:
-                self._emit(req, int(nxt[i]))
+                self._emit(req, req.pick(logits[i]))
             elif req.prefill_done:
                 # final prompt chunk: its last-token logits yield the
                 # first generated token (TTFT is measured here)
@@ -222,7 +240,7 @@ class DynamicSplitFuseScheduler:
                     self._finish(req)
                 else:
                     self._running.append(req)
-                    self._emit(req, int(nxt[i]))
+                    self._emit(req, req.pick(logits[i]))
             # else: mid-prompt chunk — logits ignored
         return sum(len(t) for t in toks)
 

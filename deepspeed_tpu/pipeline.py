@@ -30,7 +30,9 @@ class ServePipeline:
 
     def __call__(self, prompts, max_new_tokens: int = 64,
                  eos_token_id: Optional[int] = None,
-                 return_full_text: bool = False):
+                 return_full_text: bool = False,
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 seed: Optional[int] = None):
         """prompts: str | Sequence[str] (tokenizer required) or
         Sequence[Sequence[int]]. Returns decoded strings when a tokenizer
         is present, else token-id arrays; generated-only by default."""
@@ -53,10 +55,12 @@ class ServePipeline:
                                           token_budget=self.token_budget,
                                           chunk=self.chunk)
         uids = []
-        for p in ids:
+        for i, p in enumerate(ids):
             uid = self._uid = self._uid + 1
             sched.submit(uid, p, max_new_tokens=max_new_tokens,
-                         eos_token_id=eos_token_id)
+                         eos_token_id=eos_token_id,
+                         temperature=temperature, top_p=top_p,
+                         seed=None if seed is None else seed + i)
             uids.append(uid)
         sched.run()
         res = sched.results()

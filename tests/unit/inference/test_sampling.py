@@ -1,0 +1,154 @@
+"""Sampling (temperature/top-p) for the v2 serving stack.
+
+Reference surface mirrored: FastGen/MII SamplingParams over v2 logits."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2.sampling import (host_sample,
+                                                 sample_tokens)
+
+
+def test_zero_temperature_is_argmax():
+    rng = np.random.default_rng(0)
+    logits = rng.standard_normal((5, 64)).astype(np.float32)
+    out = sample_tokens(jnp.asarray(logits), jax.random.PRNGKey(0),
+                        jnp.zeros(5), jnp.ones(5))
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.argmax(logits, axis=-1))
+    g = np.random.default_rng(1)
+    for row in logits:
+        assert host_sample(row, g, 0.0, 1.0) == int(np.argmax(row))
+
+
+def test_tiny_top_p_is_argmax():
+    """top_p below the top token's probability keeps only that token."""
+    rng = np.random.default_rng(1)
+    logits = rng.standard_normal((4, 32)).astype(np.float32) * 3
+    out = sample_tokens(jnp.asarray(logits), jax.random.PRNGKey(7),
+                        jnp.full(4, 0.8), jnp.full(4, 1e-6))
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.argmax(logits, axis=-1))
+    g = np.random.default_rng(2)
+    for row in logits:
+        assert host_sample(row, g, 0.8, 1e-6) == int(np.argmax(row))
+
+
+def test_topp_restricts_support():
+    """With a 3-peak distribution and top_p covering ~2 peaks, samples
+    must come only from those peaks (device AND host samplers)."""
+    logits = np.full(16, -10.0, np.float32)
+    logits[3], logits[7], logits[11] = 3.0, 2.5, 2.0   # p ~ .52/.31/.19
+    dev = np.asarray(jax.vmap(
+        lambda k: sample_tokens(jnp.asarray(logits)[None],
+                                jax.random.PRNGKey(k),
+                                jnp.ones(1), jnp.full(1, 0.7))[0]
+    )(jnp.arange(200)))
+    assert set(np.unique(dev)) <= {3, 7}
+    g = np.random.default_rng(3)
+    host = {host_sample(logits, g, 1.0, 0.7) for _ in range(200)}
+    assert host <= {3, 7}
+    # full top_p eventually reaches the third peak
+    g = np.random.default_rng(4)
+    host_full = {host_sample(logits, g, 1.0, 1.0) for _ in range(400)}
+    assert 11 in host_full
+
+
+def test_device_host_distributions_agree():
+    """The two implementations define the same distribution: compare
+    empirical frequencies on a skewed 8-way categorical."""
+    logits = np.array([2.0, 1.5, 1.0, 0.0, -1.0, -2.0, -3.0, -4.0],
+                      np.float32)
+    n = 4000
+    dev = np.asarray(jax.vmap(
+        lambda k: sample_tokens(jnp.asarray(logits)[None],
+                                jax.random.PRNGKey(k),
+                                jnp.full(1, 0.9), jnp.full(1, 0.95))[0]
+    )(jnp.arange(n)))
+    g = np.random.default_rng(5)
+    host = np.array([host_sample(logits, g, 0.9, 0.95)
+                     for _ in range(n)])
+    fd = np.bincount(dev, minlength=8) / n
+    fh = np.bincount(host, minlength=8) / n
+    np.testing.assert_allclose(fd, fh, atol=0.04)
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+    cfg = TransformerConfig(vocab_size=128, hidden_size=64,
+                            intermediate_size=128, num_layers=2,
+                            num_heads=4, num_kv_heads=2, max_seq_len=128,
+                            remat=False, use_flash=False)
+    model = TransformerLM(cfg)
+    params = jax.tree.map(lambda x: x.astype(jnp.float32),
+                          model.init_params(jax.random.PRNGKey(0)))
+    return InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_tracked_sequences=8, max_seq_len=128, num_blocks=33,
+                block_size=16),
+            dtype="float32", prefill_bucket=16), params=params)
+
+
+def test_generate_sampling_deterministic_per_seed(tiny_engine):
+    eng = tiny_engine
+    prompts = [[3, 5, 7], [11, 13, 17, 19]]
+    a = eng.generate(prompts, max_new_tokens=8, temperature=0.8,
+                     top_p=0.9, seed=42, uids=[1, 2])
+    b = eng.generate(prompts, max_new_tokens=8, temperature=0.8,
+                     top_p=0.9, seed=42, uids=[3, 4])
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    c = eng.generate(prompts, max_new_tokens=8, temperature=0.8,
+                     top_p=0.9, seed=43, uids=[5, 6])
+    assert any(not np.array_equal(x, y) for x, y in zip(a, c))
+    # temperature=0 still exactly the greedy path
+    g1 = eng.generate(prompts, max_new_tokens=8, uids=[7, 8])
+    g2 = eng.generate(prompts, max_new_tokens=8, temperature=0.0,
+                      seed=99, uids=[9, 10])
+    for x, y in zip(g1, g2):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_scheduler_mixed_sampling_and_greedy(tiny_engine):
+    from deepspeed_tpu.inference.v2.scheduler import \
+        DynamicSplitFuseScheduler
+    eng = tiny_engine
+    greedy_ref = eng.generate([[2, 4, 6, 8]], max_new_tokens=6,
+                              uids=[90])[0]
+    sched = DynamicSplitFuseScheduler(eng, token_budget=32, chunk=16)
+    sched.submit(101, [2, 4, 6, 8], max_new_tokens=6)            # greedy
+    sched.submit(102, [3, 5, 7], max_new_tokens=6,
+                 temperature=0.9, top_p=0.9, seed=7)             # sampled
+    sched.run()
+    outs = sched.results()
+    np.testing.assert_array_equal(outs[101], greedy_ref)
+    assert len(outs[102]) == 3 + 6
+    # same seed reproduces the sampled request
+    sched2 = DynamicSplitFuseScheduler(eng, token_budget=32, chunk=16)
+    sched2.submit(201, [3, 5, 7], max_new_tokens=6,
+                  temperature=0.9, top_p=0.9, seed=7)
+    sched2.run()
+    np.testing.assert_array_equal(outs[102], sched2.results()[201])
+
+
+def test_top_p_zero_clamps_to_argmax():
+    """top_p <= 0 must behave as keep-only-the-top-token on BOTH
+    implementations (review r05: host crashed on a zero probability sum,
+    device sampled uniform garbage)."""
+    rng = np.random.default_rng(6)
+    logits = rng.standard_normal((3, 32)).astype(np.float32)
+    out = sample_tokens(jnp.asarray(logits), jax.random.PRNGKey(1),
+                        jnp.full(3, 0.7), jnp.zeros(3))
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.argmax(logits, axis=-1))
+    g = np.random.default_rng(7)
+    for row in logits:
+        assert host_sample(row, g, 0.7, 0.0) == int(np.argmax(row))
