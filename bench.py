@@ -151,10 +151,19 @@ def build_trials(base):
                           base, use_flash=True, flash_min_seq=2048,
                           attn_block_q=512, attn_block_kv=512),
                        16, policy))
+    # larger micro-batches: the r05 winner was mb=16 full-recompute; 24/32
+    # amortize per-step overheads further if they fit the 16 GB chip
+    # (OOM configs are skipped by the ladder)
+    trials.insert(2, (dataclasses.replace(
+        base, use_flash=True, flash_min_seq=2048, attn_block_q=512,
+        attn_block_kv=512), 24, "nothing_saveable"))
+    trials.insert(3, (dataclasses.replace(
+        base, use_flash=True, flash_min_seq=2048, attn_block_q=512,
+        attn_block_kv=512), 32, "nothing_saveable"))
     # unchunked CE: skips the backward recompute of the [*, V] logits
     # (~2HV per token, ~5% of step flops at vocab 32k) if the logits fit
     # now that selective remat freed activation memory
-    trials.insert(3, (dataclasses.replace(
+    trials.insert(4, (dataclasses.replace(
         base, use_flash=True, flash_min_seq=2048, loss_chunk=0),
         8, "save_dots_and_attn"))
     # long-sequence variant: seq 4096 raises the attention-flops fraction

@@ -124,18 +124,18 @@ def init_inference(model=None, config=None, params=None, **kwargs):
         from .module_inject import load_hf_model
         model, params = load_hf_model(model)
     if cfg.use_ragged:
-        if cfg.checkpoint or cfg.quant_bits:
-            # silently serving random weights (checkpoint) or unquantized
-            # weights (quant_bits) would be worse than refusing
+        if cfg.checkpoint:
+            # silently serving random weights would be worse than refusing
             raise NotImplementedError(
-                "use_ragged=True does not take 'checkpoint' or "
-                "'quant_bits' yet; pass an HF model or explicit params "
-                "(v1 path supports both keys)")
+                "use_ragged=True does not take 'checkpoint' yet; pass an "
+                "HF model or explicit params (v1 path supports the key)")
         from .inference.v2 import (InferenceEngineV2,
                                    RaggedInferenceEngineConfig)
         rdict = dict(cfg.ragged or {})
         rdict.setdefault("dtype", cfg.dtype)
         rdict.setdefault("tensor_parallel_size", cfg.tensor_parallel.tp_size)
+        if cfg.quant_bits:
+            rdict.setdefault("quant_bits", cfg.quant_bits)
         return InferenceEngineV2(model,
                                  RaggedInferenceEngineConfig.from_dict(rdict),
                                  params=params)

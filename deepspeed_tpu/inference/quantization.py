@@ -69,6 +69,11 @@ def _should_quantize(path: Tuple, leaf) -> bool:
 
 def quantize_params(params, bits: int = 8, block: int = 2048):
     """Returns (pytree with QuantizedTensor leaves, meta)."""
+    if bits not in (4, 8):
+        # the quantizer's range pick defaults anything != 8 to the int4
+        # range (ops/quantizer.py), so e.g. bits=16 would silently serve
+        # 15-level weights
+        raise ValueError(f"quant_bits must be 4 or 8, got {bits}")
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     out = []
     meta = {"bits": bits, "block": block, "n_quantized": 0}

@@ -33,6 +33,12 @@ class RaggedInferenceEngineConfig:
     dtype: str = "bfloat16"
     prefill_bucket: int = 64                 # prompt lengths pad to multiples
     use_paged_kernel: bool = True            # Pallas decode attention kernel
+    # weight-only quantization (0 = off): weights rest in HBM as int8 /
+    # packed int4 + per-block scales, dequantized inside the jitted
+    # forward where XLA fuses into the consuming matmul (same machinery
+    # as the v1 engine, inference/quantization.py) — halves/quarters
+    # weight HBM, freeing KV-pool headroom
+    quant_bits: int = 0
     seed: int = 0
 
     @classmethod

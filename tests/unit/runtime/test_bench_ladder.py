@@ -13,16 +13,20 @@ def test_bench_trial_ladder_shape():
                              intermediate_size=2816, num_layers=24,
                              num_heads=8, max_seq_len=2048)
     trials = bench.build_trials(base)
-    assert len(trials) == 18
+    assert len(trials) == 20
     # most promising first: selective remat + flash + biggest micro batch
     cfg0, micro0, pol0 = trials[0]
     assert (cfg0.use_flash, micro0, pol0) == (True, 16, "save_dots_and_attn")
     # the block-size and unchunked-CE variants sit early in the ladder
     assert any(t[0].attn_block_q == 512 for t in trials[:3])
-    assert any(t[0].loss_chunk == 0 for t in trials[:4])
+    assert any(t[0].loss_chunk == 0 for t in trials[:7])
+    # round-5 additions: mb=24/32 full-recompute (r05 winner was mb=16
+    # nothing_saveable — bigger batches amortize further if they fit)
+    assert any(t[1] == 24 for t in trials[:4])
+    assert any(t[1] == 32 for t in trials[:4])
     # round-4 additions: long-seq and tall-q flash variants, early
-    assert any(t[0].max_seq_len == 4096 for t in trials[:6])
-    assert any(t[0].attn_block_q == 1024 for t in trials[:6])
+    assert any(t[0].max_seq_len == 4096 for t in trials[:8])
+    assert any(t[0].attn_block_q == 1024 for t in trials[:8])
     # every policy gets at least one flash and one xla trial
     for pol in ("save_dots_and_attn", "dots_with_no_batch_dims_saveable",
                 "nothing_saveable"):
