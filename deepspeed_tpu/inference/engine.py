@@ -72,7 +72,9 @@ class InferenceEngine:
                 out_shardings=self.param_sharding)
             self.params = init(jax.random.PRNGKey(config.seed))
 
-        if config.quant_bits in (4, 8):
+        if config.quant_bits:
+            # quantize_params validates bits in {4, 8} — an invalid value
+            # must raise, not silently serve unquantized weights
             from .quantization import dequantize_params, quantize_params
 
             self.params, self._qmeta = quantize_params(

@@ -1,0 +1,83 @@
+"""One-call serving: pipeline() + Dynamic SplitFuse + sampling + WOQ.
+
+The MII-style front end over the ragged v2 engine
+(reference: DeepSpeed-MII pipeline over FastGen): build a pipeline from a
+model + tokenizer, then call it with string prompts — chunked prefill and
+running decodes compose into uniform token-budget steps, greedy and
+temperature/top-p sampled requests mix freely, and --quant-bits 8 serves
+int8 weights at rest.
+
+  python examples/serve_pipeline.py --cpu --temperature 0.8
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+class CharTokenizer:
+    """Character-level toy tokenizer (any encode/decode object works —
+    an HF AutoTokenizer drops in unchanged)."""
+    eos_token_id = None
+
+    def encode(self, text):
+        return [min(ord(c), 127) for c in text]
+
+    def decode(self, toks):
+        return "".join(chr(int(t)) for t in toks)
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--cpu", action="store_true",
+                   help="run on the CPU backend (no TPU needed)")
+    p.add_argument("--new-tokens", type=int, default=12)
+    p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--top-p", type=float, default=0.9)
+    p.add_argument("--quant-bits", type=int, default=0)
+    args = p.parse_args()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(vocab_size=128, hidden_size=128,
+                            intermediate_size=256, num_layers=2,
+                            num_heads=4, max_seq_len=256, remat=False,
+                            use_flash=False)
+    model = TransformerLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    ragged = {"state_manager": {"max_tracked_sequences": 8,
+                                "max_seq_len": 256, "num_blocks": 65,
+                                "block_size": 16}}
+    pipe = deepspeed_tpu.pipeline(
+        model, tokenizer=CharTokenizer(), params=params,
+        config={"dtype": "float32", "ragged": ragged,
+                "quant_bits": args.quant_bits},
+        token_budget=64, chunk=16)
+
+    prompts = ["hello tpu", "deepspeed", "a longer prompt that splits "
+               "across several prefill chunks under the token budget"]
+    outs = pipe(prompts, max_new_tokens=args.new_tokens,
+                temperature=args.temperature, top_p=args.top_p, seed=0)
+    for prompt, out in zip(prompts, outs):
+        print(f"[{prompt!r}] -> {out!r}")
+
+    # repeat call on the same pipeline reuses compiled programs; seeded
+    # sampling (and greedy) reproduce exactly
+    again = pipe(prompts[:1], max_new_tokens=args.new_tokens,
+                 temperature=args.temperature, top_p=args.top_p, seed=0)
+    assert again[0] == outs[0], (again[0], outs[0])
+    print("served", len(prompts) + 1, "requests OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,8 @@ pytestmark = pytest.mark.examples
     ["examples/serve_ragged.py", "--cpu", "--moe", "--new-tokens", "3"],
     ["examples/serve_hf.py", "--cpu", "--layers", "2", "--hidden", "64",
      "--heads", "4", "--new-tokens", "6"],
+    ["examples/serve_pipeline.py", "--cpu", "--new-tokens", "4",
+     "--temperature", "0.8", "--quant-bits", "8"],
 ])
 def test_example_runs(cmd):
     # Tight cap: a hung example must cost minutes, not the 46-min worst case
