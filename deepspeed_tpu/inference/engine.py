@@ -79,7 +79,16 @@ class InferenceEngine:
 
             self.params, self._qmeta = quantize_params(
                 self.params, bits=config.quant_bits)
-            self._deq = dequantize_params   # runs inside jit; XLA fuses it
+
+            def _deq_nonlayer(p):
+                # layer leaves stay quantized into the forward: the model
+                # scan dequantizes ONE layer per step inside its body
+                # (transformer.py scan_fn), keeping peak HBM at the
+                # quantized footprint; only embed/lm_head dequant here
+                return {k: (v if k == "layers" else dequantize_params(v))
+                        for k, v in p.items()}
+
+            self._deq = _deq_nonlayer
         else:
             self._deq = lambda p: p
         self._gen_jit = None

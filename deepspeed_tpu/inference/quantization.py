@@ -24,22 +24,39 @@ class QuantizedTensor:
     """int8 blocks + fp32 scales standing in for a dense weight; int4 is
     packed two-per-byte (real 4x at-rest saving).
 
-    A pytree node whose children are the device arrays and whose aux data is
-    the logical (shape, dtype, bits) — so it flows through jit/device_put
-    intact."""
+    A pytree node whose children are the device arrays and whose aux data
+    is the logical (shape, dtype, bits, stacked) — so it flows through
+    jit/device_put intact.
+
+    ``stacked=True`` marks a per-layer stacked weight (``[L, ...]`` under
+    a ``lax.scan``): blocks are laid out ``q [L, nb, block]`` with
+    ``shape`` holding the PER-LAYER logical shape, so the scan slices the
+    children to ``[nb, block]`` and the body's ``dequantize()`` rebuilds
+    one layer — the dequant stays inside the scan body where XLA fuses
+    it, instead of materializing every layer's weights up front (which
+    made int8 serving use MORE peak HBM than bf16: the r05 AOT serving
+    fit caught ``program 13.06G`` of dequantized scan inputs)."""
 
     def __init__(self, q, s, shape: Tuple[int, ...], dtype: str,
-                 bits: int = 8):
+                 bits: int = 8, stacked: bool = False):
         self.q, self.s, self.shape, self.dtype = q, s, tuple(shape), dtype
         self.bits = bits
+        self.stacked = stacked
 
     def dequantize(self):
-        q = Q.unpack_int4(self.q) if self.bits == 4 else self.q
-        return Q.dequantize_symmetric(q, self.s, self.shape,
+        unpack = Q.unpack_int4 if self.bits == 4 else (lambda x: x)
+        if self.stacked and self.q.ndim == 3:
+            # full stacked tensor (outside a scan): [L, nb, block]
+            return jax.vmap(lambda q, s: Q.dequantize_symmetric(
+                unpack(q), s, self.shape,
+                dtype=jnp.dtype(self.dtype)))(self.q, self.s)
+        # plain leaf, or one scan-sliced layer ([nb, block])
+        return Q.dequantize_symmetric(unpack(self.q), self.s, self.shape,
                                       dtype=jnp.dtype(self.dtype))
 
     def tree_flatten(self):
-        return (self.q, self.s), (self.shape, self.dtype, self.bits)
+        return (self.q, self.s), (self.shape, self.dtype, self.bits,
+                                  self.stacked)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -47,7 +64,7 @@ class QuantizedTensor:
 
     def __repr__(self):
         return (f"QuantizedTensor(shape={self.shape}, dtype={self.dtype}, "
-                f"bits={self.bits})")
+                f"bits={self.bits}, stacked={self.stacked})")
 
 
 def _is_qleaf(x) -> bool:
@@ -67,8 +84,18 @@ def _should_quantize(path: Tuple, leaf) -> bool:
     return "norm" not in name
 
 
+def _under_scan(path: Tuple) -> bool:
+    """Leaves under a per-layer stack (scanned with layer axis 0)."""
+    return any(getattr(k, "key", None) == "layers" for k in path)
+
+
 def quantize_params(params, bits: int = 8, block: int = 2048):
-    """Returns (pytree with QuantizedTensor leaves, meta)."""
+    """Returns (pytree with QuantizedTensor leaves, meta).
+
+    Leaves under ``params["layers"]`` are stacked ``[L, ...]`` and
+    consumed one layer at a time by ``lax.scan`` — they quantize
+    per-layer (``stacked=True``) so the scan slices them and dequant
+    runs inside the body (see QuantizedTensor)."""
     if bits not in (4, 8):
         # the quantizer's range pick defaults anything != 8 to the int4
         # range (ops/quantizer.py), so e.g. bits=16 would silently serve
@@ -77,13 +104,22 @@ def quantize_params(params, bits: int = 8, block: int = 2048):
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     out = []
     meta = {"bits": bits, "block": block, "n_quantized": 0}
+    pack = Q.pack_int4 if bits == 4 else (lambda x: x)
     for path, leaf in flat:
-        if _should_quantize(path, leaf):
-            q, s = Q.quantize_symmetric(leaf, block=block, bits=bits)
-            if bits == 4:
-                q = Q.pack_int4(q)
-            out.append(QuantizedTensor(q, s, leaf.shape, str(leaf.dtype),
-                                       bits=bits))
+        stacked = _under_scan(path) and leaf.ndim >= 3
+        per_layer = leaf[0] if stacked else leaf
+        if _should_quantize(path, per_layer):
+            if stacked:
+                q, s = jax.vmap(lambda x: Q.quantize_symmetric(
+                    x, block=block, bits=bits))(leaf)
+                q = jax.vmap(pack)(q)
+                out.append(QuantizedTensor(
+                    q, s, per_layer.shape, str(leaf.dtype), bits=bits,
+                    stacked=True))
+            else:
+                q, s = Q.quantize_symmetric(leaf, block=block, bits=bits)
+                out.append(QuantizedTensor(pack(q), s, leaf.shape,
+                                           str(leaf.dtype), bits=bits))
             meta["n_quantized"] += 1
         else:
             out.append(leaf)

@@ -92,20 +92,19 @@ class InferenceEngineV2:
 
         if config.quant_bits:
             # WOQ at rest (v1 machinery, inference/quantization.py):
-            # int8/packed-int4 + per-block scales in HBM; deq runs inside
-            # each jitted program where XLA fuses it into the consuming
-            # matmul. tp/ep shardings are declared against the dense
-            # leaf structure, so quantized serving is single-device
+            # int8/packed-int4 + per-block scales in HBM. paged_model
+            # dequantizes non-layer leaves at entry and each scanned
+            # layer INSIDE the scan body (per-layer stacked quant), so
+            # peak HBM really is the quantized footprint — see
+            # QuantizedTensor.stacked. tp/ep shardings are declared
+            # against the dense leaf structure: single-device only
             assert tp == 1 and ep == 1, \
                 "quant_bits requires tensor_parallel_size == " \
                 "expert_parallel_size == 1 (shardings are declared " \
                 "against dense leaves)"
-            from ..quantization import dequantize_params, quantize_params
+            from ..quantization import quantize_params
             self.params, self._qmeta = quantize_params(
                 self.params, bits=config.quant_bits)
-            deq = dequantize_params
-        else:
-            deq = lambda p: p  # noqa: E731
 
         self.state_manager = DSStateManager(sm)
         self.kv_cache = init_paged_kv_cache(cfg, sm.num_blocks,
@@ -118,7 +117,7 @@ class InferenceEngineV2:
         topo = self.topology if ep > 1 else None
         self._decode_jit = jax.jit(
             lambda p, t, pos, bt, c, a: paged_decode(
-                cfg, deq(p), t, pos, bt, c, a, sm.block_size,
+                cfg, p, t, pos, bt, c, a, sm.block_size,
                 use_kernel=use_kernel, topo=topo),
             donate_argnums=(4,))
 
@@ -126,7 +125,7 @@ class InferenceEngineV2:
             # greedy variant for the generate() hot loop: argmax on device
             # so the per-token host transfer is [N] int32, not [N, vocab]
             # (the reference's sampler also runs device-side)
-            logits, c = paged_decode(cfg, deq(p), t, pos, bt, c, a,
+            logits, c = paged_decode(cfg, p, t, pos, bt, c, a,
                                      sm.block_size, use_kernel=use_kernel,
                                      topo=topo)
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), c
@@ -137,7 +136,7 @@ class InferenceEngineV2:
             # sampling variant (FastGen temperature/top-p): the sampler
             # runs device-side too, still an [N] int32 host transfer
             from .sampling import sample_tokens
-            logits, c = paged_decode(cfg, deq(p), t, pos, bt, c, a,
+            logits, c = paged_decode(cfg, p, t, pos, bt, c, a,
                                      sm.block_size, use_kernel=use_kernel,
                                      topo=topo)
             return sample_tokens(logits, rng, temp, topp), c
@@ -146,13 +145,12 @@ class InferenceEngineV2:
                                           donate_argnums=(4,))
         self._prefill_jit = jax.jit(
             lambda p, ids, n, c, b, o: paged_prefill(
-                cfg, deq(p), ids, n, c, b, o,
+                cfg, p, ids, n, c, b, o,
                 use_kernel=use_kernel, topo=topo),
             donate_argnums=(3,))
         self._continue_jit = jax.jit(
             lambda p, ids, s, n, c, b, o, t: paged_continue(
-                cfg, deq(p), ids, s, n, c, b, o, t, sm.block_size,
-                topo=topo),
+                cfg, p, ids, s, n, c, b, o, t, sm.block_size, topo=topo),
             donate_argnums=(4,))
         log_dist(
             f"ragged inference engine: blocks={sm.num_blocks}x"

@@ -143,6 +143,26 @@ def _moe_mlp(cfg, lp, x, topo=None):
     return out.reshape(orig_shape)
 
 
+def _deq_nonlayer(params):
+    """Dequantize every WOQ leaf OUTSIDE params["layers"] (embed/lm_head;
+    one-shot temps XLA frees after use). Layer leaves stay quantized: the
+    lax.scan slices them and the body dequantizes ONE layer at a time —
+    dequantizing the stack up front materializes every layer's bf16
+    weights as scan inputs (the r05 AOT serving fit measured 13 GiB of
+    them on a 7B model, making int8 serving WORSE than bf16 at peak)."""
+    from ..quantization import dequantize_params
+    return {k: (v if k == "layers" else dequantize_params(v))
+            for k, v in params.items()}
+
+
+def _deq_layer(lp):
+    """Dequantize one scan-sliced layer's WOQ leaves (identity on dense
+    params); runs inside the scan body where XLA fuses the dequant into
+    the consuming matmul."""
+    from ..quantization import dequantize_params
+    return dequantize_params(lp)
+
+
 def _logits(cfg, params, x):
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     return (x @ head.astype(x.dtype)).astype(jnp.float32)
@@ -171,6 +191,7 @@ def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
     # shape gates only: off-TPU the kernel runs in interpret mode (slow but
     # identical math), which is what lets CPU tests cover this path
     flash_ok = use_kernel and C % 128 == 0 and hd % 8 == 0
+    params = _deq_nonlayer(params)
     x = params["embed"][ids[0]]                                # [C, H]
     if cfg.positional == "learned":
         # the bucket C may round past max_seq_len; clip like paged_continue
@@ -185,6 +206,7 @@ def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
     def layer_fn(carry, inputs):
         x, kc, vc = carry
         lp, l = inputs
+        lp = _deq_layer(lp)
         hn = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
         q, k, v = qkv_proj(lp, hn)
         q = q.reshape(C, nh, hd)
@@ -252,6 +274,7 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
     MB = block_table.shape[0]
     ctx = MB * block_size
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    params = _deq_nonlayer(params)
     x = params["embed"][ids[0]]                                 # [C, H]
     pos = start_pos + jnp.arange(C)                             # [C]
     if cfg.positional == "learned":
@@ -264,6 +287,7 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
     def layer_fn(carry, inputs):
         x, kc, vc = carry
         lp, l = inputs
+        lp = _deq_layer(lp)
         hn = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
         q, k, v = qkv_proj(lp, hn)
         q = q.reshape(C, nh, hd)
@@ -313,6 +337,7 @@ def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
     N, MB = block_tables.shape
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
     ctx = MB * block_size
+    params = _deq_nonlayer(params)
     x = params["embed"][toks]                                   # [N, H]
     if cfg.positional == "learned":
         x = x + params["pos_embed"][jnp.clip(pos, 0, cfg.max_seq_len - 1)]
@@ -327,6 +352,7 @@ def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
     def layer_fn(carry, inputs):
         x, kc, vc = carry
         lp, l = inputs
+        lp = _deq_layer(lp)
         hn = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
         q, k, v = qkv_proj(lp, hn)
         q = q.reshape(N, nh, hd)

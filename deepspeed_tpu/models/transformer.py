@@ -568,7 +568,12 @@ class TransformerLM:
             body = ds_ckpt.checkpoint_wrapper(body)
 
         def scan_fn(h, lp):
-            h, aux = body(h, lp, cos, sin)
+            # WOQ leaves dequantize per layer INSIDE the scan body (fused
+            # into the consuming matmuls); identity on dense params. An
+            # upfront whole-tree dequant materializes every layer as scan
+            # inputs (r05 AOT serving fit: ~23 GiB on a 7B).
+            from ..inference.quantization import dequantize_params
+            h, aux = body(h, dequantize_params(lp), cos, sin)
             return h, aux
 
         unroll = max(self.cfg.scan_unroll,
@@ -961,8 +966,10 @@ class TransformerLM:
 
         def scan_fn(h, layer_in):
             lp, ck, cv = layer_in
-            h, ck, cv = self._layer_cached(h, lp, ck, cv, cos, sin,
-                                           start_pos, max_len)
+            from ..inference.quantization import dequantize_params
+            h, ck, cv = self._layer_cached(h, dequantize_params(lp), ck,
+                                           cv, cos, sin, start_pos,
+                                           max_len)
             return h, (ck, cv)
 
         x, (new_k, new_v) = jax.lax.scan(
