@@ -274,8 +274,11 @@ def serving_7b_fit(out_dir: Optional[str] = None,
     params_f = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
     params_bf16 = jax.tree.map(
         lambda s: jax.ShapeDtypeStruct(s.shape, jnp.bfloat16), params_f)
-    params_q = jax.eval_shape(
+    params_q8 = jax.eval_shape(
         lambda p: quantize_params(p, bits=8)[0], params_bf16)
+    # int4 is omitted: the stack-based unpack materializes a 7B-scale
+    # temp the compiler rejects; int8 is the fits-one-chip headline and
+    # int4 correctness is covered at small scale (serve_pipeline example)
 
     nb = batch * (ctx // block_size) + 1
     MB = ctx // block_size
@@ -291,7 +294,7 @@ def serving_7b_fit(out_dir: Optional[str] = None,
         "batch": batch, "ctx": ctx,
         "kv_pool_blocks": nb, "hbm_bytes_per_chip": int(hbm_bytes),
     }
-    for name, params in (("bf16", params_bf16), ("int8_woq", params_q)):
+    for name, params in (("bf16", params_bf16), ("int8_woq", params_q8)):
         # paged_decode dequantizes WOQ leaves itself: non-layer params at
         # entry, each scanned layer inside the scan body
         def step(p, t, po, b, c, a):
