@@ -39,6 +39,10 @@ class RaggedInferenceEngineConfig:
     # as the v1 engine, inference/quantization.py) — halves/quarters
     # weight HBM, freeing KV-pool headroom
     quant_bits: int = 0
+    # int8 KV-cache pool (~0.53x bf16 bytes -> ~1.9x tokens in the same
+    # HBM): writes quantize per (slot, head), reads dequantize; serves
+    # through the gather path (Pallas decode kernels are bf16-tile)
+    kv_quant: bool = False
     seed: int = 0
 
     @classmethod

@@ -108,17 +108,23 @@ class InferenceEngineV2:
 
         self.state_manager = DSStateManager(sm)
         self.kv_cache = init_paged_kv_cache(cfg, sm.num_blocks,
-                                            sm.block_size, self.dtype)
+                                            sm.block_size, self.dtype,
+                                            kv_quant=config.kv_quant)
         # Pallas kernels only at tp=1: a bare pallas_call is not
         # GSPMD-partitionable, so sharded-param (tp>1) serving keeps the
         # jnp paths, which the partitioner splits over the head axis (same
-        # gate as the v1 decode kernel, models/transformer.py)
+        # gate as the v1 decode kernel, models/transformer.py). kv_quant
+        # additionally disables only the DECODE kernel (it streams bf16
+        # pool tiles; int8 pages + scale tiles would need a variant) —
+        # the flash PREFILL kernel attends over the in-chunk
+        # full-precision q/k/v and never reads the pool, so it stays on
         use_kernel = config.use_paged_kernel and tp == 1 and ep == 1
+        use_kernel_decode = use_kernel and not config.kv_quant
         topo = self.topology if ep > 1 else None
         self._decode_jit = jax.jit(
             lambda p, t, pos, bt, c, a: paged_decode(
                 cfg, p, t, pos, bt, c, a, sm.block_size,
-                use_kernel=use_kernel, topo=topo),
+                use_kernel=use_kernel_decode, topo=topo),
             donate_argnums=(4,))
 
         def _decode_tok(p, t, pos, bt, c, a):
@@ -126,7 +132,8 @@ class InferenceEngineV2:
             # so the per-token host transfer is [N] int32, not [N, vocab]
             # (the reference's sampler also runs device-side)
             logits, c = paged_decode(cfg, p, t, pos, bt, c, a,
-                                     sm.block_size, use_kernel=use_kernel,
+                                     sm.block_size,
+                                     use_kernel=use_kernel_decode,
                                      topo=topo)
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), c
 
@@ -137,7 +144,8 @@ class InferenceEngineV2:
             # runs device-side too, still an [N] int32 host transfer
             from .sampling import sample_tokens
             logits, c = paged_decode(cfg, p, t, pos, bt, c, a,
-                                     sm.block_size, use_kernel=use_kernel,
+                                     sm.block_size,
+                                     use_kernel=use_kernel_decode,
                                      topo=topo)
             return sample_tokens(logits, rng, temp, topp), c
 

@@ -78,3 +78,7 @@ def test_serving_7b_int8_fits_one_v5e():
     q = rec["int8_woq"]
     assert q["fits_hbm"], q
     assert q["peak_gib_per_chip"] < 12.0, q
+    # int8 KV pool: DOUBLE the batch fits in essentially the same bytes
+    kvq = rec["int8_woq_kvq8"]
+    assert kvq["fits_hbm"] and kvq["batch"] == 2 * q["batch"], kvq
+    assert kvq["peak_gib_per_chip"] < 12.0, kvq

@@ -644,3 +644,41 @@ def test_v2_quant_bits_invalid_rejected(tiny_model):
                 max_tracked_sequences=2, max_seq_len=64, num_blocks=9,
                 block_size=16),
             dtype="float32", quant_bits=16), params=params)
+
+
+def test_kv_quant_serving(tiny_model):
+    """int8 KV-cache pool: ~0.53x the bf16 cache bytes, logits close to
+    the bf16-cache engine across prefill + decode + chunked continuation,
+    deterministic generation end-to-end."""
+    model, params = tiny_model
+    e_fp = _v2_engine(model, params)
+    eng = InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_tracked_sequences=4, max_seq_len=128, num_blocks=17,
+                block_size=16),
+            dtype="float32", prefill_bucket=16, kv_quant=True),
+        params=params)
+    # pool bytes: int8 k/v + f32 scales vs f32 cache here; against the
+    # bf16 production dtype the ratio is ~0.53
+    assert eng.kv_cache["k"].dtype == jnp.int8
+    assert "ks" in eng.kv_cache and "vs" in eng.kv_cache
+
+    prompt = list(range(3, 12))
+    lq0 = eng.put([1], [prompt])
+    lf0 = e_fp.put([2], [prompt])
+    np.testing.assert_allclose(lq0, lf0, rtol=0.15, atol=0.2)
+    # decode + chunked continuation read dequantized pages
+    lq1 = eng.put([1], [[40]])
+    lf1 = e_fp.put([2], [[40]])
+    np.testing.assert_allclose(lq1, lf1, rtol=0.15, atol=0.25)
+    lq2 = eng.put([1], [[41, 42, 43]])
+    lf2 = e_fp.put([2], [[41, 42, 43]])
+    np.testing.assert_allclose(lq2, lf2, rtol=0.15, atol=0.3)
+
+    outs = eng.generate([[5, 7, 9], [2, 4]], max_new_tokens=6,
+                        uids=[10, 11])
+    outs2 = eng.generate([[5, 7, 9], [2, 4]], max_new_tokens=6,
+                         uids=[12, 13])
+    for a, b in zip(outs, outs2):
+        np.testing.assert_array_equal(a, b)
