@@ -319,12 +319,37 @@ def main():
         detail["note"] = ("JAX_PLATFORMS requested a TPU but device init "
                           "failed or hung; this is a CPU smoke number, not "
                           "a chip measurement")
+        # a chip window EARLIER in the round may have captured a real
+        # measurement (scripts/chip_probe_loop.sh -> chip_window*.sh);
+        # surface the newest-by-mtime one, labeled with its capture
+        # time so a carried-over file from a previous round is
+        # distinguishable from this round's evidence
+        import glob
+        import pathlib
+        here = pathlib.Path(__file__).parent
+        for cand in sorted(glob.glob(str(here / "BENCH_*_early.json")),
+                           key=os.path.getmtime, reverse=True):
+            try:
+                early = json.load(open(cand))
+                if "TPU" in str(early.get("detail", {}).get(
+                        "device_kind", "")):
+                    detail["latest_chip_capture"] = {
+                        "file": pathlib.Path(cand).name,
+                        "captured_at": time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(
+                                os.path.getmtime(cand))),
+                        "value": early["value"],
+                        "zero3_mfu": early["detail"].get("zero3_mfu"),
+                        "device_kind": early["detail"]["device_kind"],
+                    }
+                    break
+            except Exception:
+                continue
         # the chip-free scale proofs (AOT-compiled against real v5e
         # topologies with the local libtpu compiler; see
         # benchmarks/aot_scale.py) still hold — surface the committed
         # artifact numbers so the record carries the round's perf evidence
-        import pathlib
-        art = pathlib.Path(__file__).parent / "artifacts"
+        art = here / "artifacts"
         try:
             fit = json.load(open(art / "flagship_7b_v5e64.json"))
             detail["aot_7b_v5e64_fit"] = {
