@@ -19,6 +19,10 @@ class DSStateManagerConfig:
     num_blocks: int = 256                    # KV pool size (incl. null block)
     block_size: int = 64                     # tokens per KV block
     memory_reserve_fraction: float = 0.0     # reference memory_config analogue
+    # share full KV blocks across requests with identical token prefixes
+    # (registered at flush, matched at the next arrival, LRU-evicted
+    # under pool pressure) — beyond the reference; see ragged_manager.py
+    enable_prefix_caching: bool = False
 
 
 @dataclass

@@ -181,7 +181,10 @@ class InferenceEngineV2:
     def can_schedule(self, uids: Sequence[int],
                      lengths: Sequence[int]) -> bool:
         total_new = 0
-        free = self.state_manager.free_blocks()
+        # retained prefix blocks are evictable on demand (ensure_blocks
+        # evicts LRU) — counting only free blocks would spuriously
+        # reject requests once the index occupies the pool
+        free = self.state_manager.reclaimable_blocks()
         for uid, n in zip(uids, lengths):
             if not self.state_manager.can_schedule(uid, n):
                 return False
@@ -221,6 +224,8 @@ class InferenceEngineV2:
             self.params, jnp.asarray(ids), jnp.asarray(n), self.kv_cache,
             jnp.asarray(table), jnp.asarray(offs))
         seq.seen_tokens = n
+        if sm.config.enable_prefix_caching:
+            seq.token_log.extend(map(int, tokens))
         return np.asarray(logits)
 
     def _continue(self, uid: int, tokens: np.ndarray) -> np.ndarray:
@@ -246,6 +251,8 @@ class InferenceEngineV2:
             jnp.asarray(n), self.kv_cache, jnp.asarray(table),
             jnp.asarray(offs), jnp.asarray(full_table))
         seq.seen_tokens = start + n
+        if sm.config.enable_prefix_caching:
+            seq.token_log.extend(map(int, tokens))
         return np.asarray(logits)
 
     @staticmethod
@@ -296,9 +303,13 @@ class InferenceEngineV2:
         vals, self.kv_cache = jit_fn(
             self.params, toks, pos, tables, self.kv_cache, active)
         vals = np.asarray(vals)
+        log_tokens = sm.config.enable_prefix_caching
         out = {}
         for i, uid in enumerate(uids):
-            sm.seqs[uid].seen_tokens += 1
+            seq = sm.seqs[uid]
+            seq.seen_tokens += 1
+            if log_tokens:
+                seq.token_log.append(int(tokens[i]))
             out[uid] = extract(vals, i)
         return out
 
@@ -342,7 +353,14 @@ class InferenceEngineV2:
         results: Dict[int, np.ndarray] = {}
         decode_uids: List[int] = []
         decode_toks: List[int] = []
-        for uid, toks in entries:
+        for i, (uid, toks) in enumerate(entries):
+            if not sm.known_seq(uid) and len(toks) > 1:
+                # prefix caching: shared full blocks make this uid a
+                # KNOWN sequence whose suffix continues below
+                _, n_reused = sm.match_prefix(uid, toks)
+                if n_reused:
+                    toks = toks[n_reused:]
+                    entries[i] = (uid, toks)
             known = sm.known_seq(uid) and sm.seqs[uid].seen_tokens > 0
             if not known and len(toks) >= 1:
                 results[uid] = self._prefill(uid, toks)

@@ -4,9 +4,20 @@ Reference: inference/v2/ragged/ragged_manager.py:19 (DSStateManager): owns
 the block allocator and the per-sequence descriptors, answers schedulability
 questions, and materializes the per-step block tables the device program
 consumes.
+
+Prefix caching (``enable_prefix_caching``, beyond the reference): KV
+depends only on the causal token prefix, so FULL blocks whose token
+content matches a previously-served prefix are shared instead of
+recomputed. Blocks are registered into a chain-hash index at flush time
+(holding their own reference so they survive the sequence), matched on
+the next arrival, and evicted LRU when the pool needs space. Only
+block-aligned prefixes share, so shared blocks are never written again —
+no copy-on-write is ever needed.
 """
 
-from typing import Dict, Optional
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -22,6 +33,89 @@ class DSStateManager:
         self.allocator = BlockedAllocator(config.num_blocks)
         self.seqs: Dict[int, DSSequenceDescriptor] = {}
         self.max_blocks_per_seq = -(-config.max_seq_len // self.block_size)
+        # chain-hash digest -> retained block id (insertion-ordered: LRU
+        # eviction pops from the front)
+        self._prefix: "OrderedDict[bytes, int]" = OrderedDict()
+
+    # -- prefix caching -----------------------------------------------------
+    @staticmethod
+    def _chain(digest: bytes, tokens) -> bytes:
+        return hashlib.sha1(
+            digest + np.asarray(tokens, np.int32).tobytes()).digest()
+
+    def match_prefix(self, uid: int,
+                     tokens: np.ndarray) -> Tuple[List[int], int]:
+        """Longest retained block-aligned prefix of ``tokens`` (capped one
+        token short so the model still produces last-token logits).
+        Registers ``uid`` with the shared blocks; returns (blocks,
+        n_reused_tokens) — (…, 0) when nothing matches."""
+        if not self.config.enable_prefix_caching or uid in self.seqs:
+            return [], 0
+        bs = self.block_size
+        usable = ((len(tokens) - 1) // bs) * bs
+        blocks: List[int] = []
+        digest = b"prefix"
+        n = 0
+        while n + bs <= usable:
+            digest = self._chain(digest, tokens[n:n + bs])
+            blk = self._prefix.get(digest)
+            if blk is None:
+                break
+            blocks.append(blk)
+            self._prefix.move_to_end(digest)   # LRU touch
+            n += bs
+        if not n:
+            return [], 0
+        seq = self.get_or_create_sequence(uid)
+        for b in blocks:
+            self.allocator.share(b)
+        seq.blocks = list(blocks)
+        seq.seen_tokens = n
+        seq.token_log = list(map(int, tokens[:n]))
+        return blocks, n
+
+    def _register_prefix(self, seq: DSSequenceDescriptor) -> None:
+        """Index the sequence's full blocks at flush so the NEXT arrival
+        with the same prefix reuses them (the index holds its own block
+        references — retained blocks survive the flush)."""
+        bs = self.block_size
+        digest = b"prefix"
+        full = min(len(seq.token_log) // bs, len(seq.blocks))
+        for i in range(full):
+            digest = self._chain(digest, seq.token_log[i * bs:(i + 1) * bs])
+            if digest not in self._prefix:
+                self._prefix[digest] = int(seq.blocks[i])
+                self.allocator.share(seq.blocks[i])
+
+    def _evictable(self) -> int:
+        """Retained blocks held ONLY by the index (reclaimable now).
+        Memoized against the allocator's version stamp: decode steps that
+        allocate nothing reuse the cached count (the scan is O(index))."""
+        ver = self.allocator.version
+        if getattr(self, "_evictable_ver", None) != ver:
+            self._evictable_val = sum(
+                1 for b in self._prefix.values()
+                if self.allocator.refcount(b) == 1)
+            self._evictable_ver = ver
+        return self._evictable_val
+
+    def _evict_retained(self, need: int) -> None:
+        """Free LRU index entries whose blocks the index alone holds
+        until ``need`` blocks are free. Entries shared with live
+        sequences are skipped — popping them reclaims nothing and only
+        churns hot prefixes out of the cache."""
+        while self.allocator.free_blocks < need:
+            victim = next((d for d, b in self._prefix.items()
+                           if self.allocator.refcount(b) == 1), None)
+            if victim is None:
+                return
+            blk = self._prefix.pop(victim)
+            self.allocator.free([blk])
+
+    def reclaimable_blocks(self) -> int:
+        """Free blocks plus what eviction could free right now — the
+        number schedulability checks should compare against."""
+        return self.allocator.free_blocks + self._evictable()
 
     # -- queries (reference DSStateManager.query / engine can_schedule) ----
     def known_seq(self, uid: int) -> bool:
@@ -44,20 +138,25 @@ class DSStateManager:
                 len(self.seqs) >= self.config.max_tracked_sequences:
             return False
         return seq.blocks_needed(new_tokens, self.block_size) \
-            <= self.allocator.free_blocks
+            <= self.allocator.free_blocks + self._evictable()
 
     # -- allocation ---------------------------------------------------------
     def ensure_blocks(self, uid: int, new_tokens: int) -> DSSequenceDescriptor:
         seq = self.get_or_create_sequence(uid)
         need = seq.blocks_needed(new_tokens, self.block_size)
         if need:
+            if need > self.allocator.free_blocks:
+                self._evict_retained(need)
             seq.blocks.extend(int(b) for b in self.allocator.allocate(need))
         return seq
 
     def flush_sequence(self, uid: int) -> None:
-        """Reference flush: return the sequence's blocks to the pool."""
+        """Reference flush: return the sequence's blocks to the pool
+        (prefix caching first indexes the full blocks for reuse)."""
         seq = self.seqs.pop(uid, None)
         if seq is not None:
+            if self.config.enable_prefix_caching:
+                self._register_prefix(seq)
             self.allocator.free(seq.blocks)
 
     # -- device metadata ----------------------------------------------------

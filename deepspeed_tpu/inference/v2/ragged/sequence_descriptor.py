@@ -15,6 +15,9 @@ class DSSequenceDescriptor:
     seen_tokens: int = 0            # tokens whose KV is in the cache
     blocks: List[int] = field(default_factory=list)
     in_flight_tokens: int = 0       # tokens scheduled in the current batch
+    # token content in cache order — what prefix caching indexes at flush
+    # (appended by the engine's prefill/continue/decode paths)
+    token_log: List[int] = field(default_factory=list)
 
     def blocks_needed(self, new_tokens: int, block_size: int) -> int:
         total = self.seen_tokens + new_tokens

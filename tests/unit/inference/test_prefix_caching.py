@@ -1,0 +1,171 @@
+"""Prefix caching: shared full KV blocks across requests with identical
+token prefixes (beyond the reference — its blocked KV recomputes every
+prompt). Correctness hinges on causality: a block's KV depends only on
+the tokens before it, so block-aligned sharing is EXACT (bitwise-equal
+logits), not approximate."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig(vocab_size=128, hidden_size=64,
+                            intermediate_size=128, num_layers=2,
+                            num_heads=4, num_kv_heads=2, max_seq_len=256,
+                            remat=False, use_flash=False)
+    model = TransformerLM(cfg)
+    params = jax.tree.map(lambda x: x.astype(jnp.float32),
+                          model.init_params(jax.random.PRNGKey(0)))
+    return model, params
+
+
+def _engine(model, params, prefix=True, num_blocks=65):
+    return InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_tracked_sequences=8, max_seq_len=256,
+                num_blocks=num_blocks, block_size=16,
+                enable_prefix_caching=prefix),
+            dtype="float32", prefill_bucket=16), params=params)
+
+
+def test_prefix_reuse_after_flush_exact(tiny):
+    """Serve prompt P, flush, serve P again: the second request reuses
+    the retained blocks (prefill is SKIPPED for the shared prefix) and
+    produces exactly the same logits/tokens as a cache-less engine."""
+    model, params = tiny
+    rng = np.random.default_rng(0)
+    prompt = list(map(int, rng.integers(1, 127, 50)))   # 3 full blocks + 2
+
+    ref = _engine(model, params, prefix=False).generate(
+        [prompt], max_new_tokens=6)[0]
+
+    eng = _engine(model, params)
+    out1 = eng.generate([prompt], max_new_tokens=6, uids=[1])[0]
+    np.testing.assert_array_equal(out1, ref)
+    sm = eng.state_manager
+    assert len(sm._prefix) >= 3          # prompt blocks retained at flush
+
+    calls = {"prefill": 0, "continue": 0}
+    orig_p, orig_c = eng._prefill, eng._continue
+    eng._prefill = lambda *a: calls.__setitem__(
+        "prefill", calls["prefill"] + 1) or orig_p(*a)
+    eng._continue = lambda *a: calls.__setitem__(
+        "continue", calls["continue"] + 1) or orig_c(*a)
+    out2 = eng.generate([prompt], max_new_tokens=6, uids=[2])[0]
+    np.testing.assert_array_equal(out2, ref)
+    # 48 of 50 prompt tokens rode the retained blocks: no prefill ran,
+    # the 2-token suffix went through one fused continuation
+    assert calls == {"prefill": 0, "continue": 1}
+
+
+def test_prefix_includes_generated_tokens(tiny):
+    """The retained prefix covers generated tokens too: re-serving
+    prompt+generated as the new prompt reuses those blocks."""
+    model, params = tiny
+    eng = _engine(model, params)
+    prompt = list(range(1, 30))
+    out = eng.generate([prompt], max_new_tokens=8, uids=[1])[0]  # 37 toks
+    extended = list(map(int, out)) + [5, 7, 9]
+    _, n = eng.state_manager.match_prefix(99, np.asarray(extended))
+    assert n == 32                        # 2 full blocks of prompt+gen
+    eng.flush(99)
+
+
+def test_partial_overlap_shares_common_blocks_only(tiny):
+    model, params = tiny
+    eng = _engine(model, params)
+    a = list(range(1, 41))                               # 40 tokens
+    b = a[:32] + [99, 98, 97, 96, 95]                    # diverges at 32
+    ref = _engine(model, params, prefix=False).generate(
+        [b], max_new_tokens=4)[0]
+    eng.generate([a], max_new_tokens=4, uids=[1])
+    _, n = eng.state_manager.match_prefix(50, np.asarray(b))
+    eng.state_manager.flush_sequence(50)
+    assert n == 32                        # only the common full blocks
+    out = eng.generate([b], max_new_tokens=4, uids=[2])[0]
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_eviction_under_pool_pressure(tiny):
+    """Retained blocks are reclaimed LRU when a new request needs the
+    space; serving keeps working and stays correct."""
+    model, params = tiny
+    eng = _engine(model, params, num_blocks=9)           # 8 usable
+    p1 = list(range(1, 40))                              # 3 blocks
+    eng.generate([p1], max_new_tokens=3, uids=[1])
+    assert len(eng.state_manager._prefix) >= 2           # retained
+    p2 = list(range(50, 120))                            # 5 blocks: evicts
+    ref = _engine(model, params, prefix=False).generate(
+        [p2], max_new_tokens=3)[0]
+    out = eng.generate([p2], max_new_tokens=3, uids=[2])[0]
+    np.testing.assert_array_equal(out, ref)
+    # pool integrity: after flushes everything is reclaimable again
+    eng.state_manager._evict_retained(8)
+    assert eng.state_manager.free_blocks() == 8
+
+
+def test_refcounted_allocator():
+    from deepspeed_tpu.inference.v2.ragged.blocked_allocator import \
+        BlockedAllocator
+    al = BlockedAllocator(5)
+    blocks = al.allocate(2)
+    al.share(blocks[0])
+    assert al.refcount(blocks[0]) == 2
+    al.free(blocks)                      # drops one ref each
+    assert al.refcount(blocks[0]) == 1 and al.refcount(blocks[1]) == 0
+    assert al.free_blocks == 3
+    al.free([blocks[0]])
+    assert al.free_blocks == 4
+    with pytest.raises(ValueError, match="double free"):
+        al.free([blocks[0]])
+    with pytest.raises(ValueError, match="unallocated"):
+        al.share(blocks[1])
+
+
+def test_can_schedule_counts_evictable_retained_blocks(tiny):
+    """A pool occupied by retained prefix blocks must not reject new
+    requests: can_schedule counts evictable blocks and ensure_blocks
+    evicts LRU on demand (review r05: the cache was self-defeating under
+    pressure)."""
+    model, params = tiny
+    eng = _engine(model, params, num_blocks=9)           # 8 usable
+    for i, base in enumerate((1, 60)):
+        eng.generate([list(range(base, base + 40))], max_new_tokens=3,
+                     uids=[i])
+    sm = eng.state_manager
+    assert sm.free_blocks() < 5 <= sm.reclaimable_blocks()
+    big = list(range(1, 70))                             # needs 5 blocks
+    assert eng.can_schedule([7], [len(big)])
+    ref = _engine(model, params, prefix=False).generate(
+        [big], max_new_tokens=3)[0]
+    out = eng.generate([big], max_new_tokens=3, uids=[7])[0]
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_eviction_skips_blocks_shared_with_live_sequences(tiny):
+    """LRU eviction only pops index entries whose block the index alone
+    holds — destroying a hot shared prefix reclaims nothing."""
+    model, params = tiny
+    eng = _engine(model, params, num_blocks=9)
+    p_hot = list(range(1, 20))                           # 1 full block
+    eng.generate([p_hot], max_new_tokens=3, uids=[1])    # retained
+    # a LIVE sequence now shares the hot block
+    logits = eng.put([2], [p_hot])
+    assert logits.shape[0] == 1
+    hot_entries = dict(eng.state_manager._prefix)
+    eng.state_manager._evict_retained(8)                 # heavy pressure
+    # the shared entry survived; only index-only entries were evicted
+    shared = [d for d, b in hot_entries.items()
+              if eng.state_manager.allocator.refcount(b) >= 2]
+    assert all(d in eng.state_manager._prefix for d in shared)
+    eng.flush(2)
