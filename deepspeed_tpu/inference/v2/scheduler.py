@@ -156,6 +156,17 @@ class DynamicSplitFuseScheduler:
                 if (sm.tracked_sequences() + new_admitted
                         >= sm.config.max_tracked_sequences):
                     break  # sequence slots full: wait for a finish
+                # prefix caching must match against the FULL prompt here:
+                # put() only ever sees one chunk (<= self.chunk tokens),
+                # which would cap reuse at a chunk's worth
+                _, n_reused = sm.match_prefix(
+                    req.uid, np.asarray(req.prompt, np.int64))
+                if n_reused:
+                    # match_prefix registered the uid in sm.seqs, so
+                    # tracked_sequences() already counts it — no
+                    # new_admitted increment (that compensates only for
+                    # sequences created later inside put())
+                    req.prefill_sent = n_reused
             left = len(req.prompt) - req.prefill_sent
             take = min(left, budget, max(self.chunk, 1))
             piece = req.prompt[req.prefill_sent:req.prefill_sent + take]

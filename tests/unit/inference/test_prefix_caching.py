@@ -169,3 +169,37 @@ def test_eviction_skips_blocks_shared_with_live_sequences(tiny):
               if eng.state_manager.allocator.refcount(b) >= 2]
     assert all(d in eng.state_manager._prefix for d in shared)
     eng.flush(2)
+
+
+def test_splitfuse_scheduler_reuses_prefix(tiny):
+    """Under the SplitFuse scheduler, prefix matching runs against the
+    FULL prompt at admission (put() only ever sees one chunk): a repeated
+    prompt skips its shared blocks' prefill chunks entirely."""
+    from deepspeed_tpu.inference.v2.scheduler import \
+        DynamicSplitFuseScheduler
+    model, params = tiny
+    rng = np.random.default_rng(3)
+    prompt = list(map(int, rng.integers(1, 127, 50)))    # 3 full blocks
+
+    eng = _engine(model, params)
+    s1 = DynamicSplitFuseScheduler(eng, token_budget=32, chunk=16)
+    s1.submit(1, prompt, max_new_tokens=5)
+    s1.run()
+    ref = s1.results()[1]
+
+    sizes = []
+    orig_put = eng.put
+
+    def spy(uids, toks):
+        sizes.append(sum(len(t) for t in toks))
+        return orig_put(uids, toks)
+
+    eng.put = spy
+    s2 = DynamicSplitFuseScheduler(eng, token_budget=32, chunk=16)
+    s2.submit(2, prompt, max_new_tokens=5)
+    s2.run()
+    eng.put = orig_put
+    np.testing.assert_array_equal(s2.results()[2], ref)
+    # 48 of 50 prompt tokens rode retained blocks: total prefill work
+    # scheduled is just the 2-token suffix (+ decode steps of 1)
+    assert sum(sizes) <= 2 + 5
