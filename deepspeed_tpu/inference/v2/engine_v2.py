@@ -160,6 +160,21 @@ class InferenceEngineV2:
             lambda p, ids, s, n, c, b, o, t: paged_continue(
                 cfg, p, ids, s, n, c, b, o, t, sm.block_size, topo=topo),
             donate_argnums=(4,))
+        # speculative verification: greedy ids for a static window of
+        # fed positions from one fused continuation pass (prompt-lookup
+        # decoding); one compiled program per window size
+        self._continue_spec_jits: Dict[int, object] = {}
+
+        def _spec_jit(window: int):
+            if window not in self._continue_spec_jits:
+                self._continue_spec_jits[window] = jax.jit(
+                    lambda p, ids, s, n, c, b, o, t: paged_continue(
+                        cfg, p, ids, s, n, c, b, o, t, sm.block_size,
+                        topo=topo, greedy_window=window),
+                    donate_argnums=(4,))
+            return self._continue_spec_jits[window]
+
+        self._spec_jit = _spec_jit
         log_dist(
             f"ragged inference engine: blocks={sm.num_blocks}x"
             f"{sm.block_size} max_seqs={sm.max_tracked_sequences} tp={tp}"
@@ -228,9 +243,13 @@ class InferenceEngineV2:
             seq.token_log.extend(map(int, tokens))
         return np.asarray(logits)
 
-    def _continue(self, uid: int, tokens: np.ndarray) -> np.ndarray:
+    def _continue(self, uid: int, tokens: np.ndarray,
+                  all_logits: int = 0) -> np.ndarray:
         """Multi-token continuation in ONE compiled pass (replaces the
-        token-at-a-time decode loop; reference chunked prefill)."""
+        token-at-a-time decode loop; reference chunked prefill).
+        ``all_logits`` > 0 returns greedy ids for that many leading fed
+        positions (speculative verification, device-side argmax, [w]
+        int32 to host) instead of the last token's [V] logits."""
         sm = self.state_manager
         n = len(tokens)
         seq = sm.ensure_blocks(uid, n)
@@ -246,7 +265,9 @@ class InferenceEngineV2:
         seq_blocks = np.asarray(seq.blocks, np.int32)
         table[valid] = seq_blocks[block_idx[valid]]
         full_table = sm.block_table_for(uid)
-        logits, self.kv_cache = self._continue_jit(
+        jit_fn = (self._spec_jit(all_logits) if all_logits
+                  else self._continue_jit)
+        logits, self.kv_cache = jit_fn(
             self.params, jnp.asarray(ids), jnp.asarray(start),
             jnp.asarray(n), self.kv_cache, jnp.asarray(table),
             jnp.asarray(offs), jnp.asarray(full_table))
@@ -254,6 +275,122 @@ class InferenceEngineV2:
         if sm.config.enable_prefix_caching:
             seq.token_log.extend(map(int, tokens))
         return np.asarray(logits)
+
+    # -- speculative decoding (prompt-lookup) ---------------------------
+    _SPEC_SCAN_WINDOW = 512   # bound the per-round host scan (the scan
+    # is O(window); an unbounded history would make draft lookup
+    # quadratic over a long generation)
+
+    @staticmethod
+    def _lookup_draft(history: List[int], k: int, ngram: int) -> List[int]:
+        """Draft the k tokens that followed the most recent earlier
+        occurrence of the history's trailing n-gram (prompt-lookup
+        decoding: the sequence's own text is the draft model). Scans at
+        most the last _SPEC_SCAN_WINDOW tokens."""
+        W = InferenceEngineV2._SPEC_SCAN_WINDOW
+        base = max(0, len(history) - W)
+        win = history[base:]
+        for n in range(ngram, 1, -1):
+            if len(win) <= n:
+                continue
+            tail = win[-n:]
+            # scan right-to-left for the most recent earlier match
+            for i in range(len(win) - n - 1, -1, -1):
+                if win[i:i + n] == tail:
+                    start = base + i + n
+                    draft = history[start:start + k]
+                    if draft:
+                        return list(draft)
+        return []
+
+    def _speculative_step(self, uid: int, cur: int,
+                          draft: List[int]) -> List[int]:
+        """Feed [cur] + draft through one fused continuation, accept the
+        longest greedily-verified draft prefix, roll the cache position
+        back over rejected tokens, and return the emitted tokens
+        (1 + accepted; the last emitted token is NOT yet in the cache —
+        same invariant as the normal decode loop).
+
+        Rollback is a host-side counter reset: attention masks by
+        position (ctx_pos <= pos), so the rejected tokens' stale KV
+        slots are never attended and the next write overwrites them."""
+        sm = self.state_manager
+        seq = sm.seqs[uid]
+        fed = [int(cur)] + list(map(int, draft))
+        start = seq.seen_tokens
+        greedy = self._continue(uid, np.asarray(fed, np.int64),
+                                all_logits=len(fed))
+        emitted = [int(greedy[0])]
+        accepted = 0
+        for j, d in enumerate(draft):
+            if int(d) != emitted[-1]:
+                break
+            accepted += 1
+            emitted.append(int(greedy[j + 1]))
+        # rewind over the rejected fed tokens (cur + accepted stay)
+        seq.seen_tokens = start + 1 + accepted
+        if sm.config.enable_prefix_caching:
+            rejected = len(fed) - 1 - accepted
+            if rejected:
+                del seq.token_log[-rejected:]
+        return emitted
+
+    def _speculative_round(self, step_uids, outs, row_of, prompt_lens,
+                           live, max_new_tokens, eos_token_id,
+                           spec_k, spec_ngram) -> Dict[int, int]:
+        """One greedy round with prompt-lookup speculation: per uid,
+        draft from its own history and verify in one fused pass. The
+        accepted extras append to ``outs`` here (with per-token
+        eos/budget checks); the final emitted token becomes the round's
+        ``cur`` — the last-token-never-fed invariant the plain loop
+        keeps. Sequences without a usable draft fall back to the normal
+        batched greedy decode."""
+        cur: Dict[int, int] = {}
+        plain_uids: List[int] = []
+        sm = self.state_manager
+        if not hasattr(self, "_spec_miss_streak"):
+            self._spec_miss_streak: Dict[int, int] = {}
+        for uid in step_uids:
+            row = outs[row_of[uid]]
+            remaining = max_new_tokens - (len(row) - prompt_lens[uid])
+            # draft length budget: the generation budget, the sequence
+            # length limit (1+k fed tokens must fit max_seq_len — the
+            # loop's guard only covered 1), and a cold-streak cutoff
+            # (natural text with recurring n-grams but divergent
+            # continuations would otherwise pay a rejected verify pass
+            # every round, slower than plain batched greedy)
+            seq_room = sm.config.max_seq_len - sm.seqs[uid].seen_tokens - 1
+            k = min(spec_k, remaining - 1, seq_room)
+            draft = (self._lookup_draft(row, k, spec_ngram)
+                     if (k > 0
+                         and self._spec_miss_streak.get(uid, 0) < 3)
+                     else [])
+            if draft and not self.can_schedule([uid], [1 + len(draft)]):
+                draft = []
+            if not draft:
+                plain_uids.append(uid)
+                continue
+            emitted = self._speculative_step(uid, row[-1], draft)
+            if len(emitted) == 1:
+                self._spec_miss_streak[uid] = \
+                    self._spec_miss_streak.get(uid, 0) + 1
+            else:
+                self._spec_miss_streak[uid] = 0
+            finished = False
+            for tok in emitted[:-1]:
+                row.append(tok)
+                if ((eos_token_id is not None and tok == eos_token_id)
+                        or len(row) - prompt_lens[uid] >= max_new_tokens):
+                    finished = True
+                    break
+            if finished:
+                live.discard(uid)
+            else:
+                cur[uid] = emitted[-1]
+        if plain_uids:
+            cur.update(self._decode_batch_greedy(
+                plain_uids, [outs[row_of[u]][-1] for u in plain_uids]))
+        return cur
 
     @staticmethod
     def _pow2_bucket(count: int, cap: int) -> int:
@@ -389,13 +526,22 @@ class InferenceEngineV2:
                  uids: Optional[Sequence[int]] = None,
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_p: float = 1.0,
-                 seed: int = 0) -> List[np.ndarray]:
+                 seed: int = 0, speculative: bool = False, spec_k: int = 4,
+                 spec_ngram: int = 3) -> List[np.ndarray]:
         """Greedy by default; temperature > 0 samples with nucleus top_p
-        (FastGen's sampling surface), deterministic for a given seed."""
+        (FastGen's sampling surface), deterministic for a given seed.
+        ``speculative`` turns on prompt-lookup decoding (greedy only):
+        each sequence drafts spec_k tokens from its own history's last
+        matching spec_ngram-gram and verifies them in ONE fused
+        continuation pass — output is IDENTICAL to plain greedy, steps
+        shrink when the text repeats itself (quotes, code, JSON)."""
         uids = list(uids) if uids is not None else list(range(len(prompts)))
         outs: List[List[int]] = [list(map(int, p)) for p in prompts]
         row_of = {uid: i for i, uid in enumerate(uids)}
         sampling = temperature > 0.0
+        assert not (speculative and sampling), \
+            "speculative decoding is greedy-only (draft verification " \
+            "compares argmax)"
         base_rng = jax.random.PRNGKey(seed) if sampling else None
         # prompts go through put() (prefill); the continuation loop then
         # stays in token space — argmax/sampler runs on device and only
@@ -414,18 +560,25 @@ class InferenceEngineV2:
                 cur = {uid: int(t) for uid, t in
                        zip(uids, np.argmax(logits, axis=-1))}
             live = set(uids)
+            prompt_lens = {uid: len(prompts[row_of[uid]]) for uid in uids}
             for step in range(max_new_tokens):
                 step_uids = []
                 for uid in uids:
                     if uid not in live:
                         continue
                     tok = cur[uid]
-                    outs[row_of[uid]].append(tok)
-                    if eos_token_id is not None and tok == eos_token_id:
+                    row = outs[row_of[uid]]
+                    row.append(tok)
+                    # per-uid budget (not the step counter): speculative
+                    # rounds emit several tokens, so sequences finish at
+                    # different steps
+                    if ((eos_token_id is not None and tok == eos_token_id)
+                            or len(row) - prompt_lens[uid]
+                            >= max_new_tokens):
                         live.discard(uid)
                     else:
                         step_uids.append(uid)
-                if not step_uids or step == max_new_tokens - 1:
+                if not step_uids:
                     break
                 # same guard put() applies: generating past max_seq_len
                 # (or a drained block pool) must raise, not silently
@@ -443,6 +596,10 @@ class InferenceEngineV2:
                         step_uids, feed,
                         jax.random.fold_in(base_rng, step + 1),
                         temperature, top_p)
+                elif speculative:
+                    cur = self._speculative_round(
+                        step_uids, outs, row_of, prompt_lens, live,
+                        max_new_tokens, eos_token_id, spec_k, spec_ngram)
                 else:
                     cur = self._decode_batch_greedy(step_uids, feed)
         finally:

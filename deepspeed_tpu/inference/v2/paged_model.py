@@ -301,7 +301,8 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
                    start_pos: jnp.ndarray, n_new: jnp.ndarray,
                    cache: Dict[str, jnp.ndarray], block_ids: jnp.ndarray,
                    offsets: jnp.ndarray, block_table: jnp.ndarray,
-                   block_size: int, topo=None
+                   block_size: int, topo=None,
+                   greedy_window: int = 0
                    ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Multi-token continuation of ONE existing sequence in a single pass
     (the reference's chunked prefill over ragged atoms,
@@ -366,6 +367,14 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
                    cache.get("ks"), cache.get("vs")),
         (params["layers"], jnp.arange(cfg.num_layers)))
     x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
+    if greedy_window:
+        # speculative verification: greedy token ids for the first
+        # ``greedy_window`` fed positions — the projection runs on the
+        # sliced window (not the padded bucket) and only [window] int32
+        # crosses to host, keeping the decode loop's transfer discipline
+        ids_out = jnp.argmax(_logits(cfg, params, x[:greedy_window]),
+                             axis=-1).astype(jnp.int32)
+        return ids_out, _cache_dict(kc, vc, ksc, vsc)
     last = jnp.take(x, n_new - 1, axis=0)
     return _logits(cfg, params, last), _cache_dict(kc, vc, ksc, vsc)
 
