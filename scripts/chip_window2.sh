@@ -6,6 +6,7 @@
 #   artifacts/r05/serving_profile.json    decode-step cost breakdown
 #   artifacts/r05/serving2.json           serving bench w/ DMA kernel +
 #                                         sliced decode tables
+#   artifacts/r05/spec_bench.json         speculative vs plain greedy
 #   artifacts/r05/mfu_hunt.json           extended MFU ladder
 # Run when a TPU probe succeeds:  bash scripts/chip_window2.sh
 set -u
@@ -35,12 +36,13 @@ timeout 600 python -m deepspeed_tpu.benchmarks.load_bench --requests 48 \
     --rate 16 > /tmp/load_bench.out 2>/dev/null \
     && tail -n 1 /tmp/load_bench.out > artifacts/r05/load_splitfuse.json \
     || echo "load_bench failed"
+timeout 420 python scripts/spec_bench.py || echo "spec_bench failed"
 timeout 1200 python scripts/mfu_hunt.py --steps 8 --budget 900 \
     || echo "mfu_hunt failed"
 
 for path in BENCH_r05b_early.json artifacts/r05; do
     [ -e "$path" ] && git add -f "$path"
 done
-git commit -m "Chip-window 2 evidence (r05): paged DMA kernel, serving profile, bench re-run, MFU hunt" \
+git commit -m "Chip-window 2 evidence (r05): paged DMA kernel, serving profile, bench re-run, speculative timing, MFU hunt" \
     || echo "nothing to commit"
 echo "== done =="
