@@ -46,6 +46,10 @@ def _llama_family_config(hf_config, **extra) -> TransformerConfig:
     # the window full attention is IDENTICAL, so cap the sequence length
     # there rather than silently diverging from HF beyond it
     window = getattr(hf_config, "sliding_window", None)
+    # Qwen2 carries sliding_window in its config but only APPLIES it when
+    # use_sliding_window is set (HF default False -> full attention)
+    if not getattr(hf_config, "use_sliding_window", True):
+        window = None
     if window is not None and window < max_seq:
         logger.warning(
             f"sliding_window={window} < max_position_embeddings={max_seq}: "
@@ -65,7 +69,8 @@ def _llama_family_config(hf_config, **extra) -> TransformerConfig:
         activation="swiglu", positional="rope",
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
         tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
-        attn_bias=getattr(hf_config, "attention_bias", False),
+        attn_bias=extra.pop(
+            "attn_bias", getattr(hf_config, "attention_bias", False)),
         **extra,
     )
 
@@ -82,6 +87,11 @@ def config_from_hf(hf_config) -> TransformerConfig:
             moe_top_k=hf_config.num_experts_per_tok)
     if mt in ("llama", "mistral"):
         return _llama_family_config(hf_config)
+    if mt == "qwen2":
+        # Qwen2: Llama geometry with q/k/v biases and NO o_proj bias
+        # (Qwen2Config hardcodes the split rather than exposing
+        # attention_bias); the missing o bias maps to zeros — exact
+        return _llama_family_config(hf_config, attn_bias=True)
     if mt == "gpt2":
         return TransformerConfig(
             vocab_size=hf_config.vocab_size,
@@ -207,8 +217,8 @@ def config_from_hf(hf_config) -> TransformerConfig:
         )
     raise ValueError(
         f"unsupported model_type '{mt}'; supported: llama, mistral, "
-        f"mixtral, gpt2, opt, bert, roberta, distilbert (add a mapping "
-        f"here the way the reference adds policy containers)")
+        f"mixtral, qwen2, gpt2, opt, bert, roberta, distilbert (add a "
+        f"mapping here the way the reference adds policy containers)")
 
 
 # ---------------------------------------------------------------------------
@@ -237,7 +247,12 @@ def _llama_family_attn_layers(sd, cfg: TransformerConfig,
         layers["b_q"] = _stack(sd, p + "self_attn.q_proj.bias", L)
         layers["b_k"] = _stack(sd, p + "self_attn.k_proj.bias", L)
         layers["b_v"] = _stack(sd, p + "self_attn.v_proj.bias", L)
-        layers["b_o"] = _stack(sd, p + "self_attn.o_proj.bias", L)
+        if (p + "self_attn.o_proj.bias").format(0) in sd:
+            layers["b_o"] = _stack(sd, p + "self_attn.o_proj.bias", L)
+        else:
+            # Qwen2-style qkv-only bias: a missing o bias IS zero
+            layers["b_o"] = np.zeros(
+                (L, layers["wo"].shape[-1]), np.float32)
     return layers
 
 
@@ -543,7 +558,7 @@ def params_from_hf(state_dict: Dict[str, Any],
     """Convert an HF state dict (torch tensors or numpy) to the TransformerLM
     parameter tree (fp32 host arrays; the engine casts/shards on load)."""
     sd = {k: _np(v) for k, v in state_dict.items()}
-    if model_type in ("llama", "mistral"):
+    if model_type in ("llama", "mistral", "qwen2"):
         return _params_from_llama(sd, cfg)
     if model_type == "mixtral":
         return _params_from_mixtral(sd, cfg)

@@ -50,17 +50,17 @@ def main():
         eng.generate(workloads["periodic"], max_new_tokens=64,
                      speculative=spec)
     reps = 3
-    uid = [100]
+    uid = 100
     for name, prompts in workloads.items():
         times = {}
         outs = {}
         for spec in (False, True):
             t0 = time.perf_counter()
             for _ in range(reps):
-                uid[0] += len(prompts)
+                uid += len(prompts)
                 outs[spec] = eng.generate(
                     prompts, max_new_tokens=64, speculative=spec,
-                    uids=list(range(uid[0], uid[0] + len(prompts))))
+                    uids=list(range(uid, uid + len(prompts))))
             times[spec] = (time.perf_counter() - t0) / reps
         assert all((a == b).all()
                    for a, b in zip(outs[False], outs[True])), \

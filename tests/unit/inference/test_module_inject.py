@@ -310,3 +310,57 @@ def test_init_inference_hf_v1_entry():
         ref = hf.generate(torch.tensor([[3, 5, 7]]), max_new_tokens=4,
                           do_sample=False)
     np.testing.assert_array_equal(out, ref.numpy())
+
+
+def test_qwen2_injection_matches_hf():
+    """Qwen2: Llama geometry with q/k/v biases and NO o_proj bias."""
+    cfg = transformers.Qwen2Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(7)
+    hf = transformers.Qwen2ForCausalLM(cfg).eval()
+    _randomize_biases(hf, seed=7)
+    ids = np.random.default_rng(7).integers(0, 96, (2, 11), dtype=np.int64)
+    _assert_logits_match(hf, ids)
+
+
+def test_qwen2_serves_through_v2(tmp_path):
+    """Qwen2 end-to-end: init_inference(use_ragged=True) greedy tokens
+    match HF generate."""
+    cfg = transformers.Qwen2Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=False)
+    torch.manual_seed(8)
+    hf = transformers.Qwen2ForCausalLM(cfg).eval()
+    import deepspeed_tpu
+    eng = deepspeed_tpu.init_inference(
+        hf, config={"use_ragged": True, "dtype": "float32",
+                    "ragged": {"state_manager": {
+                        "max_tracked_sequences": 2, "max_seq_len": 64,
+                        "num_blocks": 9, "block_size": 16}}})
+    prompt = [3, 5, 7, 9, 11]
+    ours = eng.generate([prompt], max_new_tokens=8)[0]
+    with torch.no_grad():
+        theirs = hf.generate(
+            torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
+            pad_token_id=0).numpy()[0]
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_qwen2_use_sliding_window_false_keeps_full_context():
+    """Qwen2 carries sliding_window in its config but only applies it
+    when use_sliding_window=True (HF default False): the conversion must
+    not cap max_seq_len in the default case."""
+    from deepspeed_tpu.module_inject.auto_tp import config_from_hf
+    kw = dict(vocab_size=96, hidden_size=32, intermediate_size=64,
+              num_hidden_layers=2, num_attention_heads=4,
+              num_key_value_heads=2, max_position_embeddings=256,
+              sliding_window=64)
+    off = transformers.Qwen2Config(use_sliding_window=False, **kw)
+    assert config_from_hf(off).max_seq_len == 256
+    on = transformers.Qwen2Config(use_sliding_window=True, **kw)
+    assert config_from_hf(on).max_seq_len == 64
