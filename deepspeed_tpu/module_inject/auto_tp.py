@@ -41,6 +41,19 @@ def _np(t) -> np.ndarray:
 # ---------------------------------------------------------------------------
 def _llama_family_config(hf_config, **extra) -> TransformerConfig:
     """Shared llama/mistral/mixtral geometry (rmsnorm + rope + swiglu)."""
+    # plain RoPE only: scaled/partial rotary variants (YaRN/longrope
+    # extended-context Qwen2.5/Phi-4-class configs, partial_rotary_factor)
+    # would silently produce wrong logits — reject loudly instead
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling:
+        raise ValueError(
+            f"rope_scaling={scaling!r} is not implemented; only plain-RoPE "
+            f"configs convert")
+    prf = getattr(hf_config, "partial_rotary_factor", 1.0) or 1.0
+    if prf != 1.0:
+        raise ValueError(
+            f"partial_rotary_factor={prf} is not implemented; only "
+            f"full-rotary configs convert")
     max_seq = getattr(hf_config, "max_position_embeddings", 2048)
     # Mistral-family sliding-window attention is not implemented; within
     # the window full attention is IDENTICAL, so cap the sequence length
@@ -92,6 +105,11 @@ def config_from_hf(hf_config) -> TransformerConfig:
         # (Qwen2Config hardcodes the split rather than exposing
         # attention_bias); the missing o bias maps to zeros — exact
         return _llama_family_config(hf_config, attn_bias=True)
+    if mt == "phi3":
+        # Phi-3: Llama geometry with FUSED qkv_proj / gate_up_proj
+        # weights (split in params_from_hf); the shared guard rejects
+        # longrope/partial-rotary variants (Phi-4-class)
+        return _llama_family_config(hf_config)
     if mt == "gpt2":
         return TransformerConfig(
             vocab_size=hf_config.vocab_size,
@@ -217,8 +235,9 @@ def config_from_hf(hf_config) -> TransformerConfig:
         )
     raise ValueError(
         f"unsupported model_type '{mt}'; supported: llama, mistral, "
-        f"mixtral, qwen2, gpt2, opt, bert, roberta, distilbert (add a "
-        f"mapping here the way the reference adds policy containers)")
+        f"mixtral, qwen2, phi3, gpt2, opt, bert, roberta, distilbert "
+        f"(add a mapping here the way the reference adds policy "
+        f"containers)")
 
 
 # ---------------------------------------------------------------------------
@@ -281,6 +300,29 @@ def _params_from_llama(sd, cfg: TransformerConfig) -> Dict[str, Any]:
         "w_down": _stack(sd, p + "mlp.down_proj.weight", L, transpose=True),
     })
     return _llama_family_top(sd, cfg, layers)
+
+
+def _params_from_phi3(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+    """HF Phi-3 fuses q/k/v into self_attn.qkv_proj ([nh+2*nkv]*hd rows)
+    and gate/up into mlp.gate_up_proj ([2F] rows): split them into
+    llama-style keys, then reuse the llama mapping."""
+    L = cfg.num_layers
+    p = "model.layers.{}."
+    q_rows = cfg.num_heads * cfg.head_dim
+    kv_rows = cfg.kv_heads * cfg.head_dim
+    F = cfg.intermediate_size
+    out = dict(sd)
+    for i in range(L):
+        qkv = _np(sd[(p + "self_attn.qkv_proj.weight").format(i)])
+        out[(p + "self_attn.q_proj.weight").format(i)] = qkv[:q_rows]
+        out[(p + "self_attn.k_proj.weight").format(i)] = \
+            qkv[q_rows:q_rows + kv_rows]
+        out[(p + "self_attn.v_proj.weight").format(i)] = \
+            qkv[q_rows + kv_rows:q_rows + 2 * kv_rows]
+        gu = _np(sd[(p + "mlp.gate_up_proj.weight").format(i)])
+        out[(p + "mlp.gate_proj.weight").format(i)] = gu[:F]
+        out[(p + "mlp.up_proj.weight").format(i)] = gu[F:]
+    return _params_from_llama(out, cfg)
 
 
 def _params_from_mixtral(sd, cfg: TransformerConfig) -> Dict[str, Any]:
@@ -560,6 +602,8 @@ def params_from_hf(state_dict: Dict[str, Any],
     sd = {k: _np(v) for k, v in state_dict.items()}
     if model_type in ("llama", "mistral", "qwen2"):
         return _params_from_llama(sd, cfg)
+    if model_type == "phi3":
+        return _params_from_phi3(sd, cfg)
     if model_type == "mixtral":
         return _params_from_mixtral(sd, cfg)
     if model_type == "gpt2":

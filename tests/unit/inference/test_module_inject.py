@@ -364,3 +364,41 @@ def test_qwen2_use_sliding_window_false_keeps_full_context():
     assert config_from_hf(off).max_seq_len == 256
     on = transformers.Qwen2Config(use_sliding_window=True, **kw)
     assert config_from_hf(on).max_seq_len == 64
+
+
+def test_phi3_injection_matches_hf():
+    """Phi-3: Llama geometry with fused qkv_proj / gate_up_proj weights
+    (split at conversion)."""
+    cfg = transformers.Phi3Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rms_norm_eps=1e-5, pad_token_id=0,
+        tie_word_embeddings=False)
+    torch.manual_seed(9)
+    hf = transformers.Phi3ForCausalLM(cfg).eval()
+    ids = np.random.default_rng(9).integers(0, 96, (2, 9), dtype=np.int64)
+    _assert_logits_match(hf, ids)
+
+
+def test_rope_scaling_rejected_across_llama_family():
+    """Extended-context rope variants (YaRN/longrope, partial rotary)
+    must reject loudly — converting them would silently produce wrong
+    logits past the original context."""
+    from deepspeed_tpu.module_inject.auto_tp import config_from_hf
+    kw = dict(vocab_size=96, hidden_size=32, intermediate_size=64,
+              num_hidden_layers=2, num_attention_heads=4,
+              num_key_value_heads=2, max_position_embeddings=256)
+    cfg = transformers.Qwen2Config(
+        rope_scaling={"rope_type": "yarn", "factor": 4.0}, **kw)
+    with pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf(cfg)
+
+    class P3:
+        model_type = "phi3"
+        partial_rotary_factor = 0.75
+        rope_scaling = None
+    for k, v in kw.items():
+        setattr(P3, k, v)
+    P3.rms_norm_eps = 1e-5
+    with pytest.raises(ValueError, match="partial_rotary_factor"):
+        config_from_hf(P3())
