@@ -125,8 +125,10 @@ def _rotate(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray):
 def _mlp(cfg, lp, x, topo=None):
     if cfg.moe_num_experts > 0:
         return _moe_mlp(cfg, lp, x, topo)
-    if cfg.activation == "swiglu":
-        return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+    if cfg.is_gated_mlp:
+        from ...models.transformer import gate_act
+        return (gate_act(cfg)(x @ lp["w_gate"])
+                * (x @ lp["w_up"])) @ lp["w_down"]
     from ...models.transformer import ffn_act
     u = ffn_act(cfg)(x @ lp["w_up"] + lp["b_up"])
     return u @ lp["w_down"] + lp["b_down"]
@@ -238,6 +240,8 @@ def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
     flash_ok = use_kernel and C % 128 == 0 and hd % 8 == 0
     params = _deq_nonlayer(params)
     x = params["embed"][ids[0]]                                # [C, H]
+    if cfg.embed_scale != 1.0:
+        x = x * jnp.asarray(cfg.embed_scale, x.dtype)
     if cfg.positional == "learned":
         # the bucket C may round past max_seq_len; clip like paged_continue
         x = x + params["pos_embed"][
@@ -323,6 +327,8 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
     params = _deq_nonlayer(params)
     x = params["embed"][ids[0]]                                 # [C, H]
+    if cfg.embed_scale != 1.0:
+        x = x * jnp.asarray(cfg.embed_scale, x.dtype)
     pos = start_pos + jnp.arange(C)                             # [C]
     if cfg.positional == "learned":
         x = x + params["pos_embed"][jnp.clip(pos, 0, cfg.max_seq_len - 1)]
@@ -397,6 +403,8 @@ def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
     ctx = MB * block_size
     params = _deq_nonlayer(params)
     x = params["embed"][toks]                                   # [N, H]
+    if cfg.embed_scale != 1.0:
+        x = x * jnp.asarray(cfg.embed_scale, x.dtype)
     if cfg.positional == "learned":
         x = x + params["pos_embed"][jnp.clip(pos, 0, cfg.max_seq_len - 1)]
     cos, sin = _rope_at(cfg, pos)                               # [N, half]

@@ -45,9 +45,14 @@ class TransformerConfig:
     max_seq_len: int = 4096
     norm: str = "rmsnorm"                  # rmsnorm | layernorm
     norm_eps: float = 1e-5
-    activation: str = "swiglu"             # swiglu | gelu | relu
+    activation: str = "swiglu"     # swiglu | geglu | geglu_exact | gelu | relu
     positional: str = "rope"               # rope | learned
     attn_bias: bool = False                # q/k/v/o projection biases (GPT-2/OPT)
+    # Gemma-family knobs: q/o project to num_heads*head_dim != hidden
+    # (Gemma-7B: 16x256 vs H=3072); embeddings scale by sqrt(H) at lookup
+    # while the tied logits head uses the raw table
+    head_dim_override: Optional[int] = None
+    embed_scale: float = 1.0
     # v1 decode: Pallas dense-cache attention kernel (ops/decode_attention)
     # instead of the repeat+einsum path; interpret-mode off-TPU
     decode_kernel: bool = True
@@ -147,7 +152,11 @@ class TransformerConfig:
 
     @property
     def head_dim(self) -> int:
-        return self.hidden_size // self.num_heads
+        return self.head_dim_override or self.hidden_size // self.num_heads
+
+    @property
+    def is_gated_mlp(self) -> bool:
+        return self.activation in ("swiglu", "geglu", "geglu_exact")
 
 
 # ---------------------------------------------------------------------------
@@ -160,6 +169,18 @@ def _rope_tables(cfg: TransformerConfig, seq_len: int, offset=0):
     t = offset + jnp.arange(seq_len, dtype=jnp.float32)
     angles = jnp.outer(t, freqs)                      # (S, half)
     return jnp.cos(angles), jnp.sin(angles)
+
+
+def gate_act(cfg: TransformerConfig):
+    """Gated-MLP gate nonlinearity: silu for swiglu (llama family), tanh
+    gelu for geglu (Gemma's gelu_pytorch_tanh), erf gelu for geglu_exact
+    (HF hidden_activation="gelu") — the two gelus differ by ~1e-3 and
+    conversions must pick the right one."""
+    if cfg.activation == "swiglu":
+        return jax.nn.silu
+    if cfg.activation == "geglu_exact":
+        return lambda x: jax.nn.gelu(x, approximate=False)
+    return jax.nn.gelu
 
 
 def ffn_act(cfg: TransformerConfig):
@@ -305,7 +326,7 @@ class TransformerLM:
                 layer["res_down"] = init(k[14], (L, ffn, h), out_std)
                 layer["res_coef_w"] = init(k[15], (L, h, 2))
                 layer["res_coef_b"] = jnp.zeros((L, 2), dt)
-        elif cfg.activation == "swiglu":
+        elif cfg.is_gated_mlp:
             layer["w_gate"] = init(k[4], (L, h, ffn))
             layer["w_up"] = init(k[5], (L, h, ffn))
             layer["w_down"] = init(k[6], (L, ffn, h), out_std)
@@ -374,7 +395,7 @@ class TransformerLM:
                 layer["res_down"] = row
                 layer["res_coef_w"] = P(pipe, None, None)
                 layer["res_coef_b"] = P(pipe, None)
-        elif cfg.activation == "swiglu":
+        elif cfg.is_gated_mlp:
             layer["w_gate"] = col
         else:
             layer["b_up"] = P(pipe, "model") if tp > 1 else P(pipe, None)
@@ -519,8 +540,8 @@ class TransformerLM:
                                                lp["res_coef_w"],
                                                lp["res_coef_b"])
             x = x + moe_out
-        elif cfg.activation == "swiglu":
-            g = jax.nn.silu(hn @ lp["w_gate"])
+        elif cfg.is_gated_mlp:
+            g = gate_act(cfg)(hn @ lp["w_gate"])
             u = hn @ lp["w_up"]
             x = x + (g * u) @ lp["w_down"]
         else:
@@ -533,6 +554,8 @@ class TransformerLM:
     def forward_hidden(self, params, input_ids):
         cfg = self.cfg
         x = params["embed"][input_ids]                    # [B, S, H] gather
+        if cfg.embed_scale != 1.0:
+            x = x * jnp.asarray(cfg.embed_scale, x.dtype)
         if cfg.positional == "learned":
             x = x + params["pos_embed"][: input_ids.shape[1]][None]
         if "embed_ln_w" in params:
@@ -633,6 +656,8 @@ class TransformerLM:
 
         def body(params, ids_local, *mask_local):
             x = params["embed"][ids_local]               # [M, b, S, H] (all stages)
+            if cfg.embed_scale != 1.0:
+                x = x * jnp.asarray(cfg.embed_scale, x.dtype)
             if cfg.positional == "learned":
                 x = x + params["pos_embed"][None, None, :x.shape[2]].astype(
                     x.dtype)
@@ -940,8 +965,8 @@ class TransformerLM:
                 out = out + (topv[..., j:j + 1] * jnp.einsum(
                     "bsf,bsfh->bsh", h, ed)).astype(hn.dtype)
             x = x + out
-        elif cfg.activation == "swiglu":
-            g = jax.nn.silu(hn @ lp["w_gate"])
+        elif cfg.is_gated_mlp:
+            g = gate_act(cfg)(hn @ lp["w_gate"])
             x = x + (g * (hn @ lp["w_up"])) @ lp["w_down"]
         else:
             u = ffn_act(cfg)(hn @ lp["w_up"] + lp["b_up"])
@@ -956,6 +981,8 @@ class TransformerLM:
         max_len = cache["k"].shape[3]
         S = input_ids.shape[1]
         x = params["embed"][input_ids].astype(cache["k"].dtype)
+        if cfg.embed_scale != 1.0:
+            x = x * jnp.asarray(cfg.embed_scale, x.dtype)
         if cfg.positional == "learned":
             pos = start_pos + jnp.arange(S)
             x = x + params["pos_embed"][pos][None].astype(x.dtype)
@@ -999,7 +1026,7 @@ class TransformerLM:
         if cfg.moe_num_experts > 0:
             mlp = cfg.moe_num_experts * 3 * h * ffn + h * cfg.moe_num_experts
         else:
-            mlp = (3 if cfg.activation == "swiglu" else 2) * h * ffn
+            mlp = (3 if cfg.is_gated_mlp else 2) * h * ffn
         per_layer = attn + mlp + 2 * h
         total = L * per_layer + h
         if include_embed:
@@ -1015,7 +1042,7 @@ class TransformerLM:
         if cfg.moe_num_experts > 0:
             mlp = cfg.moe_top_k * 3 * h * ffn + h * cfg.moe_num_experts
         else:
-            mlp = (3 if cfg.activation == "swiglu" else 2) * h * ffn
+            mlp = (3 if cfg.is_gated_mlp else 2) * h * ffn
         return L * (attn + mlp + 2 * h) + h
 
 

@@ -79,7 +79,7 @@ def _llama_family_config(hf_config, **extra) -> TransformerConfig:
         num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
         max_seq_len=max_seq,
         norm="rmsnorm", norm_eps=hf_config.rms_norm_eps,
-        activation="swiglu", positional="rope",
+        activation=extra.pop("activation", "swiglu"), positional="rope",
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
         tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
         attn_bias=extra.pop(
@@ -105,6 +105,24 @@ def config_from_hf(hf_config) -> TransformerConfig:
         # (Qwen2Config hardcodes the split rather than exposing
         # attention_bias); the missing o bias maps to zeros — exact
         return _llama_family_config(hf_config, attn_bias=True)
+    if mt == "gemma":
+        # Gemma-1: llama skeleton with GeGLU, q/o projecting to
+        # num_heads*head_dim (7B: 4096 != H=3072), sqrt(H)-scaled
+        # embeddings, and (1+w) RMSNorm weights (baked into the converted
+        # norm tensors). Gemma-2 (softcapping, alternating sliding
+        # window) is not implemented.
+        act = getattr(hf_config, "hidden_activation", None) or \
+            getattr(hf_config, "hidden_act", "gelu_pytorch_tanh")
+        # HF "gelu" is the exact erf form, "gelu_pytorch_tanh" the tanh
+        # approximation — map to distinct gate activations (~1e-3 apart)
+        gate = {"gelu_pytorch_tanh": "geglu", "gelu": "geglu_exact"}.get(act)
+        if gate is None:
+            raise ValueError(f"gemma hidden_activation {act!r} is not "
+                             f"supported")
+        return _llama_family_config(
+            hf_config, activation=gate,
+            head_dim_override=hf_config.head_dim,
+            embed_scale=float(hf_config.hidden_size) ** 0.5)
     if mt == "phi3":
         # Phi-3: Llama geometry with FUSED qkv_proj / gate_up_proj
         # weights (split in params_from_hf); the shared guard rejects
@@ -235,9 +253,9 @@ def config_from_hf(hf_config) -> TransformerConfig:
         )
     raise ValueError(
         f"unsupported model_type '{mt}'; supported: llama, mistral, "
-        f"mixtral, qwen2, phi3, gpt2, opt, bert, roberta, distilbert "
-        f"(add a mapping here the way the reference adds policy "
-        f"containers)")
+        f"mixtral, qwen2, phi3, gemma, gpt2, opt, bert, roberta, "
+        f"distilbert (add a mapping here the way the reference adds "
+        f"policy containers)")
 
 
 # ---------------------------------------------------------------------------
@@ -300,6 +318,18 @@ def _params_from_llama(sd, cfg: TransformerConfig) -> Dict[str, Any]:
         "w_down": _stack(sd, p + "mlp.down_proj.weight", L, transpose=True),
     })
     return _llama_family_top(sd, cfg, layers)
+
+
+def _params_from_gemma(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+    """HF Gemma: llama-style weight names, but RMSNorm computes
+    x * (1 + w) — bake the +1 into every converted norm tensor so the
+    model's plain rms_norm is exact."""
+    out = _params_from_llama(sd, cfg)
+    layers = out["layers"]
+    for key in ("attn_norm", "mlp_norm"):
+        layers[key] = layers[key] + 1.0
+    out["final_norm"] = out["final_norm"] + 1.0
+    return out
 
 
 def _params_from_phi3(sd, cfg: TransformerConfig) -> Dict[str, Any]:
@@ -604,6 +634,8 @@ def params_from_hf(state_dict: Dict[str, Any],
         return _params_from_llama(sd, cfg)
     if model_type == "phi3":
         return _params_from_phi3(sd, cfg)
+    if model_type == "gemma":
+        return _params_from_gemma(sd, cfg)
     if model_type == "mixtral":
         return _params_from_mixtral(sd, cfg)
     if model_type == "gpt2":

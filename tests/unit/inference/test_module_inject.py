@@ -402,3 +402,55 @@ def test_rope_scaling_rejected_across_llama_family():
     P3.rms_norm_eps = 1e-5
     with pytest.raises(ValueError, match="partial_rotary_factor"):
         config_from_hf(P3())
+
+
+def test_gemma_injection_matches_hf():
+    """Gemma-1: GeGLU, (1+w) RMSNorm (baked at conversion), sqrt(H)
+    embedding scale, and q/o projecting to num_heads*head_dim != hidden
+    (the head_dim override)."""
+    cfg = transformers.GemmaConfig(
+        vocab_size=96, hidden_size=24, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+        pad_token_id=0)
+    torch.manual_seed(11)
+    hf = transformers.GemmaForCausalLM(cfg).eval()
+    ids = np.random.default_rng(11).integers(0, 96, (2, 9), dtype=np.int64)
+    _assert_logits_match(hf, ids)
+
+
+def test_gemma_serves_through_v2():
+    cfg = transformers.GemmaConfig(
+        vocab_size=96, hidden_size=24, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+        pad_token_id=0)
+    torch.manual_seed(12)
+    hf = transformers.GemmaForCausalLM(cfg).eval()
+    import deepspeed_tpu
+    eng = deepspeed_tpu.init_inference(
+        hf, config={"use_ragged": True, "dtype": "float32",
+                    "ragged": {"state_manager": {
+                        "max_tracked_sequences": 2, "max_seq_len": 64,
+                        "num_blocks": 9, "block_size": 16}}})
+    prompt = [3, 5, 7, 9, 11]
+    ours = eng.generate([prompt], max_new_tokens=8)[0]
+    with torch.no_grad():
+        theirs = hf.generate(
+            torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
+            pad_token_id=0).numpy()[0]
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_gemma_exact_gelu_variant_matches_hf():
+    """hidden_activation='gelu' (exact erf) must map to the erf gate, not
+    the tanh approximation (~1e-3 apart)."""
+    cfg = transformers.GemmaConfig(
+        vocab_size=96, hidden_size=24, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+        pad_token_id=0, hidden_activation="gelu")
+    torch.manual_seed(13)
+    hf = transformers.GemmaForCausalLM(cfg).eval()
+    ids = np.random.default_rng(13).integers(0, 96, (2, 9), dtype=np.int64)
+    _assert_logits_match(hf, ids, rtol=5e-4, atol=5e-4)
