@@ -129,9 +129,8 @@ def _mlp(cfg, lp, x, topo=None):
         from ...models.transformer import gate_act
         return (gate_act(cfg)(x @ lp["w_gate"])
                 * (x @ lp["w_up"])) @ lp["w_down"]
-    from ...models.transformer import ffn_act
-    u = ffn_act(cfg)(x @ lp["w_up"] + lp["b_up"])
-    return u @ lp["w_down"] + lp["b_down"]
+    from ...models.transformer import dense_mlp
+    return dense_mlp(cfg, lp, x)
 
 
 def _moe_mlp(cfg, lp, x, topo=None):
@@ -284,6 +283,11 @@ def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
             scores = jnp.where(mask[None], scores, NEG_INF)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             o = jnp.einsum("hqk,khd->qhd", probs, vf).reshape(C, nh * hd)
+        if cfg.parallel_residual:
+            # Falcon block: attention and MLP both read the shared normed
+            # input; one residual add
+            x = x + out_proj(lp, o) + _mlp(cfg, lp, hn, topo)
+            return (x, kc, vc, ksc, vsc), None
         x = x + out_proj(lp, o)
         hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
         x = x + _mlp(cfg, lp, hn, topo)
@@ -363,6 +367,11 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
         scores = jnp.where(mask[None], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         o = jnp.einsum("hqc,chd->qhd", probs, vpages).reshape(C, nh * hd)
+        if cfg.parallel_residual:
+            # Falcon block: attention and MLP both read the shared normed
+            # input; one residual add
+            x = x + out_proj(lp, o) + _mlp(cfg, lp, hn, topo)
+            return (x, kc, vc, ksc, vsc), None
         x = x + out_proj(lp, o)
         hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
         x = x + _mlp(cfg, lp, hn, topo)
@@ -450,6 +459,11 @@ def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
             scores = jnp.where(attn_mask[:, None, :], scores, NEG_INF)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             o = jnp.einsum("nhc,nchd->nhd", probs, vpages).reshape(N, nh * hd)
+        if cfg.parallel_residual:
+            # Falcon block: attention and MLP both read the shared normed
+            # input; one residual add
+            x = x + out_proj(lp, o) + _mlp(cfg, lp, hn, topo)
+            return (x, kc, vc, ksc, vsc), None
         x = x + out_proj(lp, o)
         hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
         x = x + _mlp(cfg, lp, hn, topo)

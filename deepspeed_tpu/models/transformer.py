@@ -53,6 +53,10 @@ class TransformerConfig:
     # while the tied logits head uses the raw table
     head_dim_override: Optional[int] = None
     embed_scale: float = 1.0
+    # Falcon-family: one shared input norm feeds BOTH sublayers and the
+    # residual adds once (x + attn(ln x) + mlp(ln x)); MLP without biases
+    parallel_residual: bool = False
+    mlp_bias: bool = True
     # v1 decode: Pallas dense-cache attention kernel (ops/decode_attention)
     # instead of the repeat+einsum path; interpret-mode off-TPU
     decode_kernel: bool = True
@@ -169,6 +173,19 @@ def _rope_tables(cfg: TransformerConfig, seq_len: int, offset=0):
     t = offset + jnp.arange(seq_len, dtype=jnp.float32)
     angles = jnp.outer(t, freqs)                      # (S, half)
     return jnp.cos(angles), jnp.sin(angles)
+
+
+def dense_mlp(cfg: TransformerConfig, lp, x):
+    """Non-gated dense MLP with optional biases — ONE definition shared
+    by training, v1 cached decode, and v2 paged serving (cfg.mlp_bias is
+    Falcon's bias-free variant)."""
+    u = x @ lp["w_up"]
+    if cfg.mlp_bias:
+        u = u + lp["b_up"]
+    out = ffn_act(cfg)(u) @ lp["w_down"]
+    if cfg.mlp_bias:
+        out = out + lp["b_down"]
+    return out
 
 
 def gate_act(cfg: TransformerConfig):
@@ -333,11 +350,16 @@ class TransformerLM:
         else:
             layer["w_up"] = init(k[5], (L, h, ffn))
             layer["w_down"] = init(k[6], (L, ffn, h), out_std)
-            layer["b_up"] = jnp.zeros((L, ffn), dt)
-            layer["b_down"] = jnp.zeros((L, h), dt)
+            if cfg.mlp_bias:
+                layer["b_up"] = jnp.zeros((L, ffn), dt)
+                layer["b_down"] = jnp.zeros((L, h), dt)
         if cfg.norm == "layernorm":
             layer["attn_norm_b"] = jnp.zeros((L, h), dt)
-            layer["mlp_norm_b"] = jnp.zeros((L, h), dt)
+            if not cfg.parallel_residual:
+                layer["mlp_norm_b"] = jnp.zeros((L, h), dt)
+        if cfg.parallel_residual:
+            # one shared norm: the mlp_norm slot does not exist
+            del layer["mlp_norm"]
         if cfg.attn_bias:
             layer["b_q"] = jnp.zeros((L, nh * hd), dt)
             layer["b_k"] = jnp.zeros((L, nkv * hd), dt)
@@ -398,11 +420,16 @@ class TransformerLM:
         elif cfg.is_gated_mlp:
             layer["w_gate"] = col
         else:
-            layer["b_up"] = P(pipe, "model") if tp > 1 else P(pipe, None)
-            layer["b_down"] = vec
+            if cfg.mlp_bias:
+                layer["b_up"] = (P(pipe, "model") if tp > 1
+                                 else P(pipe, None))
+                layer["b_down"] = vec
         if cfg.norm == "layernorm":
             layer["attn_norm_b"] = vec
-            layer["mlp_norm_b"] = vec
+            if not cfg.parallel_residual:
+                layer["mlp_norm_b"] = vec
+        if cfg.parallel_residual:
+            layer.pop("mlp_norm")
         if cfg.attn_bias:
             col_b = P(pipe, "model") if tp > 1 else P(pipe, None)
             layer["b_q"] = col_b
@@ -475,6 +502,11 @@ class TransformerLM:
             k = apply_rotary(k, cos, sin)
         o = self._attention(q, k, v)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
+        if cfg.parallel_residual:
+            # Falcon block: both sublayers read the SAME normed input and
+            # the residual adds once
+            return (x + out_proj(lp, o) + dense_mlp(cfg, lp, hn),
+                    jnp.zeros((), jnp.float32))
         x = x + out_proj(lp, o)
         if post:
             x = self._norm(x, lp["attn_norm"], lp.get("attn_norm_b"))
@@ -946,6 +978,9 @@ class TransformerLM:
             o = jnp.einsum("bhsm,bhmd->bhsd", p.astype(vv.dtype), vv,
                            preferred_element_type=jnp.float32).astype(x.dtype)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
+        if cfg.parallel_residual:
+            return (x + out_proj(lp, o) + dense_mlp(cfg, lp, hn),
+                    ck, cv)
         x = x + out_proj(lp, o)
 
         hn = self._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"))

@@ -123,6 +123,42 @@ def config_from_hf(hf_config) -> TransformerConfig:
             hf_config, activation=gate,
             head_dim_override=hf_config.head_dim,
             embed_scale=float(hf_config.hidden_size) ** 0.5)
+    if mt == "falcon":
+        # Falcon-7B-class: parallel residual (x + attn(ln x) + mlp(ln x)),
+        # fused MQA qkv, bias-free projections/MLP, LayerNorm with bias,
+        # exact-erf GELU. Variants outside that envelope reject loudly.
+        if getattr(hf_config, "alibi", False):
+            raise ValueError("falcon with alibi positions is not "
+                             "implemented (rope variants only)")
+        if getattr(hf_config, "new_decoder_architecture", False):
+            raise ValueError("falcon new_decoder_architecture (40B/180B "
+                             "grouped-qkv layout) is not implemented")
+        if not getattr(hf_config, "parallel_attn", True):
+            raise ValueError("falcon with parallel_attn=False is not "
+                             "implemented")
+        if getattr(hf_config, "bias", False):
+            raise ValueError("falcon with projection biases is not "
+                             "implemented")
+        if not getattr(hf_config, "multi_query", True):
+            # that layout interleaves qkv PER HEAD ([nh, 3, hd] rows) —
+            # the flat [q|k|v] split below would scramble it
+            raise ValueError("falcon with multi_query=False (per-head "
+                             "interleaved qkv) is not implemented")
+        nkv = 1
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=getattr(hf_config, "ffn_hidden_size", None)
+            or 4 * hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=nkv,
+            max_seq_len=getattr(hf_config, "max_position_embeddings", 2048),
+            norm="layernorm", norm_eps=hf_config.layer_norm_epsilon,
+            activation="gelu_exact", positional="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            tie_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+            parallel_residual=True, mlp_bias=False)
     if mt == "phi3":
         # Phi-3: Llama geometry with FUSED qkv_proj / gate_up_proj
         # weights (split in params_from_hf); the shared guard rejects
@@ -253,7 +289,7 @@ def config_from_hf(hf_config) -> TransformerConfig:
         )
     raise ValueError(
         f"unsupported model_type '{mt}'; supported: llama, mistral, "
-        f"mixtral, qwen2, phi3, gemma, gpt2, opt, bert, roberta, "
+        f"mixtral, qwen2, phi3, gemma, falcon, gpt2, opt, bert, roberta, "
         f"distilbert (add a mapping here the way the reference adds "
         f"policy containers)")
 
@@ -318,6 +354,52 @@ def _params_from_llama(sd, cfg: TransformerConfig) -> Dict[str, Any]:
         "w_down": _stack(sd, p + "mlp.down_proj.weight", L, transpose=True),
     })
     return _llama_family_top(sd, cfg, layers)
+
+
+def _params_from_falcon(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+    """HF Falcon: transformer.h.{i}.self_attention.query_key_value fuses
+    [q(nh*hd), k(nkv*hd), v(nkv*hd)] rows; parallel-residual layers carry
+    one (biased) input LayerNorm and a bias-free MLP."""
+    L = cfg.num_layers
+    t = "transformer.h.{}."
+    q_rows = cfg.num_heads * cfg.head_dim
+    kv_rows = cfg.kv_heads * cfg.head_dim
+
+    def split(i, lo, hi):
+        return _np(sd[(t + "self_attention.query_key_value.weight"
+                       ).format(i)])[lo:hi]
+
+    layers = {
+        "attn_norm": _stack(sd, t + "input_layernorm.weight", L),
+        "attn_norm_b": _stack(sd, t + "input_layernorm.bias", L),
+        "wq": np.ascontiguousarray(np.stack(
+            [split(i, 0, q_rows).T for i in range(L)]), np.float32),
+        "wk": np.ascontiguousarray(np.stack(
+            [split(i, q_rows, q_rows + kv_rows).T
+             for i in range(L)]), np.float32),
+        "wv": np.ascontiguousarray(np.stack(
+            [split(i, q_rows + kv_rows, q_rows + 2 * kv_rows).T
+             for i in range(L)]), np.float32),
+        "wo": _stack(sd, t + "self_attention.dense.weight", L,
+                     transpose=True),
+        "w_up": _stack(sd, t + "mlp.dense_h_to_4h.weight", L,
+                       transpose=True),
+        "w_down": _stack(sd, t + "mlp.dense_4h_to_h.weight", L,
+                         transpose=True),
+    }
+    out = {
+        "embed": np.ascontiguousarray(
+            sd["transformer.word_embeddings.weight"], np.float32),
+        "layers": layers,
+        "final_norm": np.ascontiguousarray(
+            sd["transformer.ln_f.weight"], np.float32),
+        "final_norm_b": np.ascontiguousarray(
+            sd["transformer.ln_f.bias"], np.float32),
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head"] = np.ascontiguousarray(sd["lm_head.weight"].T,
+                                              np.float32)
+    return out
 
 
 def _params_from_gemma(sd, cfg: TransformerConfig) -> Dict[str, Any]:
@@ -636,6 +718,8 @@ def params_from_hf(state_dict: Dict[str, Any],
         return _params_from_phi3(sd, cfg)
     if model_type == "gemma":
         return _params_from_gemma(sd, cfg)
+    if model_type == "falcon":
+        return _params_from_falcon(sd, cfg)
     if model_type == "mixtral":
         return _params_from_mixtral(sd, cfg)
     if model_type == "gpt2":

@@ -454,3 +454,59 @@ def test_gemma_exact_gelu_variant_matches_hf():
     hf = transformers.GemmaForCausalLM(cfg).eval()
     ids = np.random.default_rng(13).integers(0, 96, (2, 9), dtype=np.int64)
     _assert_logits_match(hf, ids, rtol=5e-4, atol=5e-4)
+
+
+def test_falcon_injection_matches_hf():
+    """Falcon-7B-class: parallel residual, fused MQA qkv, bias-free MLP,
+    biased LayerNorm, exact gelu."""
+    cfg = transformers.FalconConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, parallel_attn=True, bias=False,
+        multi_query=True, new_decoder_architecture=False, alibi=False,
+        layer_norm_epsilon=1e-5)
+    torch.manual_seed(14)
+    hf = transformers.FalconForCausalLM(cfg).eval()
+    _randomize_biases(hf, seed=14)
+    ids = np.random.default_rng(14).integers(0, 96, (2, 9), dtype=np.int64)
+    _assert_logits_match(hf, ids)
+
+
+def test_falcon_variants_rejected():
+    from deepspeed_tpu.module_inject.auto_tp import config_from_hf
+    base = dict(vocab_size=96, hidden_size=32, num_hidden_layers=1,
+                num_attention_heads=4, multi_query=True)
+    with pytest.raises(ValueError, match="alibi"):
+        config_from_hf(transformers.FalconConfig(alibi=True, **base))
+    with pytest.raises(ValueError, match="new_decoder_architecture"):
+        config_from_hf(transformers.FalconConfig(
+            new_decoder_architecture=True, **base))
+    with pytest.raises(ValueError, match="parallel_attn"):
+        config_from_hf(transformers.FalconConfig(
+            parallel_attn=False, alibi=False, **base))
+    mq = dict(base, multi_query=False)
+    with pytest.raises(ValueError, match="multi_query"):
+        config_from_hf(transformers.FalconConfig(
+            alibi=False, num_kv_heads=2, **mq))
+
+
+def test_falcon_serves_through_v2():
+    cfg = transformers.FalconConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, parallel_attn=True, bias=False,
+        multi_query=True, new_decoder_architecture=False, alibi=False)
+    torch.manual_seed(15)
+    hf = transformers.FalconForCausalLM(cfg).eval()
+    import deepspeed_tpu
+    eng = deepspeed_tpu.init_inference(
+        hf, config={"use_ragged": True, "dtype": "float32",
+                    "ragged": {"state_manager": {
+                        "max_tracked_sequences": 2, "max_seq_len": 64,
+                        "num_blocks": 9, "block_size": 16}}})
+    eos = int(hf.config.eos_token_id)
+    prompt = [3, 5, 7, 9, 13]
+    ours = eng.generate([prompt], max_new_tokens=8, eos_token_id=eos)[0]
+    with torch.no_grad():
+        theirs = hf.generate(
+            torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
+            pad_token_id=0, eos_token_id=eos).numpy()[0]
+    np.testing.assert_array_equal(ours, theirs)
