@@ -12,14 +12,19 @@ params under a "model" mesh axis IS tensor-parallel injection.
 
 Supported architectures (reference policy containers, and the reference's
 in-tree inference-v2 families inference/v2/model_implementations/
-{llama_v2,mistral,opt}): LlamaForCausalLM / MistralForCausalLM
-(RMSNorm+RoPE+SwiGLU+GQA, optional attention_bias), GPT2LMHeadModel
-(LayerNorm+learned positions+GELU+attn biases), OPTForCausalLM
-(pre-LN LayerNorm+learned positions with the HF +2 offset+ReLU+biases)
-and the post-LN MLM encoders BertForMaskedLM / RobertaForMaskedLM /
-DistilBertForMaskedLM (embeddings LayerNorm + MLM prediction head,
-exact-erf gelu; RoBERTa's +2 position offset handled like OPT's). torch
-weights are consumed as numpy; torch never touches the device path.
+{llama_v2,mistral,opt,mixtral}): LlamaForCausalLM / MistralForCausalLM
+(RMSNorm+RoPE+SwiGLU+GQA, optional attention_bias), MixtralForCausalLM
+(sparse-MoE experts), Qwen2ForCausalLM (qkv-only biases),
+Phi3ForCausalLM (fused qkv_proj/gate_up_proj, split at conversion),
+GemmaForCausalLM (GeGLU, head-dim override, sqrt(H)-scaled embeddings,
+(1+w) RMSNorm baked), FalconForCausalLM (parallel residual, fused MQA
+qkv, bias-free MLP), GPT2LMHeadModel (LayerNorm+learned
+positions+GELU+attn biases), OPTForCausalLM (pre-LN LayerNorm+learned
+positions with the HF +2 offset+ReLU+biases) and the post-LN MLM
+encoders BertForMaskedLM / RobertaForMaskedLM / DistilBertForMaskedLM
+(embeddings LayerNorm + MLM prediction head, exact-erf gelu; RoBERTa's
++2 position offset handled like OPT's). torch weights are consumed as
+numpy; torch never touches the device path.
 """
 
 from typing import Any, Dict, Optional, Tuple
