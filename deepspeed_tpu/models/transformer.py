@@ -577,8 +577,7 @@ class TransformerLM:
             u = hn @ lp["w_up"]
             x = x + (g * u) @ lp["w_down"]
         else:
-            u = ffn_act(cfg)(hn @ lp["w_up"] + lp["b_up"])
-            x = x + u @ lp["w_down"] + lp["b_down"]
+            x = x + dense_mlp(cfg, lp, hn)
         if post:
             x = self._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"))
         return x, aux
@@ -1004,8 +1003,7 @@ class TransformerLM:
             g = gate_act(cfg)(hn @ lp["w_gate"])
             x = x + (g * (hn @ lp["w_up"])) @ lp["w_down"]
         else:
-            u = ffn_act(cfg)(hn @ lp["w_up"] + lp["b_up"])
-            x = x + u @ lp["w_down"] + lp["b_down"]
+            x = x + dense_mlp(cfg, lp, hn)
         return x, ck, cv
 
     def forward_cached(self, params, input_ids, cache, start_pos):

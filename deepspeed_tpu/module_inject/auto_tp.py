@@ -18,7 +18,8 @@ in-tree inference-v2 families inference/v2/model_implementations/
 Phi3ForCausalLM (fused qkv_proj/gate_up_proj, split at conversion),
 GemmaForCausalLM (GeGLU, head-dim override, sqrt(H)-scaled embeddings,
 (1+w) RMSNorm baked), FalconForCausalLM (parallel residual, fused MQA
-qkv, bias-free MLP), GPT2LMHeadModel (LayerNorm+learned
+qkv, bias-free MLP), Starcoder2ForCausalLM (biased LayerNorms +
+projections, non-gated tanh-gelu MLP), GPT2LMHeadModel (LayerNorm+learned
 positions+GELU+attn biases), OPTForCausalLM (pre-LN LayerNorm+learned
 positions with the HF +2 offset+ReLU+biases) and the post-LN MLM
 encoders BertForMaskedLM / RobertaForMaskedLM / DistilBertForMaskedLM
@@ -44,6 +45,24 @@ def _np(t) -> np.ndarray:
 # ---------------------------------------------------------------------------
 # Config mapping (reference containers read the same HF config fields)
 # ---------------------------------------------------------------------------
+def _cap_to_window(hf_config, max_seq: int) -> int:
+    """Sliding-window attention is not implemented; within the window
+    full attention is IDENTICAL, so cap the sequence length there rather
+    than silently diverging from HF beyond it. Qwen2-style configs carry
+    sliding_window but only APPLY it when use_sliding_window is set."""
+    window = getattr(hf_config, "sliding_window", None)
+    if not getattr(hf_config, "use_sliding_window", True):
+        window = None
+    if window is not None and window < max_seq:
+        logger.warning(
+            f"sliding_window={window} < max_position_embeddings={max_seq}: "
+            f"capping max_seq_len to the window (full attention matches "
+            f"HF exactly within it; sliding-window masking is not "
+            f"implemented)")
+        return window
+    return max_seq
+
+
 def _llama_family_config(hf_config, **extra) -> TransformerConfig:
     """Shared llama/mistral/mixtral geometry (rmsnorm + rope + swiglu)."""
     # plain RoPE only: scaled/partial rotary variants (YaRN/longrope
@@ -59,22 +78,8 @@ def _llama_family_config(hf_config, **extra) -> TransformerConfig:
         raise ValueError(
             f"partial_rotary_factor={prf} is not implemented; only "
             f"full-rotary configs convert")
-    max_seq = getattr(hf_config, "max_position_embeddings", 2048)
-    # Mistral-family sliding-window attention is not implemented; within
-    # the window full attention is IDENTICAL, so cap the sequence length
-    # there rather than silently diverging from HF beyond it
-    window = getattr(hf_config, "sliding_window", None)
-    # Qwen2 carries sliding_window in its config but only APPLIES it when
-    # use_sliding_window is set (HF default False -> full attention)
-    if not getattr(hf_config, "use_sliding_window", True):
-        window = None
-    if window is not None and window < max_seq:
-        logger.warning(
-            f"sliding_window={window} < max_position_embeddings={max_seq}: "
-            f"capping max_seq_len to the window (full attention matches "
-            f"HF exactly within it; sliding-window masking is not "
-            f"implemented)")
-        max_seq = window
+    max_seq = _cap_to_window(
+        hf_config, getattr(hf_config, "max_position_embeddings", 2048))
     return TransformerConfig(
         vocab_size=hf_config.vocab_size,
         hidden_size=hf_config.hidden_size,
@@ -128,6 +133,30 @@ def config_from_hf(hf_config) -> TransformerConfig:
             hf_config, activation=gate,
             head_dim_override=hf_config.head_dim,
             embed_scale=float(hf_config.hidden_size) ** 0.5)
+    if mt == "starcoder2":
+        # StarCoder2: llama skeleton with biased LayerNorms, biased
+        # projections, and a non-gated tanh-gelu MLP (c_fc/c_proj)
+        if hf_config.hidden_act not in ("gelu_pytorch_tanh", "gelu"):
+            raise ValueError(f"starcoder2 hidden_act "
+                             f"{hf_config.hidden_act!r} is not supported")
+        max_seq = _cap_to_window(hf_config,
+                                 hf_config.max_position_embeddings)
+        use_bias = getattr(hf_config, "use_bias", True)
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+            max_seq_len=max_seq,
+            norm="layernorm", norm_eps=hf_config.norm_epsilon,
+            activation="gelu" if hf_config.hidden_act
+            == "gelu_pytorch_tanh" else "gelu_exact",
+            positional="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            tie_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+            attn_bias=use_bias, mlp_bias=use_bias)
     if mt == "falcon":
         # Falcon-7B-class: parallel residual (x + attn(ln x) + mlp(ln x)),
         # fused MQA qkv, bias-free projections/MLP, LayerNorm with bias,
@@ -294,9 +323,9 @@ def config_from_hf(hf_config) -> TransformerConfig:
         )
     raise ValueError(
         f"unsupported model_type '{mt}'; supported: llama, mistral, "
-        f"mixtral, qwen2, phi3, gemma, falcon, gpt2, opt, bert, roberta, "
-        f"distilbert (add a mapping here the way the reference adds "
-        f"policy containers)")
+        f"mixtral, qwen2, phi3, gemma, falcon, starcoder2, gpt2, opt, "
+        f"bert, roberta, distilbert (add a mapping here the way the "
+        f"reference adds policy containers)")
 
 
 # ---------------------------------------------------------------------------
@@ -359,6 +388,27 @@ def _params_from_llama(sd, cfg: TransformerConfig) -> Dict[str, Any]:
         "w_down": _stack(sd, p + "mlp.down_proj.weight", L, transpose=True),
     })
     return _llama_family_top(sd, cfg, layers)
+
+
+def _params_from_starcoder2(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+    """HF StarCoder2: llama-style attention names (with biases), biased
+    LayerNorms, and mlp.c_fc/c_proj for the non-gated MLP."""
+    L = cfg.num_layers
+    p = "model.layers.{}."
+    layers = _llama_family_attn_layers(sd, cfg, p)
+    layers.update({
+        "attn_norm_b": _stack(sd, p + "input_layernorm.bias", L),
+        "mlp_norm_b": _stack(sd, p + "post_attention_layernorm.bias", L),
+        "w_up": _stack(sd, p + "mlp.c_fc.weight", L, transpose=True),
+        "w_down": _stack(sd, p + "mlp.c_proj.weight", L, transpose=True),
+    })
+    if cfg.mlp_bias:   # use_bias=False checkpoints carry no biases
+        layers["b_up"] = _stack(sd, p + "mlp.c_fc.bias", L)
+        layers["b_down"] = _stack(sd, p + "mlp.c_proj.bias", L)
+    out = _llama_family_top(sd, cfg, layers)
+    out["final_norm_b"] = np.ascontiguousarray(sd["model.norm.bias"],
+                                               np.float32)
+    return out
 
 
 def _params_from_falcon(sd, cfg: TransformerConfig) -> Dict[str, Any]:
@@ -725,6 +775,8 @@ def params_from_hf(state_dict: Dict[str, Any],
         return _params_from_gemma(sd, cfg)
     if model_type == "falcon":
         return _params_from_falcon(sd, cfg)
+    if model_type == "starcoder2":
+        return _params_from_starcoder2(sd, cfg)
     if model_type == "mixtral":
         return _params_from_mixtral(sd, cfg)
     if model_type == "gpt2":

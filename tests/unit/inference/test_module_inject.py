@@ -510,3 +510,56 @@ def test_falcon_serves_through_v2():
             torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
             pad_token_id=0, eos_token_id=eos).numpy()[0]
     np.testing.assert_array_equal(ours, theirs)
+
+
+def test_starcoder2_injection_matches_hf():
+    """StarCoder2: biased LayerNorms + biased projections + non-gated
+    tanh-gelu MLP over the llama skeleton."""
+    cfg = transformers.Starcoder2Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, norm_epsilon=1e-5,
+        residual_dropout=0.0, embedding_dropout=0.0)
+    torch.manual_seed(16)
+    hf = transformers.Starcoder2ForCausalLM(cfg).eval()
+    _randomize_biases(hf, seed=16)
+    ids = np.random.default_rng(16).integers(0, 96, (2, 9), dtype=np.int64)
+    _assert_logits_match(hf, ids)
+
+
+def test_starcoder2_serves_through_v2():
+    cfg = transformers.Starcoder2Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, norm_epsilon=1e-5,
+        residual_dropout=0.0, embedding_dropout=0.0)
+    torch.manual_seed(17)
+    hf = transformers.Starcoder2ForCausalLM(cfg).eval()
+    _randomize_biases(hf, seed=17)
+    import deepspeed_tpu
+    eng = deepspeed_tpu.init_inference(
+        hf, config={"use_ragged": True, "dtype": "float32",
+                    "ragged": {"state_manager": {
+                        "max_tracked_sequences": 2, "max_seq_len": 64,
+                        "num_blocks": 9, "block_size": 16}}})
+    eos = int(hf.config.eos_token_id or 0)
+    prompt = [3, 5, 7, 9, 13]
+    ours = eng.generate([prompt], max_new_tokens=8, eos_token_id=eos)[0]
+    with torch.no_grad():
+        theirs = hf.generate(
+            torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
+            pad_token_id=0, eos_token_id=eos).numpy()[0]
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_starcoder2_use_bias_false_matches_hf():
+    cfg = transformers.Starcoder2Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, norm_epsilon=1e-5, use_bias=False,
+        residual_dropout=0.0, embedding_dropout=0.0)
+    torch.manual_seed(18)
+    hf = transformers.Starcoder2ForCausalLM(cfg).eval()
+    _randomize_biases(hf, seed=18)   # norms keep biases; projections none
+    ids = np.random.default_rng(18).integers(0, 96, (2, 9), dtype=np.int64)
+    _assert_logits_match(hf, ids)
