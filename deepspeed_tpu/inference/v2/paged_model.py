@@ -106,8 +106,10 @@ def _norm(cfg, x, w, b=None):
 
 
 def _rope_at(cfg: TransformerConfig, pos: jnp.ndarray):
-    """cos/sin tables at integer positions `pos` [...]-> [..., half]."""
-    half = cfg.head_dim // 2
+    """cos/sin tables at integer positions `pos` [...]-> [..., half]
+    (half = rotating dims / 2; partial rotary leaves the tail alone)."""
+    from ...models.transformer import rotary_dims
+    half = rotary_dims(cfg) // 2
     freqs = 1.0 / (cfg.rope_theta
                    ** (jnp.arange(0, half, dtype=jnp.float32) / half))
     angles = pos.astype(jnp.float32)[..., None] * freqs
@@ -115,11 +117,18 @@ def _rope_at(cfg: TransformerConfig, pos: jnp.ndarray):
 
 
 def _rotate(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray):
-    """x [..., D]; cos/sin broadcastable to [..., D/2]."""
-    half = x.shape[-1] // 2
-    x1, x2 = x[..., :half], x[..., half:]
-    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
-                           axis=-1).astype(x.dtype)
+    """x [..., D]; cos/sin broadcastable to [..., rot/2] — when rot < D
+    (partial rotary) the trailing dims pass through untouched."""
+    rot = 2 * cos.shape[-1]
+    tail = x[..., rot:]
+    xr = x[..., :rot]
+    half = rot // 2
+    x1, x2 = xr[..., :half], xr[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    if tail.shape[-1]:
+        out = jnp.concatenate([out, tail], axis=-1)
+    return out.astype(x.dtype)
 
 
 def _mlp(cfg, lp, x, topo=None):
@@ -211,7 +220,10 @@ def _deq_layer(lp):
 
 def _logits(cfg, params, x):
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    return (x @ head.astype(x.dtype)).astype(jnp.float32)
+    out = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    if "lm_head_b" in params:
+        out = out + params["lm_head_b"].astype(jnp.float32)
+    return out
 
 
 # ---------------------------------------------------------------------------

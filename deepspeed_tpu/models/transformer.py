@@ -57,6 +57,11 @@ class TransformerConfig:
     # residual adds once (x + attn(ln x) + mlp(ln x)); MLP without biases
     parallel_residual: bool = False
     mlp_bias: bool = True
+    # fraction of head_dim that rotates (GPT-NeoX/Phi-class partial
+    # rotary); the remaining dims pass through untouched
+    rotary_pct: float = 1.0
+    # Phi-class causal lm_head carries a logit bias
+    lm_head_bias: bool = False
     # v1 decode: Pallas dense-cache attention kernel (ops/decode_attention)
     # instead of the repeat+einsum path; interpret-mode off-TPU
     decode_kernel: bool = True
@@ -166,9 +171,16 @@ class TransformerConfig:
 # ---------------------------------------------------------------------------
 
 
+def rotary_dims(cfg: TransformerConfig) -> int:
+    """How many leading head dims rotate (rotary_pct < 1: NeoX/Phi).
+    Always even."""
+    rot = int(cfg.head_dim * cfg.rotary_pct)
+    return rot - (rot % 2)
+
+
 def _rope_tables(cfg: TransformerConfig, seq_len: int, offset=0):
     """offset may be a traced scalar (decode position under jit)."""
-    half = cfg.head_dim // 2
+    half = rotary_dims(cfg) // 2
     freqs = 1.0 / (cfg.rope_theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
     t = offset + jnp.arange(seq_len, dtype=jnp.float32)
     angles = jnp.outer(t, freqs)                      # (S, half)
@@ -217,12 +229,20 @@ def ffn_act(cfg: TransformerConfig):
 
 def apply_rotary(x, cos, sin):
     """x: [B, H, S, D]; rotate-half convention (reference
-    csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu)."""
-    half = x.shape[-1] // 2
-    x1, x2 = x[..., :half], x[..., half:]
+    csrc/transformer/inference/csrc/apply_rotary_pos_emb.cu). When the
+    tables cover fewer than D dims (partial rotary, rotary_pct < 1) the
+    trailing dims pass through untouched."""
+    rot = 2 * cos.shape[-1]
+    tail = x[..., rot:]
+    xr = x[..., :rot]
+    half = rot // 2
+    x1, x2 = xr[..., :half], xr[..., half:]
     c = cos[None, None, :, :]
     s = sin[None, None, :, :]
-    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1).astype(x.dtype)
+    out = jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+    if tail.shape[-1]:
+        out = jnp.concatenate([out, tail], axis=-1)
+    return out.astype(x.dtype)
 
 
 def qkv_proj(lp, hn):
@@ -388,6 +408,8 @@ class TransformerLM:
             params["mlm_bias"] = jnp.zeros((v,), dt)
         if not cfg.tie_embeddings:
             params["lm_head"] = init(k[9], (h, v))
+        if cfg.lm_head_bias:
+            params["lm_head_b"] = jnp.zeros((v,), dt)
         return params
 
     # -- sharding (TP over "model", PP over "pipe"; ZeRO composes on top) --
@@ -449,6 +471,8 @@ class TransformerLM:
         if cfg.embed_ln:
             specs["embed_ln_w"] = P(None)
             specs["embed_ln_b"] = P(None)
+        if cfg.lm_head_bias:
+            specs["lm_head_b"] = P("model") if tp > 1 else P(None)
         if cfg.mlm_head:
             specs["mlm_transform_w"] = P(None, None)
             specs["mlm_transform_b"] = P(None)
@@ -651,6 +675,9 @@ class TransformerLM:
             x = layer_norm(x, params["mlm_ln_w"], params.get("mlm_ln_b"),
                            self.cfg.norm_eps)
             bias = params.get("mlm_bias")
+        else:
+            # Phi-class causal heads carry a logit bias
+            bias = params.get("lm_head_b")
         head = (params["embed"].T if self.cfg.tie_embeddings
                 else params["lm_head"])
         return x, head, bias
@@ -724,7 +751,10 @@ class TransformerLM:
                             params.get("final_norm_b"))
             head = (params["embed"].T if cfg.tie_embeddings
                     else params["lm_head"])
-            logits = (ys @ head.astype(ys.dtype)).astype(jnp.float32)[:, :, :-1]
+            logits = (ys @ head.astype(ys.dtype)).astype(jnp.float32)
+            if "lm_head_b" in params:
+                logits = logits + params["lm_head_b"].astype(jnp.float32)
+            logits = logits[:, :, :-1]
             targets = ids_local[:, :, 1:]
             logp = jax.nn.log_softmax(logits, axis=-1)
             nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
@@ -1037,6 +1067,8 @@ class TransformerLM:
         x = self._norm(x, params["final_norm"], params.get("final_norm_b"))
         head = (params["embed"].T if cfg.tie_embeddings else params["lm_head"])
         logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+        if "lm_head_b" in params:
+            logits = logits + params["lm_head_b"].astype(jnp.float32)
         return logits, {"k": new_k, "v": new_v}
 
     def flops_per_token(self, seq_len: Optional[int] = None) -> float:

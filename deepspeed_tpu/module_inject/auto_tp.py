@@ -73,11 +73,7 @@ def _llama_family_config(hf_config, **extra) -> TransformerConfig:
         raise ValueError(
             f"rope_scaling={scaling!r} is not implemented; only plain-RoPE "
             f"configs convert")
-    prf = getattr(hf_config, "partial_rotary_factor", 1.0) or 1.0
-    if prf != 1.0:
-        raise ValueError(
-            f"partial_rotary_factor={prf} is not implemented; only "
-            f"full-rotary configs convert")
+    prf = float(getattr(hf_config, "partial_rotary_factor", 1.0) or 1.0)
     max_seq = _cap_to_window(
         hf_config, getattr(hf_config, "max_position_embeddings", 2048))
     return TransformerConfig(
@@ -94,6 +90,7 @@ def _llama_family_config(hf_config, **extra) -> TransformerConfig:
         tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
         attn_bias=extra.pop(
             "attn_bias", getattr(hf_config, "attention_bias", False)),
+        rotary_pct=prf,
         **extra,
     )
 
@@ -133,6 +130,35 @@ def config_from_hf(hf_config) -> TransformerConfig:
             hf_config, activation=gate,
             head_dim_override=hf_config.head_dim,
             embed_scale=float(hf_config.hidden_size) ** 0.5)
+    if mt == "phi":
+        # Phi-1/2: parallel residual with a single biased input
+        # LayerNorm, biased projections/MLP (fc1/fc2), PARTIAL rotary
+        # (rotary_pct from partial_rotary_factor), tanh gelu, and a
+        # biased untied lm_head
+        if getattr(hf_config, "qk_layernorm", False):
+            raise ValueError("phi qk_layernorm=True is not implemented")
+        if getattr(hf_config, "rope_scaling", None):
+            raise ValueError("phi rope_scaling is not implemented")
+        if hf_config.hidden_act not in ("gelu_new", "gelu_pytorch_tanh"):
+            raise ValueError(f"phi hidden_act {hf_config.hidden_act!r} "
+                             f"is not supported")
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+            max_seq_len=hf_config.max_position_embeddings,
+            norm="layernorm", norm_eps=hf_config.layer_norm_eps,
+            activation="gelu", positional="rope",
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            rotary_pct=float(getattr(hf_config, "partial_rotary_factor",
+                                     1.0)),
+            tie_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                   False),
+            attn_bias=True, mlp_bias=True, parallel_residual=True,
+            lm_head_bias=True)
     if mt == "starcoder2":
         # StarCoder2: llama skeleton with biased LayerNorms, biased
         # projections, and a non-gated tanh-gelu MLP (c_fc/c_proj)
@@ -323,9 +349,9 @@ def config_from_hf(hf_config) -> TransformerConfig:
         )
     raise ValueError(
         f"unsupported model_type '{mt}'; supported: llama, mistral, "
-        f"mixtral, qwen2, phi3, gemma, falcon, starcoder2, gpt2, opt, "
-        f"bert, roberta, distilbert (add a mapping here the way the "
-        f"reference adds policy containers)")
+        f"mixtral, qwen2, phi (1/2), phi3, gemma, falcon, starcoder2, "
+        f"gpt2, opt, bert, roberta, distilbert (add a mapping here the "
+        f"way the reference adds policy containers)")
 
 
 # ---------------------------------------------------------------------------
@@ -388,6 +414,45 @@ def _params_from_llama(sd, cfg: TransformerConfig) -> Dict[str, Any]:
         "w_down": _stack(sd, p + "mlp.down_proj.weight", L, transpose=True),
     })
     return _llama_family_top(sd, cfg, layers)
+
+
+def _params_from_phi(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+    """HF Phi-1/2: llama-style q/k/v names, o_proj spelled
+    self_attn.dense, fc1/fc2 MLP, one biased input LayerNorm per layer
+    (parallel residual), biased untied lm_head."""
+    L = cfg.num_layers
+    p = "model.layers.{}."
+    layers = {
+        "attn_norm": _stack(sd, p + "input_layernorm.weight", L),
+        "attn_norm_b": _stack(sd, p + "input_layernorm.bias", L),
+        "wq": _stack(sd, p + "self_attn.q_proj.weight", L, transpose=True),
+        "wk": _stack(sd, p + "self_attn.k_proj.weight", L, transpose=True),
+        "wv": _stack(sd, p + "self_attn.v_proj.weight", L, transpose=True),
+        "wo": _stack(sd, p + "self_attn.dense.weight", L, transpose=True),
+        "b_q": _stack(sd, p + "self_attn.q_proj.bias", L),
+        "b_k": _stack(sd, p + "self_attn.k_proj.bias", L),
+        "b_v": _stack(sd, p + "self_attn.v_proj.bias", L),
+        "b_o": _stack(sd, p + "self_attn.dense.bias", L),
+        "w_up": _stack(sd, p + "mlp.fc1.weight", L, transpose=True),
+        "b_up": _stack(sd, p + "mlp.fc1.bias", L),
+        "w_down": _stack(sd, p + "mlp.fc2.weight", L, transpose=True),
+        "b_down": _stack(sd, p + "mlp.fc2.bias", L),
+    }
+    out = {
+        "embed": np.ascontiguousarray(sd["model.embed_tokens.weight"],
+                                      np.float32),
+        "layers": layers,
+        "final_norm": np.ascontiguousarray(
+            sd["model.final_layernorm.weight"], np.float32),
+        "final_norm_b": np.ascontiguousarray(
+            sd["model.final_layernorm.bias"], np.float32),
+        # the logit bias survives tying (HF keeps it a separate param)
+        "lm_head_b": np.ascontiguousarray(sd["lm_head.bias"], np.float32),
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head"] = np.ascontiguousarray(sd["lm_head.weight"].T,
+                                              np.float32)
+    return out
 
 
 def _params_from_starcoder2(sd, cfg: TransformerConfig) -> Dict[str, Any]:
@@ -777,6 +842,8 @@ def params_from_hf(state_dict: Dict[str, Any],
         return _params_from_falcon(sd, cfg)
     if model_type == "starcoder2":
         return _params_from_starcoder2(sd, cfg)
+    if model_type == "phi":
+        return _params_from_phi(sd, cfg)
     if model_type == "mixtral":
         return _params_from_mixtral(sd, cfg)
     if model_type == "gpt2":

@@ -400,8 +400,8 @@ def test_rope_scaling_rejected_across_llama_family():
     for k, v in kw.items():
         setattr(P3, k, v)
     P3.rms_norm_eps = 1e-5
-    with pytest.raises(ValueError, match="partial_rotary_factor"):
-        config_from_hf(P3())
+    # partial rotary now CONVERTS (rotary_pct wiring) instead of raising
+    assert config_from_hf(P3()).rotary_pct == 0.75
 
 
 def test_gemma_injection_matches_hf():
@@ -563,3 +563,66 @@ def test_starcoder2_use_bias_false_matches_hf():
     _randomize_biases(hf, seed=18)   # norms keep biases; projections none
     ids = np.random.default_rng(18).integers(0, 96, (2, 9), dtype=np.int64)
     _assert_logits_match(hf, ids)
+
+
+def test_phi2_injection_matches_hf():
+    """Phi-1/2: parallel residual, partial rotary (rotary_pct), biased
+    everything including the untied lm_head."""
+    cfg = transformers.PhiConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, partial_rotary_factor=0.5,
+        layer_norm_eps=1e-5, resid_pdrop=0.0, embd_pdrop=0.0,
+        attention_dropout=0.0)
+    torch.manual_seed(19)
+    hf = transformers.PhiForCausalLM(cfg).eval()
+    _randomize_biases(hf, seed=19)
+    ids = np.random.default_rng(19).integers(0, 96, (2, 9), dtype=np.int64)
+    _assert_logits_match(hf, ids)
+
+
+def test_phi2_serves_through_v2():
+    cfg = transformers.PhiConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, partial_rotary_factor=0.5,
+        layer_norm_eps=1e-5, resid_pdrop=0.0, embd_pdrop=0.0,
+        attention_dropout=0.0)
+    torch.manual_seed(20)
+    hf = transformers.PhiForCausalLM(cfg).eval()
+    _randomize_biases(hf, seed=20)
+    import deepspeed_tpu
+    eng = deepspeed_tpu.init_inference(
+        hf, config={"use_ragged": True, "dtype": "float32",
+                    "ragged": {"state_manager": {
+                        "max_tracked_sequences": 2, "max_seq_len": 64,
+                        "num_blocks": 9, "block_size": 16}}})
+    eos = int(hf.config.eos_token_id or 0)
+    prompt = [3, 5, 7, 9, 13]
+    ours = eng.generate([prompt], max_new_tokens=8, eos_token_id=eos)[0]
+    with torch.no_grad():
+        theirs = hf.generate(
+            torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
+            pad_token_id=0, eos_token_id=eos).numpy()[0]
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_partial_rotary_llama_family_converts():
+    """partial_rotary_factor now wires to rotary_pct for the llama
+    family instead of rejecting (the runtime supports partial rotary)."""
+    from deepspeed_tpu.module_inject.auto_tp import config_from_hf
+
+    class C:
+        model_type = "llama"
+        vocab_size = 96
+        hidden_size = 32
+        intermediate_size = 64
+        num_hidden_layers = 2
+        num_attention_heads = 4
+        num_key_value_heads = 2
+        max_position_embeddings = 64
+        rms_norm_eps = 1e-5
+        partial_rotary_factor = 0.5
+        rope_scaling = None
+    cfg = config_from_hf(C())
+    assert cfg.rotary_pct == 0.5
