@@ -296,9 +296,11 @@ def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             o = jnp.einsum("hqk,khd->qhd", probs, vf).reshape(C, nh * hd)
         if cfg.parallel_residual:
-            # Falcon block: attention and MLP both read the shared normed
-            # input; one residual add
-            x = x + out_proj(lp, o) + _mlp(cfg, lp, hn, topo)
+            # Falcon block: attention and MLP both read the normed input;
+            # one residual add (NeoX parallel_norms norms separately)
+            hn2 = (_norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+                   if cfg.parallel_norms else hn)
+            x = x + out_proj(lp, o) + _mlp(cfg, lp, hn2, topo)
             return (x, kc, vc, ksc, vsc), None
         x = x + out_proj(lp, o)
         hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
@@ -380,9 +382,11 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         o = jnp.einsum("hqc,chd->qhd", probs, vpages).reshape(C, nh * hd)
         if cfg.parallel_residual:
-            # Falcon block: attention and MLP both read the shared normed
-            # input; one residual add
-            x = x + out_proj(lp, o) + _mlp(cfg, lp, hn, topo)
+            # Falcon block: attention and MLP both read the normed input;
+            # one residual add (NeoX parallel_norms norms separately)
+            hn2 = (_norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+                   if cfg.parallel_norms else hn)
+            x = x + out_proj(lp, o) + _mlp(cfg, lp, hn2, topo)
             return (x, kc, vc, ksc, vsc), None
         x = x + out_proj(lp, o)
         hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
@@ -472,9 +476,11 @@ def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             o = jnp.einsum("nhc,nchd->nhd", probs, vpages).reshape(N, nh * hd)
         if cfg.parallel_residual:
-            # Falcon block: attention and MLP both read the shared normed
-            # input; one residual add
-            x = x + out_proj(lp, o) + _mlp(cfg, lp, hn, topo)
+            # Falcon block: attention and MLP both read the normed input;
+            # one residual add (NeoX parallel_norms norms separately)
+            hn2 = (_norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+                   if cfg.parallel_norms else hn)
+            x = x + out_proj(lp, o) + _mlp(cfg, lp, hn2, topo)
             return (x, kc, vc, ksc, vsc), None
         x = x + out_proj(lp, o)
         hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))

@@ -54,8 +54,11 @@ class TransformerConfig:
     head_dim_override: Optional[int] = None
     embed_scale: float = 1.0
     # Falcon-family: one shared input norm feeds BOTH sublayers and the
-    # residual adds once (x + attn(ln x) + mlp(ln x)); MLP without biases
+    # residual adds once (x + attn(ln x) + mlp(ln x)); MLP without biases.
+    # parallel_norms (NeoX/Pythia): the parallel MLP reads its OWN norm
+    # of x (x + attn(ln1 x) + mlp(ln2 x)) instead of sharing ln1
     parallel_residual: bool = False
+    parallel_norms: bool = False
     mlp_bias: bool = True
     # fraction of head_dim that rotates (GPT-NeoX/Phi-class partial
     # rotary); the remaining dims pass through untouched
@@ -375,9 +378,9 @@ class TransformerLM:
                 layer["b_down"] = jnp.zeros((L, h), dt)
         if cfg.norm == "layernorm":
             layer["attn_norm_b"] = jnp.zeros((L, h), dt)
-            if not cfg.parallel_residual:
+            if not cfg.parallel_residual or cfg.parallel_norms:
                 layer["mlp_norm_b"] = jnp.zeros((L, h), dt)
-        if cfg.parallel_residual:
+        if cfg.parallel_residual and not cfg.parallel_norms:
             # one shared norm: the mlp_norm slot does not exist
             del layer["mlp_norm"]
         if cfg.attn_bias:
@@ -448,9 +451,9 @@ class TransformerLM:
                 layer["b_down"] = vec
         if cfg.norm == "layernorm":
             layer["attn_norm_b"] = vec
-            if not cfg.parallel_residual:
+            if not cfg.parallel_residual or cfg.parallel_norms:
                 layer["mlp_norm_b"] = vec
-        if cfg.parallel_residual:
+        if cfg.parallel_residual and not cfg.parallel_norms:
             layer.pop("mlp_norm")
         if cfg.attn_bias:
             col_b = P(pipe, "model") if tp > 1 else P(pipe, None)
@@ -527,9 +530,11 @@ class TransformerLM:
         o = self._attention(q, k, v)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
         if cfg.parallel_residual:
-            # Falcon block: both sublayers read the SAME normed input and
-            # the residual adds once
-            return (x + out_proj(lp, o) + dense_mlp(cfg, lp, hn),
+            # Falcon block: both sublayers read the normed input and the
+            # residual adds once; NeoX (parallel_norms) norms separately
+            hn2 = (self._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+                   if cfg.parallel_norms else hn)
+            return (x + out_proj(lp, o) + dense_mlp(cfg, lp, hn2),
                     jnp.zeros((), jnp.float32))
         x = x + out_proj(lp, o)
         if post:
@@ -1008,7 +1013,9 @@ class TransformerLM:
                            preferred_element_type=jnp.float32).astype(x.dtype)
         o = o.transpose(0, 2, 1, 3).reshape(B, S, nh * hd)
         if cfg.parallel_residual:
-            return (x + out_proj(lp, o) + dense_mlp(cfg, lp, hn),
+            hn2 = (self._norm(x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+                   if cfg.parallel_norms else hn)
+            return (x + out_proj(lp, o) + dense_mlp(cfg, lp, hn2),
                     ck, cv)
         x = x + out_proj(lp, o)
 

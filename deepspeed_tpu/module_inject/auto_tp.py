@@ -159,6 +159,36 @@ def config_from_hf(hf_config) -> TransformerConfig:
                                    False),
             attn_bias=True, mlp_bias=True, parallel_residual=True,
             lm_head_bias=True)
+    if mt == "gpt_neox":
+        # GPT-NeoX/Pythia: dual-norm parallel residual
+        # (x + attn(ln1 x) + mlp(ln2 x)), per-head-interleaved fused qkv
+        # (deinterleaved at conversion), partial rotary, exact-erf gelu,
+        # biased everything, untied embed_out head
+        if getattr(hf_config, "rope_scaling", None):
+            raise ValueError("gpt_neox rope_scaling is not implemented")
+        if hf_config.hidden_act not in ("gelu", "gelu_new",
+                                        "gelu_pytorch_tanh"):
+            raise ValueError(f"gpt_neox hidden_act "
+                             f"{hf_config.hidden_act!r} is not supported")
+        parallel = getattr(hf_config, "use_parallel_residual", True)
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            max_seq_len=hf_config.max_position_embeddings,
+            norm="layernorm", norm_eps=hf_config.layer_norm_eps,
+            activation="gelu_exact" if hf_config.hidden_act == "gelu"
+            else "gelu",
+            positional="rope",
+            rope_theta=getattr(hf_config, "rotary_emb_base", 10000.0),
+            rotary_pct=float(getattr(hf_config, "rotary_pct", 1.0)),
+            tie_embeddings=getattr(hf_config, "tie_word_embeddings",
+                                   False),
+            attn_bias=getattr(hf_config, "attention_bias", True),
+            mlp_bias=True,
+            parallel_residual=parallel, parallel_norms=parallel)
     if mt == "starcoder2":
         # StarCoder2: llama skeleton with biased LayerNorms, biased
         # projections, and a non-gated tanh-gelu MLP (c_fc/c_proj)
@@ -350,8 +380,8 @@ def config_from_hf(hf_config) -> TransformerConfig:
     raise ValueError(
         f"unsupported model_type '{mt}'; supported: llama, mistral, "
         f"mixtral, qwen2, phi (1/2), phi3, gemma, falcon, starcoder2, "
-        f"gpt2, opt, bert, roberta, distilbert (add a mapping here the "
-        f"way the reference adds policy containers)")
+        f"gpt_neox, gpt2, opt, bert, roberta, distilbert (add a mapping "
+        f"here the way the reference adds policy containers)")
 
 
 # ---------------------------------------------------------------------------
@@ -414,6 +444,62 @@ def _params_from_llama(sd, cfg: TransformerConfig) -> Dict[str, Any]:
         "w_down": _stack(sd, p + "mlp.down_proj.weight", L, transpose=True),
     })
     return _llama_family_top(sd, cfg, layers)
+
+
+def _params_from_gpt_neox(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+    """HF GPT-NeoX: attention.query_key_value fuses qkv PER HEAD
+    ([nh, 3, hd] rows) — deinterleave via reshape; both LayerNorms are
+    biased; mlp dense_h_to_4h / dense_4h_to_h; untied embed_out head."""
+    L = cfg.num_layers
+    nh, hd, H = cfg.num_heads, cfg.head_dim, cfg.hidden_size
+    t = "gpt_neox.layers.{}."
+
+    def qkv(i, j):
+        w = _np(sd[(t + "attention.query_key_value.weight").format(i)])
+        return w.reshape(nh, 3, hd, H)[:, j].reshape(nh * hd, H)
+
+    def qkv_b(i, j):
+        b = _np(sd[(t + "attention.query_key_value.bias").format(i)])
+        return b.reshape(nh, 3, hd)[:, j].reshape(nh * hd)
+
+    def stack(fn):
+        return np.ascontiguousarray(np.stack([fn(i) for i in range(L)]),
+                                    np.float32)
+
+    layers = {
+        "attn_norm": _stack(sd, t + "input_layernorm.weight", L),
+        "attn_norm_b": _stack(sd, t + "input_layernorm.bias", L),
+        "mlp_norm": _stack(sd, t + "post_attention_layernorm.weight", L),
+        "mlp_norm_b": _stack(sd, t + "post_attention_layernorm.bias", L),
+        "wq": stack(lambda i: qkv(i, 0).T),
+        "wk": stack(lambda i: qkv(i, 1).T),
+        "wv": stack(lambda i: qkv(i, 2).T),
+        "wo": _stack(sd, t + "attention.dense.weight", L, transpose=True),
+        "w_up": _stack(sd, t + "mlp.dense_h_to_4h.weight", L,
+                       transpose=True),
+        "b_up": _stack(sd, t + "mlp.dense_h_to_4h.bias", L),
+        "w_down": _stack(sd, t + "mlp.dense_4h_to_h.weight", L,
+                         transpose=True),
+        "b_down": _stack(sd, t + "mlp.dense_4h_to_h.bias", L),
+    }
+    if cfg.attn_bias:   # attention_bias=False variants carry no biases
+        layers["b_q"] = stack(lambda i: qkv_b(i, 0))
+        layers["b_k"] = stack(lambda i: qkv_b(i, 1))
+        layers["b_v"] = stack(lambda i: qkv_b(i, 2))
+        layers["b_o"] = _stack(sd, t + "attention.dense.bias", L)
+    out = {
+        "embed": np.ascontiguousarray(sd["gpt_neox.embed_in.weight"],
+                                      np.float32),
+        "layers": layers,
+        "final_norm": np.ascontiguousarray(
+            sd["gpt_neox.final_layer_norm.weight"], np.float32),
+        "final_norm_b": np.ascontiguousarray(
+            sd["gpt_neox.final_layer_norm.bias"], np.float32),
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head"] = np.ascontiguousarray(sd["embed_out.weight"].T,
+                                              np.float32)
+    return out
 
 
 def _params_from_phi(sd, cfg: TransformerConfig) -> Dict[str, Any]:
@@ -844,6 +930,8 @@ def params_from_hf(state_dict: Dict[str, Any],
         return _params_from_starcoder2(sd, cfg)
     if model_type == "phi":
         return _params_from_phi(sd, cfg)
+    if model_type == "gpt_neox":
+        return _params_from_gpt_neox(sd, cfg)
     if model_type == "mixtral":
         return _params_from_mixtral(sd, cfg)
     if model_type == "gpt2":

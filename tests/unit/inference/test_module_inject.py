@@ -626,3 +626,59 @@ def test_partial_rotary_llama_family_converts():
         rope_scaling = None
     cfg = config_from_hf(C())
     assert cfg.rotary_pct == 0.5
+
+
+@pytest.mark.parametrize("parallel", [True, False])
+def test_gpt_neox_injection_matches_hf(parallel):
+    """GPT-NeoX/Pythia: dual-norm parallel residual (or sequential when
+    use_parallel_residual=False), per-head-interleaved fused qkv,
+    partial rotary."""
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25,
+        use_parallel_residual=parallel, hidden_dropout=0.0,
+        attention_dropout=0.0, layer_norm_eps=1e-5)
+    torch.manual_seed(21 + parallel)
+    hf = transformers.GPTNeoXForCausalLM(cfg).eval()
+    _randomize_biases(hf, seed=21 + parallel)
+    ids = np.random.default_rng(21).integers(0, 96, (2, 9), dtype=np.int64)
+    _assert_logits_match(hf, ids)
+
+
+def test_gpt_neox_serves_through_v2():
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25,
+        hidden_dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(23)
+    hf = transformers.GPTNeoXForCausalLM(cfg).eval()
+    _randomize_biases(hf, seed=23)
+    import deepspeed_tpu
+    eng = deepspeed_tpu.init_inference(
+        hf, config={"use_ragged": True, "dtype": "float32",
+                    "ragged": {"state_manager": {
+                        "max_tracked_sequences": 2, "max_seq_len": 64,
+                        "num_blocks": 9, "block_size": 16}}})
+    eos = int(hf.config.eos_token_id or 0)
+    prompt = [3, 5, 7, 9, 13]
+    ours = eng.generate([prompt], max_new_tokens=8, eos_token_id=eos)[0]
+    with torch.no_grad():
+        theirs = hf.generate(
+            torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
+            pad_token_id=0, eos_token_id=eos).numpy()[0]
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_gpt_neox_attention_bias_false_matches_hf():
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25, attention_bias=False,
+        hidden_dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(24)
+    hf = transformers.GPTNeoXForCausalLM(cfg).eval()
+    _randomize_biases(hf, seed=24)
+    ids = np.random.default_rng(24).integers(0, 96, (2, 9), dtype=np.int64)
+    _assert_logits_match(hf, ids)
