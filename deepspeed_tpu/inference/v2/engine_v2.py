@@ -118,7 +118,9 @@ class InferenceEngineV2:
         # pool tiles; int8 pages + scale tiles would need a variant) —
         # the flash PREFILL kernel attends over the in-chunk
         # full-precision q/k/v and never reads the pool, so it stays on
-        use_kernel = config.use_paged_kernel and tp == 1 and ep == 1
+        use_kernel = (config.use_paged_kernel and tp == 1 and ep == 1
+                      and cfg.positional != "alibi")  # kernels carry no
+        # alibi bias; the jnp paths add the softmax-invariant row
         use_kernel_decode = use_kernel and not config.kv_quant
         topo = self.topology if ep > 1 else None
         self._decode_jit = jax.jit(

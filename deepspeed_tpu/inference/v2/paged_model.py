@@ -218,6 +218,22 @@ def _deq_layer(lp):
     return dequantize_params(lp)
 
 
+def _embed_ln(cfg, params, x):
+    """Bloom/BERT-family embeddings LayerNorm (keyed on param presence)."""
+    if "embed_ln_w" in params:
+        from ...ops.norms import layer_norm
+        return layer_norm(x, params["embed_ln_w"],
+                          params.get("embed_ln_b"), cfg.norm_eps)
+    return x
+
+
+def _alibi_row(cfg, positions):
+    """[nh, 1, len(positions)] softmax-invariant ALiBi bias row."""
+    from ...models.transformer import alibi_slopes
+    return (alibi_slopes(cfg.num_heads)[:, None, None]
+            * positions.astype(jnp.float32)[None, None, :])
+
+
 def _logits(cfg, params, x):
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     out = (x @ head.astype(x.dtype)).astype(jnp.float32)
@@ -248,11 +264,13 @@ def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
     nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
     # shape gates only: off-TPU the kernel runs in interpret mode (slow but
     # identical math), which is what lets CPU tests cover this path
-    flash_ok = use_kernel and C % 128 == 0 and hd % 8 == 0
+    flash_ok = (use_kernel and C % 128 == 0 and hd % 8 == 0
+                and cfg.positional != "alibi")
     params = _deq_nonlayer(params)
     x = params["embed"][ids[0]]                                # [C, H]
     if cfg.embed_scale != 1.0:
         x = x * jnp.asarray(cfg.embed_scale, x.dtype)
+    x = _embed_ln(cfg, params, x)
     if cfg.positional == "learned":
         # the bucket C may round past max_seq_len; clip like paged_continue
         x = x + params["pos_embed"][
@@ -292,6 +310,8 @@ def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
                 vf = jnp.repeat(vf, nh // nkv, axis=1)
             scores = jnp.einsum("qhd,khd->hqk", q, kf).astype(jnp.float32)
             scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+            if cfg.positional == "alibi":
+                scores = scores + _alibi_row(cfg, pos)
             scores = jnp.where(mask[None], scores, NEG_INF)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             o = jnp.einsum("hqk,khd->qhd", probs, vf).reshape(C, nh * hd)
@@ -347,6 +367,7 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
     x = params["embed"][ids[0]]                                 # [C, H]
     if cfg.embed_scale != 1.0:
         x = x * jnp.asarray(cfg.embed_scale, x.dtype)
+    x = _embed_ln(cfg, params, x)
     pos = start_pos + jnp.arange(C)                             # [C]
     if cfg.positional == "learned":
         x = x + params["pos_embed"][jnp.clip(pos, 0, cfg.max_seq_len - 1)]
@@ -378,6 +399,8 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
             vpages = jnp.repeat(vpages, nh // nkv, axis=1)
         scores = jnp.einsum("qhd,chd->hqc", q, kpages).astype(jnp.float32)
         scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+        if cfg.positional == "alibi":
+            scores = scores + _alibi_row(cfg, ctx_pos)
         scores = jnp.where(mask[None], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
         o = jnp.einsum("hqc,chd->qhd", probs, vpages).reshape(C, nh * hd)
@@ -430,6 +453,7 @@ def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
     x = params["embed"][toks]                                   # [N, H]
     if cfg.embed_scale != 1.0:
         x = x * jnp.asarray(cfg.embed_scale, x.dtype)
+    x = _embed_ln(cfg, params, x)
     if cfg.positional == "learned":
         x = x + params["pos_embed"][jnp.clip(pos, 0, cfg.max_seq_len - 1)]
     cos, sin = _rope_at(cfg, pos)                               # [N, half]
@@ -472,6 +496,8 @@ def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
                 vpages = jnp.repeat(vpages, nh // nkv, axis=2)
             scores = jnp.einsum("nhd,nchd->nhc", q, kpages).astype(jnp.float32)
             scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+            if cfg.positional == "alibi":
+                scores = scores + _alibi_row(cfg, ctx_pos)[None, :, 0, :]
             scores = jnp.where(attn_mask[:, None, :], scores, NEG_INF)
             probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
             o = jnp.einsum("nhc,nchd->nhd", probs, vpages).reshape(N, nh * hd)

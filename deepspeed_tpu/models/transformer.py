@@ -46,7 +46,7 @@ class TransformerConfig:
     norm: str = "rmsnorm"                  # rmsnorm | layernorm
     norm_eps: float = 1e-5
     activation: str = "swiglu"     # swiglu | geglu | geglu_exact | gelu | relu
-    positional: str = "rope"               # rope | learned
+    positional: str = "rope"               # rope | learned | alibi
     attn_bias: bool = False                # q/k/v/o projection biases (GPT-2/OPT)
     # Gemma-family knobs: q/o project to num_heads*head_dim != hidden
     # (Gemma-7B: 16x256 vs H=3072); embeddings scale by sqrt(H) at lookup
@@ -172,6 +172,21 @@ class TransformerConfig:
 
 
 # ---------------------------------------------------------------------------
+
+
+def alibi_slopes(nh: int) -> jnp.ndarray:
+    """Standard ALiBi head slopes (press et al.; HF build_alibi_tensor):
+    geometric sequence 2^(-8/nh) for power-of-two head counts, with the
+    interleaved extension otherwise."""
+    def pow2(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(nh).is_integer():
+        return jnp.asarray(pow2(nh), jnp.float32)
+    closest = 2 ** math.floor(math.log2(nh))
+    extra = pow2(2 * closest)[0::2][:nh - closest]
+    return jnp.asarray(pow2(closest) + extra, jnp.float32)
 
 
 def rotary_dims(cfg: TransformerConfig) -> int:
@@ -495,6 +510,32 @@ class TransformerLM:
     def _attention(self, q, k, v):
         cfg = self.cfg
         from ..sequence.layer import sharded_attention
+
+        if cfg.positional == "alibi":
+            # ALiBi bias is softmax-invariant in the query position, so
+            # it reduces to slope_h * key_pos — one [H, 1, S] row added
+            # pre-softmax. Plain einsum path (GSPMD partitions dp/tp);
+            # flash/sequence-parallel do not carry the bias.
+            if (self.topology is not None
+                    and self.topology.axis_size("seq") > 1):
+                raise NotImplementedError(
+                    "alibi attention does not compose with sequence "
+                    "parallelism")
+            B, H, S, D = q.shape
+            if k.shape[1] != H:
+                k = jnp.repeat(k, H // k.shape[1], axis=1)
+                v = jnp.repeat(v, H // v.shape[1], axis=1)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(
+                jnp.float32) / math.sqrt(D)
+            bias = alibi_slopes(cfg.num_heads)[:, None, None] \
+                * jnp.arange(S, dtype=jnp.float32)[None, None, :]
+            scores = scores + bias[None]
+            if cfg.is_causal:
+                causal = jnp.tril(jnp.ones((S, S), bool))
+                scores = jnp.where(causal[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+            o = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+            return checkpoint_name(o, "attn_out")
 
         # policy: XLA fused attention for short sequences, Pallas flash once
         # the S^2 score tensor dominates (see flash_min_seq rationale)
@@ -982,7 +1023,8 @@ class TransformerLM:
                                or topo.axis_size("model") <= 1)
         # tp>1 keeps the einsum path: GSPMD can partition it over the head
         # axis, while a bare pallas_call is not partition-safe
-        if cfg.decode_kernel and S == 1 and hd % 8 == 0 and tp1:
+        if (cfg.decode_kernel and S == 1 and hd % 8 == 0 and tp1
+                and cfg.positional != "alibi"):
             # Pallas dense-cache decode: streams each kv head's cache once
             # (no GQA repeat materialization) and skips blocks past the
             # sequence length — the v1-kernel decode path (reference
@@ -1006,6 +1048,9 @@ class TransformerLM:
                            preferred_element_type=jnp.float32) / math.sqrt(hd)
             q_pos = start_pos + jnp.arange(S)[:, None]         # [S,1]
             k_pos = jnp.arange(max_len)[None, :]               # [1,M]
+            if cfg.positional == "alibi":
+                s = s + (alibi_slopes(nh)[:, None, None]
+                         * k_pos.astype(jnp.float32))[None]
             mask = k_pos <= q_pos                              # causal+valid
             s = jnp.where(mask[None, None], s, -1e30)
             p = jax.nn.softmax(s, axis=-1)
@@ -1053,6 +1098,9 @@ class TransformerLM:
         x = params["embed"][input_ids].astype(cache["k"].dtype)
         if cfg.embed_scale != 1.0:
             x = x * jnp.asarray(cfg.embed_scale, x.dtype)
+        if "embed_ln_w" in params:   # Bloom/BERT-family embeddings LN
+            x = layer_norm(x, params["embed_ln_w"],
+                           params.get("embed_ln_b"), cfg.norm_eps)
         if cfg.positional == "learned":
             pos = start_pos + jnp.arange(S)
             x = x + params["pos_embed"][pos][None].astype(x.dtype)

@@ -159,6 +159,20 @@ def config_from_hf(hf_config) -> TransformerConfig:
                                    False),
             attn_bias=True, mlp_bias=True, parallel_residual=True,
             lm_head_bias=True)
+    if mt == "bloom":
+        # Bloom: ALiBi positions (no rotary), embeddings LayerNorm,
+        # per-head-interleaved fused qkv like NeoX, tanh gelu, tied head
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=4 * hf_config.hidden_size,
+            num_layers=hf_config.n_layer,
+            num_heads=hf_config.n_head,
+            max_seq_len=getattr(hf_config, "seq_length", 2048),
+            norm="layernorm", norm_eps=hf_config.layer_norm_epsilon,
+            activation="gelu", positional="alibi",
+            tie_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+            attn_bias=True, mlp_bias=True, embed_ln=True)
     if mt == "gpt_neox":
         # GPT-NeoX/Pythia: dual-norm parallel residual
         # (x + attn(ln1 x) + mlp(ln2 x)), per-head-interleaved fused qkv
@@ -380,8 +394,8 @@ def config_from_hf(hf_config) -> TransformerConfig:
     raise ValueError(
         f"unsupported model_type '{mt}'; supported: llama, mistral, "
         f"mixtral, qwen2, phi (1/2), phi3, gemma, falcon, starcoder2, "
-        f"gpt_neox, gpt2, opt, bert, roberta, distilbert (add a mapping "
-        f"here the way the reference adds policy containers)")
+        f"gpt_neox, bloom, gpt2, opt, bert, roberta, distilbert (add a "
+        f"mapping here the way the reference adds policy containers)")
 
 
 # ---------------------------------------------------------------------------
@@ -446,34 +460,103 @@ def _params_from_llama(sd, cfg: TransformerConfig) -> Dict[str, Any]:
     return _llama_family_top(sd, cfg, layers)
 
 
-def _params_from_gpt_neox(sd, cfg: TransformerConfig) -> Dict[str, Any]:
-    """HF GPT-NeoX: attention.query_key_value fuses qkv PER HEAD
-    ([nh, 3, hd] rows) — deinterleave via reshape; both LayerNorms are
-    biased; mlp dense_h_to_4h / dense_4h_to_h; untied embed_out head."""
-    L = cfg.num_layers
-    nh, hd, H = cfg.num_heads, cfg.head_dim, cfg.hidden_size
-    t = "gpt_neox.layers.{}."
-
+def _interleaved_qkv(sd, fmt: str, nh: int, hd: int, H: int, L: int):
+    """Deinterleave NeoX/Bloom-style fused qkv ([nh, 3, hd] rows): returns
+    stacked (wq, wk, wv, b_q, b_k, b_v) with weights transposed to
+    [L, H, nh*hd]."""
     def qkv(i, j):
-        w = _np(sd[(t + "attention.query_key_value.weight").format(i)])
+        w = _np(sd[(fmt + ".weight").format(i)])
         return w.reshape(nh, 3, hd, H)[:, j].reshape(nh * hd, H)
 
     def qkv_b(i, j):
-        b = _np(sd[(t + "attention.query_key_value.bias").format(i)])
+        b = _np(sd[(fmt + ".bias").format(i)])
         return b.reshape(nh, 3, hd)[:, j].reshape(nh * hd)
 
     def stack(fn):
         return np.ascontiguousarray(np.stack([fn(i) for i in range(L)]),
                                     np.float32)
 
+    return (stack(lambda i: qkv(i, 0).T), stack(lambda i: qkv(i, 1).T),
+            stack(lambda i: qkv(i, 2).T),
+            stack(lambda i: qkv_b(i, 0)), stack(lambda i: qkv_b(i, 1)),
+            stack(lambda i: qkv_b(i, 2)))
+
+
+def _interleaved_weights_only(sd, fmt, nh, hd, H, L):
+    def qkv(i, j):
+        w = _np(sd[(fmt + ".weight").format(i)])
+        return w.reshape(nh, 3, hd, H)[:, j].reshape(nh * hd, H)
+
+    def stack(fn):
+        return np.ascontiguousarray(np.stack([fn(i) for i in range(L)]),
+                                    np.float32)
+
+    return (stack(lambda i: qkv(i, 0).T), stack(lambda i: qkv(i, 1).T),
+            stack(lambda i: qkv(i, 2).T))
+
+
+def _params_from_bloom(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+    """HF Bloom: NeoX-style per-head-interleaved fused qkv under
+    transformer.h.{i}.self_attention, embeddings LayerNorm, tied head."""
+    L = cfg.num_layers
+    t = "transformer.h.{}."
+    wq, wk, wv, b_q, b_k, b_v = _interleaved_qkv(
+        sd, t + "self_attention.query_key_value", cfg.num_heads,
+        cfg.head_dim, cfg.hidden_size, L)
     layers = {
         "attn_norm": _stack(sd, t + "input_layernorm.weight", L),
         "attn_norm_b": _stack(sd, t + "input_layernorm.bias", L),
         "mlp_norm": _stack(sd, t + "post_attention_layernorm.weight", L),
         "mlp_norm_b": _stack(sd, t + "post_attention_layernorm.bias", L),
-        "wq": stack(lambda i: qkv(i, 0).T),
-        "wk": stack(lambda i: qkv(i, 1).T),
-        "wv": stack(lambda i: qkv(i, 2).T),
+        "wq": wq, "wk": wk, "wv": wv,
+        "wo": _stack(sd, t + "self_attention.dense.weight", L,
+                     transpose=True),
+        "b_q": b_q, "b_k": b_k, "b_v": b_v,
+        "b_o": _stack(sd, t + "self_attention.dense.bias", L),
+        "w_up": _stack(sd, t + "mlp.dense_h_to_4h.weight", L,
+                       transpose=True),
+        "b_up": _stack(sd, t + "mlp.dense_h_to_4h.bias", L),
+        "w_down": _stack(sd, t + "mlp.dense_4h_to_h.weight", L,
+                         transpose=True),
+        "b_down": _stack(sd, t + "mlp.dense_4h_to_h.bias", L),
+    }
+    out = {
+        "embed": np.ascontiguousarray(
+            sd["transformer.word_embeddings.weight"], np.float32),
+        "embed_ln_w": np.ascontiguousarray(
+            sd["transformer.word_embeddings_layernorm.weight"], np.float32),
+        "embed_ln_b": np.ascontiguousarray(
+            sd["transformer.word_embeddings_layernorm.bias"], np.float32),
+        "layers": layers,
+        "final_norm": np.ascontiguousarray(sd["transformer.ln_f.weight"],
+                                           np.float32),
+        "final_norm_b": np.ascontiguousarray(sd["transformer.ln_f.bias"],
+                                             np.float32),
+    }
+    if not cfg.tie_embeddings:
+        out["lm_head"] = np.ascontiguousarray(sd["lm_head.weight"].T,
+                                              np.float32)
+    return out
+
+
+def _params_from_gpt_neox(sd, cfg: TransformerConfig) -> Dict[str, Any]:
+    """HF GPT-NeoX: attention.query_key_value fuses qkv PER HEAD
+    ([nh, 3, hd] rows) — deinterleave via reshape; both LayerNorms are
+    biased; mlp dense_h_to_4h / dense_4h_to_h; untied embed_out head."""
+    L = cfg.num_layers
+    t = "gpt_neox.layers.{}."
+    wq, wk, wv, b_q, b_k, b_v = _interleaved_qkv(
+        sd, t + "attention.query_key_value", cfg.num_heads, cfg.head_dim,
+        cfg.hidden_size, L) if cfg.attn_bias else (
+        *_interleaved_weights_only(sd, t + "attention.query_key_value",
+                                   cfg.num_heads, cfg.head_dim,
+                                   cfg.hidden_size, L), None, None, None)
+    layers = {
+        "attn_norm": _stack(sd, t + "input_layernorm.weight", L),
+        "attn_norm_b": _stack(sd, t + "input_layernorm.bias", L),
+        "mlp_norm": _stack(sd, t + "post_attention_layernorm.weight", L),
+        "mlp_norm_b": _stack(sd, t + "post_attention_layernorm.bias", L),
+        "wq": wq, "wk": wk, "wv": wv,
         "wo": _stack(sd, t + "attention.dense.weight", L, transpose=True),
         "w_up": _stack(sd, t + "mlp.dense_h_to_4h.weight", L,
                        transpose=True),
@@ -483,9 +566,7 @@ def _params_from_gpt_neox(sd, cfg: TransformerConfig) -> Dict[str, Any]:
         "b_down": _stack(sd, t + "mlp.dense_4h_to_h.bias", L),
     }
     if cfg.attn_bias:   # attention_bias=False variants carry no biases
-        layers["b_q"] = stack(lambda i: qkv_b(i, 0))
-        layers["b_k"] = stack(lambda i: qkv_b(i, 1))
-        layers["b_v"] = stack(lambda i: qkv_b(i, 2))
+        layers["b_q"], layers["b_k"], layers["b_v"] = b_q, b_k, b_v
         layers["b_o"] = _stack(sd, t + "attention.dense.bias", L)
     out = {
         "embed": np.ascontiguousarray(sd["gpt_neox.embed_in.weight"],
@@ -932,6 +1013,8 @@ def params_from_hf(state_dict: Dict[str, Any],
         return _params_from_phi(sd, cfg)
     if model_type == "gpt_neox":
         return _params_from_gpt_neox(sd, cfg)
+    if model_type == "bloom":
+        return _params_from_bloom(sd, cfg)
     if model_type == "mixtral":
         return _params_from_mixtral(sd, cfg)
     if model_type == "gpt2":

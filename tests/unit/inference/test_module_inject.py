@@ -166,7 +166,7 @@ def test_unsupported_arch_raises():
     from deepspeed_tpu.module_inject import config_from_hf
 
     class FakeCfg:
-        model_type = "bloom"
+        model_type = "t5"
 
     with pytest.raises(ValueError, match="unsupported"):
         config_from_hf(FakeCfg())
@@ -682,3 +682,65 @@ def test_gpt_neox_attention_bias_false_matches_hf():
     _randomize_biases(hf, seed=24)
     ids = np.random.default_rng(24).integers(0, 96, (2, 9), dtype=np.int64)
     _assert_logits_match(hf, ids)
+
+
+def test_bloom_injection_matches_hf():
+    """Bloom: ALiBi positions, embeddings LayerNorm, per-head-interleaved
+    fused qkv, tied head."""
+    cfg = transformers.BloomConfig(
+        vocab_size=96, hidden_size=32, n_layer=2, n_head=4,
+        layer_norm_epsilon=1e-5, hidden_dropout=0.0,
+        attention_dropout=0.0)
+    torch.manual_seed(25)
+    hf = transformers.BloomForCausalLM(cfg).eval()
+    _randomize_biases(hf, seed=25)
+    ids = np.random.default_rng(25).integers(0, 96, (2, 9), dtype=np.int64)
+    _assert_logits_match(hf, ids)
+
+
+def test_bloom_serves_through_v2():
+    cfg = transformers.BloomConfig(
+        vocab_size=96, hidden_size=32, n_layer=2, n_head=4,
+        layer_norm_epsilon=1e-5, hidden_dropout=0.0,
+        attention_dropout=0.0)
+    torch.manual_seed(26)
+    hf = transformers.BloomForCausalLM(cfg).eval()
+    _randomize_biases(hf, seed=26)
+    import deepspeed_tpu
+    eng = deepspeed_tpu.init_inference(
+        hf, config={"use_ragged": True, "dtype": "float32",
+                    "ragged": {"state_manager": {
+                        "max_tracked_sequences": 2, "max_seq_len": 64,
+                        "num_blocks": 9, "block_size": 16}}})
+    eos = int(hf.config.eos_token_id or 0)
+    prompt = [3, 5, 7, 9, 13]
+    ours = eng.generate([prompt], max_new_tokens=8, eos_token_id=eos)[0]
+    with torch.no_grad():
+        theirs = hf.generate(
+            torch.tensor([prompt]), max_new_tokens=8, do_sample=False,
+            pad_token_id=0, eos_token_id=eos).numpy()[0]
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_bloom_v1_engine_generate_matches_hf():
+    """The v1 dense-cache decode path carries the alibi bias + embeddings
+    LayerNorm too."""
+    cfg = transformers.BloomConfig(
+        vocab_size=96, hidden_size=32, n_layer=2, n_head=4,
+        layer_norm_epsilon=1e-5, hidden_dropout=0.0,
+        attention_dropout=0.0)
+    torch.manual_seed(27)
+    hf = transformers.BloomForCausalLM(cfg).eval()
+    _randomize_biases(hf, seed=27)
+    model, params = load_hf_model(hf)
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    eng = InferenceEngine(model, DeepSpeedInferenceConfig(dtype="float32"),
+                          params=params)
+    out = eng.generate(np.array([[3, 5, 7, 9, 13]]), max_new_tokens=6,
+                       temperature=0.0)
+    with torch.no_grad():
+        ref = hf.generate(torch.tensor([[3, 5, 7, 9, 13]]),
+                          max_new_tokens=6, do_sample=False,
+                          pad_token_id=0, eos_token_id=None)
+    np.testing.assert_array_equal(out, ref.numpy())
