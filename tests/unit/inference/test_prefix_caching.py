@@ -203,3 +203,31 @@ def test_splitfuse_scheduler_reuses_prefix(tiny):
     # 48 of 50 prompt tokens rode retained blocks: total prefill work
     # scheduled is just the 2-token suffix (+ decode steps of 1)
     assert sum(sizes) <= 2 + 5
+
+
+def test_prefix_caching_composes_with_kv_quant(tiny):
+    """Shared prefix blocks carry their int8 scales with them: reuse
+    under kv_quant stays exact relative to a fresh kv_quant engine
+    (same quantized KV content, same dequantized reads)."""
+    model, params = tiny
+
+    def make():
+        return InferenceEngineV2(
+            model, RaggedInferenceEngineConfig(
+                state_manager=DSStateManagerConfig(
+                    max_tracked_sequences=8, max_seq_len=256,
+                    num_blocks=65, block_size=16,
+                    enable_prefix_caching=True),
+                dtype="float32", prefill_bucket=16, kv_quant=True),
+            params=params)
+
+    rng = np.random.default_rng(5)
+    prompt = list(map(int, rng.integers(1, 127, 50)))
+    ref = make().generate([prompt], max_new_tokens=6)[0]
+    eng = make()
+    out1 = eng.generate([prompt], max_new_tokens=6, uids=[1])[0]
+    np.testing.assert_array_equal(out1, ref)
+    # second serve rides the retained quantized blocks — bitwise equal
+    out2 = eng.generate([prompt], max_new_tokens=6, uids=[2])[0]
+    np.testing.assert_array_equal(out2, ref)
+    assert len(eng.state_manager._prefix) >= 3
