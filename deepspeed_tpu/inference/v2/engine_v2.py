@@ -141,15 +141,15 @@ class InferenceEngineV2:
 
         self._decode_tok_jit = jax.jit(_decode_tok, donate_argnums=(4,))
 
-        def _decode_sample(p, t, pos, bt, c, a, rng, temp, topp):
-            # sampling variant (FastGen temperature/top-p): the sampler
-            # runs device-side too, still an [N] int32 host transfer
+        def _decode_sample(p, t, pos, bt, c, a, rng, temp, topp, topk):
+            # sampling variant (FastGen temperature/top-p/top-k): the
+            # sampler runs device-side too, still an [N] int32 transfer
             from .sampling import sample_tokens
             logits, c = paged_decode(cfg, p, t, pos, bt, c, a,
                                      sm.block_size,
                                      use_kernel=use_kernel_decode,
                                      topo=topo)
-            return sample_tokens(logits, rng, temp, topp), c
+            return sample_tokens(logits, rng, temp, topp, topk), c
 
         self._decode_sample_jit = jax.jit(_decode_sample,
                                           donate_argnums=(4,))
@@ -465,16 +465,17 @@ class InferenceEngineV2:
                                    lambda v, i: int(v[i]))
 
     def _decode_batch_sample(self, uids: List[int], tokens: List[int],
-                             rng, temperature: float,
-                             top_p: float) -> Dict[int, int]:
-        """Sampled decode step (device-side temperature/top-p)."""
+                             rng, temperature: float, top_p: float,
+                             top_k: int = 0) -> Dict[int, int]:
+        """Sampled decode step (device-side temperature/top-p/top-k)."""
         N = self._decode_bucket(len(uids))
         temp = jnp.full((N,), temperature, jnp.float32)
         topp = jnp.full((N,), top_p, jnp.float32)
+        topk = jnp.full((N,), top_k, jnp.int32)
         return self._decode_common(
             uids, tokens,
             lambda p, t, pos, bt, c, a: self._decode_sample_jit(
-                p, t, pos, bt, c, a, rng, temp, topp),
+                p, t, pos, bt, c, a, rng, temp, topp, topk),
             lambda v, i: int(v[i]))
 
     def put(self, batch_uids: Sequence[int],
@@ -528,8 +529,8 @@ class InferenceEngineV2:
                  uids: Optional[Sequence[int]] = None,
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_p: float = 1.0,
-                 seed: int = 0, speculative: bool = False, spec_k: int = 4,
-                 spec_ngram: int = 3) -> List[np.ndarray]:
+                 top_k: int = 0, seed: int = 0, speculative: bool = False,
+                 spec_k: int = 4, spec_ngram: int = 3) -> List[np.ndarray]:
         """Greedy by default; temperature > 0 samples with nucleus top_p
         (FastGen's sampling surface), deterministic for a given seed.
         ``speculative`` turns on prompt-lookup decoding (greedy only):
@@ -556,7 +557,8 @@ class InferenceEngineV2:
                 first = np.asarray(sample_tokens(
                     jnp.asarray(logits), jax.random.fold_in(base_rng, 0),
                     jnp.full((len(uids),), temperature, jnp.float32),
-                    jnp.full((len(uids),), top_p, jnp.float32)))
+                    jnp.full((len(uids),), top_p, jnp.float32),
+                    jnp.full((len(uids),), top_k, jnp.int32)))
                 cur = {uid: int(t) for uid, t in zip(uids, first)}
             else:
                 cur = {uid: int(t) for uid, t in
@@ -597,7 +599,7 @@ class InferenceEngineV2:
                     cur = self._decode_batch_sample(
                         step_uids, feed,
                         jax.random.fold_in(base_rng, step + 1),
-                        temperature, top_p)
+                        temperature, top_p, top_k)
                 elif speculative:
                     cur = self._speculative_round(
                         step_uids, outs, row_of, prompt_lens, live,

@@ -23,29 +23,37 @@ import numpy as np
 NEG_INF = -1e30
 
 
-def _topp_mask_sorted(sorted_logits, top_p):
+def _topp_mask_sorted(sorted_logits, top_p, top_k=None):
     """Mask (to NEG_INF) the tail of descending-sorted logits whose
-    cumulative softmax probability lies past top_p. top_p broadcasts
-    [N] -> rows; values <= 0 clamp to keep-only-the-top-token (the limit
-    behavior — all-masked rows would crash the host twin and sample
-    uniform garbage on device)."""
+    cumulative softmax probability lies past top_p, and (when top_k > 0)
+    every rank past top_k. top_p/top_k broadcast [N] -> rows; top_p <= 0
+    clamps to keep-only-the-top-token (the limit behavior — all-masked
+    rows would crash the host twin and sample uniform garbage on
+    device)."""
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     # exclusive cumsum: a token is kept while the mass BEFORE it is
     # still below top_p — the first token survives any top_p > 0
     cum_before = jnp.cumsum(probs, axis=-1) - probs
     keep = cum_before < jnp.maximum(top_p, 1e-9)[..., None]
+    if top_k is not None:
+        rank = jnp.arange(sorted_logits.shape[-1])
+        k = jnp.where(top_k > 0, top_k,
+                      sorted_logits.shape[-1])[..., None]
+        keep = keep & (rank[None, :] < k)
     return jnp.where(keep, sorted_logits, NEG_INF)
 
 
 def sample_tokens(logits: jnp.ndarray, rng, temperature: jnp.ndarray,
-                  top_p: jnp.ndarray) -> jnp.ndarray:
-    """logits [N, V]; temperature/top_p [N] (0 temperature = greedy).
-    Returns [N] int32 tokens. Jit-friendly (no data-dependent shapes)."""
+                  top_p: jnp.ndarray,
+                  top_k: jnp.ndarray = None) -> jnp.ndarray:
+    """logits [N, V]; temperature/top_p/top_k [N] (0 temperature =
+    greedy; top_k 0/None = no rank cutoff). Returns [N] int32 tokens.
+    Jit-friendly (no data-dependent shapes)."""
     greedy = temperature <= 0.0
     scaled = logits / jnp.maximum(temperature, 1e-6)[..., None]
     order = jnp.argsort(-scaled, axis=-1)
     sorted_logits = jnp.take_along_axis(scaled, order, axis=-1)
-    masked = _topp_mask_sorted(sorted_logits, top_p)
+    masked = _topp_mask_sorted(sorted_logits, top_p, top_k)
     pick = jax.random.categorical(rng, masked, axis=-1)      # [N] sorted-idx
     sampled = jnp.take_along_axis(order, pick[..., None], axis=-1)[..., 0]
     return jnp.where(greedy, jnp.argmax(logits, axis=-1),
@@ -53,9 +61,10 @@ def sample_tokens(logits: jnp.ndarray, rng, temperature: jnp.ndarray,
 
 
 def host_sample(logits: np.ndarray, rng: np.random.Generator,
-                temperature: float, top_p: float) -> int:
-    """One row, host-side: same temperature/top-p math as sample_tokens
-    (tested equivalent) with a per-request numpy Generator."""
+                temperature: float, top_p: float, top_k: int = 0) -> int:
+    """One row, host-side: same temperature/top-p/top-k math as
+    sample_tokens (tested equivalent) with a per-request numpy
+    Generator."""
     if temperature <= 0.0:
         return int(np.argmax(logits))
     scaled = logits.astype(np.float64) / max(temperature, 1e-6)
@@ -65,6 +74,8 @@ def host_sample(logits: np.ndarray, rng: np.random.Generator,
     p /= p.sum()
     cum_before = np.cumsum(p) - p
     keep = cum_before < max(top_p, 1e-9)  # <=0 clamps to top-token-only
+    if top_k and top_k > 0:
+        keep = keep & (np.arange(len(p)) < top_k)
     p = np.where(keep, p, 0.0)
     p /= p.sum()
     return int(order[rng.choice(len(p), p=p)])

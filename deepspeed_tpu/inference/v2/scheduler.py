@@ -40,6 +40,7 @@ class _Request:
     submit_t: float
     temperature: float = 0.0         # 0 = greedy
     top_p: float = 1.0
+    top_k: int = 0                   # 0 = no rank cutoff
     rng: Optional[np.random.Generator] = None
     prefill_sent: int = 0            # prompt tokens handed to the engine
     generated: List[int] = field(default_factory=list)
@@ -50,7 +51,7 @@ class _Request:
     def pick(self, logits_row: np.ndarray) -> int:
         from .sampling import host_sample
         return host_sample(logits_row, self.rng, self.temperature,
-                           self.top_p)
+                           self.top_p, self.top_k)
 
     @property
     def prefill_done(self) -> bool:
@@ -86,7 +87,7 @@ class DynamicSplitFuseScheduler:
     def submit(self, uid: int, prompt: Sequence[int], max_new_tokens: int,
                eos_token_id: Optional[int] = None,
                temperature: float = 0.0, top_p: float = 1.0,
-               seed: Optional[int] = None) -> None:
+               top_k: int = 0, seed: Optional[int] = None) -> None:
         """temperature/top_p/seed are PER REQUEST (the MII SamplingParams
         surface): mixed greedy and sampled requests compose into the same
         steps; a SEEDED request's tokens are deterministic (independent
@@ -95,7 +96,7 @@ class DynamicSplitFuseScheduler:
         assert uid not in self._all, f"uid {uid} already submitted"
         req = _Request(uid, list(map(int, prompt)), max_new_tokens,
                        eos_token_id, self.clock(),
-                       temperature=temperature, top_p=top_p,
+                       temperature=temperature, top_p=top_p, top_k=top_k,
                        rng=np.random.default_rng(seed))
         self._all[uid] = req
         self._queue.append(req)

@@ -32,7 +32,7 @@ class ServePipeline:
                  eos_token_id: Optional[int] = None,
                  return_full_text: bool = False,
                  temperature: float = 0.0, top_p: float = 1.0,
-                 seed: Optional[int] = None):
+                 top_k: int = 0, seed: Optional[int] = None):
         """prompts: str | Sequence[str] (tokenizer required) or
         Sequence[Sequence[int]]. Returns decoded strings when a tokenizer
         is present, else token-id arrays; generated-only by default."""
@@ -60,6 +60,7 @@ class ServePipeline:
             sched.submit(uid, p, max_new_tokens=max_new_tokens,
                          eos_token_id=eos_token_id,
                          temperature=temperature, top_p=top_p,
+                         top_k=top_k,
                          seed=None if seed is None else seed + i)
             uids.append(uid)
         sched.run()

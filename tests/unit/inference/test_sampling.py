@@ -152,3 +152,35 @@ def test_top_p_zero_clamps_to_argmax():
     g = np.random.default_rng(7)
     for row in logits:
         assert host_sample(row, g, 0.7, 0.0) == int(np.argmax(row))
+
+
+def test_top_k_restricts_support_both_impls():
+    """top_k=2 on a 3-peak distribution: samples come only from the top
+    two ranks (device AND host), matching the top-p composition rule."""
+    logits = np.full(16, -10.0, np.float32)
+    logits[3], logits[7], logits[11] = 3.0, 2.5, 2.0
+    dev = np.asarray(jax.vmap(
+        lambda k: sample_tokens(jnp.asarray(logits)[None],
+                                jax.random.PRNGKey(k),
+                                jnp.ones(1), jnp.ones(1),
+                                jnp.full(1, 2, jnp.int32))[0]
+    )(jnp.arange(200)))
+    assert set(np.unique(dev)) <= {3, 7}
+    g = np.random.default_rng(9)
+    host = {host_sample(logits, g, 1.0, 1.0, top_k=2) for _ in range(200)}
+    assert host <= {3, 7}
+    # top_k=0 means no cutoff: the third peak is reachable
+    g = np.random.default_rng(10)
+    host_all = {host_sample(logits, g, 1.0, 1.0, top_k=0)
+                for _ in range(400)}
+    assert 11 in host_all
+
+
+def test_generate_top_k_deterministic(tiny_engine):
+    eng = tiny_engine
+    prompts = [[3, 5, 7]]
+    a = eng.generate(prompts, max_new_tokens=6, temperature=0.9,
+                     top_k=3, seed=4, uids=[40])
+    b = eng.generate(prompts, max_new_tokens=6, temperature=0.9,
+                     top_k=3, seed=4, uids=[41])
+    np.testing.assert_array_equal(a[0], b[0])
