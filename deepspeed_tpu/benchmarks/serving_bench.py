@@ -51,9 +51,23 @@ def bench_dense(model, params, prompts: np.ndarray, new_tokens: int,
     return B * new_tokens / dt
 
 
+def _hist_delta(registry, name, before):
+    """(count, sum) advance of a histogram family since ``before``."""
+    fam = registry.get(name)
+    if fam is None:
+        return 0, 0.0
+    c0, s0 = before.get(name, (0, 0.0))
+    return fam.count - c0, fam.sum - s0
+
+
 def bench_paged(model, params, prompts: np.ndarray, new_tokens: int,
-                repeats: int) -> float:
+                repeats: int) -> dict:
+    """Measure the v2 engine THROUGH the telemetry registry: the engine's
+    own decode-step/TTFT series are the timers (the registry numbers ARE
+    what a production scrape sees), not ad-hoc stopwatches around the
+    call. The warmup's series are snapshotted and subtracted."""
     from ..inference.v2.engine_v2 import InferenceEngineV2
+    from ..telemetry import get_registry
 
     B, S = prompts.shape
     eng = InferenceEngineV2(model, {
@@ -64,6 +78,13 @@ def bench_paged(model, params, prompts: np.ndarray, new_tokens: int,
     }, params=params)
     prompt_list = [list(map(int, p)) for p in prompts]
     eng.generate(prompt_list, max_new_tokens=new_tokens)  # compile warmup
+
+    reg = get_registry()
+    base_hist = {n: (reg.get(n).count, reg.get(n).sum) if reg.get(n) else
+                 (0, 0.0)
+                 for n in ("inference_decode_step_seconds",
+                           "inference_ttft_seconds")}
+    base_tokens = reg.counter("inference_decode_tokens_total").value
     t0 = time.perf_counter()
     for r in range(repeats):
         outs = eng.generate(prompt_list, max_new_tokens=new_tokens,
@@ -71,7 +92,22 @@ def bench_paged(model, params, prompts: np.ndarray, new_tokens: int,
                                             (r + 1) * 1000 + B)))
     dt = (time.perf_counter() - t0) / repeats
     assert len(outs) == B
-    return B * new_tokens / dt
+
+    decode_n, decode_s = _hist_delta(reg, "inference_decode_step_seconds",
+                                     base_hist)
+    ttft_n, ttft_s = _hist_delta(reg, "inference_ttft_seconds", base_hist)
+    decode_tokens = reg.counter("inference_decode_tokens_total").value \
+        - base_tokens
+    return {
+        "tok_s": B * new_tokens / dt,
+        "decode_tok_s": (decode_tokens / decode_s) if decode_s else None,
+        "decode_steps": int(decode_n),
+        "ttft_s": (ttft_s / ttft_n) if ttft_n else None,
+        # the live gauge is 0 after generate() flushes its uids; the peak
+        # is the number that says whether num_blocks has headroom
+        "kv_pool_utilization_peak":
+            reg.gauge("inference_kv_pool_utilization_peak").value,
+    }
 
 
 def main(argv=None) -> int:
@@ -93,13 +129,23 @@ def main(argv=None) -> int:
 
     paged = bench_paged(model, params, prompts, args.new, args.repeats)
     dense = bench_dense(model, params, prompts, args.new, args.repeats)
+    paged_tok_s = paged["tok_s"]
     print(json.dumps({
         "metric": "serving_tokens_per_sec",
         "backend": jax.default_backend(),
         "batch": args.batch, "prompt": args.prompt, "new_tokens": args.new,
-        "paged_tok_s": round(paged, 2),
+        "paged_tok_s": round(paged_tok_s, 2),
+        # registry-derived (telemetry/): decode-only throughput, mean TTFT
+        "paged_decode_tok_s": (round(paged["decode_tok_s"], 2)
+                               if paged["decode_tok_s"] else None),
+        "paged_decode_steps": paged["decode_steps"],
+        "paged_ttft_s": (round(paged["ttft_s"], 4)
+                         if paged["ttft_s"] else None),
+        "kv_pool_utilization_peak": round(
+            paged["kv_pool_utilization_peak"], 4),
         "dense_tok_s": round(dense, 2),
-        "paged_over_dense": round(paged / dense, 3) if dense else None,
+        "paged_over_dense": (round(paged_tok_s / dense, 3)
+                             if dense else None),
     }))
     return 0
 

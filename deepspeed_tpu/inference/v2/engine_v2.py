@@ -15,6 +15,7 @@ builder play. Mixed puts do the prefills/continuations first, then the
 fused decode batch.
 """
 
+import time
 from typing import Dict, Iterable, List, Optional, Sequence
 
 import jax
@@ -22,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.transformer import TransformerConfig
+from ...telemetry import trace
 from ...utils.logging import log_dist
 from .config_v2 import RaggedInferenceEngineConfig
 from .paged_model import (init_paged_kv_cache, paged_continue, paged_decode,
@@ -110,6 +112,11 @@ class InferenceEngineV2:
         self.kv_cache = init_paged_kv_cache(cfg, sm.num_blocks,
                                             sm.block_size, self.dtype,
                                             kv_quant=config.kv_quant)
+        # per-uid consecutive failed-verify counter for speculative
+        # decoding; entries are cleared on flush() and at generate() entry
+        # so a cold streak never bans a uid across independent calls
+        self._spec_miss_streak: Dict[int, int] = {}
+        self._init_telemetry()
         # Pallas kernels only at tp=1: a bare pallas_call is not
         # GSPMD-partitionable, so sharded-param (tp>1) serving keeps the
         # jnp paths, which the partitioner splits over the head axis (same
@@ -184,6 +191,58 @@ class InferenceEngineV2:
             ranks=[0])
 
     # ------------------------------------------------------------------
+    # Telemetry (unified registry, telemetry/registry.py)
+    # ------------------------------------------------------------------
+    def _init_telemetry(self):
+        from ...telemetry import get_registry
+        reg = get_registry()
+        self._m_prefill_tokens = reg.counter(
+            "inference_prefill_tokens_total",
+            "prompt tokens run through prefill/continuation passes")
+        self._m_decode_tokens = reg.counter(
+            "inference_decode_tokens_total",
+            "tokens produced by batched decode steps")
+        self._m_decode_steps = reg.counter(
+            "inference_decode_steps_total", "batched decode passes")
+        self._m_decode_time = reg.histogram(
+            "inference_decode_step_seconds",
+            "batched decode pass wall time", unit="s")
+        self._m_decode_tput = reg.gauge(
+            "inference_decode_tokens_per_s",
+            "last decode pass throughput (batch tokens / wall time)")
+        self._m_ttft = reg.histogram(
+            "inference_ttft_seconds",
+            "generate(): time to the first token batch", unit="s")
+        self._m_kv_util = reg.gauge(
+            "inference_kv_pool_utilization",
+            "fraction of usable KV blocks currently allocated")
+        self._m_kv_util_peak = reg.gauge(
+            "inference_kv_pool_utilization_peak",
+            "high-water mark of inference_kv_pool_utilization")
+        self._m_tracked = reg.gauge(
+            "inference_tracked_sequences", "sequences with live KV state")
+        self._m_spec_drafted = reg.counter(
+            "inference_spec_drafted_tokens_total",
+            "speculative tokens drafted for verification")
+        self._m_spec_accepted = reg.counter(
+            "inference_spec_accepted_tokens_total",
+            "speculative tokens accepted by greedy verification")
+        self._m_spec_miss_rounds = reg.counter(
+            "inference_spec_miss_rounds_total",
+            "speculative rounds whose whole draft was rejected")
+
+    def _update_pool_telemetry(self):
+        sm = self.state_manager
+        usable = max(sm.config.num_blocks - 1, 1)  # block 0 is the null
+        util = (usable - sm.free_blocks()) / usable
+        self._m_kv_util.set(util)
+        # the live gauge reads 0 between requests (flush returns blocks),
+        # so pool-pressure tuning needs the high-water mark too
+        if util > self._m_kv_util_peak.value:
+            self._m_kv_util_peak.set(util)
+        self._m_tracked.set(sm.tracked_sequences())
+
+    # ------------------------------------------------------------------
     # Schedulability (reference engine_v2.py:135 query / :161 can_schedule)
     # ------------------------------------------------------------------
     def query(self, uid: int) -> Dict[str, int]:
@@ -243,6 +302,8 @@ class InferenceEngineV2:
         seq.seen_tokens = n
         if sm.config.enable_prefix_caching:
             seq.token_log.extend(map(int, tokens))
+        self._m_prefill_tokens.inc(n)
+        self._update_pool_telemetry()
         return np.asarray(logits)
 
     def _continue(self, uid: int, tokens: np.ndarray,
@@ -276,6 +337,9 @@ class InferenceEngineV2:
         seq.seen_tokens = start + n
         if sm.config.enable_prefix_caching:
             seq.token_log.extend(map(int, tokens))
+        if not all_logits:  # spec-verify feeds count via the spec counters
+            self._m_prefill_tokens.inc(n)
+        self._update_pool_telemetry()
         return np.asarray(logits)
 
     # -- speculative decoding (prompt-lookup) ---------------------------
@@ -350,8 +414,6 @@ class InferenceEngineV2:
         cur: Dict[int, int] = {}
         plain_uids: List[int] = []
         sm = self.state_manager
-        if not hasattr(self, "_spec_miss_streak"):
-            self._spec_miss_streak: Dict[int, int] = {}
         for uid in step_uids:
             row = outs[row_of[uid]]
             remaining = max_new_tokens - (len(row) - prompt_lens[uid])
@@ -373,7 +435,10 @@ class InferenceEngineV2:
                 plain_uids.append(uid)
                 continue
             emitted = self._speculative_step(uid, row[-1], draft)
+            self._m_spec_drafted.inc(len(draft))
+            self._m_spec_accepted.inc(len(emitted) - 1)
             if len(emitted) == 1:
+                self._m_spec_miss_rounds.inc()
                 self._spec_miss_streak[uid] = \
                     self._spec_miss_streak.get(uid, 0) + 1
             else:
@@ -438,10 +503,19 @@ class InferenceEngineV2:
     def _decode_common(self, uids: List[int], tokens: List[int], jit_fn,
                        extract) -> Dict[int, object]:
         sm = self.state_manager
-        toks, pos, tables, active = self._build_decode_inputs(uids, tokens)
-        vals, self.kv_cache = jit_fn(
-            self.params, toks, pos, tables, self.kv_cache, active)
-        vals = np.asarray(vals)
+        t0 = time.perf_counter()
+        with trace.span("decode_step", batch=len(uids)):
+            toks, pos, tables, active = self._build_decode_inputs(uids,
+                                                                  tokens)
+            vals, self.kv_cache = jit_fn(
+                self.params, toks, pos, tables, self.kv_cache, active)
+            vals = np.asarray(vals)  # blocks: the pass completes here
+        dt = time.perf_counter() - t0
+        self._m_decode_steps.inc()
+        self._m_decode_tokens.inc(len(uids))
+        self._m_decode_time.observe(dt)
+        if dt > 0:
+            self._m_decode_tput.set(len(uids) / dt)
         log_tokens = sm.config.enable_prefix_caching
         out = {}
         for i, uid in enumerate(uids):
@@ -450,6 +524,7 @@ class InferenceEngineV2:
             if log_tokens:
                 seq.token_log.append(int(tokens[i]))
             out[uid] = extract(vals, i)
+        self._update_pool_telemetry()
         return out
 
     def _decode_batch(self, uids: List[int],
@@ -521,8 +596,13 @@ class InferenceEngineV2:
         return np.stack([results[uid] for uid, _ in entries])
 
     def flush(self, uid: int) -> None:
-        """Release a finished sequence's KV blocks (reference flush)."""
+        """Release a finished sequence's KV blocks (reference flush).
+        Also forgets the uid's speculative cold-streak state: uids are
+        caller-assigned and commonly reused, and a streak carried across
+        independent requests would permanently ban drafting for them."""
+        self._spec_miss_streak.pop(uid, None)
         self.state_manager.flush_sequence(uid)
+        self._update_pool_telemetry()
 
     # convenience: serve-style generation over the ragged engine
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int,
@@ -545,13 +625,18 @@ class InferenceEngineV2:
         assert not (speculative and sampling), \
             "speculative decoding is greedy-only (draft verification " \
             "compares argmax)"
+        # each generate() call is an independent request batch: spec
+        # cold-streaks from earlier calls must not ban drafting here
+        self._spec_miss_streak.clear()
         base_rng = jax.random.PRNGKey(seed) if sampling else None
+        t_start = time.perf_counter()
         # prompts go through put() (prefill); the continuation loop then
         # stays in token space — argmax/sampler runs on device and only
         # [N] int32s cross to host per step (put()'s [N, vocab] logits
         # are the API for external schedulers, not the hot loop)
         try:
             logits = self.put(uids, prompts)
+            self._m_ttft.observe(time.perf_counter() - t_start)
             if sampling:
                 from .sampling import sample_tokens
                 first = np.asarray(sample_tokens(

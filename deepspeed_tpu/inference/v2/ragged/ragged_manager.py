@@ -36,6 +36,20 @@ class DSStateManager:
         # chain-hash digest -> retained block id (insertion-ordered: LRU
         # eviction pops from the front)
         self._prefix: "OrderedDict[bytes, int]" = OrderedDict()
+        from ....telemetry import get_registry
+        reg = get_registry()
+        self._m_lookups = reg.counter(
+            "inference_prefix_lookups_total",
+            "prefix-cache matches attempted for new sequences")
+        self._m_hits = reg.counter(
+            "inference_prefix_hits_total",
+            "prefix-cache lookups that reused at least one block")
+        self._m_reused_tokens = reg.counter(
+            "inference_prefix_reused_tokens_total",
+            "prompt tokens served from shared KV blocks")
+        self._m_evicted = reg.counter(
+            "inference_prefix_evicted_blocks_total",
+            "retained prefix blocks LRU-evicted under pool pressure")
 
     # -- prefix caching -----------------------------------------------------
     @staticmethod
@@ -51,6 +65,7 @@ class DSStateManager:
         n_reused_tokens) — (…, 0) when nothing matches."""
         if not self.config.enable_prefix_caching or uid in self.seqs:
             return [], 0
+        self._m_lookups.inc()
         bs = self.block_size
         usable = ((len(tokens) - 1) // bs) * bs
         blocks: List[int] = []
@@ -72,6 +87,8 @@ class DSStateManager:
         seq.blocks = list(blocks)
         seq.seen_tokens = n
         seq.token_log = list(map(int, tokens[:n]))
+        self._m_hits.inc()
+        self._m_reused_tokens.inc(n)
         return blocks, n
 
     def _register_prefix(self, seq: DSSequenceDescriptor) -> None:
@@ -111,6 +128,7 @@ class DSStateManager:
                 return
             blk = self._prefix.pop(victim)
             self.allocator.free([blk])
+            self._m_evicted.inc()
 
     def reclaimable_blocks(self) -> int:
         """Free blocks plus what eviction could free right now — the

@@ -82,6 +82,40 @@ class DynamicSplitFuseScheduler:
         self._running: List[_Request] = []   # prefill done, decoding
         self._all: Dict[int, _Request] = {}
         self.steps = 0
+        self._init_telemetry()
+
+    def _init_telemetry(self):
+        from ...telemetry import get_registry
+        reg = get_registry()
+        self._m_queue = reg.gauge(
+            "serving_queue_depth", "requests waiting on prefill budget")
+        self._m_running = reg.gauge(
+            "serving_running_sequences", "requests decoding")
+        self._m_steps = reg.counter(
+            "serving_steps_total", "composed engine steps run")
+        self._m_step_tokens = reg.histogram(
+            "serving_step_tokens", "tokens composed per engine step",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+        self._m_submitted = reg.counter(
+            "serving_requests_submitted_total", "requests submitted")
+        self._m_finished = reg.counter(
+            "serving_requests_finished_total", "requests finished")
+        self._m_preempted = reg.counter(
+            "serving_preemptions_total",
+            "partial prefills evicted to free KV blocks")
+        self._m_ttft = reg.histogram(
+            "serving_ttft_seconds", "submit -> first generated token",
+            unit="s")
+        self._m_req_time = reg.histogram(
+            "serving_request_seconds", "submit -> request finished",
+            unit="s")
+        self._m_gen_tokens = reg.counter(
+            "serving_generated_tokens_total",
+            "tokens generated across finished requests")
+
+    def _update_depth_gauges(self):
+        self._m_queue.set(len(self._queue))
+        self._m_running.set(len(self._running))
 
     # ------------------------------------------------------------------
     def submit(self, uid: int, prompt: Sequence[int], max_new_tokens: int,
@@ -94,12 +128,29 @@ class DynamicSplitFuseScheduler:
         of batch composition — the rng is per request), an unseeded one
         draws fresh OS entropy."""
         assert uid not in self._all, f"uid {uid} already submitted"
+        max_seq_len = self.engine.state_manager.config.max_seq_len
+        # the final emitted token is never fed back (_emit), so the
+        # request writes prompt + max(new-1, 0) KV slots — the same need
+        # formula as the drain-path diagnostic below
+        need = len(prompt) + max(max_new_tokens - 1, 0)
+        if need > max_seq_len:
+            # reject up front: admitted, the request would run until the
+            # state manager refuses the decode past max_seq_len and the
+            # failure would surface as a misleading KV-pool error
+            raise RuntimeError(
+                f"request uid={uid} cannot be scheduled: "
+                f"len(prompt)={len(prompt)} + max_new_tokens="
+                f"{max_new_tokens} needs {need} KV slots, over "
+                f"max_seq_len={max_seq_len}; shorten the request or "
+                f"raise state_manager.max_seq_len")
         req = _Request(uid, list(map(int, prompt)), max_new_tokens,
                        eos_token_id, self.clock(),
                        temperature=temperature, top_p=top_p, top_k=top_k,
                        rng=np.random.default_rng(seed))
         self._all[uid] = req
         self._queue.append(req)
+        self._m_submitted.inc()
+        self._update_depth_gauges()
 
     def pending(self) -> bool:
         return bool(self._queue or self._running)
@@ -110,6 +161,12 @@ class DynamicSplitFuseScheduler:
         self.engine.flush(req.uid)
         if req in self._running:
             self._running.remove(req)
+        self._m_finished.inc()
+        self._m_gen_tokens.inc(len(req.generated))
+        self._m_ttft.observe(
+            (req.first_token_t or req.finish_t) - req.submit_t)
+        self._m_req_time.observe(req.finish_t - req.submit_t)
+        self._update_depth_gauges()
 
     def _evict_partial_prefill(self, exclude=()) -> bool:
         """Free the KV blocks of the most recently admitted partial
@@ -119,6 +176,7 @@ class DynamicSplitFuseScheduler:
             if req.prefill_sent > 0 and req.uid not in exclude:
                 self.engine.flush(req.uid)
                 req.prefill_sent = 0
+                self._m_preempted.inc()
                 return True
         return False
 
@@ -196,7 +254,8 @@ class DynamicSplitFuseScheduler:
 
         if not uids:
             if self._queue and not self._running:
-                # pool dry with nothing draining it. Two cases:
+                # pool dry with nothing draining it (requests exceeding
+                # max_seq_len were already rejected at submit). Two cases:
                 head = self._queue[0]
                 bs = sm.block_size
                 # the final emitted token is never fed back (_emit), so a
@@ -231,12 +290,17 @@ class DynamicSplitFuseScheduler:
             nxt_map = self.engine._decode_batch_greedy(
                 uids, [t[0] for t in toks])
             self.steps += 1
+            self._m_steps.inc()
+            self._m_step_tokens.observe(len(uids))
             for req in decode_reqs:
                 self._emit(req, nxt_map[req.uid])
+            self._update_depth_gauges()
             return len(uids)
 
         logits = np.asarray(self.engine.put(uids, toks))
         self.steps += 1
+        self._m_steps.inc()
+        self._m_step_tokens.observe(sum(len(t) for t in toks))
         now = self.clock()
 
         for i, uid in enumerate(uids):
@@ -254,6 +318,7 @@ class DynamicSplitFuseScheduler:
                     self._running.append(req)
                     self._emit(req, req.pick(logits[i]))
             # else: mid-prompt chunk — logits ignored
+        self._update_depth_gauges()
         return sum(len(t) for t in toks)
 
     def _emit(self, req: _Request, tok: int) -> None:

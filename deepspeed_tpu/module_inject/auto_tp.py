@@ -118,8 +118,14 @@ def config_from_hf(hf_config) -> TransformerConfig:
         # embeddings, and (1+w) RMSNorm weights (baked into the converted
         # norm tensors). Gemma-2 (softcapping, alternating sliding
         # window) is not implemented.
-        act = getattr(hf_config, "hidden_activation", None) or \
-            getattr(hf_config, "hidden_act", "gelu_pytorch_tanh")
+        # HF GemmaMLP ignores ``hidden_act`` whenever ``hidden_activation``
+        # is None/absent and forces gelu_pytorch_tanh (GemmaConfig warns
+        # and overrides) — so only an EXPLICIT hidden_activation value may
+        # select the exact erf form; a legacy config carrying
+        # hidden_act="gelu" still runs the tanh approximation.
+        act = getattr(hf_config, "hidden_activation", None)
+        if act is None:
+            act = "gelu_pytorch_tanh"
         # HF "gelu" is the exact erf form, "gelu_pytorch_tanh" the tanh
         # approximation — map to distinct gate activations (~1e-3 apart)
         gate = {"gelu_pytorch_tanh": "geglu", "gelu": "geglu_exact"}.get(act)

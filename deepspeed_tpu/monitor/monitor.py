@@ -105,6 +105,16 @@ class MonitorMaster(Monitor):
         self.wandb = WandbMonitor(ds_config.wandb)
         self.csv = CSVMonitor(ds_config.csv_monitor)
         self.enabled = self.tb.enabled or self.wandb.enabled or self.csv.enabled
+        self.telemetry = None
+
+    def attach_telemetry(self, registry=None, flush_interval: int = 1):
+        """Attach a TelemetryBridge flushing the metrics registry's
+        scalars into this master's backends every ``flush_interval``
+        steps (telemetry/bridge.py)."""
+        from ..telemetry.bridge import TelemetryBridge
+        self.telemetry = TelemetryBridge(self, registry=registry,
+                                         flush_interval=flush_interval)
+        return self.telemetry
 
     def write_events(self, event_list: List[Event]):
         if jax.process_index() != 0:

@@ -181,6 +181,19 @@ class CSVConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Unified telemetry layer (telemetry/registry.py + bridge.py).
+    ``enabled`` gates the TRAINING engine's registry series, the bridge
+    that flushes registry scalars into the monitor backends, and the
+    span->XLA-annotation mirroring; inference/serving instrumentation
+    records unconditionally (allocation-free hot path)."""
+
+    enabled: bool = True
+    flush_interval: int = 10        # flush registry scalars every N steps
+    xla_annotations: bool = False   # mirror spans into jax.profiler
+
+
+@dataclass
 class DataTypesConfig:
     grad_accum_dtype: Optional[str] = None
 
@@ -297,6 +310,7 @@ class DeepSpeedTpuConfig:
     tensorboard: TensorboardConfig = subconfig(TensorboardConfig)
     wandb: WandbConfig = subconfig(WandbConfig)
     csv_monitor: CSVConfig = subconfig(CSVConfig)
+    telemetry: TelemetryConfig = subconfig(TelemetryConfig)
     data_types: DataTypesConfig = subconfig(DataTypesConfig)
     checkpoint: CheckpointConfig = subconfig(CheckpointConfig)
     aio: AioConfig = subconfig(AioConfig)

@@ -307,6 +307,7 @@ class DeepSpeedTpuEngine:
             self.monitor = MonitorMaster(self.config)
         except Exception as e:  # monitor must never break training
             logger.warning(f"monitor disabled: {e}")
+        self._init_telemetry()
 
         log_dist(
             f"engine ready: zero_stage={self.zero_stage} dtype={config.precision_dtype} "
@@ -317,6 +318,60 @@ class DeepSpeedTpuEngine:
             from ..utils.memory import see_memory_usage
             see_memory_usage("after engine init (params + optimizer state)",
                              force=True)
+
+    def _init_telemetry(self):
+        """Wire the unified metrics registry (telemetry/) into this
+        engine: training-step series + the TelemetryBridge that flushes
+        registry scalars through MonitorMaster at the configured cadence
+        (``telemetry.flush_interval``)."""
+        from ..telemetry import get_registry, trace
+        tcfg = self.config.telemetry
+        self.telemetry_enabled = bool(tcfg.enabled)
+        self.telemetry = get_registry()
+        self.telemetry_bridge = None
+        if not self.telemetry_enabled:
+            return
+        if tcfg.xla_annotations:
+            trace.enable_xla_annotations(True)
+        reg = self.telemetry
+        self._tm_loss = reg.gauge("training_loss", "last train_batch loss")
+        self._tm_gnorm = reg.gauge("training_grad_norm",
+                                   "global gradient norm (pre-clip)")
+        self._tm_lr = reg.gauge("training_lr", "learning rate")
+        self._tm_scale = reg.gauge("training_loss_scale",
+                                   "fp16 dynamic loss scale")
+        self._tm_steps = reg.counter("training_steps_total",
+                                     "optimizer steps applied")
+        self._tm_skipped = reg.counter("training_skipped_steps_total",
+                                       "steps skipped on fp16 overflow")
+        self._tm_samples = reg.counter("training_samples_total",
+                                       "samples consumed")
+        self._tm_step_time = reg.histogram(
+            "training_step_seconds", "train_batch wall time", unit="s")
+        if self.monitor is not None and self.monitor.enabled:
+            self.telemetry_bridge = self.monitor.attach_telemetry(
+                reg, flush_interval=tcfg.flush_interval)
+
+    def _record_train_telemetry(self, metrics, skipped: int):
+        """Registry updates for one completed train_batch (+ the bridge's
+        cadence-gated flush into the monitor backends)."""
+        if not self.telemetry_enabled:
+            return
+        self._tm_loss.set(float(metrics["loss"]))
+        self._tm_gnorm.set(float(metrics["grad_norm"]))
+        self._tm_lr.set(float(metrics["lr"]))
+        if "loss_scale" in metrics:
+            self._tm_scale.set(float(metrics["loss_scale"]))
+        if skipped:
+            self._tm_skipped.inc()
+        else:
+            self._tm_steps.inc()
+            self._tm_samples.inc(self.train_batch_size)
+        dur = self.tput_timer.last_duration
+        if dur:
+            self._tm_step_time.observe(dur)
+        if self.telemetry_bridge is not None:
+            self.telemetry_bridge.step(self.global_steps)
 
     # ------------------------------------------------------------------
     # Initialization
@@ -1281,22 +1336,28 @@ class DeepSpeedTpuEngine:
                     "dict of named fields; seqlen truncation is SKIPPED — "
                     "feed dict batches (or disable the curriculum block)")
         dev_batch = self._shard_batch(batch)
+        from ..telemetry import trace
         self.tput_timer.start()
-        if self.param_offload_nvme:
-            metrics = self._train_batch_infinity(dev_batch)
-        elif self.offload_device:
-            metrics = self._train_batch_offloaded(dev_batch)
-        else:
-            (self.params, self.master_params, self.opt_state, self.scale_state,
-             self._step_arr, self._model_rng, metrics) = self._train_step(
-                self.params, self.master_params, self.opt_state, self.scale_state,
-                self._step_arr, self._model_rng, dev_batch)
-        self._relocate_params_to_storage()
+        with trace.span("train_step", step=self.global_steps):
+            if self.param_offload_nvme:
+                metrics = self._train_batch_infinity(dev_batch)
+            elif self.offload_device:
+                metrics = self._train_batch_offloaded(dev_batch)
+            else:
+                (self.params, self.master_params, self.opt_state,
+                 self.scale_state, self._step_arr, self._model_rng,
+                 metrics) = self._train_step(
+                    self.params, self.master_params, self.opt_state,
+                    self.scale_state, self._step_arr, self._model_rng,
+                    dev_batch)
+            self._relocate_params_to_storage()
+            # the loss fetch blocks on the async-dispatched device step, so
+            # it belongs inside the span/timer (XLA programs complete here)
+            loss = float(metrics["loss"])
         # Host bookkeeping mirrors the device counter: the compiled step
         # leaves ``_step_arr`` un-advanced on fp16 overflow, so the host
         # step count and the LR schedule must hold too (reference skips the
         # scheduler on overflow, stage3.py:2018 area).
-        loss = float(metrics["loss"])
         skipped = int(metrics["skipped"])
         self.skipped_steps += skipped
         self._batches_seen += 1
@@ -1333,6 +1394,7 @@ class DeepSpeedTpuEngine:
                 ("Train/loss", loss, self.global_steps),
                 ("Train/lr", float(metrics["lr"]), self.global_steps),
             ])
+        self._record_train_telemetry(metrics, skipped)
         self._last_metrics = {k: float(v) for k, v in metrics.items()}
         return loss
 

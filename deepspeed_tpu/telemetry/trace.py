@@ -1,0 +1,91 @@
+"""Lightweight span tracing with a ring-buffer exporter.
+
+``with trace.span("decode_step"):`` records (name, start, duration,
+depth) into a bounded deque — overhead is two ``perf_counter`` calls and
+one locked append, so the serving hot path can stay instrumented in
+production.
+``export()`` drains a copy for offline analysis; ``durations(name)``
+feeds assertions and benchmarks.
+
+``enable_xla_annotations(True)`` mirrors every span into a
+``jax.profiler.TraceAnnotation`` so spans line up with device activity
+in a TensorBoard/XProf trace captured via
+``deepspeed_tpu.utils.xla_profile.capture_trace`` (the hook is optional:
+absent/failed jax.profiler leaves spans host-only).
+"""
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+_DEFAULT_CAPACITY = 4096
+
+_lock = threading.Lock()
+_buffer: deque = deque(maxlen=_DEFAULT_CAPACITY)
+_xla_annotations = False
+_local = threading.local()
+
+
+def enable_xla_annotations(on: bool = True) -> None:
+    """Mirror spans into jax.profiler trace annotations (see module
+    docstring)."""
+    global _xla_annotations
+    _xla_annotations = on
+
+
+def set_capacity(capacity: int) -> None:
+    """Resize the ring buffer (drops recorded spans)."""
+    global _buffer
+    with _lock:
+        _buffer = deque(maxlen=int(capacity))
+
+
+@contextmanager
+def span(name: str, **attrs):
+    """Record a wall-clock span; nests (depth reflects enclosing spans)."""
+    depth = getattr(_local, "depth", 0)
+    _local.depth = depth + 1
+    annotation = None
+    if _xla_annotations:
+        try:
+            import jax
+            annotation = jax.profiler.TraceAnnotation(name)
+            annotation.__enter__()
+        except Exception:
+            annotation = None
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        dur = time.perf_counter() - start
+        if annotation is not None:
+            annotation.__exit__(None, None, None)
+        _local.depth = depth
+        rec = {"name": name, "start": start, "duration_s": dur,
+               "depth": depth}
+        if attrs:
+            rec["attrs"] = attrs
+        # under _lock: export() snapshots the deque while other threads
+        # record, and set_capacity() swaps the buffer out entirely
+        with _lock:
+            _buffer.append(rec)
+
+
+def export(name: Optional[str] = None) -> List[Dict]:
+    """Copy of the recorded spans (oldest first), optionally filtered."""
+    with _lock:
+        spans = list(_buffer)
+    if name is not None:
+        spans = [s for s in spans if s["name"] == name]
+    return spans
+
+
+def durations(name: str) -> List[float]:
+    return [s["duration_s"] for s in export(name)]
+
+
+def clear() -> None:
+    with _lock:
+        _buffer.clear()

@@ -53,6 +53,19 @@ class CommsLogger:
             self.world_size = jax.device_count()
         except Exception:
             pass
+        # unified registry series (telemetry/): per-op call/byte counters
+        # and eager-latency histogram, labeled by collective name
+        from ..telemetry import get_registry
+        reg = get_registry()
+        self._m_ops = reg.counter("comm_ops_total", "collective calls",
+                                  labelnames=("op",))
+        self._m_bytes = reg.counter("comm_bytes_total",
+                                    "bytes moved by collectives",
+                                    labelnames=("op",))
+        self._m_latency = reg.histogram(
+            "comm_latency_seconds",
+            "eagerly-executed collective latency (traced ops excluded)",
+            unit="s", labelnames=("op",))
 
     def append(self, log_name: str, raw_name: str, latency_s: float, msg_size: int,
                traced: bool = False):
@@ -61,6 +74,10 @@ class CommsLogger:
         records are kept (they show op/message-size coverage) but marked."""
         if not self.prof_all and log_name not in self.prof_ops:
             return
+        self._m_ops.labels(op=log_name).inc()
+        self._m_bytes.labels(op=log_name).inc(msg_size)
+        if not traced:
+            self._m_latency.labels(op=log_name).observe(latency_s)
         if traced:
             log_name = log_name + " [trace]"
         rec = self.comms_dict[log_name][msg_size]

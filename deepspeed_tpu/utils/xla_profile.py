@@ -30,12 +30,22 @@ _ASYNC_KINDS = ("all-gather", "all-reduce", "reduce-scatter",
 
 
 def capture_trace(fn: Callable, *args, trace_dir: str, steps: int = 2):
-    """Run fn(*args) `steps` times under jax.profiler.trace."""
+    """Run fn(*args) `steps` times under jax.profiler.trace.
+
+    Telemetry spans (telemetry/trace.py) are mirrored into profiler
+    TraceAnnotations for the capture's duration, so ``trace.span(...)``
+    regions inside fn line up with device activity in the XProf view."""
+    from ..telemetry import trace as ds_trace
     out = None
-    with jax.profiler.trace(trace_dir):
-        for _ in range(steps):
-            out = fn(*args)
-        jax.block_until_ready(out)
+    prev = ds_trace._xla_annotations
+    ds_trace.enable_xla_annotations(True)
+    try:
+        with jax.profiler.trace(trace_dir):
+            for _ in range(steps):
+                out = fn(*args)
+            jax.block_until_ready(out)
+    finally:
+        ds_trace.enable_xla_annotations(prev)
     return out
 
 

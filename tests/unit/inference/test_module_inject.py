@@ -456,6 +456,23 @@ def test_gemma_exact_gelu_variant_matches_hf():
     _assert_logits_match(hf, ids, rtol=5e-4, atol=5e-4)
 
 
+def test_gemma_none_hidden_activation_defaults_to_tanh():
+    """hidden_activation=None must select the tanh gate even when a
+    legacy config carries hidden_act='gelu' — HF GemmaMLP ignores
+    hidden_act and forces gelu_pytorch_tanh unless hidden_activation is
+    set explicitly."""
+    from deepspeed_tpu.module_inject import config_from_hf
+    cfg = transformers.GemmaConfig(
+        vocab_size=96, hidden_size=24, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, rms_norm_eps=1e-6,
+        pad_token_id=0, hidden_act="gelu")
+    cfg.hidden_activation = None
+    assert config_from_hf(cfg).activation == "geglu"
+    cfg.hidden_activation = "gelu"
+    assert config_from_hf(cfg).activation == "geglu_exact"
+
+
 def test_falcon_injection_matches_hf():
     """Falcon-7B-class: parallel residual, fused MQA qkv, bias-free MLP,
     biased LayerNorm, exact gelu."""

@@ -116,6 +116,34 @@ def test_speculative_rejects_sampling(tiny):
                      temperature=0.8)
 
 
+def test_spec_miss_streak_reset_between_requests(tiny):
+    """A cold streak from one request must not ban drafting for a reused
+    uid: flush() forgets the uid's streak and generate() starts every
+    call with a clean slate (the ban used to be permanent)."""
+    model, params = tiny
+    eng = _engine(model, params)
+    # direct flush path: the uid's streak entry dies with its KV state
+    eng._spec_miss_streak[5] = 3
+    eng.generate([[1, 2, 3]], max_new_tokens=2, uids=[5])
+    assert 5 not in eng._spec_miss_streak
+    # pre-banned uid on strongly periodic text: generate() clears the
+    # streak at entry, so drafting engages and beats plain greedy
+    eng._spec_miss_streak[6] = 99
+    calls = {"n": 0}
+    for name in ("_decode_batch_greedy", "_speculative_step"):
+        orig = getattr(eng, name)
+
+        def counted(*a, _o=orig, **kw):
+            calls["n"] += 1
+            return _o(*a, **kw)
+
+        setattr(eng, name, counted)
+    out = eng.generate([[5, 9, 17, 23] * 8], max_new_tokens=16,
+                       uids=[6], speculative=True)[0]
+    assert len(out) == 32 + 16
+    assert calls["n"] < 12, calls
+
+
 def test_speculative_respects_max_seq_len(tiny):
     """A late speculative round must clamp its draft to the sequence
     budget: feeding 1+k tokens past max_seq_len used to blow up in table

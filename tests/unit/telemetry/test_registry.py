@@ -1,0 +1,165 @@
+"""Metrics registry unit tests: counter/gauge/histogram semantics, label
+handling, Prometheus exposition format, and JSON snapshot round-trip."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.telemetry import MetricsRegistry
+from deepspeed_tpu.telemetry.registry import DEFAULT_BUCKETS
+
+
+@pytest.fixture()
+def reg():
+    return MetricsRegistry()
+
+
+# -- counter ----------------------------------------------------------------
+def test_counter_semantics(reg):
+    c = reg.counter("requests_total", "help text")
+    assert c.value == 0.0
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError, match="only increase"):
+        c.inc(-1)
+
+
+def test_gauge_semantics(reg):
+    g = reg.gauge("queue_depth")
+    g.set(4)
+    g.inc()
+    g.dec(2)
+    assert g.value == 3.0
+
+
+def test_histogram_semantics(reg):
+    h = reg.histogram("latency_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(55.55)
+    assert h.mean == pytest.approx(55.55 / 4)
+    # raw per-bucket slots: one observation each (+Inf slot holds 50.0)
+    assert h._default.bucket_counts == [1, 1, 1, 1]
+
+
+def test_histogram_bucket_edges_are_inclusive(reg):
+    # prometheus: le is <=, so an observation equal to a bound lands in it
+    h = reg.histogram("edge_seconds", buckets=(1.0, 2.0))
+    h.observe(1.0)
+    assert h._default.bucket_counts == [1, 0, 0]
+
+
+# -- labels -----------------------------------------------------------------
+def test_labels_resolve_distinct_series(reg):
+    c = reg.counter("ops_total", labelnames=("op",))
+    c.labels(op="all_reduce").inc(2)
+    c.labels(op="all_gather").inc()
+    assert c.labels(op="all_reduce").value == 2.0
+    assert c.labels(op="all_gather").value == 1.0
+    # same label values -> the SAME cached series object
+    assert c.labels(op="all_reduce") is c.labels(op="all_reduce")
+
+
+def test_label_name_mismatch_raises(reg):
+    c = reg.counter("ops_total", labelnames=("op",))
+    with pytest.raises(ValueError, match="declared"):
+        c.labels(kind="x")
+
+
+def test_registration_idempotent_and_kind_checked(reg):
+    a = reg.counter("x_total")
+    assert reg.counter("x_total") is a
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x_total")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.counter("x_total", labelnames=("op",))
+
+
+def test_histogram_bucket_mismatch_raises(reg):
+    h = reg.histogram("h_seconds", buckets=(0.1, 1.0))
+    # same bounds (any order) resolve to the same family
+    assert reg.histogram("h_seconds", buckets=(1.0, 0.1)) is h
+    with pytest.raises(ValueError, match="buckets"):
+        reg.histogram("h_seconds", buckets=(1.0, 10.0))
+
+
+# -- prometheus exposition ---------------------------------------------------
+def test_render_prometheus_scalars(reg):
+    c = reg.counter("requests_total", "served requests")
+    c.inc(3)
+    g = reg.gauge("depth", labelnames=("queue",))
+    g.labels(queue="prefill").set(7)
+    text = reg.render_prometheus()
+    assert "# HELP requests_total served requests" in text
+    assert "# TYPE requests_total counter" in text
+    assert "requests_total 3" in text
+    assert "# TYPE depth gauge" in text
+    assert 'depth{queue="prefill"} 7' in text
+
+
+def test_render_prometheus_histogram_cumulative(reg):
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    text = reg.render_prometheus()
+    # exposition buckets are CUMULATIVE and end at +Inf == count
+    assert 'lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{le="1"} 2' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 3' in text
+    assert "lat_seconds_sum 5.55" in text
+    assert "lat_seconds_count 3" in text
+
+
+def test_render_prometheus_label_escaping(reg):
+    g = reg.gauge("g", labelnames=("path",))
+    g.labels(path='a"b\\c\nd').set(1)
+    text = reg.render_prometheus()
+    assert 'path="a\\"b\\\\c\\nd"' in text
+
+
+# -- snapshot ---------------------------------------------------------------
+def test_snapshot_json_round_trip(reg):
+    reg.counter("c_total", "help", labelnames=("op",)).labels(op="x").inc(2)
+    reg.gauge("g").set(1.5)
+    h = reg.histogram("h_seconds", unit="s", buckets=(0.5, 1.0))
+    h.observe(0.25)
+    h.observe(2.0)
+    snap = reg.snapshot()
+    assert json.loads(json.dumps(snap)) == snap
+    m = snap["metrics"]
+    assert m["c_total"]["type"] == "counter"
+    assert m["c_total"]["series"][0] == {"labels": {"op": "x"}, "value": 2.0}
+    assert m["g"]["series"][0]["value"] == 1.5
+    hs = m["h_seconds"]["series"][0]
+    assert hs["count"] == 2 and hs["sum"] == pytest.approx(2.25)
+    assert hs["buckets"] == {"0.5": 1, "1": 0, "+Inf": 1}
+    assert m["h_seconds"]["unit"] == "s"
+
+
+def test_scalar_items_flatten(reg):
+    reg.counter("c_total").inc(2)
+    reg.gauge("g", labelnames=("k",)).labels(k="v").set(3)
+    h = reg.histogram("h_seconds")
+    h.observe(0.5)
+    items = dict(reg.scalar_items())
+    assert items["c_total"] == 2.0
+    assert items["g/k.v"] == 3.0
+    assert items["h_seconds_count"] == 1.0
+    assert items["h_seconds_sum"] == 0.5
+    assert items["h_seconds_mean"] == 0.5
+    # empty histograms emit nothing (no 0/0 means)
+    reg.histogram("empty_seconds")
+    assert "empty_seconds_count" not in dict(reg.scalar_items())
+
+
+def test_reset_drops_families(reg):
+    reg.counter("c_total").inc()
+    reg.reset()
+    assert reg.get("c_total") is None
+    assert reg.snapshot() == {"metrics": {}}
+
+
+def test_default_buckets_sorted():
+    assert list(DEFAULT_BUCKETS) == sorted(DEFAULT_BUCKETS)

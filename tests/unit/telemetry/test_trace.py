@@ -1,0 +1,96 @@
+"""Span tracing tests: ring-buffer recording, nesting depth, capacity,
+and the TelemetryBridge's cadence/dedup behavior."""
+
+import pytest
+
+from deepspeed_tpu.telemetry import MetricsRegistry, TelemetryBridge, trace
+
+
+@pytest.fixture(autouse=True)
+def _clean_spans():
+    trace.clear()
+    yield
+    trace.clear()
+
+
+def test_span_records_name_and_duration():
+    with trace.span("work", step=3):
+        pass
+    spans = trace.export("work")
+    assert len(spans) == 1
+    s = spans[0]
+    assert s["name"] == "work" and s["duration_s"] >= 0
+    assert s["depth"] == 0 and s["attrs"] == {"step": 3}
+    assert trace.durations("work") == [s["duration_s"]]
+
+
+def test_span_nesting_depth():
+    with trace.span("outer"):
+        with trace.span("inner"):
+            pass
+    by_name = {s["name"]: s for s in trace.export()}
+    assert by_name["outer"]["depth"] == 0
+    assert by_name["inner"]["depth"] == 1
+    # inner closed first -> recorded first
+    assert trace.export()[0]["name"] == "inner"
+
+
+def test_span_records_on_exception():
+    with pytest.raises(RuntimeError):
+        with trace.span("boom"):
+            raise RuntimeError("x")
+    assert len(trace.export("boom")) == 1
+
+
+def test_ring_buffer_capacity():
+    trace.set_capacity(4)
+    try:
+        for i in range(10):
+            with trace.span(f"s{i}"):
+                pass
+        names = [s["name"] for s in trace.export()]
+        assert names == ["s6", "s7", "s8", "s9"]
+    finally:
+        trace.set_capacity(4096)
+
+
+# -- bridge -----------------------------------------------------------------
+class _FakeMonitor:
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def write_events(self, ev):
+        self.events.extend(ev)
+
+
+def test_bridge_flushes_scalars_at_cadence():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total")
+    mon = _FakeMonitor()
+    bridge = TelemetryBridge(mon, registry=reg, flush_interval=2)
+    c.inc()
+    assert not bridge.step(1)        # cadence: no flush on odd call
+    assert mon.events == []
+    assert bridge.step(2)
+    assert ("c_total", 1.0, 2) in mon.events
+
+    # unchanged values are not re-written on the next flush
+    mon.events.clear()
+    bridge.step(3)
+    assert bridge.step(4) is False and mon.events == []
+    c.inc()
+    bridge.step(5)
+    assert bridge.step(6)
+    assert ("c_total", 2.0, 6) in mon.events
+
+
+def test_bridge_disabled_monitor_writes_nothing():
+    reg = MetricsRegistry()
+    reg.counter("c_total").inc()
+    mon = _FakeMonitor()
+    mon.enabled = False
+    bridge = TelemetryBridge(mon, registry=reg, flush_interval=1)
+    assert bridge.step(1) is False
+    assert mon.events == []
