@@ -15,6 +15,16 @@ the same Poisson arrival trace, the same instrumentation, driven by
 Prints ONE JSON line. Usage:
   python -m deepspeed_tpu.benchmarks.load_bench [--requests 48]
          [--rate 8.0] [--budget 128] [--chunk 32] [--new 32]
+
+``--open`` switches to the OPEN-LOOP serving-runtime mode: Poisson
+arrivals are submitted through the async ServingEngine (admission
+control + continuous-batching loop) at their trace times regardless of
+completions — the arrival process does not slow down when the server
+falls behind, so overload is real, load shedding fires, and the report
+shows what clients of a saturated deployment see: tail latency
+(p50/p95/p99 TTFT and per-request), goodput (completed tokens/s over
+the whole run), and admission rejections. Extra knobs:
+  --open [--max-pending 16] [--max-queued-tokens N] [--deadline 0]
 """
 
 import argparse
@@ -23,6 +33,10 @@ import sys
 import time
 
 import numpy as np
+
+
+def _pct(arr, q):
+    return round(float(np.percentile(np.asarray(arr), q)) * 1e3, 1)
 
 
 def run_trace(engine, arrivals, prompts, new_tokens, budget, chunk,
@@ -54,13 +68,91 @@ def run_trace(engine, arrivals, prompts, new_tokens, budget, chunk,
     return {
         "throughput_tok_s": round(gen / makespan, 2),
         "makespan_s": round(makespan, 3),
-        "ttft_p50_ms": round(float(np.percentile(ttft, 50)) * 1e3, 1),
-        "ttft_p95_ms": round(float(np.percentile(ttft, 95)) * 1e3, 1),
-        "tpot_p50_ms": round(float(np.percentile(per_tok, 50)) * 1e3, 1),
-        "tpot_p95_ms": round(float(np.percentile(per_tok, 95)) * 1e3, 1),
+        "ttft_p50_ms": _pct(ttft, 50),
+        "ttft_p95_ms": _pct(ttft, 95),
+        "tpot_p50_ms": _pct(per_tok, 50),
+        "tpot_p95_ms": _pct(per_tok, 95),
         "steps": sched.steps,
         "completed": len(m),
     }
+
+
+def run_open_loop(engine, arrivals, prompts, new_tokens, budget, chunk,
+                  max_pending, max_queued_tokens=None, deadline_s=None):
+    """Open-loop trace through the async serving runtime. Returns the
+    tail-latency/goodput/shedding report dict."""
+    import asyncio
+
+    from ..inference.v2.serve import (AdmissionConfig, DeadlineExceeded,
+                                      OverloadedError, RequestFailed,
+                                      ServingConfig, ServingEngine)
+
+    async def drive():
+        serving = ServingEngine(engine, ServingConfig(
+            token_budget=budget, chunk=chunk,
+            admission=AdmissionConfig(
+                max_pending=max_pending,
+                max_queued_tokens=max_queued_tokens)))
+        await serving.start()
+        t0 = time.perf_counter()
+        stats = {"rejected": 0, "expired": 0, "errors": 0}
+        ttfts, totals, tpots = [], [], []
+        good_tokens = 0
+
+        async def client(i):
+            nonlocal good_tokens
+            await asyncio.sleep(max(0.0, t0 + arrivals[i]
+                                    - time.perf_counter()))
+            start = time.perf_counter()
+            try:
+                stream = await serving.submit(
+                    prompts[i], new_tokens, deadline_s=deadline_s)
+            except OverloadedError:
+                stats["rejected"] += 1
+                return
+            first_t = None
+            try:
+                async for _tok in stream:
+                    if first_t is None:
+                        first_t = time.perf_counter()
+            except DeadlineExceeded:
+                stats["expired"] += 1
+                return
+            except RequestFailed:
+                stats["errors"] += 1
+                return
+            end = time.perf_counter()
+            n = len(stream.tokens)
+            good_tokens += n
+            ttfts.append((first_t or end) - start)
+            totals.append(end - start)
+            if n > 1 and first_t is not None:
+                tpots.append((end - first_t) / (n - 1))
+
+        await asyncio.gather(*[client(i) for i in range(len(prompts))])
+        await serving.stop(drain=True)
+        makespan = time.perf_counter() - t0
+        completed = len(totals)
+        return {
+            "completed": completed,
+            "rejected": stats["rejected"],
+            "expired": stats["expired"],
+            "errors": stats["errors"],
+            "makespan_s": round(makespan, 3),
+            # goodput: tokens of COMPLETED requests over the whole run
+            # (shed/expired work contributes nothing)
+            "goodput_tok_s": round(good_tokens / makespan, 2),
+            "ttft_p50_ms": _pct(ttfts, 50) if ttfts else None,
+            "ttft_p95_ms": _pct(ttfts, 95) if ttfts else None,
+            "ttft_p99_ms": _pct(ttfts, 99) if ttfts else None,
+            "latency_p50_ms": _pct(totals, 50) if totals else None,
+            "latency_p95_ms": _pct(totals, 95) if totals else None,
+            "latency_p99_ms": _pct(totals, 99) if totals else None,
+            "tpot_p50_ms": _pct(tpots, 50) if tpots else None,
+            "tpot_p95_ms": _pct(tpots, 95) if tpots else None,
+        }
+
+    return asyncio.run(drive())
 
 
 def main(argv=None) -> int:
@@ -73,6 +165,15 @@ def main(argv=None) -> int:
     p.add_argument("--new", type=int, default=32)
     p.add_argument("--layers", type=int, default=4)
     p.add_argument("--hidden", type=int, default=256)
+    p.add_argument("--open", action="store_true",
+                   help="open-loop mode through the async serving "
+                        "runtime (admission control + tail latency)")
+    p.add_argument("--max-pending", type=int, default=16,
+                   help="open mode: admission queue bound")
+    p.add_argument("--max-queued-tokens", type=int, default=0,
+                   help="open mode: queued-work token budget (0 = off)")
+    p.add_argument("--deadline", type=float, default=0.0,
+                   help="open mode: per-request deadline seconds (0 = off)")
     args = p.parse_args(argv)
 
     import jax
@@ -99,6 +200,29 @@ def main(argv=None) -> int:
                               "max_seq_len": 1024,
                               "num_blocks": 4096},
         }, params=params)
+
+    if args.open:
+        # warm with a closed-loop pass over the same trace (jit caches
+        # are per engine object and bucket size), then measure open-loop
+        eng = fresh_engine()
+        run_trace(eng, arrivals, prompts, args.new, args.budget,
+                  args.chunk, uid_base=10 ** 6)
+        report = run_open_loop(
+            eng, arrivals, prompts, args.new, args.budget, args.chunk,
+            max_pending=args.max_pending,
+            max_queued_tokens=args.max_queued_tokens or None,
+            deadline_s=args.deadline or None)
+        print(json.dumps({
+            "metric": "serving_open_loop",
+            "backend": jax.default_backend(),
+            "requests": args.requests, "rate_rps": args.rate,
+            "budget": args.budget, "chunk": args.chunk,
+            "new_tokens": args.new, "max_pending": args.max_pending,
+            "max_queued_tokens": args.max_queued_tokens or None,
+            "deadline_s": args.deadline or None,
+            **report,
+        }))
+        return 0
 
     # warm the SAME engine instances the measurement uses with the SAME
     # trace: jit caches are per engine object and per bucket size, so

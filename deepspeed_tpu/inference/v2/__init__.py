@@ -1,3 +1,7 @@
 from .config_v2 import (DSStateManagerConfig,  # noqa: F401
                         RaggedInferenceEngineConfig)
 from .engine_v2 import InferenceEngineV2  # noqa: F401
+from .scheduler import DynamicSplitFuseScheduler  # noqa: F401
+# async serving runtime (streaming front end, admission control,
+# continuous-batching loop, HTTP surface) lives in .serve:
+#   from deepspeed_tpu.inference.v2.serve import ServingEngine, ServingAPI

@@ -26,7 +26,7 @@ Usage:
 
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -46,7 +46,12 @@ class _Request:
     generated: List[int] = field(default_factory=list)
     next_token: Optional[int] = None  # pending decode input
     first_token_t: Optional[float] = None
+    last_emit_t: Optional[float] = None
     finish_t: Optional[float] = None
+    cancelled: bool = False
+    # streaming hook (the async serving runtime, serve/): called as
+    # on_token(uid, token, finished) from inside step()
+    on_token: Optional[Callable[[int, int, bool], None]] = None
 
     def pick(self, logits_row: np.ndarray) -> int:
         from .sampling import host_sample
@@ -106,9 +111,16 @@ class DynamicSplitFuseScheduler:
         self._m_ttft = reg.histogram(
             "serving_ttft_seconds", "submit -> first generated token",
             unit="s")
+        self._m_tpot = reg.histogram(
+            "serving_tpot_seconds",
+            "time per output token (gap between consecutive emitted "
+            "tokens of one request)", unit="s")
         self._m_req_time = reg.histogram(
             "serving_request_seconds", "submit -> request finished",
             unit="s")
+        self._m_cancelled = reg.counter(
+            "serving_requests_cancelled_total",
+            "requests cancelled before finishing (KV blocks released)")
         self._m_gen_tokens = reg.counter(
             "serving_generated_tokens_total",
             "tokens generated across finished requests")
@@ -121,13 +133,25 @@ class DynamicSplitFuseScheduler:
     def submit(self, uid: int, prompt: Sequence[int], max_new_tokens: int,
                eos_token_id: Optional[int] = None,
                temperature: float = 0.0, top_p: float = 1.0,
-               top_k: int = 0, seed: Optional[int] = None) -> None:
+               top_k: int = 0, seed: Optional[int] = None,
+               on_token: Optional[Callable[[int, int, bool], None]]
+               = None) -> None:
         """temperature/top_p/seed are PER REQUEST (the MII SamplingParams
         surface): mixed greedy and sampled requests compose into the same
         steps; a SEEDED request's tokens are deterministic (independent
         of batch composition — the rng is per request), an unseeded one
-        draws fresh OS entropy."""
-        assert uid not in self._all, f"uid {uid} already submitted"
+        draws fresh OS entropy. ``on_token(uid, token, finished)`` fires
+        for every emitted token (the serve/ streaming hook)."""
+        if uid in self._all:
+            # results()/metrics() are keyed by uid: admitting a second
+            # request under a live key would silently cross their
+            # per-request state. Reject loudly (a plain assert vanishes
+            # under python -O).
+            raise ValueError(
+                f"uid {uid} already submitted to this scheduler "
+                f"(per-uid results()/metrics() state would be "
+                f"corrupted); use a fresh uid, or release(uid) once the "
+                f"previous request is finished or cancelled")
         max_seq_len = self.engine.state_manager.config.max_seq_len
         # the final emitted token is never fed back (_emit), so the
         # request writes prompt + max(new-1, 0) KV slots — the same need
@@ -146,7 +170,7 @@ class DynamicSplitFuseScheduler:
         req = _Request(uid, list(map(int, prompt)), max_new_tokens,
                        eos_token_id, self.clock(),
                        temperature=temperature, top_p=top_p, top_k=top_k,
-                       rng=np.random.default_rng(seed))
+                       rng=np.random.default_rng(seed), on_token=on_token)
         self._all[uid] = req
         self._queue.append(req)
         self._m_submitted.inc()
@@ -154,6 +178,44 @@ class DynamicSplitFuseScheduler:
 
     def pending(self) -> bool:
         return bool(self._queue or self._running)
+
+    def inflight(self) -> int:
+        """Requests admitted and not yet finished/cancelled (queued for
+        prefill budget + decoding)."""
+        return len(self._queue) + len(self._running)
+
+    # ------------------------------------------------------------------
+    def cancel(self, uid: int) -> bool:
+        """Abort an in-flight request: drop it from the step composition
+        and release its KV blocks back to the pool. No further tokens are
+        emitted (and no on_token callback fires again). Returns False if
+        the uid is unknown, already finished, or already cancelled. The
+        request stays recorded (excluded from results()/metrics()) so the
+        uid cannot be silently reused; release(uid) forgets it."""
+        req = self._all.get(uid)
+        if req is None or req.done or req.cancelled:
+            return False
+        req.cancelled = True
+        req.next_token = None
+        if req in self._running:
+            self._running.remove(req)
+        if req in self._queue:
+            self._queue.remove(req)
+        self.engine.flush(uid)     # frees the blocks; no-op if none held
+        self._m_cancelled.inc()
+        self._update_depth_gauges()
+        return True
+
+    def release(self, uid: int) -> None:
+        """Forget a finished or cancelled request so its uid can be
+        resubmitted (long-lived serving: _all must not grow forever)."""
+        req = self._all.get(uid)
+        if req is None:
+            return
+        if not (req.done or req.cancelled):
+            raise ValueError(
+                f"uid {uid} is still in flight; cancel() it first")
+        del self._all[uid]
 
     # ------------------------------------------------------------------
     def _finish(self, req: _Request) -> None:
@@ -325,12 +387,20 @@ class DynamicSplitFuseScheduler:
         """Record a produced token; finish or queue it as the next decode
         input. Matches generate(): eos is included in the output, and the
         final emitted token is never fed back (no wasted forward)."""
+        now = self.clock()
+        if req.last_emit_t is not None:
+            # inter-token gap = the serving TPOT distribution (first
+            # token is TTFT territory, not TPOT)
+            self._m_tpot.observe(now - req.last_emit_t)
+        req.last_emit_t = now
         req.generated.append(tok)
         if ((req.eos_token_id is not None and tok == req.eos_token_id)
                 or len(req.generated) >= req.max_new_tokens):
             self._finish(req)
         else:
             req.next_token = tok
+        if req.on_token is not None:
+            req.on_token(req.uid, tok, req.done)
 
     # ------------------------------------------------------------------
     def run(self, max_steps: int = 10 ** 6) -> None:

@@ -1,0 +1,30 @@
+"""Async serving runtime over the SplitFuse scheduler.
+
+The layer between clients and the model loop (the reference ships it as
+DeepSpeed-MII persistent deployments over the FastGen engine):
+
+  frontend.py  — asyncio ServingEngine: async submit() -> token stream,
+                 per-request deadlines, cancellation that releases KV
+  admission.py — bounded pending queue, token-budget load shedding,
+                 weighted-fair scheduling across tenants
+  loop.py      — background thread continuously draining the SplitFuse
+                 scheduler (continuous batching) with graceful drain
+  api.py       — dependency-free HTTP endpoint: streaming /generate,
+                 /healthz, /metrics (Prometheus text from the registry)
+
+See docs/SERVING.md ("Async serving runtime") for the architecture and
+the streaming protocol.
+"""
+
+from .admission import (AdmissionConfig, AdmissionController,  # noqa: F401
+                        OverloadedError)
+from .frontend import (DeadlineExceeded, RequestFailed,  # noqa: F401
+                       ServingConfig, ServingEngine, TokenStream)
+from .loop import ServingLoop  # noqa: F401
+from .api import ServingAPI  # noqa: F401
+
+__all__ = [
+    "AdmissionConfig", "AdmissionController", "OverloadedError",
+    "DeadlineExceeded", "RequestFailed", "ServingConfig", "ServingEngine",
+    "TokenStream", "ServingLoop", "ServingAPI",
+]

@@ -1,0 +1,201 @@
+"""Dependency-free HTTP surface for the serving runtime.
+
+Built on stdlib ``asyncio.start_server`` — no web framework. Endpoints:
+
+  * ``POST /generate`` — JSON body ``{"prompt": [ids], "max_new_tokens":
+    n, ...}``; the response streams one NDJSON line per generated token
+    (``{"token": t}``) followed by a final summary line (``{"done":
+    true, "status": ..., "n": k, "tokens": [...]}``). The connection is
+    ``Connection: close`` — the stream's end IS the close. A client that
+    disconnects mid-stream cancels its request (KV blocks released).
+    Protocol note: EOF on the client->server direction is the hangup
+    signal (a TCP FIN is all a close gives us), so clients must keep
+    their write side open until the stream ends — ``shutdown(SHUT_WR)``
+    after the request body reads as a disconnect and cancels the work.
+  * ``GET /healthz`` — JSON runtime health (status, queue depth,
+    in-flight count).
+  * ``GET /metrics`` — Prometheus text exposition rendered from the
+    telemetry registry (queue depth, admission rejections, TTFT/TPOT
+    histograms, ... — see docs/TELEMETRY.md).
+
+Overload maps to ``429`` with the admission reason; malformed requests
+to ``400``; unknown routes to ``404``.
+"""
+
+import asyncio
+import json
+from typing import Optional, Tuple
+
+from .admission import OverloadedError
+from .frontend import DeadlineExceeded, RequestFailed, ServingEngine
+
+_MAX_HEADER_BYTES = 64 * 1024
+_MAX_BODY_BYTES = 8 * 1024 * 1024
+
+
+async def _read_request(reader: asyncio.StreamReader):
+    request_line = await reader.readline()
+    if not request_line:
+        raise ConnectionError("empty request")
+    parts = request_line.decode("latin-1").split()
+    if len(parts) < 2:
+        raise ValueError("malformed request line")
+    method, target = parts[0].upper(), parts[1]
+    headers = {}
+    total = 0
+    while True:
+        line = await reader.readline()
+        total += len(line)
+        if total > _MAX_HEADER_BYTES:
+            raise ValueError("headers too large")
+        if line in (b"\r\n", b"\n", b""):
+            break
+        name, _, value = line.decode("latin-1").partition(":")
+        headers[name.strip().lower()] = value.strip()
+    length = int(headers.get("content-length", 0) or 0)
+    if length > _MAX_BODY_BYTES:
+        raise ValueError("body too large")
+    body = await reader.readexactly(length) if length else b""
+    return method, target, headers, body
+
+
+def _response_head(status: str, content_type: str) -> bytes:
+    return (f"HTTP/1.1 {status}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Connection: close\r\n\r\n").encode()
+
+
+def _json_response(writer: asyncio.StreamWriter, status: str, obj) -> None:
+    writer.write(_response_head(status, "application/json")
+                 + json.dumps(obj).encode() + b"\n")
+
+
+class ServingAPI:
+    """In-process HTTP server over a :class:`ServingEngine`."""
+
+    def __init__(self, serving: ServingEngine, host: str = "127.0.0.1",
+                 port: int = 0, registry=None):
+        self.serving = serving
+        self.host = host
+        self.port = port
+        if registry is None:
+            from ....telemetry import get_registry
+            registry = get_registry()
+        self.registry = registry
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> Tuple[str, int]:
+        self._server = await asyncio.start_server(self._handle, self.host,
+                                                  self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.host, self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # ------------------------------------------------------------------
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            try:
+                method, target, _headers, body = await _read_request(reader)
+            except ConnectionError:
+                return
+            except (ValueError, asyncio.IncompleteReadError):
+                _json_response(writer, "400 Bad Request",
+                               {"error": "malformed request"})
+                return
+            target = target.split("?", 1)[0]
+            if method == "GET" and target == "/healthz":
+                _json_response(writer, "200 OK", self.serving.health())
+            elif method == "GET" and target == "/metrics":
+                writer.write(_response_head(
+                    "200 OK", "text/plain; version=0.0.4; charset=utf-8")
+                    + self.registry.render_prometheus().encode())
+            elif method == "POST" and target == "/generate":
+                await self._generate(reader, writer, body)
+            else:
+                _json_response(writer, "404 Not Found",
+                               {"error": f"no route {method} {target}"})
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            try:
+                if writer.can_write_eof():
+                    writer.write_eof()
+            except (OSError, RuntimeError):
+                pass
+            writer.close()
+
+    async def _generate(self, reader, writer, body: bytes) -> None:
+        # coerce every field up front: an unchecked value (e.g.
+        # temperature="hot") would only blow up inside scheduler.step(),
+        # where _step_error fails EVERY in-flight request
+        try:
+            payload = json.loads(body or b"{}")
+            prompt = [int(t) for t in payload["prompt"]]
+            max_new = int(payload.get("max_new_tokens", 64))
+            kw = {}
+            for name, cast in (("eos_token_id", int), ("top_k", int),
+                               ("seed", int), ("temperature", float),
+                               ("top_p", float), ("weight", float),
+                               ("deadline_s", float), ("tenant", str)):
+                if payload.get(name) is not None:
+                    kw[name] = cast(payload[name])
+        except (KeyError, TypeError, ValueError, json.JSONDecodeError):
+            _json_response(writer, "400 Bad Request",
+                           {"error": "body must be JSON with a 'prompt' "
+                                     "list of token ids (and numeric "
+                                     "sampling/deadline fields)"})
+            return
+        try:
+            stream = await self.serving.submit(prompt, max_new, **kw)
+        except OverloadedError as e:
+            _json_response(writer, "429 Too Many Requests",
+                           {"error": "overloaded", "reason": e.reason,
+                            "detail": str(e)})
+            return
+        except ValueError as e:
+            _json_response(writer, "400 Bad Request", {"error": str(e)})
+            return
+
+        writer.write(_response_head("200 OK", "application/x-ndjson"))
+        # with Connection: close the client sends nothing more; read()
+        # completing means it hung up — cancel so the KV blocks free
+        hangup = asyncio.ensure_future(reader.read(1))
+        status, detail = "completed", None
+        try:
+            while True:
+                nxt = asyncio.ensure_future(stream.__anext__())
+                done, _ = await asyncio.wait(
+                    {nxt, hangup}, return_when=asyncio.FIRST_COMPLETED)
+                if hangup in done and nxt not in done:
+                    nxt.cancel()
+                    await stream.cancel()
+                    return
+                try:
+                    tok = nxt.result()
+                except StopAsyncIteration:
+                    status = stream.status
+                    break
+                except DeadlineExceeded:
+                    status, detail = "expired", "deadline exceeded"
+                    break
+                except RequestFailed as e:
+                    status, detail = "error", str(e)
+                    break
+                writer.write(json.dumps({"token": tok}).encode() + b"\n")
+                await writer.drain()
+            tail = {"done": True, "status": status, "uid": stream.uid,
+                    "n": len(stream.tokens), "tokens": stream.tokens}
+            if detail:
+                tail["detail"] = detail
+            writer.write(json.dumps(tail).encode() + b"\n")
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            await stream.cancel()
+        finally:
+            hangup.cancel()

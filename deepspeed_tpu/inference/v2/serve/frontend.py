@@ -1,0 +1,248 @@
+"""Asyncio front end of the serving runtime.
+
+:class:`ServingEngine` decouples clients from the model loop: ``await
+submit(...)`` admission-checks the request (raising
+:class:`~.admission.OverloadedError` under overload — explicit
+backpressure, never an unbounded queue) and returns a
+:class:`TokenStream`, an async iterator that yields tokens as the
+background :class:`~.loop.ServingLoop` emits them. Cancelling a stream —
+``cancel()``, ``aclose()`` (e.g. via ``contextlib.aclosing``), or as a
+garbage-collection safety net when the stream is dropped — releases the
+request's KV blocks back to the pool mid-decode. A bare ``break`` out of
+``async for`` does NOT call ``aclose()`` on a plain async iterator:
+callers abandoning a stream early should ``await stream.cancel()`` (the
+GC net is best-effort and its timing is the collector's). Per-request
+deadlines cancel overdue work wherever it is (pending or mid-decode).
+
+Tokens are byte-identical to the direct scheduler path: the runtime
+changes WHEN work runs, never what it computes.
+"""
+
+import asyncio
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+from ..scheduler import DynamicSplitFuseScheduler
+from .admission import AdmissionConfig, AdmissionController
+from .loop import ServingLoop
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline passed before it finished; its KV blocks
+    were released and no further tokens will arrive."""
+
+
+class RequestFailed(RuntimeError):
+    """The model loop could not run the request (e.g. the prompt exceeds
+    max_seq_len, or a step-time engine failure)."""
+
+
+@dataclass
+class ServingConfig:
+    token_budget: Optional[int] = None      # scheduler step budget
+    chunk: Optional[int] = None             # prefill chunk size
+    max_inflight: Optional[int] = None      # requests inside the scheduler
+    idle_wait_s: float = 0.002
+    admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+
+
+@dataclass
+class _Entry:
+    """The loop-side request record (see ServingLoop's duck-type)."""
+    uid: int
+    prompt: List[int]
+    max_new_tokens: int
+    eos_token_id: Optional[int]
+    temperature: float
+    top_p: float
+    top_k: int
+    seed: Optional[int]
+    tenant: str
+    weight: Optional[float]
+    deadline_t: Optional[float]
+    on_token: object = None
+    on_end: object = None
+    state: str = "pending"
+
+
+class TokenStream:
+    """Async iterator over one request's generated tokens.
+
+    Ends (StopAsyncIteration) when the request completes or is
+    cancelled; raises :class:`DeadlineExceeded` on deadline expiry and
+    :class:`RequestFailed` on model-loop errors. ``status`` is one of
+    'active' | 'completed' | 'cancelled' | 'expired' | 'error'."""
+
+    def __init__(self, serving: "ServingEngine", uid: int,
+                 aio_loop: asyncio.AbstractEventLoop):
+        self._serving = serving
+        self._aio = aio_loop
+        self._q: asyncio.Queue = asyncio.Queue()
+        self._ended = False
+        self.uid = uid
+        self.status = "active"
+        self.reason: Optional[str] = None
+        self.tokens: List[int] = []
+
+    # called from the serving-loop thread
+    def _push_token(self, tok: int, finished: bool) -> None:
+        self._aio.call_soon_threadsafe(self._q.put_nowait, ("tok", tok))
+
+    def _push_end(self, status: str, reason: Optional[str]) -> None:
+        self._aio.call_soon_threadsafe(self._q.put_nowait,
+                                       ("end", status, reason))
+
+    # -- async iterator -------------------------------------------------
+    def __aiter__(self) -> "TokenStream":
+        return self
+
+    async def __anext__(self) -> int:
+        if self._ended:
+            raise StopAsyncIteration
+        item = await self._q.get()
+        if item[0] == "tok":
+            self.tokens.append(item[1])
+            return item[1]
+        self._ended = True
+        self.status, self.reason = item[1], item[2]
+        if self.status == "expired":
+            raise DeadlineExceeded(
+                f"request {self.uid}: deadline exceeded")
+        if self.status == "error":
+            raise RequestFailed(
+                f"request {self.uid}: {self.reason}")
+        raise StopAsyncIteration    # completed or cancelled
+
+    async def cancel(self) -> None:
+        """Abort the request: its KV blocks return to the pool and the
+        stream ends (status 'cancelled'); no further tokens arrive."""
+        self._serving._loop_runner.request_cancel(self.uid)
+
+    async def aclose(self) -> None:
+        if not self._ended and self.status == "active":
+            await self.cancel()
+
+    def __del__(self):
+        # best-effort net for dropped streams: without it an abandoned
+        # request decodes to max_new_tokens holding its KV blocks.
+        # request_cancel only touches a thread-safe deque + Event, so it
+        # is safe from a finalizer; a finished uid makes it a no-op.
+        if self.status == "active":
+            try:
+                self._serving._loop_runner.request_cancel(self.uid)
+            except Exception:
+                pass
+
+    async def drain(self) -> List[int]:
+        """Collect every remaining token; returns all tokens so far."""
+        async for _ in self:
+            pass
+        return self.tokens
+
+
+class ServingEngine:
+    """Async serving runtime: frontend -> admission -> loop -> scheduler.
+
+    Usage::
+
+        serving = ServingEngine(engine, ServingConfig(token_budget=128))
+        await serving.start()
+        stream = await serving.submit(prompt_ids, max_new_tokens=64)
+        async for tok in stream:
+            ...
+        await serving.stop()          # graceful drain
+    """
+
+    def __init__(self, engine, config: Optional[ServingConfig] = None,
+                 clock=time.perf_counter):
+        self.config = config or ServingConfig()
+        self.clock = clock
+        self.scheduler = DynamicSplitFuseScheduler(
+            engine, token_budget=self.config.token_budget,
+            chunk=self.config.chunk, clock=clock)
+        self.admission = AdmissionController(self.config.admission)
+        self._loop_runner = ServingLoop(
+            self.scheduler, self.admission,
+            max_inflight=self.config.max_inflight,
+            idle_wait_s=self.config.idle_wait_s, clock=clock)
+        self._uids = itertools.count(1)
+        self._stopped = False
+
+    @property
+    def loop_runner(self) -> ServingLoop:
+        return self._loop_runner
+
+    # -- lifecycle ------------------------------------------------------
+    async def start(self) -> "ServingEngine":
+        if self._stopped:
+            raise RuntimeError("serving engine already stopped")
+        self._loop_runner.start()
+        return self
+
+    async def stop(self, drain: bool = True,
+                   timeout: Optional[float] = None) -> None:
+        """Shut the runtime down. ``drain=True`` (graceful): new submits
+        are rejected immediately, everything already admitted finishes.
+        ``drain=False``: in-flight requests are cancelled (KV released)
+        and their streams end with status 'cancelled'."""
+        self._stopped = True
+        if drain:
+            self._loop_runner.request_drain()
+        else:
+            self._loop_runner.request_stop()
+        if not self._loop_runner.running:
+            # never started: end anything parked in the queues
+            self._loop_runner.start()
+        await asyncio.to_thread(self._loop_runner.join, timeout)
+
+    async def __aenter__(self) -> "ServingEngine":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop(drain=exc == (None, None, None))
+
+    # -- submission -----------------------------------------------------
+    async def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
+                     eos_token_id: Optional[int] = None,
+                     temperature: float = 0.0, top_p: float = 1.0,
+                     top_k: int = 0, seed: Optional[int] = None,
+                     tenant: str = "default",
+                     weight: Optional[float] = None,
+                     deadline_s: Optional[float] = None) -> TokenStream:
+        """Admit a request and return its token stream.
+
+        Raises :class:`~.admission.OverloadedError` when the runtime is
+        overloaded (bounded queue full / token budget exceeded /
+        draining) — callers retry with backoff or surface 429.
+        ``deadline_s`` is a wall-clock budget from now; overdue requests
+        are cancelled wherever they are and the stream raises
+        :class:`DeadlineExceeded`."""
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        uid = next(self._uids)
+        stream = TokenStream(self, uid, asyncio.get_running_loop())
+        entry = _Entry(
+            uid=uid, prompt=list(map(int, prompt)),
+            max_new_tokens=int(max_new_tokens),
+            eos_token_id=eos_token_id, temperature=temperature,
+            top_p=top_p, top_k=top_k, seed=seed, tenant=tenant,
+            weight=weight,
+            deadline_t=(self.clock() + deadline_s
+                        if deadline_s is not None else None),
+            on_token=stream._push_token, on_end=stream._push_end)
+        self.admission.try_admit(entry)     # raises OverloadedError
+        self._loop_runner.register(entry)
+        return stream
+
+    # -- introspection --------------------------------------------------
+    def health(self) -> dict:
+        return {
+            "status": ("draining" if (self.admission.closed
+                                      or self._stopped) else "ok"),
+            "queue_depth": self.admission.depth(),
+            "queued_tokens": self.admission.queued_tokens(),
+            "inflight": self.scheduler.inflight(),
+            "loop_alive": self._loop_runner.running,
+        }

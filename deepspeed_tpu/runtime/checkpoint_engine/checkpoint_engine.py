@@ -98,26 +98,66 @@ class NativeCheckpointEngine(CheckpointEngine):
 
 class AsyncCheckpointEngine(NativeCheckpointEngine):
     """Background-thread writes (reference NebulaCheckpointEngine's role):
-    save() returns immediately after snapshotting to host memory."""
+    save() returns after snapshotting to host memory; the write persists
+    on a background thread. At most ``max_writers`` writes run at once
+    (``config_params={"max_writers": n}``): a caller that outruns the
+    disk blocks in save() holding one extra snapshot instead of queueing
+    snapshots without limit. Write failures are captured per thread and
+    re-raised at the commit() barrier — a checkpoint is durable only if
+    commit() returns, never merely because join() succeeded."""
+
+    DEFAULT_MAX_WRITERS = 4
 
     def __init__(self, config_params=None):
         super().__init__(config_params)
+        max_writers = self.DEFAULT_MAX_WRITERS
+        if isinstance(config_params, dict):
+            max_writers = int(config_params.get("max_writers", max_writers))
+        if max_writers < 1:
+            # a plain assert vanishes under python -O, and
+            # BoundedSemaphore(0) would hang the first save() forever
+            raise ValueError(
+                f"max_writers must be >= 1, got {max_writers}")
+        self.max_writers = max_writers
+        self._slots = threading.BoundedSemaphore(max_writers)
         self._pending: List[threading.Thread] = []
+        self._errors: List[tuple] = []          # (path, exception)
+        self._err_lock = threading.Lock()
 
     def save(self, state_dict: Dict[str, Any], path: str):
+        # snapshot BEFORE blocking on a writer slot: the caller's arrays
+        # are captured at save() time even if all slots are busy
         snapshot = {k: (np.asarray(v).copy() if hasattr(v, "shape") else v)
                     for k, v in _flatten(state_dict)}
+        self._slots.acquire()
 
         def write():
-            NativeCheckpointEngine.save(self, _unflatten(snapshot), path)
+            try:
+                NativeCheckpointEngine.save(self, _unflatten(snapshot), path)
+            except BaseException as e:
+                with self._err_lock:
+                    self._errors.append((path, e))
+            finally:
+                self._slots.release()
 
         t = threading.Thread(target=write, daemon=True)
         t.start()
         self._pending.append(t)
 
     def commit(self, tag: str) -> bool:
+        """Durability barrier: joins every writer and RE-RAISES the first
+        background failure (join() succeeding says nothing about the
+        write). The engine stays usable after a failed commit."""
         for t in self._pending:
             t.join()
         self._pending.clear()
+        with self._err_lock:
+            errors, self._errors = self._errors, []
+        if errors:
+            path, first = errors[0]
+            raise RuntimeError(
+                f"[AsyncCheckpointEngine] commit({tag!r}): "
+                f"{len(errors)} background write(s) failed; first: "
+                f"{path}: {first!r}") from first
         logger.info(f"[AsyncCheckpointEngine] committed {tag}")
         return True

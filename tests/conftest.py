@@ -39,6 +39,10 @@ def pytest_configure(config):
         "markers",
         "examples: heavyweight in-tree example subprocess smokes "
         "(separate tier; run with -m examples or DS_TPU_RUN_EXAMPLES=1)")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running benchmarks/sweeps excluded from the tier-1 "
+        "set (tier-1 runs with -m 'not slow')")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,30 @@
+"""Open-loop load-bench smoke (slow tier).
+
+Runs `benchmarks/load_bench.py --open` — Poisson arrivals through the
+async serving runtime (admission control + continuous batching) — on a
+tiny model and checks the tail-latency/goodput report. Marked `slow`:
+the warm-up pass plus the open-loop trace is a multi-minute CPU compile
+party, so tier-1 (`-m 'not slow'`) skips it; the fast in-process serving
+coverage lives in tests/unit/inference/test_serving_runtime.py."""
+
+import json
+
+import pytest
+
+
+@pytest.mark.slow
+def test_open_loop_bench_reports_tail_latency_and_goodput(capsys):
+    from deepspeed_tpu.benchmarks.load_bench import main
+
+    rc = main(["--open", "--requests", "10", "--rate", "50.0",
+               "--budget", "64", "--chunk", "16", "--new", "8",
+               "--layers", "2", "--hidden", "64", "--max-pending", "4"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["metric"] == "serving_open_loop"
+    done = report["completed"]
+    assert done + report["rejected"] + report["expired"] \
+        + report["errors"] == 10
+    assert done > 0 and report["goodput_tok_s"] > 0
+    assert report["ttft_p50_ms"] is not None
+    assert report["latency_p99_ms"] >= report["latency_p50_ms"]

@@ -112,6 +112,50 @@ def test_async_checkpoint_engine_commit_barrier(tmp_path):
                                   _state()["model"]["w"])
 
 
+def test_async_checkpoint_commit_reraises_write_failure(tmp_path):
+    """A background write failure must surface at the commit() barrier —
+    join() succeeding says nothing about durability."""
+    eng = AsyncCheckpointEngine()
+    bad = str(tmp_path / "no_such_dir" / "ck.npz")   # open() will fail
+    eng.save(_state(), bad)
+    with pytest.raises(RuntimeError, match="background write"):
+        eng.commit("tag")
+    # the engine stays usable after a failed commit
+    good = str(tmp_path / "ck_ok.npz")
+    eng.save(_state(), good)
+    assert eng.commit("tag2")
+    np.testing.assert_array_equal(eng.load(good)["model"]["w"],
+                                  _state()["model"]["w"])
+
+
+def test_async_checkpoint_bounded_writers(tmp_path, monkeypatch):
+    """At most max_writers background writes run concurrently; an extra
+    save() blocks for a slot instead of queueing snapshots unboundedly."""
+    import threading
+    import time as _time
+
+    eng = AsyncCheckpointEngine({"max_writers": 2})
+    live, peak = [0], [0]
+    lock = threading.Lock()
+
+    def slow_save(self, state, path):
+        with lock:
+            live[0] += 1
+            peak[0] = max(peak[0], live[0])
+        _time.sleep(0.05)
+        with lock:
+            live[0] -= 1
+
+    monkeypatch.setattr(NativeCheckpointEngine, "save", slow_save)
+    for i in range(5):
+        eng.save(_state(), str(tmp_path / f"ck{i}.npz"))
+    assert eng.commit("tag")
+    assert peak[0] <= 2, f"{peak[0]} writers ran concurrently"
+
+    with pytest.raises(ValueError, match="max_writers"):
+        AsyncCheckpointEngine({"max_writers": 0})
+
+
 # -- comm bench math --------------------------------------------------------
 def test_comm_bench_single_device_smoke():
     from deepspeed_tpu.benchmarks.comm_bench import run_op
