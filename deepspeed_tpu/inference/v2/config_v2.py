@@ -47,6 +47,15 @@ class RaggedInferenceEngineConfig:
     # HBM): writes quantize per (slot, head), reads dequantize; serves
     # through the gather path (Pallas decode kernels are bf16-tile)
     kv_quant: bool = False
+    # fused multi-token decode: up to K decode steps run in ONE jitted
+    # device loop (cache write, paged attention, sampling, EOS masking,
+    # arithmetic block-table advance over pre-allocated blocks) with a
+    # single [N, K] int32 transfer per window instead of a Python
+    # round-trip per token. K is fixed per compiled program (batch rows
+    # still pad to the power-of-two buckets), so the compile cache stays
+    # bounded; per-row budgets mask shorter tails. 1 = the per-token
+    # fallback path.
+    decode_window: int = 8
     seed: int = 0
 
     @classmethod

@@ -13,6 +13,13 @@ fused ``paged_continue`` chunk pass, and running sequences batch into a
 compiled-program cache plays the role the reference's CUDA graphs + atom
 builder play. Mixed puts do the prefills/continuations first, then the
 fused decode batch.
+
+The decode hot loop itself is fused on device (``decode_window`` > 1):
+``paged_decode_window`` runs up to K decode steps per dispatch — cache
+write, paged attention, argmax/per-row-keyed sampling, EOS + budget
+masking, arithmetic block-table advancement over pre-allocated blocks —
+with one [N, K] int32 transfer per window instead of a Python round-trip
+per token (docs/SERVING.md, "Fused multi-token decode").
 """
 
 import time
@@ -27,7 +34,7 @@ from ...telemetry import trace
 from ...utils.logging import log_dist
 from .config_v2 import RaggedInferenceEngineConfig
 from .paged_model import (init_paged_kv_cache, paged_continue, paged_decode,
-                          paged_prefill)
+                          paged_decode_window, paged_prefill)
 from .ragged.blocked_allocator import NULL_BLOCK
 from .ragged.ragged_manager import DSStateManager
 
@@ -116,6 +123,10 @@ class InferenceEngineV2:
         # decoding; entries are cleared on flush() and at generate() entry
         # so a cold streak never bans a uid across independent calls
         self._spec_miss_streak: Dict[int, int] = {}
+        # per-uid incremental n-gram index (ngram_index.py): keeps draft
+        # lookup O(ngram) per round instead of re-scanning the history
+        # window; same lifecycle as the miss streaks
+        self._draft_index: Dict[int, object] = {}
         self._init_telemetry()
         # Pallas kernels only at tp=1: a bare pallas_call is not
         # GSPMD-partitionable, so sharded-param (tp>1) serving keeps the
@@ -148,18 +159,45 @@ class InferenceEngineV2:
 
         self._decode_tok_jit = jax.jit(_decode_tok, donate_argnums=(4,))
 
-        def _decode_sample(p, t, pos, bt, c, a, rng, temp, topp, topk):
+        def _decode_sample(p, t, pos, bt, c, a, rng, seeds, gidx, temp,
+                           topp, topk):
             # sampling variant (FastGen temperature/top-p/top-k): the
-            # sampler runs device-side too, still an [N] int32 transfer
-            from .sampling import sample_tokens
+            # sampler runs device-side too, still an [N] int32 transfer.
+            # Per-ROW keys (stable row seed + generated-token index) so
+            # the stream matches the fused window path bit-for-bit
+            from .sampling import fold_in_rows, sample_tokens_rowwise
             logits, c = paged_decode(cfg, p, t, pos, bt, c, a,
                                      sm.block_size,
                                      use_kernel=use_kernel_decode,
                                      topo=topo)
-            return sample_tokens(logits, rng, temp, topp, topk), c
+            keys = fold_in_rows(rng, seeds, gidx)
+            return sample_tokens_rowwise(logits, keys, temp, topp,
+                                         topk), c
 
         self._decode_sample_jit = jax.jit(_decode_sample,
                                           donate_argnums=(4,))
+        # fused multi-token decode window (the generate()/scheduler hot
+        # path when decode_window > 1): K decode steps per dispatch, one
+        # [N, K] int32 transfer per window. K is baked into the compiled
+        # program; batch rows pad to the same power-of-two buckets as the
+        # per-token path, so the compile cache stays one program per
+        # (batch bucket, table-width bucket).
+        self.decode_window = max(int(config.decode_window), 1)
+        self._m_window_size.set(self.decode_window)
+        self._fused_greedy_jit = jax.jit(
+            lambda p, t, pos, bt, c, sl, eos: paged_decode_window(
+                cfg, p, t, pos, bt, c, sl, eos, sm.block_size,
+                self.decode_window, use_kernel=use_kernel_decode,
+                topo=topo),
+            donate_argnums=(4,))
+        self._fused_sample_jit = jax.jit(
+            lambda p, t, pos, bt, c, sl, eos, rng, seeds, g0, temp, topp, \
+            topk: paged_decode_window(
+                cfg, p, t, pos, bt, c, sl, eos, sm.block_size,
+                self.decode_window, rng=rng, row_seeds=seeds, gen_idx0=g0,
+                temp=temp, topp=topp, topk=topk,
+                use_kernel=use_kernel_decode, topo=topo),
+            donate_argnums=(4,))
         self._prefill_jit = jax.jit(
             lambda p, ids, n, c, b, o: paged_prefill(
                 cfg, p, ids, n, c, b, o,
@@ -230,6 +268,16 @@ class InferenceEngineV2:
         self._m_spec_miss_rounds = reg.counter(
             "inference_spec_miss_rounds_total",
             "speculative rounds whose whole draft was rejected")
+        self._m_window_size = reg.gauge(
+            "inference_decode_window_size",
+            "configured fused decode window K (1 = per-token decode)")
+        self._m_host_syncs = reg.counter(
+            "inference_decode_host_syncs_total",
+            "device->host transfers made by the decode loop (one per "
+            "per-token step, one per fused multi-step window)")
+        self._m_fused_time = reg.histogram(
+            "inference_fused_window_seconds",
+            "fused multi-step decode window wall time", unit="s")
 
     def _update_pool_telemetry(self):
         sm = self.state_manager
@@ -352,7 +400,11 @@ class InferenceEngineV2:
         """Draft the k tokens that followed the most recent earlier
         occurrence of the history's trailing n-gram (prompt-lookup
         decoding: the sequence's own text is the draft model). Scans at
-        most the last _SPEC_SCAN_WINDOW tokens."""
+        most the last _SPEC_SCAN_WINDOW tokens.
+
+        This right-to-left scan is the REFERENCE implementation (O(window
+        * ngram) per round); the hot path uses the incremental
+        NGramIndex (ngram_index.py, parity-tested against this)."""
         W = InferenceEngineV2._SPEC_SCAN_WINDOW
         base = max(0, len(history) - W)
         win = history[base:]
@@ -425,10 +477,16 @@ class InferenceEngineV2:
             # every round, slower than plain batched greedy)
             seq_room = sm.config.max_seq_len - sm.seqs[uid].seen_tokens - 1
             k = min(spec_k, remaining - 1, seq_room)
-            draft = (self._lookup_draft(row, k, spec_ngram)
-                     if (k > 0
-                         and self._spec_miss_streak.get(uid, 0) < 3)
-                     else [])
+            if k > 0 and self._spec_miss_streak.get(uid, 0) < 3:
+                idx = self._draft_index.get(uid)
+                if idx is None:
+                    from .ngram_index import NGramIndex
+                    idx = self._draft_index[uid] = NGramIndex(
+                        spec_ngram, self._SPEC_SCAN_WINDOW)
+                idx.sync(row)
+                draft = idx.draft(k, spec_ngram)
+            else:
+                draft = []
             if draft and not self.can_schedule([uid], [1 + len(draft)]):
                 draft = []
             if not draft:
@@ -475,28 +533,44 @@ class InferenceEngineV2:
         return self._pow2_bucket(
             count, self.state_manager.config.max_tracked_sequences)
 
-    def _build_decode_inputs(self, uids: List[int], tokens: List[int]):
+    @staticmethod
+    def _pad_i32(N: int, vals) -> jnp.ndarray:
+        """[N] int32 with ``vals`` in the leading rows, zeros as padding."""
+        out = np.zeros(N, np.int32)
+        out[:len(vals)] = vals
+        return jnp.asarray(out)
+
+    def _assemble_decode_rows(self, uids: List[int], tokens: List[int],
+                              new_tokens: List[int]):
+        """Shared decode-batch assembly (per-token step AND fused
+        window): pad rows to the power-of-two batch bucket, allocate
+        each row's blocks for the ``new_tokens[i]`` KV writes it will
+        make, and slice tables to the used-page bucket. The decode
+        program's cost scales with table width (the BlockSpec-pipelined
+        kernel streams EVERY table slot, and the gather fallback
+        materializes [N, MB*bs, ...]), so a 128-token sequence in a
+        2048-token-wide table would pay 16x the bandwidth."""
         sm = self.state_manager
         N = self._decode_bucket(len(uids))
         MB = sm.max_blocks_per_seq
         toks = np.zeros(N, np.int32)
         pos = np.zeros(N, np.int32)
         tables = np.full((N, MB), NULL_BLOCK, np.int32)
-        active = np.zeros(N, bool)
         used_pages = 1
-        for i, (uid, tok) in enumerate(zip(uids, tokens)):
-            seq = sm.ensure_blocks(uid, 1)
+        for i, (uid, tok, k) in enumerate(zip(uids, tokens, new_tokens)):
+            seq = sm.ensure_blocks(uid, int(k))
             toks[i] = tok
             pos[i] = seq.seen_tokens
             tables[i] = sm.block_table_for(uid)
-            active[i] = True
             used_pages = max(used_pages, len(seq.blocks))
-        # Slice the table to the page bucket actually in use: the decode
-        # program's cost scales with table width (the BlockSpec-pipelined
-        # kernel streams EVERY table slot, and the gather fallback
-        # materializes [N, MB*bs, ...]), so a 128-token sequence in a
-        # 2048-token-wide table would pay 16x the bandwidth.
         tables = tables[:, :self._pow2_bucket(used_pages, MB)]
+        return N, toks, pos, tables
+
+    def _build_decode_inputs(self, uids: List[int], tokens: List[int]):
+        N, toks, pos, tables = self._assemble_decode_rows(
+            uids, tokens, [1] * len(uids))
+        active = np.zeros(N, bool)
+        active[:len(uids)] = True
         return (jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables),
                 jnp.asarray(active))
 
@@ -510,6 +584,7 @@ class InferenceEngineV2:
             vals, self.kv_cache = jit_fn(
                 self.params, toks, pos, tables, self.kv_cache, active)
             vals = np.asarray(vals)  # blocks: the pass completes here
+        self._m_host_syncs.inc()
         dt = time.perf_counter() - t0
         self._m_decode_steps.inc()
         self._m_decode_tokens.inc(len(uids))
@@ -539,19 +614,134 @@ class InferenceEngineV2:
         return self._decode_common(uids, tokens, self._decode_tok_jit,
                                    lambda v, i: int(v[i]))
 
+    def _sampling_arrays(self, N: int, row_seeds: List[int],
+                         gen_idx: List[int], temperature: float,
+                         top_p: float, top_k: int):
+        """Padded per-row sampling inputs shared by the per-token and
+        fused-window sampled paths (keeping them one definition is part
+        of the bit-identical-streams guarantee)."""
+        return (self._pad_i32(N, row_seeds), self._pad_i32(N, gen_idx),
+                jnp.full((N,), temperature, jnp.float32),
+                jnp.full((N,), top_p, jnp.float32),
+                jnp.full((N,), top_k, jnp.int32))
+
     def _decode_batch_sample(self, uids: List[int], tokens: List[int],
-                             rng, temperature: float, top_p: float,
+                             rng, row_seeds: List[int],
+                             gen_idx: List[int], temperature: float,
+                             top_p: float,
                              top_k: int = 0) -> Dict[int, int]:
-        """Sampled decode step (device-side temperature/top-p/top-k)."""
-        N = self._decode_bucket(len(uids))
-        temp = jnp.full((N,), temperature, jnp.float32)
-        topp = jnp.full((N,), top_p, jnp.float32)
-        topk = jnp.full((N,), top_k, jnp.int32)
+        """Sampled decode step (device-side temperature/top-p/top-k with
+        per-row keys — see sampling.fold_in_rows)."""
+        seeds, g0, temp, topp, topk = self._sampling_arrays(
+            self._decode_bucket(len(uids)), row_seeds, gen_idx,
+            temperature, top_p, top_k)
         return self._decode_common(
             uids, tokens,
             lambda p, t, pos, bt, c, a: self._decode_sample_jit(
-                p, t, pos, bt, c, a, rng, temp, topp, topk),
+                p, t, pos, bt, c, a, rng, seeds, g0, temp, topp, topk),
             lambda v, i: int(v[i]))
+
+    # -- fused multi-token decode window --------------------------------
+    def _decode_window_common(self, uids: List[int], tokens: List[int],
+                              steps_left: List[int], eos_ids: List[int],
+                              run) -> Dict[int, List[int]]:
+        """Run one fused window and fold the [N, K] result back into
+        host state. Returns {uid: emitted tokens} (1..steps_left[i] each;
+        the row's last emitted token is never fed/cached — the same
+        invariant as the per-token loop)."""
+        sm = self.state_manager
+        t0 = time.perf_counter()
+        with trace.span("decode_window", batch=len(uids),
+                        window=self.decode_window):
+            # block pre-allocation contract: every block row i can write
+            # during its steps_left[i] steps is allocated HERE, so the
+            # device loop never needs the host mid-window (block-table
+            # advancement is position arithmetic over a complete table)
+            N, toks, pos, tables = self._assemble_decode_rows(
+                uids, tokens, steps_left)
+            eos = np.full(N, -1, np.int32)
+            eos[:len(uids)] = eos_ids
+            out, self.kv_cache = run(
+                jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables),
+                self._pad_i32(N, steps_left), jnp.asarray(eos))
+            out = np.asarray(out)   # ONE transfer for the whole window
+        self._m_host_syncs.inc()
+        dt = time.perf_counter() - t0
+        log_tokens = sm.config.enable_prefix_caching
+        emitted: Dict[int, List[int]] = {}
+        total = 0
+        for i, uid in enumerate(uids):
+            row = out[i]
+            e = int((row >= 0).sum())   # active steps are a prefix
+            toks_out = [int(t) for t in row[:e]]
+            seq = sm.seqs[uid]
+            seq.seen_tokens += e        # e tokens were fed and cached
+            if log_tokens:
+                # fed tokens: the input token plus all but the last emit
+                seq.token_log.extend([int(tokens[i])] + toks_out[:-1])
+            emitted[uid] = toks_out
+            total += e
+        self._m_decode_steps.inc()
+        self._m_decode_tokens.inc(total)
+        self._m_decode_time.observe(dt)
+        self._m_fused_time.observe(dt)
+        if dt > 0:
+            self._m_decode_tput.set(total / dt)
+        self._update_pool_telemetry()
+        return emitted
+
+    def _decode_window_greedy(self, uids: List[int], tokens: List[int],
+                              steps_left: List[int],
+                              eos_ids: List[int]) -> Dict[int, List[int]]:
+        return self._decode_window_common(
+            uids, tokens, steps_left, eos_ids,
+            lambda t, pos, bt, sl, eos: self._fused_greedy_jit(
+                self.params, t, pos, bt, self.kv_cache, sl, eos))
+
+    def _decode_window_sample(self, uids: List[int], tokens: List[int],
+                              steps_left: List[int], eos_ids: List[int],
+                              rng, row_seeds: List[int],
+                              gen_idx0: List[int], temperature: float,
+                              top_p: float,
+                              top_k: int = 0) -> Dict[int, List[int]]:
+        seeds, g0, temp, topp, topk = self._sampling_arrays(
+            self._decode_bucket(len(uids)), row_seeds, gen_idx0,
+            temperature, top_p, top_k)
+        return self._decode_window_common(
+            uids, tokens, steps_left, eos_ids,
+            lambda t, pos, bt, sl, eos: self._fused_sample_jit(
+                self.params, t, pos, bt, self.kv_cache, sl, eos, rng,
+                seeds, g0, temp, topp, topk))
+
+    def _window_steps_left(self, step_uids: List[int],
+                           remaining: List[int]) -> List[int]:
+        """Per-row step budgets for one window: the generation budget,
+        the sequence-length room, and — when the KV pool is too tight for
+        the full window everywhere — a halving cap so the window shrinks
+        instead of failing (cap 1 is always schedulable: the caller
+        already ran the per-token can_schedule guard).
+
+        The halving checks ONLY the KV block pool. can_schedule's other
+        term — sum(lengths) <= max_ragged_batch_size — is the put()
+        prefill cap (one pass over that many tokens); a window is K
+        sequential steps of at most N tokens each, so a large decode
+        batch times K must not shrink the window against it."""
+        sm = self.state_manager
+        K = self.decode_window
+        sl = [max(1, min(K, r,
+                         sm.config.max_seq_len
+                         - sm.seqs[u].seen_tokens))
+              for u, r in zip(step_uids, remaining)]
+
+        def blocks_ok(lengths):
+            need = sum(sm.seqs[u].blocks_needed(n, self.block_size)
+                       for u, n in zip(step_uids, lengths))
+            return need <= sm.reclaimable_blocks()
+
+        cap = K
+        while cap > 1 and not blocks_ok([min(cap, s) for s in sl]):
+            cap //= 2
+        return [min(cap, s) for s in sl]
 
     def put(self, batch_uids: Sequence[int],
             batch_tokens: Sequence[Iterable[int]]) -> np.ndarray:
@@ -601,6 +791,7 @@ class InferenceEngineV2:
         caller-assigned and commonly reused, and a streak carried across
         independent requests would permanently ban drafting for them."""
         self._spec_miss_streak.pop(uid, None)
+        self._draft_index.pop(uid, None)
         self.state_manager.flush_sequence(uid)
         self._update_pool_telemetry()
 
@@ -626,8 +817,10 @@ class InferenceEngineV2:
             "speculative decoding is greedy-only (draft verification " \
             "compares argmax)"
         # each generate() call is an independent request batch: spec
-        # cold-streaks from earlier calls must not ban drafting here
+        # cold-streaks (and draft indexes) from earlier calls must not
+        # leak into this one
         self._spec_miss_streak.clear()
+        self._draft_index.clear()
         base_rng = jax.random.PRNGKey(seed) if sampling else None
         t_start = time.perf_counter()
         # prompts go through put() (prefill); the continuation loop then
@@ -638,9 +831,16 @@ class InferenceEngineV2:
             logits = self.put(uids, prompts)
             self._m_ttft.observe(time.perf_counter() - t_start)
             if sampling:
-                from .sampling import sample_tokens
-                first = np.asarray(sample_tokens(
-                    jnp.asarray(logits), jax.random.fold_in(base_rng, 0),
+                from .sampling import fold_in_rows, sample_tokens_rowwise
+                # per-row keys (stable row seed + generated-token index):
+                # a row's stream depends only on its own draw history,
+                # so the per-token and fused-window paths sample the
+                # exact same tokens for a given seed
+                keys = fold_in_rows(base_rng,
+                                    jnp.arange(len(uids), dtype=jnp.int32),
+                                    jnp.zeros(len(uids), jnp.int32))
+                first = np.asarray(sample_tokens_rowwise(
+                    jnp.asarray(logits), keys,
                     jnp.full((len(uids),), temperature, jnp.float32),
                     jnp.full((len(uids),), top_p, jnp.float32),
                     jnp.full((len(uids),), top_k, jnp.int32)))
@@ -650,7 +850,9 @@ class InferenceEngineV2:
                        zip(uids, np.argmax(logits, axis=-1))}
             live = set(uids)
             prompt_lens = {uid: len(prompts[row_of[uid]]) for uid in uids}
-            for step in range(max_new_tokens):
+            row_seed = {uid: i for i, uid in enumerate(uids)}
+            window = 1 if speculative else self.decode_window
+            while max_new_tokens > 0:   # 0 -> prompt-only rows (no emit)
                 step_uids = []
                 for uid in uids:
                     if uid not in live:
@@ -658,9 +860,9 @@ class InferenceEngineV2:
                     tok = cur[uid]
                     row = outs[row_of[uid]]
                     row.append(tok)
-                    # per-uid budget (not the step counter): speculative
-                    # rounds emit several tokens, so sequences finish at
-                    # different steps
+                    # per-uid budget (not a step counter): speculative
+                    # rounds and fused windows emit several tokens, so
+                    # sequences finish at different steps
                     if ((eos_token_id is not None and tok == eos_token_id)
                             or len(row) - prompt_lens[uid]
                             >= max_new_tokens):
@@ -680,15 +882,50 @@ class InferenceEngineV2:
                 # every step_uid is already tracked, so the batch can
                 # never exceed max_tracked_sequences — one call suffices
                 feed = [outs[row_of[u]][-1] for u in step_uids]
-                if sampling:
-                    cur = self._decode_batch_sample(
-                        step_uids, feed,
-                        jax.random.fold_in(base_rng, step + 1),
-                        temperature, top_p, top_k)
-                elif speculative:
+                gen_count = [len(outs[row_of[u]]) - prompt_lens[u]
+                             for u in step_uids]
+                if speculative:
                     cur = self._speculative_round(
                         step_uids, outs, row_of, prompt_lens, live,
                         max_new_tokens, eos_token_id, spec_k, spec_ngram)
+                    continue
+                if window > 1:
+                    sl = self._window_steps_left(
+                        step_uids, [max_new_tokens - g for g in gen_count])
+                    eos = -1 if eos_token_id is None else int(eos_token_id)
+                    if sampling:
+                        em = self._decode_window_sample(
+                            step_uids, feed, sl, [eos] * len(step_uids),
+                            base_rng, [row_seed[u] for u in step_uids],
+                            gen_count, temperature, top_p, top_k)
+                    else:
+                        em = self._decode_window_greedy(
+                            step_uids, feed, sl, [eos] * len(step_uids))
+                    cur = {}
+                    for uid in step_uids:
+                        row = outs[row_of[uid]]
+                        toks_out = em[uid]
+                        finished = False
+                        # all but the last emit are fed/cached already;
+                        # the host only re-applies the eos/budget cuts
+                        # (defensively — the device enforced them too)
+                        for tok in toks_out[:-1]:
+                            row.append(tok)
+                            if ((eos_token_id is not None
+                                 and tok == eos_token_id)
+                                    or len(row) - prompt_lens[uid]
+                                    >= max_new_tokens):
+                                finished = True
+                                break
+                        if finished:
+                            live.discard(uid)
+                        else:
+                            cur[uid] = toks_out[-1]
+                elif sampling:
+                    cur = self._decode_batch_sample(
+                        step_uids, feed, base_rng,
+                        [row_seed[u] for u in step_uids], gen_count,
+                        temperature, top_p, top_k)
                 else:
                     cur = self._decode_batch_greedy(step_uids, feed)
         finally:

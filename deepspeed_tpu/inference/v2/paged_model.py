@@ -519,3 +519,79 @@ def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
         (params["layers"], jnp.arange(cfg.num_layers)))
     x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
     return _logits(cfg, params, x), _cache_dict(kc, vc, ksc, vsc)
+
+
+# ---------------------------------------------------------------------------
+# Fused multi-token decode window
+# ---------------------------------------------------------------------------
+def paged_decode_window(cfg: TransformerConfig, params, toks: jnp.ndarray,
+                        pos: jnp.ndarray, block_tables: jnp.ndarray,
+                        cache: Dict[str, jnp.ndarray],
+                        steps_left: jnp.ndarray, eos_ids: jnp.ndarray,
+                        block_size: int, window: int,
+                        rng=None, row_seeds: jnp.ndarray = None,
+                        gen_idx0: jnp.ndarray = None,
+                        temp: jnp.ndarray = None, topp: jnp.ndarray = None,
+                        topk: jnp.ndarray = None,
+                        use_kernel: bool = True, topo=None
+                        ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Up to ``window`` decode steps entirely on device — the answer to
+    the dispatch-bound per-token loop (one Python round-trip + [N] int32
+    transfer PER TOKEN). One ``lax.while_loop`` runs cache write, paged
+    attention, sampling, EOS masking and block-table advancement for K
+    steps; the only host traffic per window is the [N, window] int32
+    token block (plus the donated cache staying resident).
+
+    Block tables never change on device: block boundaries are arithmetic
+    in the token position (``pos // block_size``), so as long as the host
+    pre-allocates every block the window can write (``steps_left[i]``
+    tokens from ``pos[i]``), advancement is just the existing indexing in
+    ``paged_decode``. That pre-allocation is the caller's contract.
+
+    Per-row state: ``toks``/``pos`` [N] are the fed token and its cache
+    position; ``steps_left`` [N] caps each row's steps (rows with
+    exhausted generation budget or sequence room mask out — K stays a
+    compile-time constant across ragged budgets); ``eos_ids`` [N] is the
+    per-row stop token (-1 = none). A row that emits its EOS goes
+    inactive: the EOS is emitted but never fed back (the same
+    last-token-never-fed invariant as the per-token loop), later steps
+    write to the null block. The loop exits early when every row is
+    inactive.
+
+    Sampling (``rng`` is not None): per-row keys
+    ``fold_in(fold_in(rng, row_seeds[i]), gen_idx0[i] + s)`` make each
+    row's draw depend only on its own seed and its own generated-token
+    index — invariant to batch composition, so fused and per-token
+    streams are bit-identical under a fixed seed.
+
+    Returns (tokens [N, window] int32 with -1 in steps a row did not
+    take, cache). Emitted tokens form a prefix of each row.
+    """
+    N = toks.shape[0]
+    sampled = rng is not None
+
+    def body(state):
+        s, toks, pos, active, out, cache = state
+        logits, cache = paged_decode(cfg, params, toks, pos, block_tables,
+                                     cache, active, block_size,
+                                     use_kernel=use_kernel, topo=topo)
+        if sampled:
+            from .sampling import fold_in_rows, sample_tokens_rowwise
+            keys = fold_in_rows(rng, row_seeds, gen_idx0 + s)
+            nxt = sample_tokens_rowwise(logits, keys, temp, topp, topk)
+        else:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out = out.at[:, s].set(jnp.where(active, nxt, -1))
+        pos = jnp.where(active, pos + 1, pos)
+        toks = jnp.where(active, nxt, toks)
+        active = active & (nxt != eos_ids) & (s + 1 < steps_left)
+        return s + 1, toks, pos, active, out, cache
+
+    def cond(state):
+        s, _, _, active, _, _ = state
+        return (s < window) & jnp.any(active)
+
+    state = (jnp.asarray(0, jnp.int32), toks, pos, steps_left > 0,
+             jnp.full((N, window), -1, jnp.int32), cache)
+    _, _, _, _, out, cache = jax.lax.while_loop(cond, body, state)
+    return out, cache

@@ -349,6 +349,21 @@ class DynamicSplitFuseScheduler:
             # put() path's prefill-completion handling — and on greedy
             # rows only (sampled requests draw from host rngs).
             assert all(len(t) == 1 for t in toks)
+            window = getattr(self.engine, "decode_window", 1)
+            if window > 1:
+                # fused multi-step window. Reaching this path means the
+                # composition loop above added NO prompt chunk this step
+                # — the queue is empty or blocked (sequence slots full,
+                # KV pool tight, or the budget consumed by decodes), so
+                # no prefill work is stalled by running K steps at once;
+                # composition re-runs after every window, so prefill
+                # admission latency is bounded by one window (<= K
+                # tokens/row). Each request carries its own budget/eos,
+                # so rows finish mid-window (masked on device); every
+                # emitted token still flows through _emit -> on_token,
+                # arriving in bursts of up to K per step.
+                return self._step_fused_window(uids, toks, decode_reqs,
+                                               window)
             nxt_map = self.engine._decode_batch_greedy(
                 uids, [t[0] for t in toks])
             self.steps += 1
@@ -382,6 +397,30 @@ class DynamicSplitFuseScheduler:
             # else: mid-prompt chunk — logits ignored
         self._update_depth_gauges()
         return sum(len(t) for t in toks)
+
+    def _step_fused_window(self, uids: List[int], toks: List[List[int]],
+                           decode_reqs: List["_Request"],
+                           window: int) -> int:
+        """One fused K-step decode window over the composed greedy
+        decode set; emits every produced token through _emit (streaming
+        on_token hooks fire per token, deadlines/cancellation re-check
+        at the window boundary)."""
+        remaining = [r.max_new_tokens - len(r.generated)
+                     for r in decode_reqs]
+        sl = self.engine._window_steps_left(uids, remaining)
+        eos = [(-1 if r.eos_token_id is None else int(r.eos_token_id))
+               for r in decode_reqs]
+        em = self.engine._decode_window_greedy(
+            uids, [t[0] for t in toks], sl, eos)
+        self.steps += 1
+        self._m_steps.inc()
+        total = sum(len(em[u]) for u in uids)
+        self._m_step_tokens.observe(total)
+        for req in decode_reqs:
+            for tok in em[req.uid]:
+                self._emit(req, tok)
+        self._update_depth_gauges()
+        return total
 
     def _emit(self, req: _Request, tok: int) -> None:
         """Record a produced token; finish or queue it as the next decode

@@ -132,6 +132,17 @@ class TransformerConfig:
     mlm_head: bool = False
 
     def __post_init__(self):
+        if self.num_kv_heads and self.num_heads % self.num_kv_heads:
+            # fail at CONFIG time with the fix in the message: the r05
+            # chip window lost its second bench scale point to this
+            # pairing asserting deep inside flash_attention mid-capture
+            divisors = [d for d in range(1, self.num_heads + 1)
+                        if self.num_heads % d == 0]
+            raise ValueError(
+                f"GQA requires num_heads % num_kv_heads == 0, got "
+                f"num_heads={self.num_heads}, "
+                f"num_kv_heads={self.num_kv_heads}; pick num_kv_heads "
+                f"from {divisors}")
         if self.objective not in ("causal_lm", "mlm"):
             # a typo here would silently pair bidirectional attention with
             # the shifted next-token loss — label leakage, loss collapse

@@ -17,6 +17,15 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
+# The AOT scale tests use libtpu as a host COMPILER library (topology
+# described explicitly, no devices). Its init, however, queries the GCP
+# metadata server for TPU env vars — and when the chip tunnel is dead
+# those queries 403 and retry 30x per variable, stalling the whole suite
+# for tens of minutes inside the first tests/model collection (observed
+# r06: tier-1 wedged at 0 dots with /tmp/libtpu_lockfile held). Tests
+# never need metadata — skip the queries outright.
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

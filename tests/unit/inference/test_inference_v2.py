@@ -475,12 +475,15 @@ def test_decode_table_sliced_to_used_pages():
     width — r05 chip capture), widening as the context grows."""
     cfg = _tiny_cfg(max_seq_len=128)  # block_size 16 -> 8 pages max
     model = TransformerLM(cfg)
+    # decode_window=1 pins the per-token hot loop this spy intercepts
+    # (the fused window slices tables identically — covered by
+    # test_fused_decode.py's boundary-crossing parity)
     eng = InferenceEngineV2(
         model, RaggedInferenceEngineConfig(
             state_manager=DSStateManagerConfig(
                 max_tracked_sequences=2, max_seq_len=128, num_blocks=17,
                 block_size=16),
-            dtype="float32", prefill_bucket=16))
+            dtype="float32", prefill_bucket=16, decode_window=1))
     widths = []
     inner = eng._decode_tok_jit  # generate()'s greedy hot loop
 

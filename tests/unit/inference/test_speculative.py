@@ -165,3 +165,65 @@ def test_speculative_respects_max_seq_len(tiny):
     out = eng.generate([prompt], max_new_tokens=16, speculative=True)[0]
     np.testing.assert_array_equal(out, ref)
     assert len(out) == 33
+
+
+def test_ngram_index_parity_with_scan():
+    """The incremental NGramIndex must return byte-for-byte what the
+    O(window * ngram) reference scan returns — every prefix length, over
+    token streams with heavy n-gram repetition, for several (k, ngram)
+    shapes and with the scan-window bound exercised."""
+    from deepspeed_tpu.inference.v2.ngram_index import NGramIndex
+
+    scan = InferenceEngineV2._lookup_draft
+    rng = np.random.default_rng(0)
+    for trial, vocab in enumerate((4, 8, 64)):   # small vocab => matches
+        toks = list(map(int, rng.integers(0, vocab, 400)))
+        for ngram in (2, 3, 4):
+            idx = NGramIndex(ngram, InferenceEngineV2._SPEC_SCAN_WINDOW)
+            for L in range(1, len(toks) + 1):
+                idx.append(toks[L - 1])
+                for k in (1, 4):
+                    assert idx.draft(k, ngram) == scan(toks[:L], k, ngram), \
+                        (trial, ngram, L, k)
+
+
+def test_ngram_index_window_bound_parity():
+    """Occurrences older than the scan window must be ignored by BOTH
+    implementations (a small window forces the case)."""
+    from deepspeed_tpu.inference.v2.ngram_index import NGramIndex
+
+    # the trailing 3-gram [1,2,3] occurs early (pos 0) and the window
+    # excludes it: both must fall back (here: to the 2-gram [2,3]? no —
+    # also out of window => no draft)
+    hist = [1, 2, 3] + [9] * 30 + [1, 2, 3]
+    W = 8
+    idx = NGramIndex(3, W)
+    idx.extend(hist)
+
+    def scan_w(history, k, ngram, window):
+        saved = InferenceEngineV2._SPEC_SCAN_WINDOW
+        InferenceEngineV2._SPEC_SCAN_WINDOW = window
+        try:
+            return InferenceEngineV2._lookup_draft(history, k, ngram)
+        finally:
+            InferenceEngineV2._SPEC_SCAN_WINDOW = saved
+
+    assert idx.draft(3, 3) == scan_w(hist, 3, 3, W) == []
+    # in-window repetition still drafts identically
+    hist2 = [9] * 30 + [1, 2, 3, 7, 1, 2, 3]
+    idx2 = NGramIndex(3, W)
+    idx2.extend(hist2)
+    assert idx2.draft(2, 3) == scan_w(hist2, 2, 3, W) == [7, 1]
+
+
+def test_ngram_index_sync_appends_only_new_tokens():
+    from deepspeed_tpu.inference.v2.ngram_index import NGramIndex
+
+    idx = NGramIndex(3, 512)
+    row = [1, 2, 3, 4]
+    idx.sync(row)
+    assert idx.tokens == row
+    row += [5, 6]
+    idx.sync(row)
+    assert idx.tokens == row
+    assert idx.draft(2, 3) == InferenceEngineV2._lookup_draft(row, 2, 3)

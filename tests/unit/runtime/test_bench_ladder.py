@@ -3,6 +3,8 @@ this pins its shape off-chip so edits can't silently break the autotune."""
 
 import sys
 
+import pytest
+
 
 def test_bench_trial_ladder_shape():
     sys.path.insert(0, ".")
@@ -37,3 +39,53 @@ def test_bench_trial_ladder_shape():
     # variant changes max_seq_len only; MFU normalizes by measured seq)
     assert all(t[0].hidden_size == base.hidden_size and
                t[0].num_layers == base.num_layers for t in trials)
+
+
+def test_bench_scale_points_construct_off_chip():
+    """Every bench scale point must CONSTRUCT off-chip: the r05 chip
+    window lost its only >374M MFU datum to the large proxy inheriting
+    num_kv_heads=8 against num_heads=12 and asserting mid-capture
+    ('GQA requires h(12) % hk(8) == 0'). Config validation now rejects
+    the pairing at construction, and this test builds the exact configs
+    bench.py / benchmarks/aot_scale.py will run on the next window."""
+    sys.path.insert(0, ".")
+    import bench
+    from __graft_entry__ import _flagship_cfg
+
+    base = _flagship_cfg()
+    big = bench.large_proxy_cfg(base)
+    assert big.num_heads % big.kv_heads == 0
+    assert (big.hidden_size, big.num_heads, big.num_kv_heads) \
+        == (1536, 12, 4)
+    # the ladder's trial configs are all replace()s of base — each one
+    # revalidates through __post_init__ when constructed
+    for cfg, _, _ in bench.build_trials(base):
+        assert cfg.num_heads % cfg.kv_heads == 0
+    # aot_scale's overlap proxy (the other off-chip scale point)
+    from deepspeed_tpu.models import TransformerConfig
+    aot = TransformerConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_layers=24, num_heads=8, num_kv_heads=8, max_seq_len=2048)
+    assert aot.num_heads % aot.kv_heads == 0
+
+
+def test_indivisible_gqa_pair_fails_at_config_time():
+    """An indivisible (num_heads, num_kv_heads) pair must fail when the
+    config is BUILT, with the valid choices in the message — not
+    mid-capture inside flash_attention on a live chip."""
+    import dataclasses
+
+    from deepspeed_tpu.models import TransformerConfig
+
+    with pytest.raises(ValueError, match=r"num_kv_heads.*\[1, 2, 3, 4"):
+        TransformerConfig(vocab_size=128, hidden_size=768,
+                          intermediate_size=1536, num_layers=2,
+                          num_heads=12, num_kv_heads=8, max_seq_len=128)
+    # dataclasses.replace() re-runs validation: the exact r05 failure
+    # shape (replace() setting num_heads without num_kv_heads) now
+    # raises immediately instead of compiling toward an assert
+    base = TransformerConfig(vocab_size=128, hidden_size=512,
+                             intermediate_size=1024, num_layers=2,
+                             num_heads=8, num_kv_heads=8, max_seq_len=128)
+    with pytest.raises(ValueError, match="GQA requires"):
+        dataclasses.replace(base, hidden_size=768, num_heads=12)

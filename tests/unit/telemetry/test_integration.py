@@ -62,12 +62,17 @@ def test_generate_populates_inference_metrics(tiny_model, fresh_registry):
     ttft = reg.get("inference_ttft_seconds")
     assert ttft.count == 1 and ttft.sum > 0
     assert reg.get("inference_prefill_tokens_total").value == 27
-    # first token comes from prefill; the remaining 7 rounds are batched
-    # decodes over both sequences
+    # first token comes from prefill; the remaining 7 tokens per row run
+    # in ONE fused decode window (decode_window default 8 covers them),
+    # i.e. one decode dispatch and one device->host sync
     assert reg.get("inference_decode_tokens_total").value == 14
-    assert reg.get("inference_decode_steps_total").value == 7
+    assert reg.get("inference_decode_steps_total").value == 1
+    assert reg.get("inference_decode_host_syncs_total").value == 1
+    assert reg.get("inference_decode_window_size").value == 8
     dt = reg.get("inference_decode_step_seconds")
-    assert dt.count == 7 and dt.sum > 0
+    assert dt.count == 1 and dt.sum > 0
+    fw = reg.get("inference_fused_window_seconds")
+    assert fw.count == 1 and fw.sum > 0
     assert reg.get("inference_decode_tokens_per_s").value > 0
     # generate() flushed its uids: pool back to empty, gauge updated last
     assert reg.get("inference_kv_pool_utilization").value == 0.0
