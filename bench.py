@@ -82,9 +82,12 @@ def _measure(cfg, micro, gas, steps, warmup, n_dev, zero_stage=None,
             for _ in range(max(steps, 3)):
                 engine.eval_batch(batch=batch)
             fwd = (time.perf_counter() - t1) / max(steps, 3)
-            from deepspeed_tpu.utils.xla_profile import \
-                overlap_report_from_compiled
-            rep = overlap_report_from_compiled(engine.lower_train_step(batch))
+            from deepspeed_tpu.utils.xla_profile import (
+                grad_exchange_report_from_compiled,
+                overlap_report_from_compiled)
+            compiled = engine.lower_train_step(batch)
+            rep = overlap_report_from_compiled(compiled)
+            gx = grad_exchange_report_from_compiled(compiled)
             extra_phases = {
                 "fwd_s": round(fwd, 4),
                 "fwd_frac": round(fwd / dt, 3),
@@ -92,7 +95,17 @@ def _measure(cfg, micro, gas, steps, warmup, n_dev, zero_stage=None,
                 "async_pairs": rep.async_pairs,
                 "sync_collectives": rep.sync_collectives,
                 "exposed_collective_fraction": round(rep.exposed_fraction, 4),
+                # gradient-exchange regression metric (grad_overlap.py):
+                # share of grad collectives with no overlap window
+                "grad_exposed_collective_fraction":
+                    round(gx.exposed_fraction, 4),
+                "grad_overlap_mode": engine.grad_overlap_mode,
             }
+            if engine.grad_bucket_plan is not None:
+                extra_phases["reduce_buckets"] = \
+                    engine.grad_bucket_plan.num_buckets
+                extra_phases["reduce_bucket_max_bytes"] = \
+                    engine.grad_bucket_plan.max_bucket_bytes
         except Exception as exc:
             extra_phases = {"error": repr(exc)[:150]}
     tokens_per_step = gm * gas * seq
@@ -199,15 +212,14 @@ def build_trials(base):
 def main():
     import os
 
-    # async-collective overlap (ZeRO-3 variant): make the latency-hiding
-    # scheduler explicit rather than relying on the backend default. It is
-    # a libtpu flag here (this jaxlib's XLA_FLAGS parser rejects it as
-    # unknown and would abort CPU runs), so it rides LIBTPU_INIT_ARGS,
-    # which only the TPU runtime reads (README perf methodology).
-    lt = os.environ.get("LIBTPU_INIT_ARGS", "")
-    if "latency_hiding_scheduler" not in lt:
-        os.environ["LIBTPU_INIT_ARGS"] = (
-            lt + " --xla_tpu_enable_latency_hiding_scheduler=true").strip()
+    # collective-overlap XLA knobs (latency-hiding scheduler + async
+    # collective fusion incl. reduce-scatter chaining for the bucketed
+    # grad reduction) ride LIBTPU_INIT_ARGS — only the TPU runtime reads
+    # them (this jaxlib's XLA_FLAGS parser rejects them and would abort
+    # CPU runs). Must be set before the TPU client initializes.
+    from deepspeed_tpu.accelerator.tpu_accelerator import \
+        apply_collective_overlap_flags
+    apply_collective_overlap_flags()
 
     from __graft_entry__ import _ensure_jax_platform, _flagship_cfg
 
@@ -296,6 +308,28 @@ def main():
             detail["profile_trace"] = prof_dir
     except Exception as exc:
         detail["zero3_error"] = repr(exc)[:200]
+
+    # chip-free AOT dp8 proxy: gradient-reduction overlap, monolithic vs
+    # bucketed (benchmarks/aot_scale.grad_overlap_dp8 — the libtpu compiler
+    # runs on the CPU host, so this rides every bench). The bucketed
+    # exposed_collective_fraction is the tracked regression metric
+    # (acceptance bar <= 0.5, from 1.0 at the seed).
+    if time.perf_counter() - t_start < budget_s:
+        try:
+            from deepspeed_tpu.benchmarks.aot_scale import grad_overlap_dp8
+            rec = grad_overlap_dp8(out_dir="artifacts")
+            detail["aot_grad_overlap_dp8"] = {
+                "exposed_collective_fraction":
+                    round(rec["exposed_collective_fraction"], 4),
+                "exposed_collective_fraction_monolithic":
+                    round(rec["exposed_collective_fraction_monolithic"], 4),
+                "buckets": rec["bucketed"].get("bucket_plan", {}).get(
+                    "num_buckets"),
+                "median_overlap_window":
+                    rec["bucketed"].get("median_overlap_window"),
+            }
+        except Exception as exc:
+            detail["aot_grad_overlap_error"] = repr(exc)[:200]
 
     if on_tpu and time.perf_counter() - t_start < budget_s:
         # larger proxy (~780M total / ~680M non-embed): closer to the 7B

@@ -132,6 +132,72 @@ def overlap_dp8(model_cfg=None, out_dir: Optional[str] = None,
     return record
 
 
+def grad_overlap_dp8(model_cfg=None, out_dir: Optional[str] = None,
+                     topology_name: str = "v5e:2x4", stage: int = 2,
+                     reduce_bucket_size: int = 1 << 19) -> Dict[str, Any]:
+    """Gradient-reduction overlap at dp=8: monolithic vs bucketed.
+
+    Compiles the engine's real train step twice on an 8-chip v5e topology —
+    ``overlap_grad_reduce='off'`` (the seed behavior: GSPMD emits the
+    reduction, in practice one fused collective after the full backward,
+    BENCH_r05 ``exposed_collective_fraction: 1.0``) vs ``'bucketed'``
+    (runtime/grad_overlap.py issues per-bucket collectives the TPU
+    latency-hiding scheduler can float into the backward as async
+    ppermute-ring hops). The headline regression metric is the bucketed
+    variant's ``exposed_collective_fraction`` — the share of
+    gradient-exchange collectives with no overlap window in the scheduled
+    HLO. Chip-free: the libtpu compiler runs on the CPU host. Artifact:
+    ``artifacts/grad_overlap_dp8.json``."""
+    from ..utils.xla_profile import (grad_exchange_report_from_compiled,
+                                     tpu_overlap_report_from_compiled)
+
+    if model_cfg is None:
+        from ..models import TransformerConfig
+        # proxy sized so tier-1 can afford the compile; the layer scan is
+        # fully unrolled (scan_unroll) so the bucket plan slices the
+        # stacked layer leaves per layer — a layer's bucket then reduces
+        # while shallower layers are still in backward
+        model_cfg = TransformerConfig(
+            vocab_size=2048, hidden_size=256, intermediate_size=512,
+            num_layers=4, num_heads=4, max_seq_len=128, use_flash=False,
+            scan_unroll=4)
+    record: Dict[str, Any] = {"topology": topology_name, "stage": stage,
+                              "num_layers": model_cfg.num_layers,
+                              "reduce_bucket_size": int(reduce_bucket_size)}
+    for name, mode in (("monolithic", "off"), ("bucketed", "bucketed")):
+        engine, batch = build_abstract_engine(
+            model_cfg,
+            {"train_micro_batch_size_per_gpu": 1,
+             "bf16": {"enabled": True},
+             "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+             "zero_optimization": {
+                 "stage": stage, "overlap_comm": True,
+                 "overlap_grad_reduce": mode,
+                 "reduce_bucket_size": int(reduce_bucket_size),
+                 "allgather_bucket_size": int(reduce_bucket_size),
+                 "stage3_param_persistence_threshold": 100000},
+             "steps_per_print": 10 ** 9},
+            topology_name=topology_name)
+        compiled = engine.lower_train_step(batch)
+        gx = grad_exchange_report_from_compiled(compiled)
+        acf = tpu_overlap_report_from_compiled(compiled)
+        rec = gx.to_dict()
+        rec["acf"] = {k: v for k, v in acf.to_dict().items()
+                      if k != "bare_ops"}
+        if engine.grad_bucket_plan is not None:
+            rec["bucket_plan"] = engine.grad_bucket_plan.to_dict()
+        record[name] = rec
+    record["exposed_collective_fraction"] = \
+        record["bucketed"]["exposed_collective_fraction"]
+    record["exposed_collective_fraction_monolithic"] = \
+        record["monolithic"]["exposed_collective_fraction"]
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "grad_overlap_dp8.json"), "w") as fh:
+            json.dump(record, fh, indent=1)
+    return record
+
+
 def flagship_7b_fit(out_dir: Optional[str] = None,
                     topology_name: str = "v5e:8x8",
                     hbm_bytes: int = V5E_HBM_BYTES,
@@ -339,6 +405,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default="artifacts")
     ap.add_argument("--skip-overlap", action="store_true")
+    ap.add_argument("--skip-grad-overlap", action="store_true")
     ap.add_argument("--skip-7b", action="store_true")
     ap.add_argument("--skip-longcontext", action="store_true")
     ap.add_argument("--skip-serving", action="store_true")
@@ -351,6 +418,15 @@ def main(argv=None) -> int:
                 u["param_gather_exposed_fraction"],
             "exposed_bytes_fraction": u["exposed_bytes_fraction"],
             "async_chains": u["async_chains"]}}))
+    if not args.skip_grad_overlap:
+        rec = grad_overlap_dp8(out_dir=args.out)
+        print(json.dumps({"grad_overlap_dp8": {
+            "exposed_collective_fraction":
+                rec["exposed_collective_fraction"],
+            "monolithic":
+                rec["exposed_collective_fraction_monolithic"],
+            "buckets": rec["bucketed"].get(
+                "bucket_plan", {}).get("num_buckets")}}))
     if not args.skip_7b:
         rec = flagship_7b_fit(out_dir=args.out)
         print(json.dumps({"flagship_7b_v5e64": {

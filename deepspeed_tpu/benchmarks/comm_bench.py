@@ -28,8 +28,9 @@ def _collective_fn(op: str, axis: str):
     if op == "reduce_scatter":
         return lambda x: jax.lax.psum_scatter(x, axis, tiled=True)
     if op == "all_to_all":
+        from ..comm.quantized import _one_axis_size
         return lambda x: jax.lax.all_to_all(
-            x.reshape(jax.lax.axis_size(axis), -1), axis, 0, 0,
+            x.reshape(_one_axis_size(axis), -1), axis, 0, 0,
             tiled=False).reshape(-1)
     raise ValueError(f"unknown op {op}")
 
@@ -62,6 +63,66 @@ def run_op(op: str, size_bytes: int, trials: int = 20, warmups: int = 3,
             "latency_us": lat * 1e6, "algbw_gbps": algbw, "busbw_gbps": busbw}
 
 
+def run_bucket_sweep(total_pw: int = 22, bucket_pws=(16, 18, 20, 22),
+                     trials: int = 10, warmups: int = 2,
+                     axis: str = "data", n_leaves: int = 32,
+                     dtype=jnp.float32) -> List[Dict]:
+    """Sweep ``reduce_bucket_size`` over a synthetic gradient tree and
+    report achieved bandwidth per bucket layout.
+
+    Runs the REAL bucketed reducer (runtime/grad_overlap.py: plan build +
+    ring collectives inside shard_map) over ``n_leaves`` equal leaves
+    totalling 2^total_pw bytes, once per bucket cap. Small caps mean many
+    latency-bound collectives; large caps mean fewer, bandwidth-bound ones
+    but a later start for the first reduce — this sweep is how a deployment
+    picks the knob for its interconnect.
+    """
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ..runtime.grad_overlap import (ALL_REDUCE, GradUnit,
+                                        apply_bucketed_reduction,
+                                        build_bucket_plan)
+    from ..utils.comms_logging import calc_bw_log
+
+    n = jax.device_count()
+    mesh = Mesh(np.asarray(jax.devices()), (axis,))
+    itemsize = np.dtype(dtype).itemsize
+    leaf_elems = max((1 << total_pw) // itemsize // n_leaves // n * n, n)
+    leaves = [jnp.ones((leaf_elems,), dtype) for _ in range(n_leaves)]
+    total_bytes = leaf_elems * itemsize * n_leaves
+    rows: List[Dict] = []
+    for pw in bucket_pws:
+        cap = max((1 << pw) // itemsize, 1)
+        units = [GradUnit(i, -1, leaf_elems, f"leaf{i}", ALL_REDUCE)
+                 for i in range(n_leaves)]
+        plan = build_bucket_plan(units, reduce_bucket_size=cap,
+                                 allgather_bucket_size=cap)
+
+        def body(*ls):
+            out = apply_bucketed_reduction(
+                list(ls), plan, [0] * n_leaves, (axis,), (), n, 1,
+                axis_sizes={axis: n})
+            return tuple(out)
+
+        fn = jax.jit(shard_map_unchecked(
+            body, mesh, in_specs=(P(),) * n_leaves,
+            out_specs=(P(),) * n_leaves))
+        for _ in range(warmups):
+            jax.block_until_ready(fn(*leaves))
+        t0 = time.perf_counter()
+        for _ in range(trials):
+            out = fn(*leaves)
+        jax.block_until_ready(out)
+        lat = (time.perf_counter() - t0) / trials
+        algbw, busbw = calc_bw_log("all_reduce", total_bytes, lat, n)
+        rows.append({"bucket_bytes": cap * itemsize,
+                     "num_buckets": plan.num_buckets,
+                     "total_bytes": total_bytes,
+                     "latency_us": lat * 1e6,
+                     "algbw_gbps": algbw, "busbw_gbps": busbw})
+    return rows
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--ops", nargs="+", default=["all_reduce", "all_gather",
@@ -72,7 +133,28 @@ def main(argv=None):
     p.add_argument("--minsize", type=int, default=12)
     p.add_argument("--trials", type=int, default=20)
     p.add_argument("--mesh-axis", default="data")
+    p.add_argument("--bucket-sweep", action="store_true",
+                   help="sweep grad-reduction bucket sizes (the "
+                        "reduce_bucket_size knob) instead of raw ops")
+    p.add_argument("--sweep-total", type=int, default=22,
+                   help="total synthetic grad bytes as a power of two")
+    p.add_argument("--sweep-buckets", type=int, nargs="+",
+                   default=[16, 18, 20, 22],
+                   help="bucket caps to sweep, powers of two (bytes)")
     args = p.parse_args(argv)
+    if args.bucket_sweep:
+        print(f"devices: {jax.device_count()} x "
+              f"{getattr(jax.devices()[0], 'device_kind', '?')}")
+        print(f"{'bucket':>12} {'n_buckets':>10} {'lat(us)':>10} "
+              f"{'algbw(GB/s)':>12} {'busbw(GB/s)':>12}")
+        rows = run_bucket_sweep(total_pw=args.sweep_total,
+                                bucket_pws=tuple(args.sweep_buckets),
+                                trials=args.trials, axis=args.mesh_axis)
+        for r in rows:
+            print(f"{r['bucket_bytes']:>12} {r['num_buckets']:>10} "
+                  f"{r['latency_us']:>10.1f} {r['algbw_gbps']:>12.2f} "
+                  f"{r['busbw_gbps']:>12.2f}")
+        return rows
     print(f"devices: {jax.device_count()} x "
           f"{getattr(jax.devices()[0], 'device_kind', '?')}")
     header = f"{'op':>16} {'size':>12} {'lat(us)':>10} " \

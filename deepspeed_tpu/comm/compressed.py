@@ -32,9 +32,10 @@ def _axes_tuple(axes: AxisNames) -> Tuple[str, ...]:
 
 
 def _axis_size(axes: AxisNames):
+    from .quantized import _one_axis_size
     size = 1
     for a in _axes_tuple(axes):
-        size = size * jax.lax.axis_size(a)
+        size = size * _one_axis_size(a)
     return size
 
 

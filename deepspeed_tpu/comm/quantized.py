@@ -53,12 +53,21 @@ def shard_map_unchecked(f, mesh, in_specs, out_specs, axis_names=None):
                   check_rep=False, **kw)
 
 
-def _axis_size(axes: AxisNames) -> jnp.ndarray:
+def _one_axis_size(a: str) -> int:
+    """Static axis size inside shard_map. ``jax.lax.axis_size`` only exists
+    on newer jax; on older releases ``psum`` of a unit literal
+    constant-folds to the axis size as a plain Python int."""
+    if hasattr(jax.lax, "axis_size"):
+        return int(jax.lax.axis_size(a))
+    return int(jax.lax.psum(1, a))
+
+
+def _axis_size(axes: AxisNames) -> int:
     if isinstance(axes, str):
         axes = (axes,)
     size = 1
     for a in axes:
-        size = size * jax.lax.axis_size(a)
+        size = size * _one_axis_size(a)
     return size
 
 
@@ -136,7 +145,7 @@ def reduce_scatter_leaf(grad: jnp.ndarray, dim: int, axes: AxisNames,
         axes = (axes,)
     out = grad
     for a in axes:
-        if jax.lax.axis_size(a) == 1:
+        if _one_axis_size(a) == 1:
             continue
         out = jax.lax.psum_scatter(out, a, scatter_dimension=dim, tiled=True)
     if mean:

@@ -830,9 +830,11 @@ class TransformerLM:
         args = (params, ids) + ((mask,) if mask is not None else ())
         self._inside_manual_pipe = True
         try:
-            return jax.shard_map(body, mesh=topo.mesh,
-                                 in_specs=(param_specs, ids_spec) + mask_specs,
-                                 out_specs=P(), check_vma=False)(*args)
+            from ..comm.quantized import shard_map_unchecked
+            return shard_map_unchecked(
+                body, mesh=topo.mesh,
+                in_specs=(param_specs, ids_spec) + mask_specs,
+                out_specs=P())(*args)
         finally:
             self._inside_manual_pipe = False
 
@@ -944,10 +946,11 @@ class TransformerLM:
         # fully-manual pipeline program traces (pp x ep)
         self._inside_manual_pipe = True
         try:
-            return jax.shard_map(body, mesh=topo.mesh,
-                                 in_specs=(param_specs, ids_spec) + mask_specs,
-                                 out_specs=(P(), grad_specs),
-                                 check_vma=False)(*args)
+            from ..comm.quantized import shard_map_unchecked
+            return shard_map_unchecked(
+                body, mesh=topo.mesh,
+                in_specs=(param_specs, ids_spec) + mask_specs,
+                out_specs=(P(), grad_specs))(*args)
         finally:
             self._inside_manual_pipe = False
 

@@ -61,10 +61,19 @@ class ZeroConfig:
     stage: int = 0
     contiguous_gradients: bool = True
     reduce_scatter: bool = True
+    # bucket caps are ELEMENT counts (reference zero/config.py semantics),
+    # consumed by runtime/grad_overlap.py: reduce_bucket_size caps
+    # reduce-scatter buckets; min(reduce_bucket_size, allgather_bucket_size)
+    # caps all-reduce buckets (reduce + implicit allgather of the result)
     reduce_bucket_size: int = 500_000_000
     allgather_partitions: bool = True
     allgather_bucket_size: int = 500_000_000
     overlap_comm: bool = True
+    # bucketed grad-reduction program (runtime/grad_overlap.py):
+    #   "auto"     engage on pure data-parallel meshes with dp > 1
+    #   "bucketed" force it (unsupported compositions raise)
+    #   "off"      legacy GSPMD-inserted monolithic reduction
+    overlap_grad_reduce: str = "auto"
     offload_optimizer: OffloadConfig = subconfig(OffloadConfig)
     offload_param: OffloadConfig = subconfig(OffloadConfig)
     sub_group_size: int = 1_000_000_000
@@ -86,6 +95,18 @@ class ZeroConfig:
     def __post_init__(self):
         if self.stage not in (0, 1, 2, 3):
             raise ConfigError(f"zero_optimization.stage must be 0-3, got {self.stage}")
+        # bucket knobs are CONSUMED (grad_overlap.py / stage-3 plan), so a
+        # nonsensical value must fail at config load, not mid-bucketing
+        for key in ("reduce_bucket_size", "allgather_bucket_size",
+                    "stage3_prefetch_bucket_size"):
+            if getattr(self, key) <= 0:
+                raise ConfigError(
+                    f"zero_optimization.{key} must be > 0, got "
+                    f"{getattr(self, key)}")
+        if self.overlap_grad_reduce not in ("auto", "bucketed", "off"):
+            raise ConfigError(
+                "zero_optimization.overlap_grad_reduce must be one of "
+                f"'auto'|'bucketed'|'off', got {self.overlap_grad_reduce!r}")
         if self.zero_hpz_partition_size > 1 and self.stage != 3:
             # hpZ is a stage-3 feature (secondary partition of the COMPUTE
             # params; reference zero/config.py:256-272) — rejecting loudly

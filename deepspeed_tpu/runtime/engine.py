@@ -138,6 +138,21 @@ class DeepSpeedTpuEngine:
         self._compiled = None
         self._grad_buffer = None  # forward/backward/step compat path
         self._cached_batches = []
+        # grad_overlap.py: set by _build_train_step for the standard jitted
+        # step; offload/onebit/infinity paths keep the legacy reduction
+        self.grad_overlap_mode = "off"
+        self.grad_bucket_plan = None
+
+        # collective-overlap XLA knobs (async collective fusion +
+        # latency-hiding scheduler) ride LIBTPU_INIT_ARGS; only the TPU
+        # runtime reads them, so this is a no-op on CPU smoke runs. Best
+        # effort: if the TPU client initialized earlier in this process the
+        # flags for THIS run were whatever the launcher set.
+        if self.config.zero_optimization.overlap_comm and \
+                self.config.zero_optimization.overlap_grad_reduce != "off":
+            from ..accelerator.tpu_accelerator import \
+                apply_collective_overlap_flags
+            apply_collective_overlap_flags()
 
         self.compute_dtype = DTYPES[config.precision_dtype]
         self.fp16_enabled = self.config.fp16.enabled
@@ -348,6 +363,18 @@ class DeepSpeedTpuEngine:
                                        "samples consumed")
         self._tm_step_time = reg.histogram(
             "training_step_seconds", "train_batch wall time", unit="s")
+        # comm-overlap series (grad_overlap.py): bucket geometry is known
+        # at build time; the exposed fraction is measured from the compiled
+        # HLO whenever the step is AOT-lowered (lower_train_step)
+        self._tm_comm_exposed = reg.gauge(
+            "training_comm_exposed_fraction",
+            "fraction of grad-reduce collectives in the compiled train "
+            "step with no overlap window (from HLO scheduling analysis)")
+        self._tm_bucket_bytes = reg.gauge(
+            "training_reduce_bucket_bytes",
+            "largest gradient-reduction bucket", unit="bytes")
+        if self.grad_bucket_plan is not None:
+            self._tm_bucket_bytes.set(self.grad_bucket_plan.max_bucket_bytes)
         if self.monitor is not None and self.monitor.enabled:
             self.telemetry_bridge = self.monitor.attach_telemetry(
                 reg, flush_interval=tcfg.flush_interval)
@@ -442,6 +469,7 @@ class DeepSpeedTpuEngine:
     def _init_state(self, seed: int):
         rng = jax.random.PRNGKey(seed)
         shapes = jax.eval_shape(self.model.init_params, rng)
+        self._param_shapes = shapes  # grad bucket planning (grad_overlap.py)
         base_specs = self._base_specs()
         zc = self.config.zero_optimization
         # Ulysses x ZeRO (reference stage3.py:1181: sp ranks are dp ranks
@@ -555,8 +583,20 @@ class DeepSpeedTpuEngine:
             return
 
         # materialize master fp32 directly sharded (no host round-trip)
-        init_master = jax.jit(self.model.init_params, out_shardings=master_sh)
-        self.master_params = init_master(rng)
+        if self.topology.axis_size("pipe") > 1:
+            # pipe-stacked leaves are sharded on the LAYER dim, which cuts
+            # across independent per-layer rng draws — on this jax,
+            # compiling the init with such out_shardings changes the
+            # threefry bits, so a pp=4 engine would initialize differently
+            # from the dp engine it must numerically match
+            # (cross-topology parity/checkpoint contract). Init replicated,
+            # then place.
+            self.master_params = jax.device_put(
+                jax.jit(self.model.init_params)(rng), master_sh)
+        else:
+            init_master = jax.jit(self.model.init_params,
+                                  out_shardings=master_sh)
+            self.master_params = init_master(rng)
         # cast with the plan's device shardings; offload_param then
         # relocates the layer stack to pinned_host with a plain device_put
         # (mixing memory kinds in one jit's out_shardings trips the SPMD
@@ -583,8 +623,14 @@ class DeepSpeedTpuEngine:
 
         self.scale_state = init_scale_state(self.scale_cfg) if self.fp16_enabled else None
         self.param_count = int(sum(np.prod(l.shape) for l in jax.tree.leaves(shapes)))
-        self._step_arr = jnp.asarray(0, jnp.int32)
-        self._model_rng = jax.random.PRNGKey(seed + 1)
+        # committed replicated placement: the compiled step RETURNS these
+        # replicated, so an uncommitted scalar here would make the second
+        # train_batch a different cache entry (one wasted recompile)
+        repl = self.topology.replicated()
+        self._step_arr = jax.device_put(jnp.asarray(0, jnp.int32), repl)
+        self._model_rng = jax.device_put(jax.random.PRNGKey(seed + 1), repl)
+        if self.scale_state is not None:
+            self.scale_state = jax.device_put(self.scale_state, repl)
 
     def _init_infinity_state(self, rng):
         """ZeRO-Infinity parameter tier: layer params + optimizer state on
@@ -685,33 +731,46 @@ class DeepSpeedTpuEngine:
             return jax.tree.map(lambda x, s: jax.lax.with_sharding_constraint(x, s),
                                 tree, sh)
 
-        # --- ZeRO++ (reference zero/config.py:256-272): quantized weight
-        # gather (qwZ) / quantized gradient reduce (qgZ) run as an explicit
-        # shard_map program instead of compiler-inserted collectives.
+        # --- manual gradient program (runtime/grad_overlap.py): bucketed
+        # per-bucket collectives XLA can float into the backward, and the
+        # ZeRO++ quantized transport (qwZ/qgZ) as a parameterization of the
+        # same program. Legacy GSPMD-inserted reduction remains the
+        # fallback ("off" / unsupported compositions).
+        from .grad_overlap import make_overlapped_grad_fn, resolve_overlap_mode
         zc = self.config.zero_optimization
         zpp_w = zc.zero_quantized_weights and self.zero_stage == 3
         zpp_g = zc.zero_quantized_gradients and self.zero_stage >= 2
         use_zeropp = zpp_w or zpp_g
-        if use_zeropp:
-            # the manual quantized-collective program gathers from DEVICE
-            # shards; host-streamed params would need its own H2D stage
+        self.grad_overlap_mode = resolve_overlap_mode(self, use_zeropp)
+        use_manual = self.grad_overlap_mode == "bucketed"
+        self.grad_bucket_plan = None
+        if use_manual:
+            # the manual program gathers from DEVICE shards; host-streamed
+            # params would need its own H2D stage
             if self.param_offload:
                 from .config import ConfigError
                 raise ConfigError(
-                    "ZeRO++ quantized collectives do not compose with "
-                    "offload_param (host-streamed layer storage)")
+                    "the manual (bucketed/ZeRO++) gradient program does not "
+                    "compose with offload_param (host-streamed layer "
+                    "storage)")
 
-            # tensor AND sequence parallelism compose: the quantized-
-            # collective program is manual over the DP axes only, and
-            # GSPMD keeps inserting the tp/sp collectives on the auto
-            # "model"/"seq" axes (reference runs qwZ/qgZ under whatever
-            # the mpu provides, stage3.py:1226). expert/pipe would need
-            # manual programs of their own inside the shard_map.
+            # tensor AND sequence parallelism compose: the program is
+            # manual over the DP axes only, and GSPMD keeps inserting the
+            # tp/sp collectives on the auto "model"/"seq" axes (reference
+            # runs qwZ/qgZ under whatever the mpu provides, stage3.py:1226).
+            # expert/pipe would need manual programs of their own inside
+            # the shard_map.
             for ax in ("expert", "pipe"):
                 assert self.topology.axis_size(ax) == 1, \
-                    f"ZeRO++ quantized collectives compose with dp/tp/sp " \
+                    f"the manual gradient program composes with dp/tp/sp " \
                     f"only (got {ax} size {self.topology.axis_size(ax)})"
-            zeropp_grad_fn = self._make_zeropp_grad_fn(zpp_w, zpp_g)
+            manual_grad_fn, self.grad_bucket_plan = \
+                make_overlapped_grad_fn(self, zpp_w, zpp_g)
+            log_dist(
+                f"grad overlap: bucketed reduction "
+                f"({self.grad_bucket_plan.num_buckets} buckets, "
+                f"{len(self.grad_bucket_plan.vjp_leaves)} vjp-reduced "
+                f"leaves, quantized={zpp_g})", ranks=[0])
 
         pipeline_mode = self.topology.axis_size("pipe") > 1
         # the 1F1B path computes unscaled grads, so fp16 loss scaling falls
@@ -799,9 +858,9 @@ class DeepSpeedTpuEngine:
                 grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
                 grads = constrain(grads, grad_sh)
                 inv = 1.0 / scale
-            elif use_zeropp:
+            elif use_manual:
                 rng, sub = jax.random.split(rng)
-                grads, loss = zeropp_grad_fn(params, sub, batch, scale)
+                grads, loss = manual_grad_fn(params, sub, batch, scale)
                 grads = constrain(grads, grad_sh)
                 inv = 1.0 / (gas * scale)
             else:
@@ -902,150 +961,6 @@ class DeepSpeedTpuEngine:
 
         self._eval_step = jax.jit(eval_step,
                                   in_shardings=(param_store_sh, repl, None))
-
-    def _make_zeropp_grad_fn(self, zpp_w: bool, zpp_g: bool):
-        """Build the shard_map gradient program for ZeRO++.
-
-        Stage 3: parameters enter device-local (sharded); each microbatch
-        gathers them with qwZ int8 transport, and autodiff's VJP of the
-        gather IS the (quantized) reduce-scatter of the gradients — see
-        comm/quantized.py make_zero3_gather. Stage 1/2: params are
-        replicated; gradients are int8 all-to-all reduced at the gas
-        boundary (qgZ). Returns (params, rng, batch, scale) -> (grads, loss)
-        with grads already summed over microbatches and meaned over the DP
-        world (divide by gas only, like the SPMD path).
-        """
-        from ..comm.quantized import (all_to_all_quant_reduce,
-                                      make_zero3_gather, reduce_scatter_leaf,
-                                      shard_map_unchecked)
-
-        mesh = self.mesh
-        axes = self.topology.dp_axes
-        axis_sizes = self.topology.sizes
-        plan = self.zero_plan
-        stage3 = self.zero_stage == 3
-        model = self.model
-        # hpZ (reference partition_parameters.py:639 secondary tensors):
-        # params are stored secondary-sharded (within-group axis only), so
-        # the fwd/bwd gather traverses the group's fast links; gradients
-        # still reduce over the full DP world (group mean in the gather's
-        # VJP, then a cross-group mean in finalize).
-        hpz = stage3 and self.topology.hpz_enabled
-        gather_axes = self.topology.secondary_axes if hpz else axes
-        cross_group_axes = tuple(a for a in axes if a not in gather_axes)
-
-        param_specs = jax.tree.map(lambda ns: ns.spec, plan.param_sharding)
-        grad_specs = jax.tree.map(lambda ns: ns.spec, plan.grad_sharding)
-
-        def dim_of(spec):
-            # -1 sentinel (None collapses pytree structure)
-            for i, e in enumerate(spec):
-                entries = e if isinstance(e, tuple) else (e,)
-                if any(a in axes for a in entries if a is not None):
-                    return i
-            return -1
-
-        param_dims = jax.tree.map(dim_of, param_specs)
-        grad_dims = jax.tree.map(dim_of, grad_specs)
-        identity = lambda x: x  # noqa: E731
-        gather_fns = jax.tree.map(
-            lambda d: (make_zero3_gather(d, gather_axes, fwd_quantized=zpp_w,
-                                         bwd_quantized=zpp_g)
-                       if stage3 and d >= 0 else identity),
-            param_dims)
-
-        def linear_index():
-            idx = jnp.asarray(0, jnp.int32)
-            for a in axes:
-                idx = idx * axis_sizes[a] + jax.lax.axis_index(a)
-            return idx
-
-        def body(params_l, rng, batch_l, scale):
-            def apply_model(pshards, micro, sub):
-                pf = (jax.tree.map(lambda f, p: f(p), gather_fns, pshards)
-                      if stage3 else pshards)
-                out = model.apply(pf, micro, train=True, rng=sub)
-                loss, _aux = _split_loss_aux(out)
-                loss = loss.astype(jnp.float32)
-                return loss * scale, loss
-
-            def micro_fn(carry, micro):
-                grads_acc, rng = carry
-                rng, sub = jax.random.split(rng)
-                sub = jax.random.fold_in(sub, linear_index())
-                (_, loss), g = jax.value_and_grad(
-                    apply_model, has_aux=True)(params_l, micro, sub)
-                grads_acc = jax.tree.map(
-                    lambda a, x: a + x.astype(jnp.float32), grads_acc, g)
-                return (grads_acc, rng), loss
-
-            grads0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
-                                  params_l)
-            (grads, rng), losses = jax.lax.scan(micro_fn, (grads0, rng),
-                                                batch_l)
-
-            def finalize(g, gd, pd):
-                # pd >= 0 MUST be checked before gd < 0: under hpZ a dim
-                # can divide the small group but not the full world
-                # (pd >= 0, gd < 0), and its cotangent was already
-                # reduce-scattered over the shard axis by the gather's VJP
-                # — a pmean over that axis would average different shard
-                # halves into corrupt gradients
-                if stage3 and pd >= 0:
-                    # the gather's VJP reduced over gather_axes; hpZ still
-                    # owes the cross-group mean (grads stay
-                    # secondary-sharded, replicated across groups — the
-                    # engine re-shards them onto the full-world grad spec)
-                    if hpz and cross_group_axes:
-                        return jax.lax.pmean(g, cross_group_axes)
-                    return g
-                if gd < 0:  # grad stays replicated: plain mean-allreduce
-                    return jax.lax.pmean(g, axes)
-                if zpp_g:
-                    return all_to_all_quant_reduce(g, gd, axes, mean=True)
-                return reduce_scatter_leaf(g, gd, axes, mean=True)
-
-            grads = jax.tree.map(finalize, grads, grad_dims, param_dims)
-            loss = jax.lax.pmean(jnp.mean(losses), axes)
-            return grads, loss
-
-        # grads of hpZ-sharded params leave the program secondary-sharded
-        out_grad_specs = grad_specs
-        if hpz:
-            out_grad_specs = jax.tree.map(
-                lambda gs, ps, pd: ps if pd >= 0 else gs,
-                grad_specs, param_specs, param_dims)
-
-        # tensor/sequence parallelism ride the AUTO axes: the program is
-        # manual over the DP axes only, and specs mention only those (GSPMD
-        # keeps the "model"/"seq"-axis collectives inside model.apply)
-        tp = (self.topology.axis_size("model") > 1
-              or self.topology.axis_size("seq") > 1)
-        manual = tuple(axes)
-
-        def strip_auto(spec):
-            if not tp:
-                return spec
-            out = []
-            for e in spec:
-                ents = e if isinstance(e, tuple) else (e,)
-                kept = tuple(a for a in ents if a in manual)
-                out.append(kept if len(kept) > 1 else
-                           (kept[0] if kept else None))
-            return P(*out)
-
-        if tp:
-            param_specs_in = jax.tree.map(strip_auto, param_specs)
-            out_grad_specs = jax.tree.map(strip_auto, out_grad_specs)
-        else:
-            param_specs_in = param_specs
-
-        bt = self.topology.batch_axes
-        return shard_map_unchecked(
-            body, mesh=mesh,
-            in_specs=(param_specs_in, P(), P(None, bt), P()),
-            out_specs=(out_grad_specs, P()),
-            axis_names=manual if tp else None)
 
     def _build_offload_step(self):
         """Grad-only device program for ZeRO-Offload: the optimizer runs on
@@ -1280,9 +1195,14 @@ class DeepSpeedTpuEngine:
     # ------------------------------------------------------------------
     # Public API (reference surface)
     # ------------------------------------------------------------------
-    def lower_train_step(self, batch):
+    def lower_train_step(self, batch, compiler_options=None):
         """AOT-compile the train step for analysis (HLO text, overlap
-        report, cost) without executing it. Returns the jax Compiled."""
+        report, cost) without executing it. Returns the jax Compiled.
+
+        TPU targets get the collective-overlap compiler options by default
+        (the AOT compile-only client does not read LIBTPU_INIT_ARGS, and
+        reduce-scatter async-fusion is off without them — the bucketed
+        reduction would measure as fully exposed for want of a flag)."""
         if self.offload_device or self.onebit_mode or self.param_offload_nvme:
             raise NotImplementedError(
                 "lower_train_step supports the standard jitted step only "
@@ -1302,10 +1222,39 @@ class DeepSpeedTpuEngine:
             dev_batch = jax.tree.map(prep, batch)
         else:
             dev_batch = self._shard_batch(batch)
-        return self._train_step.lower(
+        if compiler_options is None:
+            try:
+                on_tpu = self.mesh.devices.flat[0].platform == "tpu"
+            except Exception:
+                on_tpu = False
+            # bucketed engines only: legacy GSPMD programs keep the
+            # backend-default pass order (the extra fusion knobs measurably
+            # shuffle which stage-3 param gathers get async chains)
+            if on_tpu and self.grad_overlap_mode == "bucketed":
+                from ..accelerator.tpu_accelerator import \
+                    COLLECTIVE_OVERLAP_COMPILER_OPTIONS
+                compiler_options = dict(COLLECTIVE_OVERLAP_COMPILER_OPTIONS)
+        lowered = self._train_step.lower(
             self.params, self.master_params, self.opt_state,
-            self.scale_state, self._step_arr, self._model_rng,
-            dev_batch).compile()
+            self.scale_state, self._step_arr, self._model_rng, dev_batch)
+        compiled = (lowered.compile(compiler_options=compiler_options)
+                    if compiler_options else lowered.compile())
+        self._record_comm_overlap(compiled)
+        return compiled
+
+    def _record_comm_overlap(self, compiled):
+        """Feed ``training_comm_exposed_fraction`` from the compiled step's
+        HLO scheduling (TPU: async-collective-fusion chains; CPU backend:
+        start/done pairs). Best-effort — analysis must never break AOT."""
+        if not getattr(self, "telemetry_enabled", False):
+            return
+        try:
+            from ..utils.xla_profile import grad_exchange_report_from_compiled
+            rep = grad_exchange_report_from_compiled(compiled)
+            if rep.total:
+                self._tm_comm_exposed.set(float(rep.exposed_fraction))
+        except Exception as e:  # pragma: no cover - diagnostics only
+            logger.debug(f"comm overlap analysis skipped: {e}")
 
     def train_batch(self, data_iter=None, batch=None):
         """Run one full (micro*gas) training batch; returns scalar loss.

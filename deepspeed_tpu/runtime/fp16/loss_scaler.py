@@ -35,11 +35,15 @@ def init_scale_state(cfg: LossScaleConfig) -> Dict[str, Any]:
 
 
 def grads_finite(grads) -> jnp.ndarray:
+    """Global inf/nan check as ONE fused reduction: per-leaf partials are
+    stacked and reduced together (the ``global_norm`` trick), instead of an
+    O(n-leaves) chain of sequential ``logical_and`` ops that serialized the
+    traced graph and defeated fusion on wide pytrees."""
     leaves = jax.tree.leaves(grads)
-    finite = jnp.asarray(True)
-    for g in leaves:
-        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
-    return finite
+    if not leaves:
+        return jnp.asarray(True)
+    partials = jnp.stack([jnp.all(jnp.isfinite(g)) for g in leaves])
+    return jnp.all(partials)
 
 
 def update_scale(state: Dict[str, Any], finite: jnp.ndarray,

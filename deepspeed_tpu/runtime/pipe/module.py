@@ -397,11 +397,11 @@ class PipelineModule:
                                  loss_args=rest_l, dp_axes=dp_axes,
                                  pipe_reduce_mask=reduce_mask)
 
-        sm = jax.shard_map(
+        from ...comm.quantized import shard_map_unchecked
+        sm = shard_map_unchecked(
             body, mesh=topo.mesh,
             in_specs=(param_specs, batch_spec) + (batch_spec,) * len(rest),
-            out_specs=(P(), param_specs),
-            check_vma=False)
+            out_specs=(P(), param_specs))
         return sm(params, x, *rest)
 
     def apply(self, params, batch, train: bool = True, rng=None):
@@ -449,8 +449,9 @@ class PipelineModule:
             loss = jnp.mean(jnp.stack([one(m) for m in range(M)]))
             return jax.lax.pmean(loss, dp_axes)
 
-        sm = jax.shard_map(
+        from ...comm.quantized import shard_map_unchecked
+        sm = shard_map_unchecked(
             body, mesh=topo.mesh,
             in_specs=(param_specs, batch_spec) + (batch_spec,) * len(rest),
-            out_specs=P(), check_vma=False)
+            out_specs=P())
         return sm(params, x, *rest)

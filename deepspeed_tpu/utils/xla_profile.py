@@ -28,6 +28,11 @@ import jax
 _ASYNC_KINDS = ("all-gather", "all-reduce", "reduce-scatter",
                 "collective-permute", "all-to-all")
 
+# collective kinds that carry a gradient REDUCTION (the data-parallel
+# exchange runtime/grad_overlap.py buckets); all-to-all is included because
+# qgZ transports the quantized reduce over it
+_REDUCE_KINDS = ("all-reduce", "reduce-scatter", "all-to-all")
+
 
 def capture_trace(fn: Callable, *args, trace_dir: str, steps: int = 2):
     """Run fn(*args) `steps` times under jax.profiler.trace.
@@ -167,6 +172,20 @@ class TpuOverlapReport:
         total = self.async_channels.get("all-gather", 0) + bare_param
         return bare_param / total if total else 0.0
 
+    @property
+    def grad_reduce_exposed_fraction(self) -> float:
+        """Exposed fraction of the gradient-reduction side specifically:
+        reduce-kind collectives (all-reduce / reduce-scatter / all-to-all)
+        NOT covered by an async chain. The companion of
+        ``param_gather_exposed_fraction`` — together they split the ZeRO
+        exchange into its gather and reduce halves."""
+        bare = sum(v for k, v in self.bare_channels.items()
+                   if k in _REDUCE_KINDS)
+        chained = sum(v for k, v in self.async_channels.items()
+                      if k in _REDUCE_KINDS)
+        total = bare + chained
+        return bare / total if total else 0.0
+
     def to_dict(self) -> Dict[str, Any]:
         return {"async_channels": dict(self.async_channels),
                 "bare_channels": dict(self.bare_channels),
@@ -177,6 +196,8 @@ class TpuOverlapReport:
                 "exposed_bytes_fraction": self.exposed_bytes_fraction,
                 "param_gather_exposed_fraction":
                     self.param_gather_exposed_fraction,
+                "grad_reduce_exposed_fraction":
+                    self.grad_reduce_exposed_fraction,
                 "bare_ops": list(self.bare_ops)}
 
     def summary(self) -> str:
@@ -262,6 +283,102 @@ def tpu_overlap_report_from_compiled(compiled) -> TpuOverlapReport:
     return analyze_hlo_tpu("\n".join(texts))
 
 
+@dataclass
+class GradExchangeReport:
+    """Overlap verdict for the GRADIENT exchange specifically.
+
+    Covers (a) all-reduce / reduce-scatter collectives anywhere in the
+    program carrying at least ``_GRAD_MIN_BYTES`` (a monolithic GSPMD
+    reduction shows up here; the scalar loss-pmean / grad-norm /
+    grads_finite reduces do not) and (b) collective-permute / all-gather /
+    all-to-all ops whose metadata source points into the gradient
+    machinery (``runtime/grad_overlap.py`` rings, ``comm/quantized.py``
+    qgZ transport) — forward-path all-to-alls (Ulysses, MoE dispatch) are
+    excluded. A sync op is exposed by definition; an async start/done
+    pair is exposed when NOTHING is scheduled inside its window. Works on
+    both the TPU backend's scheduled HLO (ppermute start/done pairs) and
+    the CPU backend's (sync collectives).
+    """
+
+    total: int = 0
+    exposed: int = 0
+    sync_ops: Dict[str, int] = field(default_factory=dict)
+    async_ops: Dict[str, int] = field(default_factory=dict)
+    distances: Dict[str, List[int]] = field(default_factory=dict)
+
+    @property
+    def exposed_fraction(self) -> float:
+        return self.exposed / self.total if self.total else 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        ds = [d for v in self.distances.values() for d in v]
+        return {"total": self.total, "exposed": self.exposed,
+                "exposed_collective_fraction": self.exposed_fraction,
+                "sync_ops": dict(self.sync_ops),
+                "async_ops": dict(self.async_ops),
+                "median_overlap_window": (sorted(ds)[len(ds) // 2]
+                                          if ds else 0)}
+
+
+_GRAD_SOURCE_HINTS = ("grad_overlap", "comm/quantized")
+# reduce-kind collectives smaller than this carry bookkeeping scalars
+# (loss pmean, grads_finite, grad-norm), not gradient bytes
+_GRAD_MIN_BYTES = 4096
+
+
+def analyze_grad_exchange(hlo: str) -> GradExchangeReport:
+    """Classify every gradient-exchange collective as exposed/overlapped
+    (see GradExchangeReport). Walks the scheduled instruction stream in
+    order; the distance between an async start and its done is the
+    overlap window the scheduler actually created."""
+    rep = GradExchangeReport()
+    lines = [l.strip() for l in hlo.splitlines()
+             if re.match(r"^\s*(ROOT\s+)?%?[\w.\-]+\s*=", l)]
+    starts: Dict[str, tuple] = {}
+    reduce_kinds = {"all-reduce", "reduce-scatter"}
+    sourced_kinds = {"collective-permute", "all-gather", "all-to-all"}
+    for pos, line in enumerate(lines):
+        name_m = re.match(r"^(?:ROOT\s+)?%?([\w.\-]+)\s*=", line)
+        if not name_m:
+            continue
+        var = name_m.group(1)
+        for kind in _ASYNC_KINDS:
+            included = (
+                (kind in reduce_kinds
+                 and _shape_bytes(line) >= _GRAD_MIN_BYTES)
+                or (kind in sourced_kinds
+                    and any(h in line for h in _GRAD_SOURCE_HINTS)))
+            if re.search(rf"\b{kind}-start\(", line):
+                if included:
+                    starts[var] = (kind, pos)
+                    rep.async_ops[kind] = rep.async_ops.get(kind, 0) + 1
+                    rep.total += 1
+            elif re.search(rf"\b{kind}-done\(", line):
+                for tok in re.findall(r"%([\w.\-]+)", line):
+                    if tok in starts:
+                        kind0, p0 = starts.pop(tok)
+                        d = pos - p0
+                        rep.distances.setdefault(kind0, []).append(d)
+                        if d <= 1:
+                            rep.exposed += 1
+                        break
+            elif re.search(rf"\b{kind}\(", line):
+                if included:
+                    rep.sync_ops[kind] = rep.sync_ops.get(kind, 0) + 1
+                    rep.total += 1
+                    rep.exposed += 1
+    # a start whose done we failed to locate gives no overlap evidence:
+    # count it exposed (conservative) rather than silently overlapped
+    rep.exposed += len(starts)
+    return rep
+
+
+def grad_exchange_report_from_compiled(compiled) -> GradExchangeReport:
+    texts = [m.to_string() for m in compiled.runtime_executable().hlo_modules()] \
+        if hasattr(compiled, "runtime_executable") else [compiled.as_text()]
+    return analyze_grad_exchange("\n".join(texts))
+
+
 def analyze_hlo(hlo: str) -> OverlapReport:
     rep = OverlapReport()
     # walk the entry computation's instruction stream in order
@@ -279,11 +396,14 @@ def analyze_hlo(hlo: str) -> OverlapReport:
                 starts[var] = (kind, pos)
                 rep.async_pairs[kind] = rep.async_pairs.get(kind, 0) + 1
             elif re.search(rf"\b{kind}-done\(", line):
-                # operand var name inside the parens
-                om = re.search(rf"{kind}-done\(\s*%?([\w.\-]+)", line)
-                if om and om.group(1) in starts:
-                    kind0, p0 = starts.pop(om.group(1))
-                    rep.distances.setdefault(kind0, []).append(pos - p0)
+                # operand var name: post-scheduling HLO spells the full
+                # tuple SHAPE before the operand (%foo-done((f32[..], ..)
+                # %foo-start.3)), so scan every %token for a known start
+                for tok in re.findall(r"%([\w.\-]+)", line):
+                    if tok in starts:
+                        kind0, p0 = starts.pop(tok)
+                        rep.distances.setdefault(kind0, []).append(pos - p0)
+                        break
             elif re.search(rf"\b{kind}\(", line):
                 rep.sync_collectives[kind] = \
                     rep.sync_collectives.get(kind, 0) + 1

@@ -58,3 +58,30 @@ def test_pallas_builder_load():
     q, s = mod.quantize_symmetric(np.linspace(-1, 1, 4096, dtype=np.float32))
     out = mod.dequantize_symmetric(q, s, (4096,))
     assert np.allclose(out, np.linspace(-1, 1, 4096), atol=1e-2)
+
+
+def test_collective_overlap_flags_merge_by_token():
+    """LIBTPU_INIT_ARGS merging: defaults fill in, a user-pinned flag's
+    value wins, and a LONGER pinned flag whose name merely prefixes a
+    default must not suppress it (exact-token matching, not substring)."""
+    from deepspeed_tpu.accelerator.tpu_accelerator import (
+        COLLECTIVE_OVERLAP_XLA_FLAGS, apply_collective_overlap_flags,
+        collective_overlap_init_args)
+
+    merged = collective_overlap_init_args("")
+    for flag in COLLECTIVE_OVERLAP_XLA_FLAGS:
+        assert flag in merged.split()
+    # pinned value wins over our default
+    pinned = "--xla_tpu_enable_latency_hiding_scheduler=false"
+    merged = collective_overlap_init_args(pinned)
+    assert pinned in merged.split()
+    assert "--xla_tpu_enable_latency_hiding_scheduler=true" not in merged
+    # a longer pinned flag must NOT swallow the shorter master switch
+    longer = "--xla_tpu_enable_async_collective_fusion_fuse_reduce_scatter=false"
+    merged = collective_overlap_init_args(longer)
+    assert "--xla_tpu_enable_async_collective_fusion=true" in merged.split()
+    assert longer in merged.split()
+    # env application is idempotent
+    env = {"LIBTPU_INIT_ARGS": longer}
+    once = apply_collective_overlap_flags(env)
+    assert apply_collective_overlap_flags(env) == once

@@ -9,6 +9,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 import numpy as np
 import pytest
 import jax
+from deepspeed_tpu.comm.quantized import shard_map_unchecked
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -185,8 +186,8 @@ def test_pipeline_module_1f1b_bounded_stash():
         mesh = jax.sharding.Mesh(
             np.array(jax.devices()[:4]).reshape(4), ("pipe",))
         jaxpr = jax.make_jaxpr(
-            jax.shard_map(body, mesh=mesh, in_specs=(P(), P(), P()),
-                          out_specs=(P(), P()), check_vma=False))(params, x, y)
+            shard_map_unchecked(body, mesh=mesh, in_specs=(P(), P(), P()),
+                          out_specs=(P(), P())))(params, x, y)
 
         def scan_carry_elems(jxp):
             total = 0

@@ -16,8 +16,6 @@ REPO = pathlib.Path(__file__).resolve().parents[3]
 # key -> why it is legitimately inert on this stack
 INERT_BY_DESIGN = {
     # XLA owns gradient bucketing/fusion; there are no hand-rolled buckets
-    "allgather_bucket_size": "XLA fuses/schedules collectives; no buckets",
-    "reduce_bucket_size": "XLA fuses/schedules collectives; no buckets",
     "allgather_partitions": "stage-1/2 gather strategy is a sharding spec",
     "contiguous_gradients": "grads are XLA-managed buffers, always packed",
     "round_robin_gradients": "no per-rank bucket ordering to rotate",

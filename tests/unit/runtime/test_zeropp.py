@@ -208,6 +208,12 @@ def test_hpz_with_qwz_trains():
     np.testing.assert_allclose(losses, ref, rtol=0.05, atol=2e-2)
 
 
+@pytest.mark.skipif(
+    not __import__("deepspeed_tpu.runtime.grad_overlap",
+                   fromlist=["partial_manual_supported"]
+                   ).partial_manual_supported(),
+    reason="partial-manual shard_map needs jax>=0.5 (this jaxlib's SPMD "
+           "partitioner aborts on collectives under auto axes)")
 def test_zeropp_composes_with_tensor_parallel():
     """qwZ+qgZ under tp=2 (the lifted pure-DP assert): the quantized-
     collective program is manual over the DP axes only; GSPMD keeps the
@@ -296,6 +302,12 @@ def test_hpz_qwz_group_divisible_leaf_gradients():
     assert b[0] > 2.5 and b[5] < -2.5, b
 
 
+@pytest.mark.skipif(
+    not __import__("deepspeed_tpu.runtime.grad_overlap",
+                   fromlist=["partial_manual_supported"]
+                   ).partial_manual_supported(),
+    reason="partial-manual shard_map needs jax>=0.5 (this jaxlib's SPMD "
+           "partitioner aborts on collectives under auto axes)")
 def test_zeropp_composes_with_sequence_parallel():
     """qwZ/qgZ at sp=2 (VERDICT r4 Next #5): the quantized-collective
     shard_map is manual over the DP axes only, and the Ulysses seq-axis

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 import jax
+from deepspeed_tpu.comm.quantized import shard_map_unchecked
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -34,10 +35,10 @@ def test_distributed_attention_matches_dense(mesh):
     def body(q_, k_, v_):
         return dist_attn(q_, k_, v_)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map_unchecked(
         body, mesh=mesh,
         in_specs=(P(None, None, "seq", None),) * 3,
-        out_specs=P(None, None, "seq", None), check_vma=False))(q, k, v)
+        out_specs=P(None, None, "seq", None)))(q, k, v)
     ref = mha_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
@@ -51,10 +52,10 @@ def test_distributed_attention_grads_match_dense(mesh):
     def sp_loss(q_, k_, v_):
         def body(a, b, c):
             return dist_attn(a, b, c)
-        out = jax.shard_map(
+        out = shard_map_unchecked(
             body, mesh=mesh,
             in_specs=(P(None, None, "seq", None),) * 3,
-            out_specs=P(None, None, "seq", None), check_vma=False)(q_, k_, v_)
+            out_specs=P(None, None, "seq", None))(q_, k_, v_)
         return jnp.sum(out.astype(jnp.float32) ** 2)
 
     def dense_loss(q_, k_, v_):
@@ -76,7 +77,7 @@ def test_seq_all_to_all_roundtrip(mesh):
         assert w.shape == (B, H // SP, S, D)
         return seq_all_to_all(w, "seq", 2, 1)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map_unchecked(
         body, mesh=mesh, in_specs=P(None, None, "seq", None),
-        out_specs=P(None, None, "seq", None), check_vma=False))(x)
+        out_specs=P(None, None, "seq", None)))(x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
