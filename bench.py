@@ -14,20 +14,11 @@ import time
 
 import numpy as np
 
-PEAK_FLOPS = {
-    "TPU v5 lite": 197e12,   # v5e bf16 peak per chip
-    "TPU v5": 459e12,        # v5p
-    "TPU v4": 275e12,
-    "cpu": 1e12,             # nominal, for smoke runs
-}
-
-
-def peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "cpu")
-    for key, val in PEAK_FLOPS.items():
-        if key.lower() in str(kind).lower():
-            return val
-    return PEAK_FLOPS["cpu"]
+# the peak-FLOPS table lives with the accelerator (serving_bench shares
+# it for its MFU field); these aliases keep the historical bench surface
+from deepspeed_tpu.accelerator.tpu_accelerator import (PEAK_FLOPS_BY_KIND
+                                                       as PEAK_FLOPS,
+                                                       peak_flops)
 
 
 def _measure(cfg, micro, gas, steps, warmup, n_dev, zero_stage=None,
@@ -88,7 +79,23 @@ def _measure(cfg, micro, gas, steps, warmup, n_dev, zero_stage=None,
             compiled = engine.lower_train_step(batch)
             rep = overlap_report_from_compiled(compiled)
             gx = grad_exchange_report_from_compiled(compiled)
+            # compiler-measured MFU (satellite of the flops profiler):
+            # XLA's own flop count for the compiled step over the
+            # measured wall time and the chip's peak — cross-checks the
+            # analytic model.flops_per_token MFU headline. cost_analysis
+            # reports the PER-DEVICE partitioned module's flops, so no
+            # further /n_dev — peak is also per chip
+            from deepspeed_tpu.telemetry.memory import cost_analysis_dict
+            ca = cost_analysis_dict(compiled)
+            step_flops = float(ca.get("flops", 0.0))
+            step_bytes = float(ca.get("bytes accessed", 0.0))
             extra_phases = {
+                "cost_analysis_flops": step_flops,
+                "cost_analysis_bytes": step_bytes,
+                "mfu_cost_analysis": (
+                    round(step_flops / dt
+                          / peak_flops(jax.devices()[0]), 4)
+                    if step_flops else None),
                 "fwd_s": round(fwd, 4),
                 "fwd_frac": round(fwd / dt, 3),
                 "bwd_opt_s": round(dt - fwd, 4),
@@ -209,8 +216,17 @@ def build_trials(base):
     return trials
 
 
-def main():
+def main(argv=None):
+    import argparse
     import os
+
+    ap = argparse.ArgumentParser(prog="bench")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write the run's telemetry spans (training step "
+                         "phases incl. train_data/device_dispatch/"
+                         "host_sync) as Chrome-trace-event JSON to PATH "
+                         "(open in Perfetto; see docs/PROFILING.md)")
+    args, _ = ap.parse_known_args(argv)
 
     # collective-overlap XLA knobs (latency-hiding scheduler + async
     # collective fusion incl. reduce-scatter chaining for the bucketed
@@ -415,6 +431,20 @@ def main():
                 "exposed_bytes_fraction": u.get("exposed_bytes_fraction")}
         except Exception:
             pass
+    try:
+        # pin the exact compiler configuration to the perf record so a
+        # number is attributable to a jax/jaxlib/libtpu + flag set
+        from deepspeed_tpu.env_report import compiler_fingerprint
+        detail["compiler_config"] = compiler_fingerprint()
+    except Exception:
+        pass
+    if args.trace_out:
+        try:
+            from deepspeed_tpu.telemetry import timeline
+            detail["trace_out"] = timeline.write_chrome_trace(
+                args.trace_out)
+        except Exception as exc:
+            detail["trace_out_error"] = repr(exc)[:150]
     result = {
         "metric": "train_mfu_llama_flagship",
         "value": round(mfu * 100, 2),

@@ -39,6 +39,27 @@ COLLECTIVE_OVERLAP_COMPILER_OPTIONS: Dict[str, str] = {
 }
 
 
+# bf16 peak matmul FLOPS per chip by device_kind substring — the MFU
+# denominator for bench.py / serving_bench (model-flops utilization =
+# achieved flops/s over this peak)
+PEAK_FLOPS_BY_KIND: Dict[str, float] = {
+    "TPU v5 lite": 197e12,   # v5e bf16 peak per chip
+    "TPU v5": 459e12,        # v5p
+    "TPU v4": 275e12,
+    "cpu": 1e12,             # nominal, for smoke runs
+}
+
+
+def peak_flops(device) -> float:
+    """Peak bf16 FLOPS of ``device`` (a jax.Device), by device_kind
+    substring; unknown kinds fall back to the nominal CPU figure."""
+    kind = getattr(device, "device_kind", "cpu")
+    for key, val in PEAK_FLOPS_BY_KIND.items():
+        if key.lower() in str(kind).lower():
+            return val
+    return PEAK_FLOPS_BY_KIND["cpu"]
+
+
 def collective_overlap_init_args(existing: str = "") -> str:
     """Merge the overlap flags into a LIBTPU_INIT_ARGS string, keeping any
     flag the caller already pinned (their value wins over our default).

@@ -74,8 +74,11 @@ def bench_paged(model, params, prompts: np.ndarray, new_tokens: int,
     into steady-state tok/s) and its series are snapshotted and
     subtracted. ``decode_window=1`` measures the per-token fallback —
     the fused-vs-per-token comparison is the dispatch-overhead story."""
+    from ..accelerator.tpu_accelerator import peak_flops
     from ..inference.v2.engine_v2 import InferenceEngineV2
-    from ..telemetry import get_registry
+    from ..telemetry import get_registry, watchdog
+
+    import jax
 
     B, S = prompts.shape
     eng = InferenceEngineV2(model, {
@@ -97,12 +100,23 @@ def bench_paged(model, params, prompts: np.ndarray, new_tokens: int,
                            "inference_ttft_seconds")}
     base_tokens = reg.counter("inference_decode_tokens_total").value
     base_syncs = reg.counter("inference_decode_host_syncs_total").value
-    t0 = time.perf_counter()
-    for r in range(repeats):
-        outs = eng.generate(prompt_list, max_new_tokens=new_tokens,
-                            uids=list(range(uid_base + (r + 1) * 1000,
-                                            uid_base + (r + 1) * 1000 + B)))
-    dt = (time.perf_counter() - t0) / repeats
+    # warmup compiled every bucket this workload uses; the measured phase
+    # must not compile AGAIN — the recompile watchdog enforces it and the
+    # violation count lands in the bench record
+    base_steady = reg.family_total("xla_steady_state_recompiles_total")
+    watchdog.mark_steady(True)
+    try:
+        t0 = time.perf_counter()
+        for r in range(repeats):
+            outs = eng.generate(
+                prompt_list, max_new_tokens=new_tokens,
+                uids=list(range(uid_base + (r + 1) * 1000,
+                                uid_base + (r + 1) * 1000 + B)))
+        dt = (time.perf_counter() - t0) / repeats
+    finally:
+        watchdog.mark_steady(False)
+    steady_recompiles = reg.family_total(
+        "xla_steady_state_recompiles_total") - base_steady
     assert len(outs) == B
 
     decode_n, decode_s = _hist_delta(reg, "inference_decode_step_seconds",
@@ -112,11 +126,34 @@ def bench_paged(model, params, prompts: np.ndarray, new_tokens: int,
         - base_tokens
     host_syncs = reg.counter("inference_decode_host_syncs_total").value \
         - base_syncs
+    # MFU from the compiler's own numbers (telemetry/memory.py records
+    # the decode program's cost analysis chip-free): flops per generated
+    # token x measured decode tok/s over the chip's peak
+    flops_per_token = decode_peak_bytes = None
+    try:
+        rep = eng.memory_report(batch=B)
+        N = eng._decode_bucket(B)
+        if decode_window > 1:
+            prog = rep["programs"]["decode_window_greedy"]
+            flops_per_token = prog.get("flops", 0.0) / (N * decode_window)
+        else:
+            prog = rep["programs"]["decode_greedy"]
+            flops_per_token = prog.get("flops", 0.0) / N
+        decode_peak_bytes = prog.get("peak_bytes")
+    except Exception:  # analysis is a bonus; the bench still reports
+        pass
+    decode_tok_s = (decode_tokens / decode_s) if decode_s else None
+    mfu = (decode_tok_s * flops_per_token / peak_flops(jax.devices()[0])
+           if decode_tok_s and flops_per_token else None)
     return {
+        "decode_mfu": mfu,
+        "decode_flops_per_token": flops_per_token,
+        "decode_peak_bytes": decode_peak_bytes,
+        "steady_state_recompiles": steady_recompiles,
         "tok_s": B * new_tokens / dt,
         "warmup_s": warmup_s,
         "decode_window": decode_window,
-        "decode_tok_s": (decode_tokens / decode_s) if decode_s else None,
+        "decode_tok_s": decode_tok_s,
         "decode_steps": int(decode_n),
         # the fused window's dispatch win, visible in one artifact: one
         # device->host transfer per window vs one per token
@@ -141,6 +178,10 @@ def main(argv=None) -> int:
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--window", type=int, default=8,
                    help="fused decode window K (1 = per-token only)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write the run's telemetry spans (request "
+                        "lifelines, decode windows) as Chrome-trace-event "
+                        "JSON to PATH (open in Perfetto)")
     args = p.parse_args(argv)
 
     import jax
@@ -161,6 +202,10 @@ def main(argv=None) -> int:
     dense = bench_dense(model, params, prompts, args.new, args.repeats)
     paged_tok_s = paged["tok_s"]
     dense_tok_s = dense["tok_s"]
+    trace_out = None
+    if args.trace_out:
+        from ..telemetry import timeline
+        trace_out = timeline.write_chrome_trace(args.trace_out)
     print(json.dumps({
         "metric": "serving_tokens_per_sec",
         "backend": jax.default_backend(),
@@ -195,6 +240,17 @@ def main(argv=None) -> int:
             else None),
         "kv_pool_utilization_peak": round(
             paged["kv_pool_utilization_peak"], 4),
+        # forensics fields (this PR): compiler-measured MFU of the decode
+        # hot path, its program footprint, and the watchdog's verdict
+        # that steady-state serving compiled nothing
+        "decode_mfu": (round(paged["decode_mfu"], 5)
+                       if paged["decode_mfu"] else None),
+        "decode_flops_per_token": (round(paged["decode_flops_per_token"])
+                                   if paged["decode_flops_per_token"]
+                                   else None),
+        "decode_peak_bytes": paged["decode_peak_bytes"],
+        "steady_state_recompiles": paged["steady_state_recompiles"],
+        "trace_out": trace_out,
         "dense_tok_s": round(dense_tok_s, 2),
         "dense_warmup_s": round(dense["warmup_s"], 3),
         "paged_over_dense": (round(paged_tok_s / dense_tok_s, 3)

@@ -33,12 +33,47 @@ def op_report(verbose: bool = False):
 
 def software_report():
     rows = [("python", sys.version.split()[0])]
-    for mod in ("jax", "jaxlib", "flax", "optax", "numpy", "ml_dtypes"):
+    for mod in ("jax", "jaxlib", "libtpu", "flax", "optax", "numpy",
+                "ml_dtypes"):
         v = _try_version(mod)
         rows.append((mod, v or "not installed"))
     from . import __version__ as ds_version
     rows.append(("deepspeed_tpu", ds_version))
     return rows
+
+
+def compiler_fingerprint():
+    """The exact compiler configuration a perf artifact ran under:
+    jax/jaxlib/libtpu versions plus the RESOLVED ``LIBTPU_INIT_ARGS``
+    (the env merged with the collective-overlap defaults
+    ``apply_collective_overlap_flags`` would export) and the overlap
+    flag list itself. A bench number without this dict is not
+    attributable to a compiler; bench.py embeds it in every record."""
+    import os
+
+    from .accelerator.tpu_accelerator import (
+        COLLECTIVE_OVERLAP_XLA_FLAGS, collective_overlap_init_args)
+    return {
+        "jax": _try_version("jax"),
+        "jaxlib": _try_version("jaxlib"),
+        "libtpu": _try_version("libtpu"),
+        "libtpu_init_args_env": os.environ.get("LIBTPU_INIT_ARGS", ""),
+        "libtpu_init_args_resolved": collective_overlap_init_args(
+            os.environ.get("LIBTPU_INIT_ARGS", "")),
+        "collective_overlap_flags": list(COLLECTIVE_OVERLAP_XLA_FLAGS),
+        "xla_flags": os.environ.get("XLA_FLAGS", ""),
+    }
+
+
+def compiler_config_report():
+    """compiler_fingerprint() as printable rows (ds_report section)."""
+    fp = compiler_fingerprint()
+    return [
+        ("libtpu", fp["libtpu"] or "not installed"),
+        ("LIBTPU_INIT_ARGS", fp["libtpu_init_args_env"] or "(unset)"),
+        ("resolved overlap args", fp["libtpu_init_args_resolved"]),
+        ("XLA_FLAGS", fp["xla_flags"] or "(unset)"),
+    ]
 
 
 def hardware_report(probe_timeout: int = 30):
@@ -108,6 +143,9 @@ def main(hide_operator_status=False, hide_errors_and_warnings=False):
     print("hardware:")
     for k, v in hardware_report():
         print(f"  {k:>16}: {v}")
+    print("compiler configuration:")
+    for k, v in compiler_config_report():
+        print(f"  {k:>22}: {v}")
     if not hide_operator_status:
         print("op compatibility:")
         for name, kind, ok in op_report():

@@ -30,7 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.transformer import TransformerConfig
-from ...telemetry import trace
+from ...telemetry import memory as ds_memory
+from ...telemetry import trace, watchdog
 from ...utils.logging import log_dist
 from .config_v2 import RaggedInferenceEngineConfig
 from .paged_model import (init_paged_kv_cache, paged_continue, paged_decode,
@@ -141,11 +142,14 @@ class InferenceEngineV2:
         # alibi bias; the jnp paths add the softmax-invariant row
         use_kernel_decode = use_kernel and not config.kv_quant
         topo = self.topology if ep > 1 else None
-        self._decode_jit = jax.jit(
+        # every compile point below is watchdog-wrapped: the power-of-two
+        # bucketing is SUPPOSED to make steady-state serving compile-free,
+        # and the watchdog is what proves it (telemetry/watchdog.py)
+        self._decode_jit = watchdog.watch("decode", jax.jit(
             lambda p, t, pos, bt, c, a: paged_decode(
                 cfg, p, t, pos, bt, c, a, sm.block_size,
                 use_kernel=use_kernel_decode, topo=topo),
-            donate_argnums=(4,))
+            donate_argnums=(4,)))
 
         def _decode_tok(p, t, pos, bt, c, a):
             # greedy variant for the generate() hot loop: argmax on device
@@ -157,7 +161,8 @@ class InferenceEngineV2:
                                      topo=topo)
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), c
 
-        self._decode_tok_jit = jax.jit(_decode_tok, donate_argnums=(4,))
+        self._decode_tok_jit = watchdog.watch(
+            "decode_greedy", jax.jit(_decode_tok, donate_argnums=(4,)))
 
         def _decode_sample(p, t, pos, bt, c, a, rng, seeds, gidx, temp,
                            topp, topk):
@@ -174,8 +179,8 @@ class InferenceEngineV2:
             return sample_tokens_rowwise(logits, keys, temp, topp,
                                          topk), c
 
-        self._decode_sample_jit = jax.jit(_decode_sample,
-                                          donate_argnums=(4,))
+        self._decode_sample_jit = watchdog.watch(
+            "decode_sample", jax.jit(_decode_sample, donate_argnums=(4,)))
         # fused multi-token decode window (the generate()/scheduler hot
         # path when decode_window > 1): K decode steps per dispatch, one
         # [N, K] int32 transfer per window. K is baked into the compiled
@@ -184,29 +189,29 @@ class InferenceEngineV2:
         # (batch bucket, table-width bucket).
         self.decode_window = max(int(config.decode_window), 1)
         self._m_window_size.set(self.decode_window)
-        self._fused_greedy_jit = jax.jit(
+        self._fused_greedy_jit = watchdog.watch("decode_window_greedy", jax.jit(
             lambda p, t, pos, bt, c, sl, eos: paged_decode_window(
                 cfg, p, t, pos, bt, c, sl, eos, sm.block_size,
                 self.decode_window, use_kernel=use_kernel_decode,
                 topo=topo),
-            donate_argnums=(4,))
-        self._fused_sample_jit = jax.jit(
+            donate_argnums=(4,)))
+        self._fused_sample_jit = watchdog.watch("decode_window_sample", jax.jit(
             lambda p, t, pos, bt, c, sl, eos, rng, seeds, g0, temp, topp, \
             topk: paged_decode_window(
                 cfg, p, t, pos, bt, c, sl, eos, sm.block_size,
                 self.decode_window, rng=rng, row_seeds=seeds, gen_idx0=g0,
                 temp=temp, topp=topp, topk=topk,
                 use_kernel=use_kernel_decode, topo=topo),
-            donate_argnums=(4,))
-        self._prefill_jit = jax.jit(
+            donate_argnums=(4,)))
+        self._prefill_jit = watchdog.watch("prefill", jax.jit(
             lambda p, ids, n, c, b, o: paged_prefill(
                 cfg, p, ids, n, c, b, o,
                 use_kernel=use_kernel, topo=topo),
-            donate_argnums=(3,))
-        self._continue_jit = jax.jit(
+            donate_argnums=(3,)))
+        self._continue_jit = watchdog.watch("continue", jax.jit(
             lambda p, ids, s, n, c, b, o, t: paged_continue(
                 cfg, p, ids, s, n, c, b, o, t, sm.block_size, topo=topo),
-            donate_argnums=(4,))
+            donate_argnums=(4,)))
         # speculative verification: greedy ids for a static window of
         # fed positions from one fused continuation pass (prompt-lookup
         # decoding); one compiled program per window size
@@ -214,14 +219,23 @@ class InferenceEngineV2:
 
         def _spec_jit(window: int):
             if window not in self._continue_spec_jits:
-                self._continue_spec_jits[window] = jax.jit(
-                    lambda p, ids, s, n, c, b, o, t: paged_continue(
-                        cfg, p, ids, s, n, c, b, o, t, sm.block_size,
-                        topo=topo, greedy_window=window),
-                    donate_argnums=(4,))
+                self._continue_spec_jits[window] = watchdog.watch(
+                    f"spec_verify_w{window}", jax.jit(
+                        lambda p, ids, s, n, c, b, o, t: paged_continue(
+                            cfg, p, ids, s, n, c, b, o, t, sm.block_size,
+                            topo=topo, greedy_window=window),
+                        donate_argnums=(4,)))
             return self._continue_spec_jits[window]
 
         self._spec_jit = _spec_jit
+        try:  # HBM accounting (telemetry/memory.py): the two big
+            # long-lived buffers every decode program references
+            ds_memory.record_buffer("kv_pool",
+                                    ds_memory.tree_bytes(self.kv_cache))
+            ds_memory.record_buffer("params",
+                                    ds_memory.tree_bytes(self.params))
+        except Exception:  # accounting must never block serving
+            pass
         log_dist(
             f"ragged inference engine: blocks={sm.num_blocks}x"
             f"{sm.block_size} max_seqs={sm.max_tracked_sequences} tp={tp}"
@@ -344,9 +358,10 @@ class InferenceEngineV2:
         table = np.full(C, NULL_BLOCK, np.int32)
         valid = positions < n
         table[valid] = np.asarray(seq.blocks, np.int32)[block_idx[valid]]
-        logits, self.kv_cache = self._prefill_jit(
-            self.params, jnp.asarray(ids), jnp.asarray(n), self.kv_cache,
-            jnp.asarray(table), jnp.asarray(offs))
+        with trace.span("prefill", uid=int(uid), tokens=int(n)):
+            logits, self.kv_cache = self._prefill_jit(
+                self.params, jnp.asarray(ids), jnp.asarray(n),
+                self.kv_cache, jnp.asarray(table), jnp.asarray(offs))
         seq.seen_tokens = n
         if sm.config.enable_prefix_caching:
             seq.token_log.extend(map(int, tokens))
@@ -378,10 +393,12 @@ class InferenceEngineV2:
         full_table = sm.block_table_for(uid)
         jit_fn = (self._spec_jit(all_logits) if all_logits
                   else self._continue_jit)
-        logits, self.kv_cache = jit_fn(
-            self.params, jnp.asarray(ids), jnp.asarray(start),
-            jnp.asarray(n), self.kv_cache, jnp.asarray(table),
-            jnp.asarray(offs), jnp.asarray(full_table))
+        with trace.span("continue", uid=int(uid), tokens=int(n),
+                        spec=bool(all_logits)):
+            logits, self.kv_cache = jit_fn(
+                self.params, jnp.asarray(ids), jnp.asarray(start),
+                jnp.asarray(n), self.kv_cache, jnp.asarray(table),
+                jnp.asarray(offs), jnp.asarray(full_table))
         seq.seen_tokens = start + n
         if sm.config.enable_prefix_caching:
             seq.token_log.extend(map(int, tokens))
@@ -578,7 +595,8 @@ class InferenceEngineV2:
                        extract) -> Dict[int, object]:
         sm = self.state_manager
         t0 = time.perf_counter()
-        with trace.span("decode_step", batch=len(uids)):
+        with trace.span("decode_step", batch=len(uids),
+                        uids=[int(u) for u in uids]):
             toks, pos, tables, active = self._build_decode_inputs(uids,
                                                                   tokens)
             vals, self.kv_cache = jit_fn(
@@ -652,7 +670,8 @@ class InferenceEngineV2:
         sm = self.state_manager
         t0 = time.perf_counter()
         with trace.span("decode_window", batch=len(uids),
-                        window=self.decode_window):
+                        window=self.decode_window,
+                        uids=[int(u) for u in uids]):
             # block pre-allocation contract: every block row i can write
             # during its steps_left[i] steps is allocated HERE, so the
             # device loop never needs the host mid-window (block-table
@@ -794,6 +813,56 @@ class InferenceEngineV2:
         self._draft_index.pop(uid, None)
         self.state_manager.flush_sequence(uid)
         self._update_pool_telemetry()
+
+    # ------------------------------------------------------------------
+    # Device-memory accounting (telemetry/memory.py; chip-free)
+    # ------------------------------------------------------------------
+    def memory_report(self, batch: int = 1) -> Dict[str, object]:
+        """AOT compile-and-analyze the serving hot-path programs —
+        per-token decode, the fused window (when ``decode_window`` > 1)
+        and one prefill chunk — at the bucket shapes a ``batch``-row
+        step uses, with the FULL block-table width (the worst-case
+        program a long sequence pays). Publishes peak/argument/temp
+        bytes per program and returns ``{"programs", "buffers", "flops"
+        per program}``. Runs chip-free: the compiler is a host library,
+        so OOM forensics and the perf gate never need a TPU.
+
+        Analysis compiles are NOT watchdog events — they never run on
+        the serving path."""
+        sm = self.state_manager
+        N = self._decode_bucket(max(int(batch), 1))
+        MB = sm.max_blocks_per_seq
+
+        def sds(x):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                        sharding=getattr(x, "sharding",
+                                                         None))
+
+        def i32(*shape):
+            return jax.ShapeDtypeStruct(shape, jnp.int32)
+
+        params = jax.tree.map(sds, self.params)
+        cache = jax.tree.map(sds, self.kv_cache)
+        toks, pos, tables = i32(N), i32(N), i32(N, MB)
+        programs: Dict[str, dict] = {}
+        compiled = self._decode_tok_jit.lower(
+            params, toks, pos, tables, cache,
+            jax.ShapeDtypeStruct((N,), jnp.bool_)).compile()
+        programs["decode_greedy"] = ds_memory.record_memory_analysis(
+            "decode_greedy", compiled)
+        if self.decode_window > 1:
+            compiled = self._fused_greedy_jit.lower(
+                params, toks, pos, tables, cache, i32(N), i32(N)).compile()
+            programs["decode_window_greedy"] = \
+                ds_memory.record_memory_analysis("decode_window_greedy",
+                                                 compiled)
+        C = self._bucket(self.config.prefill_bucket)
+        compiled = self._prefill_jit.lower(
+            params, i32(1, C), jax.ShapeDtypeStruct((), jnp.int32), cache,
+            i32(C), i32(C)).compile()
+        programs["prefill"] = ds_memory.record_memory_analysis(
+            "prefill", compiled)
+        return {"programs": programs, "buffers": ds_memory.buffers()}
 
     # convenience: serve-style generation over the ragged engine
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int,

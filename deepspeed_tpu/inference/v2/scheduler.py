@@ -30,6 +30,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ...telemetry import trace
+
 
 @dataclass
 class _Request:
@@ -52,6 +54,13 @@ class _Request:
     # streaming hook (the async serving runtime, serve/): called as
     # on_token(uid, token, finished) from inside step()
     on_token: Optional[Callable[[int, int, bool], None]] = None
+    # timeline anchors (telemetry/timeline.py request lifeline). These are
+    # ALWAYS perf_counter stamps — submit_t/finish_t follow the
+    # scheduler's injectable clock (tests fake it), and a fake timestamp
+    # must never leak into the shared trace buffer's time base.
+    t_submit_pc: float = 0.0
+    t_prefill_pc: Optional[float] = None
+    t_first_tok_pc: Optional[float] = None
 
     def pick(self, logits_row: np.ndarray) -> int:
         from .sampling import host_sample
@@ -170,7 +179,8 @@ class DynamicSplitFuseScheduler:
         req = _Request(uid, list(map(int, prompt)), max_new_tokens,
                        eos_token_id, self.clock(),
                        temperature=temperature, top_p=top_p, top_k=top_k,
-                       rng=np.random.default_rng(seed), on_token=on_token)
+                       rng=np.random.default_rng(seed), on_token=on_token,
+                       t_submit_pc=time.perf_counter())
         self._all[uid] = req
         self._queue.append(req)
         self._m_submitted.inc()
@@ -197,6 +207,10 @@ class DynamicSplitFuseScheduler:
             return False
         req.cancelled = True
         req.next_token = None
+        now_pc = time.perf_counter()
+        t0 = req.t_submit_pc or now_pc
+        trace.record("request", t0, now_pc - t0, uid=req.uid,
+                     tokens=len(req.generated), status="cancelled")
         if req in self._running:
             self._running.remove(req)
         if req in self._queue:
@@ -220,6 +234,13 @@ class DynamicSplitFuseScheduler:
     # ------------------------------------------------------------------
     def _finish(self, req: _Request) -> None:
         req.finish_t = self.clock()
+        now_pc = time.perf_counter()
+        start = req.t_first_tok_pc or now_pc
+        trace.record("request_decode", start, now_pc - start,
+                     uid=req.uid, tokens=len(req.generated))
+        t0 = req.t_submit_pc or start
+        trace.record("request", t0, now_pc - t0, uid=req.uid,
+                     tokens=len(req.generated), status="completed")
         self.engine.flush(req.uid)
         if req in self._running:
             self._running.remove(req)
@@ -299,6 +320,13 @@ class DynamicSplitFuseScheduler:
                 break  # KV pool full: wait for a running seq to finish
             if req.prefill_sent == 0:
                 new_admitted += 1
+            if req.t_prefill_pc is None:
+                # first prefill chunk composed: the queue phase of the
+                # request's timeline lifeline ends here
+                req.t_prefill_pc = time.perf_counter()
+                trace.record("request_queue", req.t_submit_pc,
+                             req.t_prefill_pc - req.t_submit_pc,
+                             uid=req.uid)
             uids.append(req.uid)
             toks.append(piece)
             req.prefill_sent += take
@@ -388,6 +416,11 @@ class DynamicSplitFuseScheduler:
                 # final prompt chunk: its last-token logits yield the
                 # first generated token (TTFT is measured here)
                 req.first_token_t = now
+                req.t_first_tok_pc = time.perf_counter()
+                start = req.t_prefill_pc or req.t_first_tok_pc
+                trace.record("request_prefill", start,
+                             req.t_first_tok_pc - start, uid=req.uid,
+                             prompt_tokens=len(req.prompt))
                 self._queue.remove(req)
                 if req.max_new_tokens <= 0:
                     self._finish(req)

@@ -17,6 +17,12 @@ Built on stdlib ``asyncio.start_server`` — no web framework. Endpoints:
   * ``GET /metrics`` — Prometheus text exposition rendered from the
     telemetry registry (queue depth, admission rejections, TTFT/TPOT
     histograms, ... — see docs/TELEMETRY.md).
+  * ``GET /debug/timeline[?uid=N]`` — the telemetry span ring buffer as
+    Chrome-trace-event JSON (load in chrome://tracing or Perfetto);
+    ``uid`` filters to one request's lifeline (queue -> prefill ->
+    decode windows -> finish). See docs/PROFILING.md.
+  * ``GET /statusz`` — one-call forensics snapshot: runtime health plus
+    the recompile-watchdog rollup and the device-memory report.
 
 Overload maps to ``429`` with the admission reason; malformed requests
 to ``400``; unknown routes to ``404``.
@@ -108,13 +114,17 @@ class ServingAPI:
                 _json_response(writer, "400 Bad Request",
                                {"error": "malformed request"})
                 return
-            target = target.split("?", 1)[0]
+            target, _, query = target.partition("?")
             if method == "GET" and target == "/healthz":
                 _json_response(writer, "200 OK", self.serving.health())
             elif method == "GET" and target == "/metrics":
                 writer.write(_response_head(
                     "200 OK", "text/plain; version=0.0.4; charset=utf-8")
                     + self.registry.render_prometheus().encode())
+            elif method == "GET" and target == "/debug/timeline":
+                self._timeline(writer, query)
+            elif method == "GET" and target == "/statusz":
+                _json_response(writer, "200 OK", self._statusz())
             elif method == "POST" and target == "/generate":
                 await self._generate(reader, writer, body)
             else:
@@ -129,6 +139,36 @@ class ServingAPI:
             except (OSError, RuntimeError):
                 pass
             writer.close()
+
+    def _timeline(self, writer, query: str) -> None:
+        """Chrome-trace JSON of the span ring buffer (``?uid=N`` filters
+        to one request's correlated spans)."""
+        from urllib.parse import parse_qs
+
+        from ....telemetry import timeline
+        from ....telemetry import trace as ds_trace
+        spans = ds_trace.export()
+        try:
+            uid = parse_qs(query).get("uid")
+            if uid:
+                spans = timeline.request_spans(int(uid[0]), spans)
+        except (TypeError, ValueError):
+            _json_response(writer, "400 Bad Request",
+                           {"error": "uid must be an integer"})
+            return
+        _json_response(writer, "200 OK", timeline.to_chrome_trace(spans))
+
+    def _statusz(self) -> dict:
+        from ....telemetry import memory as ds_memory
+        from ....telemetry import watchdog
+        return {
+            "health": self.serving.health(),
+            "compile": {"programs": watchdog.summary(),
+                        "steady_state": watchdog.is_steady(),
+                        "recent_events": len(watchdog.events())},
+            "memory": ds_memory.oom_report(),
+            "metric_families": len(self.registry.families()),
+        }
 
     async def _generate(self, reader, writer, body: bytes) -> None:
         # coerce every field up front: an unchecked value (e.g.

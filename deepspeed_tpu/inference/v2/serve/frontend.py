@@ -156,7 +156,10 @@ class ServingEngine:
     """
 
     def __init__(self, engine, config: Optional[ServingConfig] = None,
-                 clock=time.perf_counter):
+                 clock=time.perf_counter, bridge=None):
+        """``bridge``: optional :class:`~...telemetry.TelemetryBridge`;
+        the loop final-flushes (``close()``) it on drain/stop so the last
+        partial flush interval reaches the monitor backends."""
         self.config = config or ServingConfig()
         self.clock = clock
         self.scheduler = DynamicSplitFuseScheduler(
@@ -166,7 +169,8 @@ class ServingEngine:
         self._loop_runner = ServingLoop(
             self.scheduler, self.admission,
             max_inflight=self.config.max_inflight,
-            idle_wait_s=self.config.idle_wait_s, clock=clock)
+            idle_wait_s=self.config.idle_wait_s, clock=clock,
+            bridge=bridge)
         self._uids = itertools.count(1)
         self._stopped = False
 

@@ -32,9 +32,13 @@ class ServingLoop:
 
     def __init__(self, scheduler, admission, *,
                  max_inflight: Optional[int] = None,
-                 idle_wait_s: float = 0.002, clock=time.perf_counter):
+                 idle_wait_s: float = 0.002, clock=time.perf_counter,
+                 bridge=None):
         self.scheduler = scheduler
         self.admission = admission
+        # optional TelemetryBridge: final-flushed (close()) when the loop
+        # exits, so a drain's last partial flush interval isn't dropped
+        self.bridge = bridge
         sm = scheduler.engine.state_manager.config
         # cap on requests inside the scheduler at once; the admission
         # queue (bounded) holds the rest
@@ -248,3 +252,8 @@ class ServingLoop:
             self._wake.clear()
         self._run_cmds()
         self._abort_remaining()
+        if self.bridge is not None:
+            try:  # drain/stop must end cleanly even if a backend throws
+                self.bridge.close()
+            except Exception:
+                pass

@@ -1237,10 +1237,34 @@ class DeepSpeedTpuEngine:
         lowered = self._train_step.lower(
             self.params, self.master_params, self.opt_state,
             self.scale_state, self._step_arr, self._model_rng, dev_batch)
+        t0 = time.perf_counter()
         compiled = (lowered.compile(compiler_options=compiler_options)
                     if compiler_options else lowered.compile())
         self._record_comm_overlap(compiled)
+        self._record_train_forensics(compiled, time.perf_counter() - t0)
         return compiled
+
+    def _record_train_forensics(self, compiled, compile_s: float):
+        """Feed the performance-forensics subsystem from an AOT-compiled
+        train step: the compile event (watchdog counters) and the
+        program's device-memory/cost analysis plus the big long-lived
+        buffers (telemetry/memory.py gauges + oom_report). Best-effort —
+        forensics must never break AOT analysis."""
+        if not getattr(self, "telemetry_enabled", False):
+            return
+        try:
+            from ..telemetry import memory as ds_memory
+            from ..telemetry import watchdog
+            watchdog.record_compile("train_step", compile_s,
+                                    analysis=True)
+            ds_memory.record_memory_analysis("train_step", compiled)
+            ds_memory.record_buffer(
+                "train_params", ds_memory.tree_bytes(self.params))
+            if self.opt_state is not None:
+                ds_memory.record_buffer(
+                    "optimizer_state", ds_memory.tree_bytes(self.opt_state))
+        except Exception as e:  # pragma: no cover - diagnostics only
+            logger.debug(f"train-step forensics skipped: {e}")
 
     def _record_comm_overlap(self, compiled):
         """Feed ``training_comm_exposed_fraction`` from the compiled step's
@@ -1284,25 +1308,31 @@ class DeepSpeedTpuEngine:
                     "curriculum_learning is enabled but the batch is not a "
                     "dict of named fields; seqlen truncation is SKIPPED — "
                     "feed dict batches (or disable the curriculum block)")
-        dev_batch = self._shard_batch(batch)
         from ..telemetry import trace
+        # step-phase spans (timeline.py): data sharding, the async device
+        # dispatch, and the host sync that blocks on the compiled step —
+        # the host-side split of a training step's wall time
+        with trace.span("train_data", step=self.global_steps):
+            dev_batch = self._shard_batch(batch)
         self.tput_timer.start()
         with trace.span("train_step", step=self.global_steps):
-            if self.param_offload_nvme:
-                metrics = self._train_batch_infinity(dev_batch)
-            elif self.offload_device:
-                metrics = self._train_batch_offloaded(dev_batch)
-            else:
-                (self.params, self.master_params, self.opt_state,
-                 self.scale_state, self._step_arr, self._model_rng,
-                 metrics) = self._train_step(
-                    self.params, self.master_params, self.opt_state,
-                    self.scale_state, self._step_arr, self._model_rng,
-                    dev_batch)
-            self._relocate_params_to_storage()
+            with trace.span("train_device_dispatch"):
+                if self.param_offload_nvme:
+                    metrics = self._train_batch_infinity(dev_batch)
+                elif self.offload_device:
+                    metrics = self._train_batch_offloaded(dev_batch)
+                else:
+                    (self.params, self.master_params, self.opt_state,
+                     self.scale_state, self._step_arr, self._model_rng,
+                     metrics) = self._train_step(
+                        self.params, self.master_params, self.opt_state,
+                        self.scale_state, self._step_arr, self._model_rng,
+                        dev_batch)
+                self._relocate_params_to_storage()
             # the loss fetch blocks on the async-dispatched device step, so
             # it belongs inside the span/timer (XLA programs complete here)
-            loss = float(metrics["loss"])
+            with trace.span("train_host_sync"):
+                loss = float(metrics["loss"])
         # Host bookkeeping mirrors the device counter: the compiled step
         # leaves ``_step_arr`` un-advanced on fp16 overflow, so the host
         # step count and the LR schedule must hold too (reference skips the
@@ -1820,6 +1850,12 @@ class DeepSpeedTpuEngine:
     # ------------------------------------------------------------------
     def destroy(self):
         """Release host-side resources (reference engine.py destroy)."""
+        if getattr(self, "telemetry_bridge", None) is not None:
+            try:  # final flush: metrics since the last cadence boundary
+                # would otherwise never reach the monitor backends
+                self.telemetry_bridge.close(self.global_steps)
+            except Exception:
+                pass
         try:
             self._join_pending_saves()  # may raise a failed async write
         finally:

@@ -23,9 +23,10 @@ accepts). See docs/TELEMETRY.md for the metrics catalog.
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry, set_registry)
 from .bridge import TelemetryBridge
-from . import trace
+from . import memory, timeline, trace, watchdog
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "get_registry", "set_registry", "TelemetryBridge", "trace",
+    "timeline", "watchdog", "memory",
 ]

@@ -1,0 +1,181 @@
+"""Device-memory accounting from XLA's own numbers.
+
+XLA already knows every program's device footprint
+(``compiled.memory_analysis()``: argument / output / temp / code bytes)
+— this module publishes it, chip-free, as registry gauges plus a
+one-call OOM-forensics report, instead of leaving it buried in
+``benchmarks/aot_scale.py``.
+
+Two record kinds:
+
+  * **programs** — :func:`record_memory_analysis` extracts an
+    AOT-compiled program's memory stats (and its cost analysis: flops /
+    bytes accessed, the MFU inputs) into
+    ``xla_program_{peak,argument,temp,output}_bytes{program=...}``
+    gauges. ``runtime.engine.lower_train_step`` records the train step;
+    ``InferenceEngineV2.memory_report()`` AOT-lowers the decode/prefill
+    programs at representative bucket shapes (no chip needed — the
+    compiler runs on the host).
+  * **buffers** — :func:`record_buffer` publishes long-lived allocations
+    the programs reference (KV pool, weights, optimizer state) as
+    ``device_buffer_bytes{buffer=...}``.
+
+:func:`oom_report` ranks both and names the largest — the first thing to
+read after a RESOURCE_EXHAUSTED (docs/PROFILING.md, "Triaging OOMs").
+"""
+
+import threading
+from typing import Any, Dict, Optional
+
+from .registry import get_registry
+
+_lock = threading.Lock()
+_programs: Dict[str, Dict[str, Any]] = {}
+_buffers: Dict[str, int] = {}
+
+_MEM_FIELDS = ("argument_size_in_bytes", "output_size_in_bytes",
+               "temp_size_in_bytes", "alias_size_in_bytes",
+               "generated_code_size_in_bytes")
+
+
+def _gauges():
+    reg = get_registry()
+    return {
+        "peak": reg.gauge("xla_program_peak_bytes",
+                          "arguments + temps + code of a compiled "
+                          "program (donated inputs alias outputs)",
+                          unit="bytes", labelnames=("program",)),
+        "argument": reg.gauge("xla_program_argument_bytes",
+                              "argument bytes of a compiled program",
+                              unit="bytes", labelnames=("program",)),
+        "temp": reg.gauge("xla_program_temp_bytes",
+                          "temp/scratch bytes of a compiled program",
+                          unit="bytes", labelnames=("program",)),
+        "output": reg.gauge("xla_program_output_bytes",
+                            "output bytes of a compiled program",
+                            unit="bytes", labelnames=("program",)),
+    }
+
+
+def cost_analysis_dict(compiled) -> Dict[str, float]:
+    """``compiled.cost_analysis()`` normalized to a plain dict (older
+    jax returns ``[dict]``) — the ONE copy of this shim; bench.py and
+    the perf gate share it."""
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca or {})
+
+
+def record_memory_analysis(program: str, compiled) -> Dict[str, Any]:
+    """Extract ``compiled.memory_analysis()`` (+ ``cost_analysis()``)
+    into gauges and the program table; returns the record."""
+    ma = compiled.memory_analysis()
+    rec: Dict[str, Any] = {k: int(getattr(ma, k)) for k in _MEM_FIELDS
+                           if hasattr(ma, k)}
+    # donated inputs alias outputs, so peak live state is args + temps
+    # (+ the program text itself) — the aot_scale.py convention
+    rec["peak_bytes"] = (rec.get("argument_size_in_bytes", 0)
+                         + rec.get("temp_size_in_bytes", 0)
+                         + rec.get("generated_code_size_in_bytes", 0))
+    try:
+        ca = cost_analysis_dict(compiled)
+        rec["flops"] = float(ca.get("flops", 0.0))
+        rec["bytes_accessed"] = float(ca.get("bytes accessed", 0.0))
+    except Exception:  # cost analysis is a bonus, never a blocker
+        pass
+    g = _gauges()
+    g["peak"].labels(program=program).set(rec["peak_bytes"])
+    g["argument"].labels(program=program).set(
+        rec.get("argument_size_in_bytes", 0))
+    g["temp"].labels(program=program).set(rec.get("temp_size_in_bytes", 0))
+    g["output"].labels(program=program).set(
+        rec.get("output_size_in_bytes", 0))
+    with _lock:
+        _programs[program] = dict(rec)
+    return rec
+
+
+def tree_bytes(tree) -> int:
+    """Total bytes of a pytree of arrays (KV cache, params, opt state)."""
+    import jax
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None and hasattr(leaf, "shape"):
+            import numpy as np
+            nbytes = int(np.prod(leaf.shape)) * leaf.dtype.itemsize
+        total += int(nbytes or 0)
+    return total
+
+
+def record_buffer(name: str, nbytes: int) -> None:
+    """Publish a long-lived device allocation (KV pool, weights, ...)."""
+    get_registry().gauge(
+        "device_buffer_bytes",
+        "long-lived device allocations (KV pool, weights, optimizer "
+        "state)", unit="bytes", labelnames=("buffer",)).labels(
+        buffer=name).set(int(nbytes))
+    with _lock:
+        _buffers[name] = int(nbytes)
+
+
+def programs() -> Dict[str, Dict[str, Any]]:
+    with _lock:
+        return {k: dict(v) for k, v in _programs.items()}
+
+
+def buffers() -> Dict[str, int]:
+    with _lock:
+        return dict(_buffers)
+
+
+def reset() -> None:
+    with _lock:
+        _programs.clear()
+        _buffers.clear()
+
+
+def oom_report(top: int = 5) -> Dict[str, Any]:
+    """One-call OOM forensics: programs by peak bytes and buffers by
+    size, largest first, plus the headline culprit."""
+    all_buffers = buffers()
+    progs = sorted(
+        ({"program": name, **rec} for name, rec in programs().items()),
+        key=lambda r: -r.get("peak_bytes", 0))[:top]
+    bufs = sorted(({"buffer": name, "bytes": b}
+                   for name, b in all_buffers.items()),
+                  key=lambda r: -r["bytes"])[:top]
+    rep: Dict[str, Any] = {
+        "programs": progs,
+        "buffers": bufs,
+        # the total covers EVERY recorded buffer, not just the top-N
+        # shown — a truncated "total" would mislead the OOM triage
+        "total_buffer_bytes": sum(all_buffers.values()),
+    }
+    if progs:
+        rep["largest_program"] = progs[0]["program"]
+        rep["largest_program_peak_bytes"] = progs[0].get("peak_bytes", 0)
+    if bufs:
+        rep["largest_buffer"] = bufs[0]["buffer"]
+        rep["largest_buffer_bytes"] = bufs[0]["bytes"]
+    return rep
+
+
+def format_oom_report(rep: Optional[Dict[str, Any]] = None) -> str:
+    """Human-readable :func:`oom_report` (what to paste into an OOM
+    issue)."""
+    rep = rep or oom_report()
+    lines = ["device-memory forensics (largest first):", "  programs:"]
+    for p in rep["programs"]:
+        lines.append(
+            f"    {p['program']:<24} peak={p.get('peak_bytes', 0) / 2**20:8.1f} MiB "
+            f"(args={p.get('argument_size_in_bytes', 0) / 2**20:.1f} "
+            f"temps={p.get('temp_size_in_bytes', 0) / 2**20:.1f})")
+    lines.append("  buffers:")
+    for b in rep["buffers"]:
+        lines.append(f"    {b['buffer']:<24} {b['bytes'] / 2**20:8.1f} MiB")
+    if not rep["programs"] and not rep["buffers"]:
+        lines.append("    (nothing recorded yet — run memory_report() "
+                     "or lower_train_step first)")
+    return "\n".join(lines)

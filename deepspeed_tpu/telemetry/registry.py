@@ -230,6 +230,15 @@ class MetricsRegistry:
     def get(self, name: str) -> Optional[_Family]:
         return self._families.get(name)
 
+    def family_total(self, name: str) -> float:
+        """Sum of every series of a (possibly labeled) family; 0.0 when
+        the family doesn't exist (benches/gates summing labeled
+        counters like the watchdog's per-program series)."""
+        fam = self._families.get(name)
+        if fam is None:
+            return 0.0
+        return sum(s.value for _, s in fam.series())
+
     def families(self) -> List[_Family]:
         return list(self._families.values())
 

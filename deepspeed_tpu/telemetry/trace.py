@@ -7,6 +7,15 @@ production.
 ``export()`` drains a copy for offline analysis; ``durations(name)``
 feeds assertions and benchmarks.
 
+Every span also carries a process-unique ``id``, the ``parent`` id of
+the enclosing span (None at top level), and a ``track`` — by default the
+recording thread's name, overridable with :func:`set_track` — so the
+ring buffer reconstructs into per-thread timelines
+(:mod:`deepspeed_tpu.telemetry.timeline` exports them as Chrome trace
+events). :func:`record` appends a RETROACTIVE span from saved
+timestamps (e.g. a request's queue wait, measured between two scheduler
+events rather than around a ``with`` block).
+
 ``enable_xla_annotations(True)`` mirrors every span into a
 ``jax.profiler.TraceAnnotation`` so spans line up with device activity
 in a TensorBoard/XProf trace captured via
@@ -14,6 +23,7 @@ in a TensorBoard/XProf trace captured via
 absent/failed jax.profiler leaves spans host-only).
 """
 
+import itertools
 import threading
 import time
 from collections import deque
@@ -26,6 +36,7 @@ _lock = threading.Lock()
 _buffer: deque = deque(maxlen=_DEFAULT_CAPACITY)
 _xla_annotations = False
 _local = threading.local()
+_ids = itertools.count(1)
 
 
 def enable_xla_annotations(on: bool = True) -> None:
@@ -42,11 +53,26 @@ def set_capacity(capacity: int) -> None:
         _buffer = deque(maxlen=int(capacity))
 
 
+def set_track(name: Optional[str]) -> None:
+    """Name this thread's timeline track (None restores the default —
+    the thread's own name). Tracks map to rows in the Chrome trace
+    export."""
+    _local.track = name
+
+
+def current_track() -> str:
+    track = getattr(_local, "track", None)
+    return track if track is not None else threading.current_thread().name
+
+
 @contextmanager
 def span(name: str, **attrs):
     """Record a wall-clock span; nests (depth reflects enclosing spans)."""
     depth = getattr(_local, "depth", 0)
+    parent = getattr(_local, "span_id", None)
+    span_id = next(_ids)
     _local.depth = depth + 1
+    _local.span_id = span_id
     annotation = None
     if _xla_annotations:
         try:
@@ -63,14 +89,35 @@ def span(name: str, **attrs):
         if annotation is not None:
             annotation.__exit__(None, None, None)
         _local.depth = depth
+        _local.span_id = parent
         rec = {"name": name, "start": start, "duration_s": dur,
-               "depth": depth}
+               "depth": depth, "id": span_id, "parent": parent,
+               "track": current_track()}
         if attrs:
             rec["attrs"] = attrs
         # under _lock: export() snapshots the deque while other threads
         # record, and set_capacity() swaps the buffer out entirely
         with _lock:
             _buffer.append(rec)
+
+
+def record(name: str, start: float, duration_s: float,
+           track: Optional[str] = None, **attrs) -> None:
+    """Append a retroactive span from saved ``perf_counter`` timestamps.
+
+    For phases whose boundaries are events rather than a ``with`` block
+    (a request's queue wait between submit and first prefill chunk, its
+    decode phase between first token and finish). Retroactive spans are
+    top-level (no parent) on ``track`` (default: the calling thread's
+    track)."""
+    rec = {"name": name, "start": float(start),
+           "duration_s": float(duration_s), "depth": 0, "id": next(_ids),
+           "parent": None,
+           "track": track if track is not None else current_track()}
+    if attrs:
+        rec["attrs"] = attrs
+    with _lock:
+        _buffer.append(rec)
 
 
 def export(name: Optional[str] = None) -> List[Dict]:

@@ -1,0 +1,314 @@
+#!/usr/bin/env python
+"""Chip-free perf-regression gate.
+
+Compares a set of STRUCTURAL performance metrics — numbers that are
+properties of the compiled programs and the scheduling logic, not of the
+machine's wall clock — against a committed baseline with per-metric
+tolerances, and exits non-zero on drift. Because every metric is
+compiler-derived (AOT cost/memory analysis, HLO scheduling analysis,
+host-sync and compile counters), the gate runs on any CPU host: perf
+drift fails like a unit test, before a chip ever sees the regression.
+
+Gated metrics (see ``collect()``):
+
+  * ``decode_host_syncs_per_token`` — device->host transfers per
+    generated token on the fused decode path (the PR-3 dispatch win;
+    1/K at window K).
+  * ``fused_decode_compile_events`` / ``steady_state_recompiles`` —
+    compile counts from the recompile watchdog: one program per bucket,
+    ZERO compiles after warmup.
+  * ``decode_window_flops_per_token`` / ``decode_window_peak_bytes`` —
+    XLA cost/memory analysis of the fused decode program.
+  * ``train_step_flops`` / ``train_step_bytes`` /
+    ``train_step_peak_bytes`` — the same for a dp8 ZeRO-2 train step on
+    the virtual 8-device CPU mesh.
+  * ``train_grad_exposed_collective_fraction`` — share of gradient
+    collectives the scheduler left without an overlap window
+    (utils/xla_profile.analyze_grad_exchange; the PR-4 regression
+    metric).
+
+Usage::
+
+  python scripts/perf_gate.py --collect                    # gate now
+  python scripts/perf_gate.py --collect --update           # re-baseline
+  python scripts/perf_gate.py --current current.json       # gate a file
+  python scripts/perf_gate.py --collect --out current.json # also save
+
+Baseline format (scripts/perf_baseline.json)::
+
+  {"metrics": {"<name>": {"value": <number>,
+               "direction": "max"|"min"|"both",   # which drift fails
+               "rel_tol": 0.2, "abs_tol": 0.0,    # allowed slack
+               "optional": false}}}               # skip when uncollected
+
+``direction: "max"`` means the metric must not EXCEED baseline + slack
+(lower is better: syncs, recompiles, bytes); ``"min"`` must not fall
+below (higher is better); ``"both"`` pins it from both sides (flops: a
+big move either way means the program changed materially).
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "perf_baseline.json")
+
+
+# ---------------------------------------------------------------------------
+# comparison
+# ---------------------------------------------------------------------------
+def compare(baseline: Dict[str, Any],
+            current: Dict[str, float]) -> List[str]:
+    """Failure messages (empty = gate passes). A baseline metric missing
+    from ``current`` fails unless marked optional — silently skipping a
+    metric is how gates rot."""
+    failures: List[str] = []
+    for name, spec in baseline.get("metrics", {}).items():
+        base = float(spec["value"])
+        rel = float(spec.get("rel_tol", 0.0))
+        abs_tol = float(spec.get("abs_tol", 0.0))
+        direction = spec.get("direction", "both")
+        if name not in current or current[name] is None:
+            if spec.get("optional"):
+                continue
+            failures.append(f"{name}: missing from current metrics "
+                            f"(baseline {base})")
+            continue
+        cur = float(current[name])
+        slack = abs(base) * rel + abs_tol
+        hi, lo = base + slack, base - slack
+        if direction in ("max", "both") and cur > hi:
+            failures.append(
+                f"{name}: {cur} exceeds baseline {base} + tolerance "
+                f"{slack:g} (limit {hi:g})")
+        if direction in ("min", "both") and cur < lo:
+            failures.append(
+                f"{name}: {cur} below baseline {base} - tolerance "
+                f"{slack:g} (limit {lo:g})")
+    return failures
+
+
+# ---------------------------------------------------------------------------
+# chip-free collection
+# ---------------------------------------------------------------------------
+def _ensure_cpu_mesh() -> None:
+    """Pin the CPU backend with 8 virtual devices BEFORE jax initializes
+    (the same harness tests/conftest.py uses); no-op when jax is already
+    initialized with enough devices."""
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8")
+        os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+def collect(seq_len: int = 64, new_tokens: int = 16,
+            decode_window: int = 8) -> Dict[str, float]:
+    """Run the chip-free collection: a tiny serving workload through the
+    real v2 engine (host syncs, compile counts, steady-state recompiles,
+    decode program cost/memory) and a tiny dp8 bucketed-overlap train
+    step AOT (grad exposed fraction, step cost/memory). Metrics are
+    isolated in a fresh registry and do not disturb the process
+    default."""
+    _ensure_cpu_mesh()
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    from deepspeed_tpu.models import TransformerConfig, TransformerLM
+    from deepspeed_tpu.telemetry import (MetricsRegistry, get_registry,
+                                         set_registry, watchdog)
+    from deepspeed_tpu.telemetry import memory as ds_memory
+
+    prev = set_registry(MetricsRegistry())
+    watchdog.reset()
+    ds_memory.reset()   # collect() must gate ITS programs, not stale or
+    # co-resident engines' records (and must not leave toy records behind)
+    metrics: Dict[str, float] = {}
+    try:
+        # -- serving side -------------------------------------------------
+        cfg = TransformerConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2,
+            max_seq_len=seq_len, remat=False, use_flash=False)
+        model = TransformerLM(cfg)
+        params = jax.tree.map(lambda x: x.astype(jnp.float32),
+                              model.init_params(jax.random.PRNGKey(0)))
+        eng = InferenceEngineV2(
+            model, RaggedInferenceEngineConfig(
+                state_manager=DSStateManagerConfig(
+                    max_tracked_sequences=8, max_seq_len=seq_len,
+                    num_blocks=65, block_size=16),
+                dtype="float32", prefill_bucket=16,
+                decode_window=decode_window),
+            params=params)
+        prompts = [[2, 4, 6, 8], [3, 5, 7]]
+        eng.generate(prompts, max_new_tokens=new_tokens)   # warmup
+        reg = get_registry()
+        fam_total = reg.family_total
+        base_syncs = fam_total("inference_decode_host_syncs_total")
+        base_toks = fam_total("inference_decode_tokens_total")
+        base_compiles = fam_total("xla_compile_events_total")
+        watchdog.mark_steady(True)
+        try:
+            eng.generate(prompts, max_new_tokens=new_tokens,
+                         uids=[10, 11])
+        finally:
+            watchdog.mark_steady(False)
+        syncs = fam_total("inference_decode_host_syncs_total") - base_syncs
+        toks = fam_total("inference_decode_tokens_total") - base_toks
+        metrics["decode_host_syncs_per_token"] = (syncs / toks if toks
+                                                  else 0.0)
+        metrics["steady_state_recompiles"] = fam_total(
+            "xla_steady_state_recompiles_total")
+        metrics["steady_state_compile_events"] = fam_total(
+            "xla_compile_events_total") - base_compiles
+        fused = [e for e in watchdog.events()
+                 if e["program"] == "decode_window_greedy"]
+        metrics["fused_decode_compile_events"] = float(len(fused))
+
+        rep = eng.memory_report(batch=len(prompts))
+        N = eng._decode_bucket(len(prompts))
+        prog = rep["programs"]["decode_window_greedy"]
+        metrics["decode_window_flops_per_token"] = (
+            prog.get("flops", 0.0) / (N * decode_window))
+        metrics["decode_window_peak_bytes"] = float(prog["peak_bytes"])
+        metrics["kv_pool_utilization_peak"] = reg.gauge(
+            "inference_kv_pool_utilization_peak").value
+
+        # -- training side: the REAL dp8 bucketed-overlap train step,
+        # AOT-compiled against a v5e:2x4 topology with the libtpu host
+        # compiler (the tests/unit/runtime/test_grad_overlap_aot.py
+        # pipeline — no chip; the CPU backend has no latency-hiding
+        # scheduler, so only this compile gives a meaningful exposed
+        # fraction). Skipped (metrics optional) when libtpu topology
+        # descriptions are unavailable on the host.
+        try:
+            from deepspeed_tpu.benchmarks import aot_scale
+            from deepspeed_tpu.utils.xla_profile import (
+                grad_exchange_report_from_compiled)
+            tcfg = TransformerConfig(
+                vocab_size=1024, hidden_size=256, intermediate_size=512,
+                num_layers=2, num_heads=4, max_seq_len=128,
+                use_flash=False, scan_unroll=2)
+            engine, batch = aot_scale.build_abstract_engine(
+                tcfg, {"train_micro_batch_size_per_gpu": 1,
+                       "bf16": {"enabled": True},
+                       "optimizer": {"type": "adamw",
+                                     "params": {"lr": 1e-3}},
+                       "zero_optimization": {
+                           "stage": 2, "overlap_comm": True,
+                           "overlap_grad_reduce": "bucketed",
+                           "reduce_bucket_size": 1 << 18}})
+            compiled = engine.lower_train_step(batch)
+            gx = grad_exchange_report_from_compiled(compiled)
+            metrics["train_grad_exposed_collective_fraction"] = \
+                gx.exposed_fraction
+            ca = ds_memory.cost_analysis_dict(compiled)
+            metrics["train_step_flops"] = float(ca.get("flops", 0.0))
+            metrics["train_step_bytes"] = float(
+                ca.get("bytes accessed", 0.0))
+            ma = ds_memory.programs().get("train_step", {})
+            if ma:
+                metrics["train_step_peak_bytes"] = float(
+                    ma["peak_bytes"])
+        except Exception as e:
+            print(f"perf_gate: training AOT metrics skipped: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+    finally:
+        watchdog.reset()
+        ds_memory.reset()
+        set_registry(prev)
+    return metrics
+
+
+# ---------------------------------------------------------------------------
+def make_baseline(metrics: Dict[str, float]) -> Dict[str, Any]:
+    """Baseline skeleton from collected metrics, with the default
+    tolerance policy (counts exact, fractions +0.05, sizes/flops 25%)."""
+    spec: Dict[str, Any] = {}
+    for name, value in metrics.items():
+        if name in ("steady_state_recompiles", "steady_state_compile_events",
+                    "fused_decode_compile_events"):
+            spec[name] = {"value": value, "direction": "max",
+                          "abs_tol": 0.0}
+        elif name == "decode_host_syncs_per_token":
+            spec[name] = {"value": value, "direction": "max",
+                          "rel_tol": 0.01}
+        elif name.endswith("fraction") or name.endswith("peak"):
+            spec[name] = {"value": value, "direction": "max",
+                          "abs_tol": 0.05, "optional": "train" in name}
+        else:
+            spec[name] = {"value": value, "direction": "both",
+                          "rel_tol": 0.25, "optional": "train" in name}
+    return {"metrics": spec}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="perf_gate", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--current", default=None,
+                    help="JSON file of current metrics (skip collection)")
+    ap.add_argument("--collect", action="store_true",
+                    help="run the chip-free collection for the current "
+                         "metrics")
+    ap.add_argument("--out", default=None,
+                    help="write the current metrics JSON here")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the baseline from the current metrics "
+                         "(tolerance policy re-derived) instead of gating")
+    args = ap.parse_args(argv)
+
+    if args.current:
+        with open(args.current) as fh:
+            current = json.load(fh)
+        current = current.get("metrics", current)
+    elif args.collect or args.update:
+        current = collect()
+    else:
+        ap.error("need --collect or --current FILE")
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump({"metrics": current}, fh, indent=2, sort_keys=True)
+
+    if args.update:
+        with open(args.baseline, "w") as fh:
+            json.dump(make_baseline(current), fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"perf_gate: baseline rewritten at {args.baseline}")
+        return 0
+
+    with open(args.baseline) as fh:
+        baseline = json.load(fh)
+    failures = compare(baseline, current)
+    for name in sorted(current):
+        print(f"perf_gate: {name} = {current[name]}")
+    if failures:
+        for f in failures:
+            print(f"perf_gate: FAIL {f}", file=sys.stderr)
+        print(f"perf_gate: {len(failures)} metric(s) drifted past "
+              f"tolerance", file=sys.stderr)
+        return 1
+    print(f"perf_gate: OK ({len(baseline.get('metrics', {}))} metrics "
+          f"within tolerance)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

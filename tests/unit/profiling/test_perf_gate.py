@@ -1,0 +1,115 @@
+"""Chip-free perf-regression gate (scripts/perf_gate.py): tolerance
+semantics, drift detection, and the end-to-end collect-and-compare run
+against the committed baseline — perf drift fails like a unit test."""
+
+import importlib.util
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+_SCRIPTS = pathlib.Path(__file__).resolve().parents[3] / "scripts"
+
+
+@pytest.fixture(scope="module")
+def perf_gate():
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", _SCRIPTS / "perf_gate.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    with open(_SCRIPTS / "perf_baseline.json") as fh:
+        return json.load(fh)
+
+
+# -- comparison semantics ---------------------------------------------------
+def test_compare_within_tolerance_passes(perf_gate):
+    base = {"metrics": {
+        "syncs": {"value": 0.125, "direction": "max", "rel_tol": 0.01},
+        "flops": {"value": 1000.0, "direction": "both", "rel_tol": 0.2},
+        "tput": {"value": 50.0, "direction": "min", "rel_tol": 0.1},
+    }}
+    assert perf_gate.compare(base, {"syncs": 0.125, "flops": 1100.0,
+                                    "tput": 60.0}) == []
+
+
+def test_compare_flags_each_drift_direction(perf_gate):
+    base = {"metrics": {
+        "syncs": {"value": 0.125, "direction": "max", "rel_tol": 0.0},
+        "flops": {"value": 1000.0, "direction": "both", "rel_tol": 0.1},
+        "tput": {"value": 50.0, "direction": "min", "rel_tol": 0.1},
+    }}
+    fails = perf_gate.compare(base, {"syncs": 0.5,      # worse (higher)
+                                     "flops": 1500.0,   # big move
+                                     "tput": 30.0})     # worse (lower)
+    assert len(fails) == 3
+    assert any("syncs" in f for f in fails)
+    # improving a direction=max metric is NOT a failure
+    assert perf_gate.compare(base, {"syncs": 0.01, "flops": 1000.0,
+                                    "tput": 55.0}) == []
+
+
+def test_compare_missing_metric_fails_unless_optional(perf_gate):
+    base = {"metrics": {
+        "required": {"value": 1.0, "direction": "max"},
+        "extra": {"value": 1.0, "direction": "max", "optional": True},
+    }}
+    fails = perf_gate.compare(base, {})
+    assert len(fails) == 1 and "required" in fails[0]
+
+
+def test_zero_tolerance_counters_fail_on_any_increase(perf_gate,
+                                                      baseline):
+    """The committed baseline pins steady-state recompiles at ZERO with
+    zero tolerance: a single recompile drifts the gate red."""
+    spec = baseline["metrics"]["steady_state_recompiles"]
+    assert spec["value"] == 0 and spec["direction"] == "max"
+    current = {name: m["value"] for name, m in baseline["metrics"].items()}
+    assert perf_gate.compare(baseline, current) == []
+    current["steady_state_recompiles"] = 1
+    fails = perf_gate.compare(baseline, current)
+    assert len(fails) == 1 and "steady_state_recompiles" in fails[0]
+
+
+# -- end-to-end: collect on this host, gate against the committed baseline --
+def test_gate_end_to_end_chip_free(perf_gate, baseline):
+    """The real gate: run the chip-free collection (tiny serving
+    workload through the v2 engine + dp8 AOT train step) and compare it
+    to the committed baseline. This is what fails when someone regresses
+    host-syncs/token, bucketing, program footprints, or grad overlap."""
+    current = perf_gate.collect()
+    fails = perf_gate.compare(baseline, current)
+    assert fails == [], f"perf gate drifted: {fails}\ncurrent={current}"
+    # the collection measured the real thing, not defaults
+    assert 0 < current["decode_host_syncs_per_token"] <= 0.125
+    assert current["steady_state_recompiles"] == 0
+    assert current["decode_window_flops_per_token"] > 0
+
+
+def test_gate_cli_fails_on_injected_drift(tmp_path):
+    """CLI contract: rc=0 on matching metrics, rc=1 on drift (what CI
+    keys off)."""
+    base = {"metrics": {"m": {"value": 1.0, "direction": "max",
+                              "abs_tol": 0.0}}}
+    bpath = tmp_path / "base.json"
+    bpath.write_text(json.dumps(base))
+    cur_ok = tmp_path / "ok.json"
+    cur_ok.write_text(json.dumps({"metrics": {"m": 1.0}}))
+    cur_bad = tmp_path / "bad.json"
+    cur_bad.write_text(json.dumps({"metrics": {"m": 2.0}}))
+    gate = str(_SCRIPTS / "perf_gate.py")
+    ok = subprocess.run([sys.executable, gate, "--baseline", str(bpath),
+                         "--current", str(cur_ok)],
+                        capture_output=True, text=True)
+    assert ok.returncode == 0, ok.stderr
+    bad = subprocess.run([sys.executable, gate, "--baseline", str(bpath),
+                          "--current", str(cur_bad)],
+                         capture_output=True, text=True)
+    assert bad.returncode == 1
+    assert "FAIL" in bad.stderr

@@ -219,6 +219,31 @@ def test_train_telemetry_respects_flush_interval(tmp_path, fresh_registry):
     assert [float(r[1]) for r in rows[1:]] == [2, 4]
 
 
+def test_engine_destroy_final_flushes_bridge(tmp_path, fresh_registry):
+    """destroy() closes the TelemetryBridge: metrics from the last
+    partial flush interval reach the CSV backend instead of being
+    dropped with the engine."""
+    from tests.unit.simple_model import SimpleModel, base_config
+
+    cfg = base_config(micro=2, lr=1e-2)
+    cfg["csv_monitor"] = {"enabled": True, "output_path": str(tmp_path),
+                          "job_name": "run"}
+    cfg["telemetry"] = {"enabled": True, "flush_interval": 100}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=cfg)
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.standard_normal((1, gm, 16)).astype("f4"),
+             "y": rng.standard_normal((1, gm, 16)).astype("f4")}
+    for _ in range(3):
+        engine.train_batch(batch=batch)
+    step_csv = tmp_path / "run" / "training_steps_total.csv"
+    assert not step_csv.exists()     # interval (100) never reached
+    engine.destroy()
+    rows = list(csv.reader(open(step_csv)))
+    assert [float(r[1]) for r in rows[1:]] == [3]
+
+
 def test_train_telemetry_disabled_records_nothing(fresh_registry):
     from tests.unit.simple_model import SimpleModel, base_config
 

@@ -1,0 +1,186 @@
+"""Timeline export: spans -> Chrome trace events, with per-request
+lifelines (queue -> prefill -> decode -> finish) and training step
+phases — the acceptance surface of the performance-forensics PR."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+from deepspeed_tpu.inference.v2.scheduler import DynamicSplitFuseScheduler
+from deepspeed_tpu.models import TransformerConfig, TransformerLM
+from deepspeed_tpu.telemetry import (MetricsRegistry, set_registry,
+                                     timeline, trace)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    trace.clear()
+    prev = set_registry(MetricsRegistry())
+    yield
+    set_registry(prev)
+    trace.clear()
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = TransformerConfig(vocab_size=128, hidden_size=64,
+                            intermediate_size=128, num_layers=2,
+                            num_heads=4, num_kv_heads=2, max_seq_len=128,
+                            remat=False, use_flash=False)
+    model = TransformerLM(cfg)
+    params = jax.tree.map(lambda x: x.astype(jnp.float32),
+                          model.init_params(jax.random.PRNGKey(0)))
+    return model, params
+
+
+def _engine(model, params, **kw):
+    return InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_tracked_sequences=8, max_seq_len=128, num_blocks=33,
+                block_size=16),
+            dtype="float32", prefill_bucket=16, **kw), params=params)
+
+
+def _validate_chrome_trace(obj):
+    """Structural validity of the Chrome trace-event format: JSON
+    round-trips, every event has the required keys, X events carry
+    numeric ts/dur, metadata names the tracks."""
+    rt = json.loads(json.dumps(obj))
+    assert isinstance(rt["traceEvents"], list)
+    tids = set()
+    for ev in rt["traceEvents"]:
+        assert ev["ph"] in ("X", "M")
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert isinstance(ev["name"], str)
+            assert ev["ts"] >= 0 and ev["dur"] >= 0
+            tids.add(ev["tid"])
+        else:
+            assert ev["name"] == "thread_name"
+    named = {ev["tid"] for ev in rt["traceEvents"] if ev["ph"] == "M"}
+    assert tids <= named, "every X event's track must be named"
+    return rt
+
+
+# -- span plumbing ----------------------------------------------------------
+def test_span_ids_parents_and_tracks():
+    with trace.span("outer"):
+        with trace.span("inner"):
+            pass
+    by_name = {s["name"]: s for s in trace.export()}
+    assert by_name["inner"]["parent"] == by_name["outer"]["id"]
+    assert by_name["outer"]["parent"] is None
+    assert by_name["outer"]["track"] == by_name["inner"]["track"]
+
+
+def test_retroactive_record_and_track_override():
+    trace.set_track("my-track")
+    try:
+        trace.record("queued", start=10.0, duration_s=0.5, uid=7)
+    finally:
+        trace.set_track(None)
+    (s,) = trace.export("queued")
+    assert s["duration_s"] == 0.5 and s["track"] == "my-track"
+    assert s["attrs"] == {"uid": 7}
+
+
+def test_chrome_trace_export_shape():
+    with trace.span("a", step=1):
+        with trace.span("b"):
+            pass
+    obj = timeline.to_chrome_trace()
+    rt = _validate_chrome_trace(obj)
+    xs = {ev["name"]: ev for ev in rt["traceEvents"] if ev["ph"] == "X"}
+    assert set(xs) == {"a", "b"}
+    assert xs["a"]["args"]["step"] == 1
+    # nesting is preserved through args.parent_id
+    assert xs["b"]["args"]["parent_id"] == xs["a"]["args"]["span_id"]
+
+
+def test_write_chrome_trace_round_trips(tmp_path):
+    with trace.span("w"):
+        pass
+    path = timeline.write_chrome_trace(str(tmp_path / "t" / "trace.json"))
+    _validate_chrome_trace(json.load(open(path)))
+
+
+# -- serving request lifeline ----------------------------------------------
+def test_request_lifeline_complete(tiny_model):
+    """One scheduled request leaves a complete, ordered lifeline: queue
+    -> prefill -> decode -> total, all uid-correlated, plus the decode
+    windows it rode in — and the whole thing exports as valid Chrome
+    trace JSON."""
+    model, params = tiny_model
+    eng = _engine(model, params, decode_window=4)
+    sched = DynamicSplitFuseScheduler(eng, token_budget=64)
+    rng = np.random.default_rng(0)
+    sched.submit(42, list(map(int, rng.integers(1, 127, 30))),
+                 max_new_tokens=6)
+    sched.run(max_steps=100)
+    assert len(sched.results()[42]) == 36
+
+    life = timeline.request_lifeline(42)
+    for phase in ("request_queue", "request_prefill", "request_decode",
+                  "request"):
+        assert phase in life, sorted(life)
+        assert life[phase]["attrs"]["uid"] == 42
+    q, p, d, tot = (life["request_queue"], life["request_prefill"],
+                    life["request_decode"], life["request"])
+    # ordered and nested inside the total span
+    assert q["start"] <= p["start"] <= d["start"]
+    assert tot["start"] <= q["start"]
+    assert (tot["start"] + tot["duration_s"]
+            >= d["start"] + d["duration_s"] - 1e-6)
+    assert tot["attrs"]["status"] == "completed"
+    assert tot["attrs"]["tokens"] == 6
+    assert life["decode_batches"], "no decode window spans correlated"
+
+    rt = _validate_chrome_trace(timeline.to_chrome_trace(
+        timeline.request_spans(42)))
+    names = [e["name"] for e in rt["traceEvents"] if e["ph"] == "X"]
+    assert "request_queue" in names and "decode_window" in names
+
+
+def test_cancelled_request_records_status(tiny_model):
+    model, params = tiny_model
+    eng = _engine(model, params)
+    sched = DynamicSplitFuseScheduler(eng, token_budget=16)
+    sched.submit(7, list(range(1, 40)), max_new_tokens=8)
+    sched.step()                       # partial prefill only
+    assert sched.cancel(7)
+    life = timeline.request_lifeline(7)
+    assert life["request"]["attrs"]["status"] == "cancelled"
+
+
+# -- training step phases ---------------------------------------------------
+def test_training_step_phases_in_timeline():
+    from tests.unit.simple_model import SimpleModel, base_config
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=SimpleModel(hidden_dim=16), config=base_config(micro=2,
+                                                             lr=1e-2))
+    gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+    rng = np.random.default_rng(0)
+    batch = {"x": rng.standard_normal((1, gm, 16)).astype("f4"),
+             "y": rng.standard_normal((1, gm, 16)).astype("f4")}
+    engine.train_batch(batch=batch)
+
+    by_name = {s["name"]: s for s in trace.export()}
+    for phase in ("train_data", "train_step", "train_device_dispatch",
+                  "train_host_sync"):
+        assert phase in by_name, sorted(by_name)
+    step = by_name["train_step"]
+    assert by_name["train_device_dispatch"]["parent"] == step["id"]
+    assert by_name["train_host_sync"]["parent"] == step["id"]
+    rt = _validate_chrome_trace(timeline.to_chrome_trace())
+    names = {e["name"] for e in rt["traceEvents"] if e["ph"] == "X"}
+    assert {"train_data", "train_step"} <= names

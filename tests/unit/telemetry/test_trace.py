@@ -94,3 +94,22 @@ def test_bridge_disabled_monitor_writes_nothing():
     bridge = TelemetryBridge(mon, registry=reg, flush_interval=1)
     assert bridge.step(1) is False
     assert mon.events == []
+
+
+def test_bridge_close_flushes_partial_interval():
+    """close() is the final flush: scalars recorded since the last
+    cadence boundary land in the monitor (at the last seen step), and a
+    second close is a no-op."""
+    reg = MetricsRegistry()
+    c = reg.counter("c_total")
+    mon = _FakeMonitor()
+    bridge = TelemetryBridge(mon, registry=reg, flush_interval=10)
+    c.inc()
+    bridge.step(1)
+    bridge.step(2)
+    assert mon.events == []          # cadence (10) never reached
+    assert bridge.close() is True
+    assert ("c_total", 1.0, 2) in mon.events
+    c.inc()
+    assert bridge.close() is False   # idempotent: no second flush
+    assert ("c_total", 2.0, 2) not in mon.events

@@ -1,0 +1,93 @@
+"""Recompile watchdog unit behavior: compile detection via jit-cache
+growth, steady-state violation accounting, and proxy transparency."""
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.telemetry import (MetricsRegistry, get_registry,
+                                     set_registry, watchdog)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    prev = set_registry(MetricsRegistry())
+    watchdog.reset()
+    yield get_registry()
+    watchdog.reset()
+    set_registry(prev)
+
+
+def test_watch_counts_compiles_per_shape(_fresh):
+    fn = watchdog.watch("square", jax.jit(lambda x: x * x))
+    fn(jnp.ones(3))            # compile 1
+    fn(jnp.ones(3))            # cache hit
+    fn(jnp.ones((2, 2)))       # compile 2 (new shape)
+    reg = _fresh
+    assert reg.get("xla_compile_events_total").labels(
+        program="square").value == 2
+    assert reg.get("xla_compile_seconds_total").labels(
+        program="square").value > 0
+    assert reg.get("xla_compiled_programs").labels(
+        program="square").value == 2
+    evs = [e for e in watchdog.events() if e["program"] == "square"]
+    assert len(evs) == 2
+    # the bucket key that triggered the second compile is recorded
+    assert evs[1]["signature"] == (((2, 2), "float32"),)
+    assert not evs[0]["steady_state"]
+
+
+def test_steady_state_recompile_flagged(_fresh):
+    fn = watchdog.watch("bucketed", jax.jit(lambda x: x + 1))
+    fn(jnp.ones(4))
+    watchdog.mark_steady(True)
+    try:
+        fn(jnp.ones(4))        # cache hit: fine at steady state
+        assert _fresh.get("xla_steady_state_recompiles_total") is None \
+            or _fresh.get("xla_steady_state_recompiles_total").labels(
+                program="bucketed").value == 0
+        fn(jnp.ones(5))        # NEW shape at steady state: violation
+    finally:
+        watchdog.mark_steady(False)
+    assert _fresh.get("xla_steady_state_recompiles_total").labels(
+        program="bucketed").value == 1
+    s = watchdog.summary()["bucketed"]
+    assert s["compiles"] == 2 and s["steady_state_recompiles"] == 1
+
+
+def test_proxy_forwards_jit_surface(_fresh):
+    jit_fn = jax.jit(lambda x: x - 1)
+    fn = watchdog.watch("fwd", jit_fn)
+    fn(jnp.ones(2))
+    assert fn._cache_size() == 1                 # attr passthrough
+    lowered = fn.lower(jnp.ones(2))              # AOT surface intact
+    assert lowered.compile() is not None
+    # idempotent wrap: watch() of a watched function is the same object
+    assert watchdog.watch("fwd", fn) is fn
+
+
+def test_record_compile_explicit_point(_fresh):
+    watchdog.record_compile("train_step", 1.5)
+    assert _fresh.get("xla_compile_events_total").labels(
+        program="train_step").value == 1
+    assert _fresh.get("xla_compile_seconds_total").labels(
+        program="train_step").value == pytest.approx(1.5)
+
+
+def test_analysis_compiles_never_steady_violations(_fresh):
+    """A deliberate AOT analysis compile (lower_train_step,
+    memory_report) during a steady-state window is counted but is NOT a
+    recompile violation — only hot-path retracing is."""
+    watchdog.mark_steady(True)
+    try:
+        watchdog.record_compile("train_step", 0.5, analysis=True)
+        watchdog.record_compile("hot_path", 0.5)
+    finally:
+        watchdog.mark_steady(False)
+    steady = _fresh.get("xla_steady_state_recompiles_total")
+    assert steady.labels(program="train_step").value == 0
+    assert steady.labels(program="hot_path").value == 1
+    assert _fresh.get("xla_compile_events_total").labels(
+        program="train_step").value == 1
+    assert watchdog.summary()["train_step"]["steady_state_recompiles"] == 0
