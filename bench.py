@@ -226,7 +226,17 @@ def main(argv=None):
                          "phases incl. train_data/device_dispatch/"
                          "host_sync) as Chrome-trace-event JSON to PATH "
                          "(open in Perfetto; see docs/PROFILING.md)")
+    ap.add_argument("--postmortem-dir", default=None, metavar="DIR",
+                    help="install the crash-handler hooks so an aborted "
+                         "bench run leaves a post-mortem bundle "
+                         "(metrics/timeline/recorder/anomalies) under "
+                         "DIR; see docs/TELEMETRY.md")
     args, _ = ap.parse_known_args(argv)
+
+    if args.postmortem_dir:
+        from deepspeed_tpu.telemetry import DiagnosticsConfig, postmortem
+        postmortem.install_crash_handler(
+            DiagnosticsConfig(postmortem_dir=args.postmortem_dir))
 
     # collective-overlap XLA knobs (latency-hiding scheduler + async
     # collective fusion incl. reduce-scatter chaining for the bucketed
@@ -436,6 +446,19 @@ def main(argv=None):
         # number is attributable to a jax/jaxlib/libtpu + flag set
         from deepspeed_tpu.env_report import compiler_fingerprint
         detail["compiler_config"] = compiler_fingerprint()
+    except Exception:
+        pass
+    try:
+        # black-box summary: the flight recorder ran through the whole
+        # bench (train_step events per batch), and any anomaly verdict
+        # (NaN/spike/stall) belongs in the record next to the number
+        from deepspeed_tpu.telemetry import anomaly, get_recorder
+        detail["flight_recorder"] = get_recorder().stats()
+        verdicts = anomaly.recent()
+        if verdicts:
+            detail["anomalies"] = [
+                {"kind": v["kind"], "summary": v["summary"]}
+                for v in verdicts]
     except Exception:
         pass
     if args.trace_out:

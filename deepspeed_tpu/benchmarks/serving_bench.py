@@ -206,6 +206,19 @@ def main(argv=None) -> int:
     if args.trace_out:
         from ..telemetry import timeline
         trace_out = timeline.write_chrome_trace(args.trace_out)
+    # flight-recorder + anomaly summary (the black box ran through the
+    # whole bench): events per decode step is the same overhead number
+    # the perf gate pins, and TTFT percentiles come from the histogram's
+    # quantile() — no raw-sample lists
+    from ..telemetry import anomaly, get_recorder, get_registry
+    reg = get_registry()
+    rec_stats = get_recorder().stats()
+    decode_steps_total = reg.family_total("inference_decode_steps_total")
+    ttft_fam = reg.get("inference_ttft_seconds")
+
+    def _q(q):
+        v = ttft_fam.quantile(q) if ttft_fam and ttft_fam.count else None
+        return round(v, 4) if v is not None and v == v else None
     print(json.dumps({
         "metric": "serving_tokens_per_sec",
         "backend": jax.default_backend(),
@@ -250,6 +263,16 @@ def main(argv=None) -> int:
                                    else None),
         "decode_peak_bytes": paged["decode_peak_bytes"],
         "steady_state_recompiles": paged["steady_state_recompiles"],
+        # active-observability summary (this PR): black-box coverage,
+        # overhead, histogram-quantile TTFT percentiles, and any
+        # anomaly verdict raised during the run
+        "recorder_events": rec_stats["recorded"],
+        "recorder_events_per_decode_step": (
+            round(rec_stats["recorded"] / decode_steps_total, 2)
+            if decode_steps_total else None),
+        "ttft_p50_s": _q(0.5), "ttft_p95_s": _q(0.95),
+        "ttft_p99_s": _q(0.99),
+        "anomalies": [v["kind"] for v in anomaly.recent()],
         "trace_out": trace_out,
         "dense_tok_s": round(dense_tok_s, 2),
         "dense_warmup_s": round(dense["warmup_s"], 3),

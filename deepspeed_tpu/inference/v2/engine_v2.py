@@ -31,6 +31,7 @@ import numpy as np
 
 from ...models.transformer import TransformerConfig
 from ...telemetry import memory as ds_memory
+from ...telemetry import recorder as flight
 from ...telemetry import trace, watchdog
 from ...utils.logging import log_dist
 from .config_v2 import RaggedInferenceEngineConfig
@@ -362,6 +363,7 @@ class InferenceEngineV2:
             logits, self.kv_cache = self._prefill_jit(
                 self.params, jnp.asarray(ids), jnp.asarray(n),
                 self.kv_cache, jnp.asarray(table), jnp.asarray(offs))
+        flight.record("prefill", uid=int(uid), tokens=int(n))
         seq.seen_tokens = n
         if sm.config.enable_prefix_caching:
             seq.token_log.extend(map(int, tokens))
@@ -609,6 +611,8 @@ class InferenceEngineV2:
         self._m_decode_time.observe(dt)
         if dt > 0:
             self._m_decode_tput.set(len(uids) / dt)
+        flight.record("decode_step", batch=len(uids),
+                      dur_s=round(dt, 5))
         log_tokens = sm.config.enable_prefix_caching
         out = {}
         for i, uid in enumerate(uids):
@@ -706,6 +710,8 @@ class InferenceEngineV2:
         self._m_fused_time.observe(dt)
         if dt > 0:
             self._m_decode_tput.set(total / dt)
+        flight.record("decode_window", batch=len(uids), tokens=total,
+                      window=self.decode_window, dur_s=round(dt, 5))
         self._update_pool_telemetry()
         return emitted
 

@@ -21,6 +21,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ....telemetry import recorder as flight
 from ..config_v2 import DSStateManagerConfig
 from .blocked_allocator import NULL_BLOCK, BlockedAllocator
 from .sequence_descriptor import DSSequenceDescriptor
@@ -50,6 +51,18 @@ class DSStateManager:
         self._m_evicted = reg.counter(
             "inference_prefix_evicted_blocks_total",
             "retained prefix blocks LRU-evicted under pool pressure")
+        # KV-pool flow accounting (the leak detector's reconciliation
+        # inputs, and the flight recorder's kv_alloc/kv_free events):
+        # allocated counts fresh blocks handed to sequences; freed counts
+        # block REFERENCES returned (a prefix-shared block freed by one
+        # owner still lives until its last reference drops)
+        self._m_alloc = reg.counter(
+            "inference_kv_blocks_allocated_total",
+            "KV blocks allocated to sequences")
+        self._m_freed = reg.counter(
+            "inference_kv_blocks_freed_total",
+            "KV block references released (sequence flush + prefix "
+            "eviction)")
 
     # -- prefix caching -----------------------------------------------------
     @staticmethod
@@ -129,6 +142,7 @@ class DSStateManager:
             blk = self._prefix.pop(victim)
             self.allocator.free([blk])
             self._m_evicted.inc()
+            self._m_freed.inc()
 
     def reclaimable_blocks(self) -> int:
         """Free blocks plus what eviction could free right now — the
@@ -166,6 +180,9 @@ class DSStateManager:
             if need > self.allocator.free_blocks:
                 self._evict_retained(need)
             seq.blocks.extend(int(b) for b in self.allocator.allocate(need))
+            self._m_alloc.inc(need)
+            flight.record("kv_alloc", uid=int(uid), blocks=int(need),
+                          free=self.allocator.free_blocks)
         return seq
 
     def flush_sequence(self, uid: int) -> None:
@@ -176,6 +193,11 @@ class DSStateManager:
             if self.config.enable_prefix_caching:
                 self._register_prefix(seq)
             self.allocator.free(seq.blocks)
+            if seq.blocks:
+                self._m_freed.inc(len(seq.blocks))
+                flight.record("kv_free", uid=int(uid),
+                              blocks=len(seq.blocks),
+                              free=self.allocator.free_blocks)
 
     # -- device metadata ----------------------------------------------------
     def block_table_for(self, uid: int) -> np.ndarray:

@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from ...telemetry import trace
+from ...telemetry import recorder as flight
 
 
 @dataclass
@@ -184,6 +185,9 @@ class DynamicSplitFuseScheduler:
         self._all[uid] = req
         self._queue.append(req)
         self._m_submitted.inc()
+        flight.record("request_submit", uid=int(uid),
+                      prompt_tokens=len(req.prompt),
+                      max_new_tokens=int(max_new_tokens))
         self._update_depth_gauges()
 
     def pending(self) -> bool:
@@ -193,6 +197,12 @@ class DynamicSplitFuseScheduler:
         """Requests admitted and not yet finished/cancelled (queued for
         prefill budget + decoding)."""
         return len(self._queue) + len(self._running)
+
+    def known_uids(self) -> List[int]:
+        """Every uid the scheduler still tracks (in flight, finished but
+        not yet released) — the set the KV-leak detector reconciles the
+        block pool against at drain."""
+        return list(self._all)
 
     # ------------------------------------------------------------------
     def cancel(self, uid: int) -> bool:
@@ -217,6 +227,8 @@ class DynamicSplitFuseScheduler:
             self._queue.remove(req)
         self.engine.flush(uid)     # frees the blocks; no-op if none held
         self._m_cancelled.inc()
+        flight.record("request_cancel", uid=int(uid),
+                      tokens=len(req.generated))
         self._update_depth_gauges()
         return True
 
@@ -246,9 +258,13 @@ class DynamicSplitFuseScheduler:
             self._running.remove(req)
         self._m_finished.inc()
         self._m_gen_tokens.inc(len(req.generated))
-        self._m_ttft.observe(
-            (req.first_token_t or req.finish_t) - req.submit_t)
+        ttft = (req.first_token_t or req.finish_t) - req.submit_t
+        self._m_ttft.observe(ttft)
         self._m_req_time.observe(req.finish_t - req.submit_t)
+        flight.record("request_finish", uid=int(req.uid),
+                      tokens=len(req.generated),
+                      ttft_s=round(ttft, 4),
+                      total_s=round(req.finish_t - req.submit_t, 4))
         self._update_depth_gauges()
 
     def _evict_partial_prefill(self, exclude=()) -> bool:

@@ -24,6 +24,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Deque, Dict, Optional
 
+from ....telemetry import recorder as flight
+
 
 class OverloadedError(RuntimeError):
     """Explicit admission rejection (HTTP surfaces map it to 429).
@@ -95,6 +97,8 @@ class AdmissionController:
 
     def _reject(self, reason: str, message: str):
         self._m_rejected.labels(reason=reason).inc()
+        flight.record("shed", reason=reason, depth=self._depth,
+                      queued_tokens=self._tokens)
         raise OverloadedError(reason, message)
 
     # ------------------------------------------------------------------
@@ -128,6 +132,8 @@ class AdmissionController:
             self._depth += 1
             self._tokens += cost
             self._m_admitted.inc()
+            flight.record("admit", uid=entry.uid, tenant=entry.tenant,
+                          cost_tokens=cost, depth=self._depth)
             self._update_gauges()
 
     def pop(self):

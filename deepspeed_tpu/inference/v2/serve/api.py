@@ -22,7 +22,13 @@ Built on stdlib ``asyncio.start_server`` — no web framework. Endpoints:
     ``uid`` filters to one request's lifeline (queue -> prefill ->
     decode windows -> finish). See docs/PROFILING.md.
   * ``GET /statusz`` — one-call forensics snapshot: runtime health plus
-    the recompile-watchdog rollup and the device-memory report.
+    the recompile-watchdog rollup, the device-memory report, recent
+    anomaly verdicts, and SLO state (p50/p95/p99 TTFT/TPOT from
+    histogram quantiles plus the fast/slow burn rates).
+  * ``POST /debug/postmortem`` — write a post-mortem bundle (metrics
+    snapshot, timeline, memory report, compiler fingerprint, last-N
+    flight-recorder events, anomaly verdicts) and return its path
+    (docs/SERVING.md § Post-mortem bundles).
 
 Overload maps to ``429`` with the admission reason; malformed requests
 to ``400``; unknown routes to ``404``.
@@ -125,6 +131,8 @@ class ServingAPI:
                 self._timeline(writer, query)
             elif method == "GET" and target == "/statusz":
                 _json_response(writer, "200 OK", self._statusz())
+            elif method == "POST" and target == "/debug/postmortem":
+                await self._postmortem(writer)
             elif method == "POST" and target == "/generate":
                 await self._generate(reader, writer, body)
             else:
@@ -159,16 +167,58 @@ class ServingAPI:
         _json_response(writer, "200 OK", timeline.to_chrome_trace(spans))
 
     def _statusz(self) -> dict:
+        import math
+
+        from ....telemetry import anomaly as ds_anomaly
         from ....telemetry import memory as ds_memory
         from ....telemetry import watchdog
-        return {
+        from ....telemetry.recorder import get_recorder
+        out = {
             "health": self.serving.health(),
             "compile": {"programs": watchdog.summary(),
                         "steady_state": watchdog.is_steady(),
                         "recent_events": len(watchdog.events())},
             "memory": ds_memory.oom_report(),
             "metric_families": len(self.registry.families()),
+            "recorder": get_recorder().stats(),
+            "anomalies": {"recent": ds_anomaly.recent(16)},
         }
+        diag = getattr(self.serving, "diagnostics", None)
+        if diag is not None and diag.slo is not None:
+            def clean(d):
+                return {k: (None if isinstance(v, float)
+                            and not math.isfinite(v) else v)
+                        for k, v in d.items()}
+            out["slo"] = {
+                "quantiles": {s: clean(q) for s, q
+                              in diag.slo.quantiles().items()},
+                "burn": diag.slo.tick(),
+            }
+        return out
+
+    async def _postmortem(self, writer) -> None:
+        import json as _json
+        import os
+
+        from ....telemetry import postmortem as ds_postmortem
+        diag = getattr(self.serving, "diagnostics", None)
+        cfg = diag.config if diag is not None else None
+
+        def collect():
+            # bundle writing is disk I/O exactly when the server is in
+            # trouble — keep it off the event-loop thread so live
+            # /generate streams don't stall behind it
+            path = ds_postmortem.write_bundle("http_request", config=cfg)
+            with open(os.path.join(path, "manifest.json")) as fh:
+                return path, _json.load(fh)
+
+        try:
+            path, manifest = await asyncio.to_thread(collect)
+            _json_response(writer, "200 OK",
+                           {"path": path, "manifest": manifest})
+        except Exception as e:
+            _json_response(writer, "500 Internal Server Error",
+                           {"error": f"{type(e).__name__}: {e}"})
 
     async def _generate(self, reader, writer, body: bytes) -> None:
         # coerce every field up front: an unchecked value (e.g.

@@ -24,6 +24,9 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
+from ....telemetry.anomaly import (DiagnosticsConfig, KVLeakDetector,
+                                   SLOBurnRateMonitor, StallWatchdog)
+from ....telemetry.recorder import get_recorder
 from ..scheduler import DynamicSplitFuseScheduler
 from .admission import AdmissionConfig, AdmissionController
 from .loop import ServingLoop
@@ -46,6 +49,36 @@ class ServingConfig:
     max_inflight: Optional[int] = None      # requests inside the scheduler
     idle_wait_s: float = 0.002
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+    # active observability: flight-recorder budget, SLO burn-rate
+    # monitoring, stall watchdog, KV-leak check at drain (telemetry/
+    # anomaly.py; docs/TELEMETRY.md § Anomaly detectors)
+    diagnostics: DiagnosticsConfig = field(
+        default_factory=DiagnosticsConfig)
+
+
+class ServingDiagnostics:
+    """The serving runtime's active-observability bundle: the SLO
+    burn-rate monitor the loop ticks, the stall watchdog it beats, and
+    the KV-leak detector it runs at drain. ``None`` members mean the
+    feature is disabled; the loop checks for that."""
+
+    def __init__(self, config: DiagnosticsConfig):
+        self.config = config
+        self.slo: Optional[SLOBurnRateMonitor] = None
+        self.stall: Optional[StallWatchdog] = None
+        self.leak: Optional[KVLeakDetector] = None
+        if not config.enabled:
+            return
+        get_recorder().set_budget(config.recorder_max_bytes)
+        self.slo = SLOBurnRateMonitor(config)
+        self.leak = KVLeakDetector(config)
+        if config.stall_enabled:
+            self.stall = StallWatchdog(config).start()
+            self.stall.register("serving_loop")
+
+    def close(self) -> None:
+        if self.stall is not None:
+            self.stall.stop()
 
 
 @dataclass
@@ -166,11 +199,12 @@ class ServingEngine:
             engine, token_budget=self.config.token_budget,
             chunk=self.config.chunk, clock=clock)
         self.admission = AdmissionController(self.config.admission)
+        self.diagnostics = ServingDiagnostics(self.config.diagnostics)
         self._loop_runner = ServingLoop(
             self.scheduler, self.admission,
             max_inflight=self.config.max_inflight,
             idle_wait_s=self.config.idle_wait_s, clock=clock,
-            bridge=bridge)
+            bridge=bridge, diagnostics=self.diagnostics)
         self._uids = itertools.count(1)
         self._stopped = False
 
@@ -200,6 +234,7 @@ class ServingEngine:
             # never started: end anything parked in the queues
             self._loop_runner.start()
         await asyncio.to_thread(self._loop_runner.join, timeout)
+        self.diagnostics.close()
 
     async def __aenter__(self) -> "ServingEngine":
         return await self.start()

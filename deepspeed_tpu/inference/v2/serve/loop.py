@@ -33,12 +33,19 @@ class ServingLoop:
     def __init__(self, scheduler, admission, *,
                  max_inflight: Optional[int] = None,
                  idle_wait_s: float = 0.002, clock=time.perf_counter,
-                 bridge=None):
+                 bridge=None, diagnostics=None):
         self.scheduler = scheduler
         self.admission = admission
         # optional TelemetryBridge: final-flushed (close()) when the loop
         # exits, so a drain's last partial flush interval isn't dropped
         self.bridge = bridge
+        # optional ServingDiagnostics (frontend.py): the loop beats the
+        # stall watchdog around every scheduler step, ticks the SLO
+        # burn-rate monitor at ~1 Hz, and runs the KV-leak check when it
+        # drains — the loop thread is the only place that sees all three
+        # moments
+        self.diagnostics = diagnostics
+        self._last_slo_tick = 0.0
         sm = scheduler.engine.state_manager.config
         # cap on requests inside the scheduler at once; the admission
         # queue (bounded) holds the rest
@@ -209,11 +216,66 @@ class ServingLoop:
     def _step_error(self, e: BaseException) -> None:
         # a step-time failure cannot be attributed to one request here;
         # fail every in-flight request loudly rather than wedging the loop
-        for entry in [en for en in self._entries.values()
-                      if en.state == "inflight"]:
+        failed = [en for en in self._entries.values()
+                  if en.state == "inflight"]
+        for entry in failed:
             self.scheduler.cancel(entry.uid)
             self.scheduler.release(entry.uid)
             self._end(entry, "error", f"{type(e).__name__}: {e}")
+        if self.diagnostics is not None and failed:
+            from ....telemetry import anomaly, postmortem
+            anomaly.report(
+                "serving_step_error",
+                f"scheduler.step() raised {type(e).__name__}: {e}; "
+                f"{len(failed)} in-flight request(s) failed",
+                error=f"{type(e).__name__}: {e}",
+                failed_uids=[en.uid for en in failed])
+            if self.diagnostics.config.postmortem_on_anomaly:
+                postmortem.maybe_write_bundle(
+                    "serving_step_error", config=self.diagnostics.config)
+
+    # -- diagnostics hooks (loop thread) --------------------------------
+    def _diag_step(self, fn):
+        """Run one scheduler step inside the stall-watchdog heartbeat
+        window and tick the SLO monitor at most once a second."""
+        diag = self.diagnostics
+        if diag is None:
+            return fn()
+        if diag.stall is not None:
+            diag.stall.set_active("serving_loop", True)
+        try:
+            return fn()
+        finally:
+            if diag.stall is not None:
+                diag.stall.beat("serving_loop")
+            self._diag_tick()
+
+    def _diag_tick(self) -> None:
+        diag = self.diagnostics
+        if diag is None or diag.slo is None:
+            return
+        now = time.monotonic()
+        if now - self._last_slo_tick >= 1.0:
+            self._last_slo_tick = now
+            try:
+                diag.slo.tick()
+            except Exception:   # monitoring must never stall serving
+                pass
+
+    def _diag_drain(self) -> None:
+        """KV-pool reconciliation at drain: every allocated block must be
+        owned by a still-inflight request or the prefix cache."""
+        diag = self.diagnostics
+        if diag is None or diag.leak is None:
+            return
+        try:
+            if diag.stall is not None:
+                diag.stall.set_active("serving_loop", False)
+            diag.leak.check_at_drain(
+                self.scheduler.engine.state_manager,
+                inflight_uids=self.scheduler.known_uids())
+        except Exception:
+            pass
 
     def _abort_remaining(self) -> None:
         for entry in list(self._entries.values()):
@@ -231,27 +293,42 @@ class ServingLoop:
             self._admit_ready()
             if self.scheduler.pending():
                 try:
-                    self.scheduler.step()
+                    self._diag_step(self.scheduler.step)
                 except Exception as e:
                     self._step_error(e)
                 self._cancel_dead()
                 self._flush_finished()
                 continue
+            if (self.diagnostics is not None
+                    and self.diagnostics.stall is not None):
+                # idle is silence, not a stall
+                self.diagnostics.stall.set_active("serving_loop", False)
+            # an idle loop must still tick the SLO monitor, or the burn
+            # gauges (and a latched slo_burn alert) freeze at their
+            # last busy-time values after traffic stops
+            self._diag_tick()
             if (self._draining and not self._entries
                     and self.admission.empty() and not self._cmds):
                 break
             # idle: block until woken (every external command calls
             # wake()), or until the nearest registered deadline so
-            # queued requests still expire — never a fixed-rate poll
+            # queued requests still expire. With the SLO monitor
+            # attached the wait is additionally capped at its ~1 Hz
+            # tick cadence (burn windows must keep decaying after
+            # traffic stops); otherwise never a fixed-rate poll
             if self._deadlines:
                 timeout = max(self._deadlines[0][0] - self.clock(),
                               self.idle_wait_s)
             else:
                 timeout = None
+            if (self.diagnostics is not None
+                    and self.diagnostics.slo is not None):
+                timeout = 1.0 if timeout is None else min(timeout, 1.0)
             self._wake.wait(timeout)
             self._wake.clear()
         self._run_cmds()
         self._abort_remaining()
+        self._diag_drain()
         if self.bridge is not None:
             try:  # drain/stop must end cleanly even if a backend throws
                 self.bridge.close()

@@ -201,6 +201,12 @@ class CSVConfig:
     job_name: str = "DeepSpeedTpuJobName"
 
 
+# the diagnostics block (flight recorder, anomaly detectors, post-mortem
+# bundles) is shared with the serving runtime's ServingConfig — one
+# schema for both stacks (telemetry/anomaly.py)
+from ..telemetry.anomaly import DiagnosticsConfig  # noqa: E402
+
+
 @dataclass
 class TelemetryConfig:
     """Unified telemetry layer (telemetry/registry.py + bridge.py).
@@ -332,6 +338,7 @@ class DeepSpeedTpuConfig:
     wandb: WandbConfig = subconfig(WandbConfig)
     csv_monitor: CSVConfig = subconfig(CSVConfig)
     telemetry: TelemetryConfig = subconfig(TelemetryConfig)
+    diagnostics: DiagnosticsConfig = subconfig(DiagnosticsConfig)
     data_types: DataTypesConfig = subconfig(DataTypesConfig)
     checkpoint: CheckpointConfig = subconfig(CheckpointConfig)
     aio: AioConfig = subconfig(AioConfig)

@@ -51,16 +51,29 @@ def _split_loss_aux(out):
     return out, {}
 
 
+def per_leaf_sqnorms(tree):
+    """Per-leaf sums of squares (fp32), in ``jax.tree.leaves`` order —
+    the sub-expressions :func:`global_norm` sums. Anomaly attribution
+    (telemetry/anomaly.py) stacks them; computing them HERE (rather
+    than as fresh reductions after the fact) lets XLA CSE them against
+    the global norm, so exporting them costs a handful of scalars, not
+    another pass over the gradient tree."""
+    return [jnp.sum(jnp.square(g.astype(jnp.float32)))
+            for g in jax.tree.leaves(tree)]
+
+
 def global_norm(tree):
-    leaves = jax.tree.leaves(tree)
-    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+    return jnp.sqrt(sum(per_leaf_sqnorms(tree)))
 
 
-def unscale_clip_check(grads, inv, clip, fp16, frozen_mask=None):
+def unscale_clip_check(grads, inv, clip, fp16, frozen_mask=None,
+                       with_leaf_sqnorms=False):
     """Shared gradient epilogue of every step variant: unscale by ``inv``
     (1/(gas*loss_scale)), zero frozen leaves, global inf/nan check (on the
     unclipped grads — clipping an inf produces nan and would hide it), and
-    grad-norm clipping. Returns (grads, finite, gnorm)."""
+    grad-norm clipping. Returns (grads, finite, gnorm), plus the stacked
+    per-leaf squared norms when ``with_leaf_sqnorms`` (the anomaly
+    detector's attribution input — shares the global-norm reductions)."""
     grads = jax.tree.map(lambda g: g * inv, grads)
     if frozen_mask is not None:
         # frozen leaves (reference requires_grad=False): zero their grads
@@ -68,10 +81,17 @@ def unscale_clip_check(grads, inv, clip, fp16, frozen_mask=None):
         grads = jax.tree.map(
             lambda g, f: jnp.zeros_like(g) if f else g, grads, frozen_mask)
     finite = grads_finite(grads) if fp16 else jnp.asarray(True)
-    gnorm = global_norm(grads)
+    leaf_sq = per_leaf_sqnorms(grads)
+    gnorm = jnp.sqrt(sum(leaf_sq))
     if clip and clip > 0:
         factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
         grads = jax.tree.map(lambda g: g * factor, grads)
+    if with_leaf_sqnorms:
+        # as a TUPLE of scalars, not jnp.stack: the in-step concatenate
+        # defeats the square+reduce fusion into the grad pipeline and
+        # keeps a full fp32 grad-tree copy alive as temps (+6.7 MB on
+        # the dp8 AOT proxy, measured); scalar outputs add ~1 KB
+        return grads, finite, gnorm, tuple(leaf_sq)
     return grads, finite, gnorm
 
 
@@ -345,6 +365,7 @@ class DeepSpeedTpuEngine:
         self.telemetry = get_registry()
         self.telemetry_bridge = None
         if not self.telemetry_enabled:
+            self._init_diagnostics()   # attributes must exist either way
             return
         if tcfg.xla_annotations:
             trace.enable_xla_annotations(True)
@@ -378,6 +399,71 @@ class DeepSpeedTpuEngine:
         if self.monitor is not None and self.monitor.enabled:
             self.telemetry_bridge = self.monitor.attach_telemetry(
                 reg, flush_interval=tcfg.flush_interval)
+        self._init_diagnostics()
+
+    def _init_diagnostics(self):
+        """Active observability (telemetry/anomaly.py): the flight
+        recorder budget, the loss/grad anomaly detector fed by
+        train_batch, and (lazily, on the first batch) the host-sync
+        stall watchdog. All gated by the ``diagnostics`` config block."""
+        from ..telemetry import recorder as flight
+        from ..telemetry.anomaly import LossAnomalyDetector
+        dcfg = self.config.diagnostics
+        self.diagnostics_enabled = (self.telemetry_enabled
+                                    and bool(dcfg.enabled))
+        self._anomaly_detector = None
+        self._stall_watchdog = None
+        if not self.diagnostics_enabled:
+            return
+        flight.get_recorder().set_budget(dcfg.recorder_max_bytes)
+        self._anomaly_detector = LossAnomalyDetector(
+            dcfg, leaf_names=self._grad_leaf_names())
+        # stacks the step's per-leaf scalar sqnorms on device so the
+        # host fetches ONE small array, not one scalar per leaf
+        self._leaf_stack_fn = None
+        if dcfg.postmortem_on_crash:
+            from ..telemetry import postmortem
+            postmortem.install_crash_handler(dcfg)
+
+    def _grad_leaf_names(self):
+        """Stable names for the gradient pytree's leaves — the
+        "parameter bucket" labels anomaly attribution reports (same
+        leaf order as jax.tree.leaves, which is how the compiled step
+        stacks grad_leaf_sqnorms)."""
+        import jax.tree_util as jtu
+
+        def keystr(path) -> str:
+            parts = []
+            for k in path:
+                if hasattr(k, "key"):
+                    parts.append(str(k.key))
+                elif hasattr(k, "idx"):
+                    parts.append(f"[{k.idx}]")
+                elif hasattr(k, "name"):
+                    parts.append(str(k.name))
+                else:
+                    parts.append(str(k))
+            return "/".join(parts) or "<root>"
+
+        try:
+            leaves, _ = jtu.tree_flatten_with_path(self.params)
+            return [keystr(path) for path, _ in leaves]
+        except Exception:
+            return []
+
+    def _ensure_stall_watchdog(self):
+        """Start the train host-sync stall watchdog on first use (no
+        thread for engines that never train)."""
+        if not self.diagnostics_enabled:
+            return None
+        dcfg = self.config.diagnostics
+        if not dcfg.stall_enabled:
+            return None
+        if self._stall_watchdog is None:
+            from ..telemetry.anomaly import StallWatchdog
+            self._stall_watchdog = StallWatchdog(dcfg).start()
+            self._stall_watchdog.register("train_step")
+        return self._stall_watchdog
 
     def _record_train_telemetry(self, metrics, skipped: int):
         """Registry updates for one completed train_batch (+ the bridge's
@@ -726,6 +812,12 @@ class DeepSpeedTpuEngine:
         po_constrain = self.param_offload
         master_sh_c = plan.master_sharding
         opt_sh_c = self._opt_shardings
+        # anomaly attribution (telemetry/anomaly.py): export each grad
+        # leaf's squared norm from the compiled step so a NaN/spiking
+        # loss names its parameter buckets without a second backward
+        dcfg = self.config.diagnostics
+        grad_attribution = (bool(self.config.telemetry.enabled)
+                            and dcfg.enabled and dcfg.grad_attribution)
 
         def constrain(tree, sh):
             return jax.tree.map(lambda x, s: jax.lax.with_sharding_constraint(x, s),
@@ -881,8 +973,18 @@ class DeepSpeedTpuEngine:
                 (grads, rng), losses = jax.lax.scan(micro_fn, (grads0, rng), batch)
                 loss = jnp.mean(losses)
                 inv = 1.0 / (gas * scale)
-            grads, finite, gnorm = unscale_clip_check(
-                grads, inv, clip, fp16, frozen_mask)
+            if grad_attribution:
+                # the per-leaf squared norms are the global norm's own
+                # sub-expressions (CSE'd, so exporting them is free) and
+                # deliberately not gated on `finite`: the non-finite
+                # step is exactly the one whose per-bucket norms name
+                # the culprit parameter buckets
+                grads, finite, gnorm, leaf_sq = unscale_clip_check(
+                    grads, inv, clip, fp16, frozen_mask,
+                    with_leaf_sqnorms=True)
+            else:
+                grads, finite, gnorm = unscale_clip_check(
+                    grads, inv, clip, fp16, frozen_mask)
             target = master if has_master else params
             new_target, new_opt, new_step = apply_update_with_skip(
                 optimizer, target, grads, opt_state, step, lr, finite,
@@ -916,6 +1018,8 @@ class DeepSpeedTpuEngine:
             }
             if fp16:
                 metrics["loss_scale"] = scale
+            if grad_attribution:
+                metrics["grad_leaf_sqnorms"] = leaf_sq
             return new_params, new_master, new_opt, new_scale_state, new_step, rng, metrics
 
         # [gas, global_micro, ...]: shard dim 1 over data axes
@@ -1314,6 +1418,12 @@ class DeepSpeedTpuEngine:
         # the host-side split of a training step's wall time
         with trace.span("train_data", step=self.global_steps):
             dev_batch = self._shard_batch(batch)
+        # stall watchdog: armed only while a step is in flight — a hung
+        # host sync (wedged collective, dead chip) is what it catches
+        stall = self._ensure_stall_watchdog()
+        if stall is not None:
+            stall.beat("train_step")
+            stall.set_active("train_step", True)
         self.tput_timer.start()
         with trace.span("train_step", step=self.global_steps):
             with trace.span("train_device_dispatch"):
@@ -1333,6 +1443,9 @@ class DeepSpeedTpuEngine:
             # it belongs inside the span/timer (XLA programs complete here)
             with trace.span("train_host_sync"):
                 loss = float(metrics["loss"])
+        if stall is not None:
+            stall.beat("train_step")
+            stall.set_active("train_step", False)
         # Host bookkeeping mirrors the device counter: the compiled step
         # leaves ``_step_arr`` un-advanced on fp16 overflow, so the host
         # step count and the LR schedule must hold too (reference skips the
@@ -1374,8 +1487,51 @@ class DeepSpeedTpuEngine:
                 ("Train/lr", float(metrics["lr"]), self.global_steps),
             ])
         self._record_train_telemetry(metrics, skipped)
+        # grad_leaf_sqnorms is a vector (attribution input), not a scalar
+        # metric — route it to the anomaly detector, not _last_metrics
+        leaf_sqnorms = metrics.pop("grad_leaf_sqnorms", None)
+        self._record_flight_and_anomaly(metrics, loss, skipped,
+                                        leaf_sqnorms)
         self._last_metrics = {k: float(v) for k, v in metrics.items()}
         return loss
+
+    def _record_flight_and_anomaly(self, metrics, loss: float,
+                                   skipped: int, leaf_sqnorms) -> None:
+        """One flight-recorder event per completed batch plus the online
+        loss/grad anomaly check (telemetry/anomaly.py). Best-effort:
+        diagnostics must never fail a training step."""
+        if not getattr(self, "diagnostics_enabled", False):
+            return
+        try:
+            from ..telemetry import postmortem
+            from ..telemetry import recorder as flight
+            gnorm = float(metrics["grad_norm"])
+            fields = {"step": self.global_steps, "loss": loss,
+                      "grad_norm": gnorm, "skipped": bool(skipped),
+                      "lr": float(metrics["lr"])}
+            if "loss_scale" in metrics:
+                fields["loss_scale"] = float(metrics["loss_scale"])
+            dur = self.tput_timer.last_duration
+            if dur:
+                fields["dur_s"] = round(dur, 4)
+            flight.record("train_step", **fields)
+            if leaf_sqnorms:
+                if self._leaf_stack_fn is None:
+                    self._leaf_stack_fn = jax.jit(
+                        lambda *xs: jnp.stack(xs))
+                leaf_sqnorms = np.asarray(
+                    self._leaf_stack_fn(*leaf_sqnorms), dtype=np.float64)
+            else:
+                leaf_sqnorms = None
+            verdict = self._anomaly_detector.update(
+                self.global_steps, loss, gnorm,
+                leaf_sqnorms=leaf_sqnorms, skipped=bool(skipped))
+            if (verdict is not None
+                    and self.config.diagnostics.postmortem_on_anomaly):
+                postmortem.maybe_write_bundle(
+                    verdict["kind"], config=self.config.diagnostics)
+        except Exception as e:  # pragma: no cover - diagnostics only
+            logger.debug(f"train-step diagnostics skipped: {e}")
 
     def eval_batch(self, data_iter=None, batch=None):
         if batch is None:
@@ -1850,6 +2006,12 @@ class DeepSpeedTpuEngine:
     # ------------------------------------------------------------------
     def destroy(self):
         """Release host-side resources (reference engine.py destroy)."""
+        if getattr(self, "_stall_watchdog", None) is not None:
+            try:
+                self._stall_watchdog.stop()
+            except Exception:
+                pass
+            self._stall_watchdog = None
         if getattr(self, "telemetry_bridge", None) is not None:
             try:  # final flush: metrics since the last cadence boundary
                 # would otherwise never reach the monitor backends

@@ -23,10 +23,15 @@ accepts). See docs/TELEMETRY.md for the metrics catalog.
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        get_registry, set_registry)
 from .bridge import TelemetryBridge
-from . import memory, timeline, trace, watchdog
+from . import anomaly, memory, postmortem, recorder, timeline, trace, \
+    watchdog
+from .anomaly import DiagnosticsConfig
+from .recorder import FlightRecorder, get_recorder, set_recorder
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "get_registry", "set_registry", "TelemetryBridge", "trace",
-    "timeline", "watchdog", "memory",
+    "timeline", "watchdog", "memory", "recorder", "anomaly",
+    "postmortem", "DiagnosticsConfig", "FlightRecorder", "get_recorder",
+    "set_recorder",
 ]

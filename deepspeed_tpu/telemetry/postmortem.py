@@ -1,0 +1,184 @@
+"""Post-mortem bundles: one call writes everything a debugger needs.
+
+A production incident's evidence is scattered across the registry, the
+trace ring, the memory tables, the compiler fingerprint, the flight
+recorder, and the anomaly ledger — and all of it is process-local, so
+it dies with the process. :func:`write_bundle` snapshots the lot into a
+dated directory::
+
+    postmortems/postmortem-20260803-141523-nan_loss/
+      manifest.json     # reason, time, versions, file index
+      metrics.json      # registry snapshot() (every series)
+      timeline.json     # Chrome-trace JSON of the span ring buffer
+      memory.json       # memory.oom_report() (programs + buffers)
+      fingerprint.json  # env_report.compiler_fingerprint()
+      recorder.json     # last-N flight-recorder events
+      anomalies.json    # recent anomaly verdicts
+
+Surfaces: ``POST /debug/postmortem`` on the serving API, the training
+engine's anomaly hook (``diagnostics.postmortem_on_anomaly``), and
+:func:`install_crash_handler` — an unhandled-exception hook (bundle +
+re-raise) plus an ``atexit`` pass that writes a bundle only when
+anomalies were recorded and none was captured yet (a clean exit stays
+silent).
+
+Bundles are rate-limited (``diagnostics.postmortem_min_interval_s``):
+an anomaly firing every step must not turn the disk into the hot path.
+"""
+
+import atexit
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ..utils.logging import logger
+from . import anomaly as ds_anomaly
+from . import memory as ds_memory
+from . import recorder as ds_recorder
+from . import timeline
+from .anomaly import DiagnosticsConfig
+from .registry import get_registry
+
+_lock = threading.Lock()
+_last_bundle_t = 0.0
+_last_bundle_path: Optional[str] = None
+_installed = False
+
+
+def _dump(path: str, obj: Any) -> str:
+    with open(path, "w") as fh:
+        json.dump(obj, fh, indent=2, default=str)
+    return os.path.basename(path)
+
+
+def last_bundle() -> Optional[str]:
+    """Path of the most recent bundle this process wrote (None yet)."""
+    return _last_bundle_path
+
+
+def write_bundle(reason: str = "manual",
+                 config: Optional[DiagnosticsConfig] = None,
+                 out_dir: Optional[str] = None,
+                 extra: Optional[Dict[str, Any]] = None,
+                 force: bool = True) -> Optional[str]:
+    """Write one bundle; returns its directory path.
+
+    ``force=False`` honors the rate limit
+    (``postmortem_min_interval_s`` since the last bundle → returns the
+    previous path instead of writing). Collection is best-effort per
+    artifact: a failing section is recorded in the manifest, never an
+    exception out of a crash handler."""
+    global _last_bundle_t, _last_bundle_path
+    cfg = config or DiagnosticsConfig()
+    with _lock:
+        now = time.time()
+        if (not force and _last_bundle_path is not None
+                and now - _last_bundle_t < cfg.postmortem_min_interval_s):
+            return _last_bundle_path
+        _last_bundle_t = now
+    safe_reason = "".join(c if c.isalnum() or c in "-_" else "_"
+                          for c in reason)[:48] or "manual"
+    stamp = time.strftime("%Y%m%d-%H%M%S", time.localtime(now))
+    root = out_dir or cfg.postmortem_dir
+    path = os.path.join(root, f"postmortem-{stamp}-{safe_reason}")
+    suffix = 1
+    while os.path.exists(path):   # several bundles in one second
+        suffix += 1
+        path = os.path.join(root,
+                            f"postmortem-{stamp}-{safe_reason}-{suffix}")
+    os.makedirs(path, exist_ok=True)
+
+    files: Dict[str, str] = {}
+    errors: Dict[str, str] = {}
+
+    def section(name: str, fn):
+        try:
+            files[name] = _dump(os.path.join(path, f"{name}.json"), fn())
+        except Exception as e:   # pragma: no cover - defensive
+            errors[name] = f"{type(e).__name__}: {e}"
+
+    section("metrics", lambda: get_registry().snapshot())
+    section("timeline", lambda: timeline.to_chrome_trace())
+    section("memory", lambda: ds_memory.oom_report())
+    section("recorder", lambda: {
+        "stats": ds_recorder.get_recorder().stats(),
+        "events": ds_recorder.get_recorder().events(
+            last=cfg.postmortem_last_events)})
+    section("anomalies", lambda: ds_anomaly.recent())
+
+    def fingerprint():
+        from ..env_report import compiler_fingerprint
+        return compiler_fingerprint()
+    section("fingerprint", fingerprint)
+
+    manifest: Dict[str, Any] = {
+        "reason": reason, "written_at": now,
+        "written_at_iso": time.strftime("%Y-%m-%dT%H:%M:%S",
+                                        time.localtime(now)),
+        "pid": os.getpid(), "files": files,
+    }
+    if extra:
+        manifest["extra"] = extra
+    if errors:
+        manifest["collection_errors"] = errors
+    _dump(os.path.join(path, "manifest.json"), manifest)
+    with _lock:
+        _last_bundle_path = path
+    logger.warning(f"post-mortem bundle written: {path} (reason={reason})")
+    return path
+
+
+def maybe_write_bundle(reason: str,
+                       config: Optional[DiagnosticsConfig] = None,
+                       **kw) -> Optional[str]:
+    """Rate-limited :func:`write_bundle` (the anomaly-hook entry)."""
+    return write_bundle(reason, config=config, force=False, **kw)
+
+
+def install_crash_handler(config: Optional[DiagnosticsConfig] = None,
+                          out_dir: Optional[str] = None) -> bool:
+    """Install the unhandled-exception and atexit bundle hooks
+    (idempotent; returns True the first time). The excepthook chains to
+    the previous one — the traceback still prints."""
+    global _installed
+    with _lock:
+        if _installed:
+            return False
+        _installed = True
+    cfg = config or DiagnosticsConfig()
+    prev_hook = sys.excepthook
+
+    def _hook(exc_type, exc, tb):
+        try:
+            write_bundle(f"unhandled_{exc_type.__name__}", config=cfg,
+                         out_dir=out_dir,
+                         extra={"exception": repr(exc)})
+        except Exception:   # the handler must never mask the crash
+            pass
+        prev_hook(exc_type, exc, tb)
+
+    sys.excepthook = _hook
+
+    def _at_exit():
+        # a clean exit writes nothing; an exit after anomalies with no
+        # bundle captured yet is the black box's last chance
+        try:
+            if ds_anomaly.recent() and last_bundle() is None:
+                write_bundle("atexit_with_anomalies", config=cfg,
+                             out_dir=out_dir)
+        except Exception:
+            pass
+
+    atexit.register(_at_exit)
+    return True
+
+
+def _reset_for_tests() -> None:
+    """Drop the rate-limit/bundle-path state (test isolation only)."""
+    global _last_bundle_t, _last_bundle_path
+    with _lock:
+        _last_bundle_t = 0.0
+        _last_bundle_path = None

@@ -37,6 +37,12 @@ def _escape_label_value(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
+def _escape_help(v: str) -> str:
+    # HELP text escapes ONLY backslash and newline (exposition format
+    # 0.0.4); quotes are legal there, unlike in label values
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _format_value(v: float) -> str:
     if v == _INF:
         return "+Inf"
@@ -105,6 +111,38 @@ class _HistogramSeries:
     def mean(self) -> float:
         return self.sum / self.count if self.count else 0.0
 
+    def quantile(self, q: float) -> float:
+        """Estimate the q-quantile (0 <= q <= 1) from the fixed buckets
+        by linear interpolation inside the bucket holding the target
+        rank — the same estimate PromQL's ``histogram_quantile`` makes
+        server-side, available process-locally (the SLO monitor and
+        /statusz p50/p95/p99 read it without raw-sample lists).
+
+        Error is bounded by the width of the bucket the quantile lands
+        in (observations are uniform-within-bucket by assumption). The
+        first bucket interpolates from 0; a quantile landing in the
+        +Inf overflow bucket returns the largest finite bound (there is
+        no upper edge to interpolate toward). NaN when empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile q must be in [0, 1], got {q}")
+        if not self.count:
+            return float("nan")
+        target = q * self.count
+        acc = 0
+        for i, c in enumerate(self.bucket_counts):
+            if not c:
+                continue
+            if acc + c >= target:
+                if i >= len(self.bounds):      # +Inf overflow bucket
+                    return float(self.bounds[-1]) if self.bounds \
+                        else float("nan")
+                lo = float(self.bounds[i - 1]) if i else 0.0
+                hi = float(self.bounds[i])
+                frac = (target - acc) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            acc += c
+        return float(self.bounds[-1]) if self.bounds else float("nan")
+
 
 _SERIES_CLS = {"counter": _CounterSeries, "gauge": _GaugeSeries,
                "histogram": _HistogramSeries}
@@ -160,6 +198,9 @@ class _Family:
 
     def observe(self, value: float) -> None:
         self._default.observe(value)
+
+    def quantile(self, q: float) -> float:
+        return self._default.quantile(q)
 
     @property
     def value(self) -> float:
@@ -265,11 +306,17 @@ class MetricsRegistry:
         return {"metrics": out}
 
     def render_prometheus(self) -> str:
-        """Text exposition format 0.0.4 (the format Prometheus scrapes)."""
+        """Text exposition format 0.0.4 (the format Prometheus scrapes).
+
+        Correctness contract (pinned by the round-trip parse test in
+        tests/unit/telemetry/test_registry.py): ``# HELP``/``# TYPE``
+        appear exactly once per family, immediately before its samples;
+        HELP text escapes backslash and newline; label values escape
+        backslash, quote, and newline."""
         lines: List[str] = []
         for fam in self.families():
             if fam.help:
-                lines.append(f"# HELP {fam.name} {fam.help}")
+                lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
             lines.append(f"# TYPE {fam.name} {fam.kind}")
             for values, s in fam.series():
                 label_s = _label_str(fam.labelnames, values)

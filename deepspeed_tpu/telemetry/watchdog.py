@@ -140,6 +140,14 @@ def record_compile(program: str, seconds: float,
            "time": time.time()}
     with _lock:
         _events.append(rec)
+    # mirror into the flight recorder: a compile near an incident is a
+    # prime suspect, and the black box should hold it without anyone
+    # having to correlate the watchdog's own deque after the fact
+    from . import recorder as ds_recorder
+    ds_recorder.record(
+        "xla_compile", program=program, seconds=round(float(seconds), 4),
+        signature=repr(signature) if signature else None,
+        steady_state=_steady and not analysis, analysis=analysis)
     if _steady and not analysis:
         steady_total.labels(program=program).inc()
         logger.warning(

@@ -26,6 +26,15 @@ Gated metrics (see ``collect()``):
     collectives the scheduler left without an overlap window
     (utils/xla_profile.analyze_grad_exchange; the PR-4 regression
     metric).
+  * ``recorder_events_per_decode_step`` /
+    ``recorder_ns_per_event`` — flight-recorder overhead
+    (telemetry/recorder.py): how many black-box events the serving
+    workload records per decode step, and the per-event record() cost
+    measured directly. The recorder is always on; these keep it from
+    ever silently becoming the hot path (the ns metric gets a wide
+    absolute tolerance — it guards against order-of-magnitude
+    regressions like snapshotting state per event, not scheduler
+    jitter).
 
 Usage::
 
@@ -130,11 +139,14 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
                                             RaggedInferenceEngineConfig)
     from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
     from deepspeed_tpu.models import TransformerConfig, TransformerLM
-    from deepspeed_tpu.telemetry import (MetricsRegistry, get_registry,
-                                         set_registry, watchdog)
+    from deepspeed_tpu.telemetry import (FlightRecorder, MetricsRegistry,
+                                         get_recorder, get_registry,
+                                         set_recorder, set_registry,
+                                         watchdog)
     from deepspeed_tpu.telemetry import memory as ds_memory
 
     prev = set_registry(MetricsRegistry())
+    prev_rec = set_recorder(FlightRecorder())
     watchdog.reset()
     ds_memory.reset()   # collect() must gate ITS programs, not stale or
     # co-resident engines' records (and must not leave toy records behind)
@@ -163,6 +175,8 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
         base_syncs = fam_total("inference_decode_host_syncs_total")
         base_toks = fam_total("inference_decode_tokens_total")
         base_compiles = fam_total("xla_compile_events_total")
+        base_steps = fam_total("inference_decode_steps_total")
+        base_rec = get_recorder().stats()["recorded"]
         watchdog.mark_steady(True)
         try:
             eng.generate(prompts, max_new_tokens=new_tokens,
@@ -189,6 +203,25 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
         metrics["decode_window_peak_bytes"] = float(prog["peak_bytes"])
         metrics["kv_pool_utilization_peak"] = reg.gauge(
             "inference_kv_pool_utilization_peak").value
+
+        # -- flight-recorder overhead (always-on black box) ---------------
+        steps = fam_total("inference_decode_steps_total") - base_steps
+        rec_events = get_recorder().stats()["recorded"] - base_rec
+        metrics["recorder_events_per_decode_step"] = (
+            rec_events / steps if steps else 0.0)
+        import time as _time
+        bench_rec = FlightRecorder()
+        prev_bench = set_recorder(bench_rec)
+        try:
+            n = 20000
+            t0 = _time.perf_counter()
+            for i in range(n):
+                bench_rec.record("gate_bench", uid=i, step=i,
+                                 value=0.5, note="perf-gate probe")
+            metrics["recorder_ns_per_event"] = (
+                (_time.perf_counter() - t0) / n * 1e9)
+        finally:
+            set_recorder(prev_bench)
 
         # -- training side: the REAL dp8 bucketed-overlap train step,
         # AOT-compiled against a v5e:2x4 topology with the libtpu host
@@ -233,6 +266,7 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
         watchdog.reset()
         ds_memory.reset()
         set_registry(prev)
+        set_recorder(prev_rec)
     return metrics
 
 
@@ -249,6 +283,17 @@ def make_baseline(metrics: Dict[str, float]) -> Dict[str, Any]:
         elif name == "decode_host_syncs_per_token":
             spec[name] = {"value": value, "direction": "max",
                           "rel_tol": 0.01}
+        elif name == "recorder_events_per_decode_step":
+            # structural: events per step is a property of the call
+            # sites, not the machine — small absolute slack only
+            spec[name] = {"value": value, "direction": "max",
+                          "abs_tol": 2.0}
+        elif name == "recorder_ns_per_event":
+            # wall-clock-ish: wide absolute tolerance so scheduler
+            # jitter never flaps the gate, but an order-of-magnitude
+            # regression (per-event snapshotting, lock convoy) fails
+            spec[name] = {"value": value, "direction": "max",
+                          "abs_tol": 20000.0}
         elif name.endswith("fraction") or name.endswith("peak"):
             spec[name] = {"value": value, "direction": "max",
                           "abs_tol": 0.05, "optional": "train" in name}

@@ -1,0 +1,334 @@
+"""Anomaly detector unit tests: NaN/spike loss with bucket attribution,
+SLO burn-rate multi-window behavior (degradation up, recovery down),
+the stall watchdog's adaptive deadline + stack dumps, and KV-pool leak
+reconciliation."""
+
+import math
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.telemetry import (FlightRecorder, MetricsRegistry,
+                                     get_recorder, get_registry,
+                                     set_recorder, set_registry)
+from deepspeed_tpu.telemetry import anomaly
+from deepspeed_tpu.telemetry.anomaly import (DiagnosticsConfig,
+                                             KVLeakDetector,
+                                             LossAnomalyDetector,
+                                             SLOBurnRateMonitor,
+                                             StallWatchdog, estimate_over)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    prev_reg = set_registry(MetricsRegistry())
+    prev_rec = set_recorder(FlightRecorder())
+    anomaly.reset()
+    yield get_registry()
+    anomaly.reset()
+    set_recorder(prev_rec)
+    set_registry(prev_reg)
+
+
+def _anomaly_count(kind):
+    fam = get_registry().get("anomaly_events_total")
+    return fam.labels(kind=kind).value if fam else 0.0
+
+
+# -- report plumbing --------------------------------------------------------
+def test_report_feeds_counter_recorder_and_ledger(_fresh):
+    v = anomaly.report("stall", "test summary", channel="x")
+    assert _anomaly_count("stall") == 1
+    assert anomaly.recent()[-1]["summary"] == "test summary"
+    evs = get_recorder().events(kind="anomaly")
+    assert evs and evs[-1]["anomaly"] == "stall"
+    assert v["channel"] == "x"
+
+
+# -- loss/grad anomalies ----------------------------------------------------
+def test_nan_loss_names_offending_bucket(_fresh):
+    det = LossAnomalyDetector(DiagnosticsConfig(),
+                              leaf_names=["embed", "layers/attn/wq",
+                                          "layers/mlp/w1"])
+    # healthy baseline steps
+    for s in range(10):
+        det.update(s, 2.0 + 0.01 * s, 1.0,
+                   leaf_sqnorms=np.array([1.0, 4.0, 0.25]))
+    v = det.update(10, float("nan"), float("nan"),
+                   leaf_sqnorms=np.array([1.0, float("nan"), 0.25]))
+    assert v is not None and v["kind"] == "nan_loss"
+    assert v["top_buckets"][0]["bucket"] == "layers/attn/wq"
+    assert v["top_buckets"][0]["non_finite"] is True
+    assert _anomaly_count("nan_loss") == 1
+
+
+def test_loss_spike_zscore_with_attribution(_fresh):
+    det = LossAnomalyDetector(DiagnosticsConfig(loss_zscore=6.0),
+                              leaf_names=["a", "b", "c"])
+    rng = np.random.default_rng(0)
+    for s in range(32):
+        det.update(s, 2.0 + 0.01 * float(rng.standard_normal()), 1.0,
+                   leaf_sqnorms=np.array([1.0, 1.0, 1.0]))
+    # a 100x loss with bucket "b" blowing up
+    v = det.update(32, 200.0, 30.0,
+                   leaf_sqnorms=np.array([1.0, 900.0, 1.0]))
+    assert v is not None and v["kind"] == "loss_spike"
+    assert v["zscore"] > 6.0
+    assert v["top_buckets"][0]["bucket"] == "b"
+    # anomalous values never poison the baseline: the next healthy
+    # step is not flagged
+    assert det.update(33, 2.0, 1.0,
+                      leaf_sqnorms=np.array([1.0, 1.0, 1.0])) is None
+
+
+def test_fp16_skip_step_is_not_an_anomaly(_fresh):
+    det = LossAnomalyDetector(DiagnosticsConfig())
+    for s in range(10):
+        det.update(s, 2.0, 1.0)
+    # overflowed grads + finite loss + skip flag = dynamic loss scaling
+    # working as designed
+    assert det.update(10, 2.0, float("inf"), skipped=True) is None
+    assert _anomaly_count("nan_grad") == 0
+    # but a genuinely NaN loss on a skipped step still fires
+    assert det.update(11, float("nan"), float("inf"),
+                      skipped=True)["kind"] == "nan_loss"
+
+
+def test_healthy_stream_raises_nothing(_fresh):
+    det = LossAnomalyDetector(DiagnosticsConfig())
+    rng = np.random.default_rng(1)
+    for s in range(200):
+        assert det.update(s, 2.0 + 0.05 * float(rng.standard_normal()),
+                          1.0 + 0.02 * float(rng.standard_normal())) \
+            is None
+
+
+# -- SLO burn rate ----------------------------------------------------------
+def test_estimate_over_interpolates(_fresh):
+    h = get_registry().histogram("x_seconds", buckets=(0.1, 0.2, 0.4))
+    for v in [0.05] * 50 + [0.15] * 30 + [0.3] * 20:
+        h.observe(v)
+    s = h._series[()]
+    assert estimate_over(s, 0.2) == pytest.approx(20.0)
+    assert estimate_over(s, 0.1) == pytest.approx(50.0)
+    # mid-bucket: half of the (0.1, 0.2] bucket counts as under
+    assert estimate_over(s, 0.15) == pytest.approx(35.0)
+
+
+def test_burn_rate_rises_on_degradation_and_recovers(_fresh):
+    """The acceptance scenario: synthetic TTFT degradation drives the
+    fast-window burn above threshold (verdict fires once both windows
+    agree); recovery brings the fast window back down and re-arms."""
+    reg = get_registry()
+    ttft = reg.histogram("serving_ttft_seconds", unit="s")
+    clock = {"t": 0.0}
+    cfg = DiagnosticsConfig(ttft_slo_s=0.5, slo_target=0.99,
+                            burn_threshold=2.0, slo_fast_window_s=10.0,
+                            slo_slow_window_s=60.0, slo_min_samples=10)
+    mon = SLOBurnRateMonitor(cfg, registry=reg,
+                             clock=lambda: clock["t"],
+                             signals=[("ttft", "serving_ttft_seconds",
+                                       0.5)])
+    # healthy traffic: 1% tail right at budget
+    for step in range(20):
+        clock["t"] += 1.0
+        for _ in range(99):
+            ttft.observe(0.05)
+        ttft.observe(1.0)
+        burns = mon.tick()
+    assert burns["ttft"]["fast"] == pytest.approx(1.0, rel=0.2)
+    assert _anomaly_count("slo_burn") == 0
+
+    # degradation: 30% of requests blow the bound
+    for step in range(70):
+        clock["t"] += 1.0
+        for _ in range(70):
+            ttft.observe(0.05)
+        for _ in range(30):
+            ttft.observe(2.0)
+        burns = mon.tick()
+    assert burns["ttft"]["fast"] > 2.0 and burns["ttft"]["slow"] > 2.0
+    assert _anomaly_count("slo_burn") == 1          # fires once, not 70x
+    g = reg.get("slo_burn_rate")
+    assert g.labels(signal="ttft", window="fast").value > 2.0
+
+    # recovery: fast window drains within ~its width and re-arms
+    for step in range(15):
+        clock["t"] += 1.0
+        for _ in range(100):
+            ttft.observe(0.05)
+        burns = mon.tick()
+    assert burns["ttft"]["fast"] < 2.0
+    assert get_recorder().events(kind="slo_recovered")
+    # a second excursion can fire again
+    for step in range(80):
+        clock["t"] += 1.0
+        for _ in range(2):
+            ttft.observe(2.0)
+        ttft.observe(0.05)
+        mon.tick()
+    assert _anomaly_count("slo_burn") == 2
+
+
+def test_no_traffic_is_zero_burn(_fresh):
+    reg = get_registry()
+    reg.histogram("serving_ttft_seconds", unit="s")
+    mon = SLOBurnRateMonitor(DiagnosticsConfig(), registry=reg)
+    burns = mon.tick()
+    assert burns["ttft"]["fast"] == 0.0
+
+
+def test_cold_start_blip_below_min_samples_does_not_page(_fresh):
+    """One compile-inflated token out of a handful of observations is
+    noise, not a 14x burn: windows under slo_min_samples read 0."""
+    reg = get_registry()
+    tpot = reg.histogram("serving_tpot_seconds", unit="s")
+    cfg = DiagnosticsConfig(tpot_slo_s=0.25, slo_min_samples=50)
+    mon = SLOBurnRateMonitor(cfg, registry=reg, clock=lambda: 100.0,
+                             signals=[("tpot", "serving_tpot_seconds",
+                                       0.25)])
+    for _ in range(6):
+        tpot.observe(0.004)
+    tpot.observe(1.5)          # the first-window compile gap
+    burns = mon.tick()
+    assert burns["tpot"]["fast"] == 0.0
+    assert _anomaly_count("slo_burn") == 0
+
+
+def test_quantiles_for_statusz(_fresh):
+    reg = get_registry()
+    ttft = reg.histogram("serving_ttft_seconds", unit="s")
+    for v in [0.01] * 90 + [0.3] * 10:
+        ttft.observe(v)
+    mon = SLOBurnRateMonitor(DiagnosticsConfig(), registry=reg)
+    q = mon.quantiles()
+    assert q["ttft"]["count"] == 100
+    assert q["ttft"]["p50"] <= q["ttft"]["p95"] <= q["ttft"]["p99"]
+    assert math.isfinite(q["ttft"]["p99"])
+
+
+# -- stall watchdog ---------------------------------------------------------
+def test_stall_fires_with_stack_dump_and_recovers(_fresh):
+    clock = {"t": 0.0}
+    wd = StallWatchdog(DiagnosticsConfig(stall_min_deadline_s=1.0,
+                                         stall_factor=4.0),
+                       clock=lambda: clock["t"])
+    wd.register("loop", min_deadline_s=1.0)
+    wd.set_active("loop", True)
+    for _ in range(8):   # steady cadence: median interval 0.1s
+        clock["t"] += 0.1
+        wd.beat("loop")
+    assert wd.check_now() == []       # healthy
+    clock["t"] += 1.5                 # > max(1.0, 4 x 0.1)
+    verdicts = wd.check_now()
+    assert len(verdicts) == 1 and verdicts[0]["kind"] == "stall"
+    assert verdicts[0]["channel"] == "loop"
+    # the stack dump names this (the test runner's) thread somewhere
+    assert any("test_anomaly" in "".join(frames)
+               for frames in verdicts[0]["stacks"].values())
+    # one verdict per episode, not one per scan
+    clock["t"] += 5.0
+    assert wd.check_now() == []
+    # a beat recovers the channel and re-arms detection
+    wd.beat("loop")
+    assert get_recorder().events(kind="stall_recovered")
+    clock["t"] += 10.0
+    assert len(wd.check_now()) == 1
+
+
+def test_adaptive_deadline_follows_slow_cadence(_fresh):
+    """A workload whose windows take 2s must not be flagged at the 1s
+    floor: the deadline is factor x the channel's own median."""
+    clock = {"t": 0.0}
+    wd = StallWatchdog(DiagnosticsConfig(stall_min_deadline_s=1.0,
+                                         stall_factor=4.0),
+                       clock=lambda: clock["t"])
+    wd.register("slow")
+    wd.set_active("slow", True)
+    for _ in range(8):
+        clock["t"] += 2.0
+        wd.beat("slow")
+    clock["t"] += 6.0                 # < 4 x 2s: fine
+    assert wd.check_now() == []
+    clock["t"] += 3.0                 # 9s > 8s deadline
+    assert len(wd.check_now()) == 1
+
+
+def test_inactive_channel_never_fires(_fresh):
+    clock = {"t": 0.0}
+    wd = StallWatchdog(DiagnosticsConfig(stall_min_deadline_s=0.5),
+                       clock=lambda: clock["t"])
+    wd.register("idle")
+    wd.beat("idle")
+    clock["t"] += 100.0
+    assert wd.check_now() == []       # never set_active
+
+
+def test_watchdog_thread_detects_real_stall(_fresh):
+    """End-to-end with the real thread and clock: a channel that stops
+    beating trips within the configured deadline."""
+    wd = StallWatchdog(DiagnosticsConfig(stall_min_deadline_s=0.15,
+                                         stall_check_interval_s=0.03))
+    wd.register("t", min_deadline_s=0.15)
+    wd.start()
+    try:
+        wd.set_active("t", True)
+        wd.beat("t")
+        deadline = time.time() + 3.0
+        while not get_recorder().events(kind="anomaly") \
+                and time.time() < deadline:
+            time.sleep(0.02)
+        evs = get_recorder().events(kind="anomaly")
+        assert evs and evs[-1]["anomaly"] == "stall"
+    finally:
+        wd.stop()
+
+
+# -- KV leak detection ------------------------------------------------------
+def _state_manager(num_blocks=17, block_size=4, prefix=False):
+    from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+    from deepspeed_tpu.inference.v2.ragged.ragged_manager import \
+        DSStateManager
+    return DSStateManager(DSStateManagerConfig(
+        max_tracked_sequences=8, max_seq_len=32, num_blocks=num_blocks,
+        block_size=block_size, enable_prefix_caching=prefix))
+
+
+def test_clean_drain_reconciles(_fresh):
+    sm = _state_manager()
+    sm.ensure_blocks(1, 8)
+    sm.flush_sequence(1)
+    det = KVLeakDetector()
+    assert det.check_at_drain(sm, inflight_uids=[]) is None
+    assert get_recorder().events(kind="kv_drain_clean")
+    assert _anomaly_count("kv_leak") == 0
+
+
+def test_skipped_free_path_is_reported(_fresh):
+    """The acceptance scenario: a sequence whose free path was skipped
+    is named at drain."""
+    sm = _state_manager()
+    sm.ensure_blocks(1, 8)
+    sm.ensure_blocks(2, 4)
+    sm.flush_sequence(2)              # 2 freed properly; 1 leaked
+    v = KVLeakDetector().check_at_drain(sm, inflight_uids=[])
+    assert v is not None and v["kind"] == "kv_leak"
+    assert v["orphan_uids"] == [1]
+    assert v["orphan_blocks"] == 2    # 8 tokens / block_size 4
+    assert _anomaly_count("kv_leak") == 1
+
+
+def test_inflight_sequences_are_not_leaks(_fresh):
+    sm = _state_manager()
+    sm.ensure_blocks(5, 8)
+    assert KVLeakDetector().check_at_drain(sm, inflight_uids=[5]) is None
+
+
+def test_prefix_retained_blocks_are_not_leaks(_fresh):
+    sm = _state_manager(prefix=True)
+    seq = sm.ensure_blocks(1, 8)
+    seq.token_log = list(range(8))
+    sm.flush_sequence(1)              # registers 2 blocks in the index
+    assert sm.free_blocks() < sm.config.num_blocks - 1
+    assert KVLeakDetector().check_at_drain(sm, inflight_uids=[]) is None

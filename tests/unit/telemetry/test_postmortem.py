@@ -1,0 +1,140 @@
+"""Post-mortem bundle tests: layout/contents, rate limiting, and the
+crash-handler hooks (in a subprocess — they are process-global)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.telemetry import (FlightRecorder, MetricsRegistry,
+                                     get_registry, set_recorder,
+                                     set_registry)
+from deepspeed_tpu.telemetry import anomaly, postmortem
+from deepspeed_tpu.telemetry.anomaly import DiagnosticsConfig
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    prev_reg = set_registry(MetricsRegistry())
+    prev_rec = set_recorder(FlightRecorder())
+    anomaly.reset()
+    postmortem._reset_for_tests()
+    yield
+    anomaly.reset()
+    postmortem._reset_for_tests()
+    set_recorder(prev_rec)
+    set_registry(prev_reg)
+
+
+def _load(path, name):
+    with open(os.path.join(path, f"{name}.json")) as fh:
+        return json.load(fh)
+
+
+def test_bundle_layout_and_contents(tmp_path, _fresh):
+    from deepspeed_tpu.telemetry import get_recorder, trace
+    reg = get_registry()
+    reg.counter("bundle_probe_total").inc(7)
+    with trace.span("bundle_span"):
+        pass
+    get_recorder().record("train_step", step=3, loss=2.0)
+    anomaly.report("nan_loss", "probe verdict", step=3)
+
+    path = postmortem.write_bundle(
+        "unit_test", config=DiagnosticsConfig(), out_dir=str(tmp_path))
+    assert os.path.basename(path).startswith("postmortem-")
+    assert "unit_test" in path
+    manifest = _load(path, "manifest")
+    assert manifest["reason"] == "unit_test"
+    assert "collection_errors" not in manifest
+    for section in ("metrics", "timeline", "memory", "recorder",
+                    "anomalies", "fingerprint"):
+        assert section in manifest["files"]
+        assert os.path.exists(os.path.join(path, f"{section}.json"))
+    # each artifact holds what it claims
+    assert _load(path, "metrics")["metrics"][
+        "bundle_probe_total"]["series"][0]["value"] == 7
+    assert any(e["name"] == "bundle_span"
+               for e in _load(path, "timeline")["traceEvents"])
+    rec = _load(path, "recorder")
+    assert any(e["kind"] == "train_step" for e in rec["events"])
+    assert _load(path, "anomalies")[-1]["kind"] == "nan_loss"
+    assert "jax" in _load(path, "fingerprint")
+    assert postmortem.last_bundle() == path
+
+
+def test_rate_limit_and_force(tmp_path, _fresh):
+    cfg = DiagnosticsConfig(postmortem_min_interval_s=3600)
+    p1 = postmortem.write_bundle("first", config=cfg,
+                                 out_dir=str(tmp_path))
+    # rate-limited call returns the previous bundle instead of writing
+    p2 = postmortem.maybe_write_bundle("second", config=cfg,
+                                       out_dir=str(tmp_path))
+    assert p2 == p1
+    assert len(os.listdir(tmp_path)) == 1
+    # force always writes
+    p3 = postmortem.write_bundle("third", config=cfg,
+                                 out_dir=str(tmp_path))
+    assert p3 != p1 and len(os.listdir(tmp_path)) == 2
+
+
+def test_hostile_reason_is_sanitized(tmp_path, _fresh):
+    p = postmortem.write_bundle("../../etc passwd!",
+                                out_dir=str(tmp_path))
+    assert os.path.dirname(p) == str(tmp_path)
+    assert ".." not in os.path.basename(p)
+
+
+_CRASH_SCRIPT = r"""
+import sys
+from deepspeed_tpu.telemetry import postmortem
+from deepspeed_tpu.telemetry.anomaly import DiagnosticsConfig
+postmortem.install_crash_handler(
+    DiagnosticsConfig(postmortem_dir=sys.argv[1]))
+raise RuntimeError("boom for the black box")
+"""
+
+_ATEXIT_SCRIPT = r"""
+import sys
+from deepspeed_tpu.telemetry import anomaly, postmortem
+from deepspeed_tpu.telemetry.anomaly import DiagnosticsConfig
+postmortem.install_crash_handler(
+    DiagnosticsConfig(postmortem_dir=sys.argv[1]))
+if sys.argv[2] == "anomalous":
+    anomaly.report("stall", "wedged before exit")
+"""
+
+
+def _run(script, *args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TPU_SKIP_MDS_QUERY="1")
+    return subprocess.run([sys.executable, "-c", script, *args],
+                          capture_output=True, text=True, env=env,
+                          cwd=os.path.dirname(os.path.dirname(
+                              os.path.dirname(os.path.dirname(
+                                  os.path.abspath(__file__))))))
+
+
+def test_unhandled_exception_writes_bundle(tmp_path, _fresh):
+    out = _run(_CRASH_SCRIPT, str(tmp_path))
+    assert out.returncode != 0
+    assert "boom for the black box" in out.stderr   # traceback intact
+    bundles = os.listdir(tmp_path)
+    assert len(bundles) == 1 and "unhandled_RuntimeError" in bundles[0]
+    manifest = _load(os.path.join(str(tmp_path), bundles[0]), "manifest")
+    assert "boom" in manifest["extra"]["exception"]
+
+
+def test_atexit_writes_only_after_anomalies(tmp_path, _fresh):
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    out = _run(_ATEXIT_SCRIPT, str(clean), "clean")
+    assert out.returncode == 0
+    assert os.listdir(clean) == []          # clean exit stays silent
+    dirty = tmp_path / "dirty"
+    dirty.mkdir()
+    out = _run(_ATEXIT_SCRIPT, str(dirty), "anomalous")
+    assert out.returncode == 0
+    bundles = os.listdir(dirty)
+    assert len(bundles) == 1 and "atexit_with_anomalies" in bundles[0]

@@ -1,0 +1,140 @@
+"""Flight recorder unit tests: byte-budget eviction, typed-event
+filtering, metric series, thread safety, and the process-default swap."""
+
+import json
+import threading
+
+import pytest
+
+from deepspeed_tpu.telemetry import (FlightRecorder, MetricsRegistry,
+                                     get_recorder, set_recorder,
+                                     set_registry)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    prev_reg = set_registry(MetricsRegistry())
+    prev_rec = set_recorder(FlightRecorder())
+    yield get_recorder()
+    set_recorder(prev_rec)
+    set_registry(prev_reg)
+
+
+def test_events_are_typed_ordered_and_json_serializable(_fresh):
+    r = _fresh
+    r.record("train_step", step=1, loss=2.5)
+    r.record("admit", uid=7, tenant="t")
+    r.record("train_step", step=2, loss=2.4)
+    evs = r.events()
+    assert [e["kind"] for e in evs] == ["train_step", "admit",
+                                       "train_step"]
+    assert [e["seq"] for e in evs] == sorted(e["seq"] for e in evs)
+    # monotonic timestamps and wall clocks present
+    assert all(e["t"] > 0 and e["wall"] > 0 for e in evs)
+    json.dumps(evs)   # bundles dump them verbatim
+    # filtering
+    assert [e["step"] for e in r.events(kind="train_step")] == [1, 2]
+    assert [e["step"] for e in r.events(kind="train_step",
+                                        last=1)] == [2]
+
+
+def test_byte_budget_evicts_oldest(_fresh):
+    r = FlightRecorder(max_bytes=2000)
+    for i in range(100):
+        r.record("e", i=i, pad="x" * 50)
+    st = r.stats()
+    assert st["bytes"] <= 2000
+    assert st["dropped"] > 0
+    assert st["recorded"] == 100
+    evs = r.events()
+    # oldest evicted, newest retained, order preserved
+    assert evs[-1]["i"] == 99
+    assert evs[0]["i"] == 100 - len(evs)
+    assert [e["i"] for e in evs] == list(range(evs[0]["i"], 100))
+
+
+def test_chatty_kind_cannot_starve_history_shape(_fresh):
+    """The budget is bytes, not events: one big event displaces many
+    small ones and vice versa, but the buffer never exceeds budget."""
+    r = FlightRecorder(max_bytes=4096)
+    r.record("big", blob="y" * 3000)
+    for i in range(50):
+        r.record("small", i=i)
+    assert r.stats()["bytes"] <= 4096
+    assert r.events()[-1]["kind"] == "small"
+
+
+def test_set_budget_shrinks_immediately(_fresh):
+    r = _fresh
+    for i in range(50):
+        r.record("e", i=i, pad="z" * 100)
+    before = r.stats()["bytes"]
+    r.set_budget(before // 4)
+    assert r.stats()["bytes"] <= before // 4
+    assert r.events()[-1]["i"] == 49
+
+
+def test_registry_series(_fresh):
+    from deepspeed_tpu.telemetry import get_registry
+    r = _fresh
+    for _ in range(3):
+        r.record("decode_window", batch=2)
+    r.record("anomaly", anomaly="stall")
+    reg = get_registry()
+    fam = reg.get("recorder_events_total")
+    assert fam.labels(kind="decode_window").value == 3
+    assert fam.labels(kind="anomaly").value == 1
+    assert reg.get("recorder_buffer_bytes").value == r.stats()["bytes"]
+
+
+def test_registry_swap_is_picked_up(_fresh):
+    """The cached series must follow set_registry (test isolation)."""
+    from deepspeed_tpu.telemetry import get_registry
+    r = _fresh
+    r.record("a")
+    fresh = MetricsRegistry()
+    prev = set_registry(fresh)
+    try:
+        r.record("a")
+        assert get_registry().get(
+            "recorder_events_total").labels(kind="a").value == 1
+    finally:
+        set_registry(prev)
+
+
+def test_disabled_recorder_records_nothing(_fresh):
+    r = _fresh
+    r.enabled = False
+    assert r.record("e") is None
+    assert r.stats()["recorded"] == 0
+    r.enabled = True
+    assert r.record("e") is not None
+
+
+def test_concurrent_writers_keep_accounting_consistent(_fresh):
+    r = FlightRecorder(max_bytes=64 * 1024)
+    n_threads, per_thread = 8, 500
+
+    def writer(t):
+        for i in range(per_thread):
+            r.record("w", thread=t, i=i, pad="p" * 40)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    st = r.stats()
+    assert st["recorded"] == n_threads * per_thread
+    assert st["retained"] == len(r.events())
+    assert st["bytes"] <= 64 * 1024
+    # per-event byte accounting reconciles exactly with the retained set
+    from deepspeed_tpu.telemetry.recorder import _event_bytes
+    assert st["bytes"] == sum(_event_bytes(e) for e in r.events())
+
+
+def test_module_level_record_goes_to_default(_fresh):
+    from deepspeed_tpu.telemetry import recorder as flight
+    flight.record("via_module", x=1)
+    assert get_recorder().events(kind="via_module")

@@ -163,3 +163,97 @@ def test_reset_drops_families(reg):
 
 def test_default_buckets_sorted():
     assert list(DEFAULT_BUCKETS) == sorted(DEFAULT_BUCKETS)
+
+
+# -- quantile estimation (PR 6 satellite) -----------------------------------
+def test_histogram_quantile_interpolates_within_buckets(reg):
+    h = reg.histogram("q_seconds", buckets=(0.1, 0.2, 0.4))
+    for v in [0.05] * 50 + [0.15] * 30 + [0.3] * 20:
+        h.observe(v)
+    # p50 lands exactly at the first bucket's upper edge (50/100 obs)
+    assert h.quantile(0.5) == pytest.approx(0.1)
+    # p60: 10 of the 30 obs in (0.1, 0.2] -> 1/3 into the bucket
+    assert h.quantile(0.6) == pytest.approx(0.1 + (0.2 - 0.1) / 3)
+    # p95: 15 of the 20 obs in (0.2, 0.4] -> 3/4 into the bucket
+    assert h.quantile(0.95) == pytest.approx(0.2 + (0.4 - 0.2) * 0.75)
+    # monotone in q
+    qs = [h.quantile(q / 20) for q in range(21)]
+    assert qs == sorted(qs)
+
+
+def test_histogram_quantile_overflow_and_empty(reg):
+    h = reg.histogram("q2_seconds", buckets=(0.1, 0.2))
+    assert h.quantile(0.5) != h.quantile(0.5)   # NaN when empty
+    for v in (5.0, 6.0, 7.0):
+        h.observe(v)
+    # everything in the +Inf bucket: report the largest finite bound
+    # (documented: no upper edge to interpolate toward)
+    assert h.quantile(0.5) == pytest.approx(0.2)
+    with pytest.raises(ValueError, match="in \\[0, 1\\]"):
+        h.quantile(1.5)
+
+
+# -- exposition round-trip (PR 6 satellite) ---------------------------------
+def _parse_exposition(text):
+    """Minimal 0.0.4 parser: returns ({name: kind}, {name: [help lines]},
+    [(metric, labels_dict, value)])."""
+    import re
+    types, helps, samples = {}, {}, []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            types.setdefault(name, []).append(kind)
+            continue
+        if line.startswith("# HELP "):
+            _, _, name, help_text = line.split(" ", 3)
+            helps.setdefault(name, []).append(help_text)
+            continue
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)$",
+                     line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, labels_raw, value = m.groups()
+        labels = {}
+        if labels_raw:
+            for lm in re.finditer(r'(\w+)="((?:[^"\\]|\\.)*)"',
+                                  labels_raw[1:-1]):
+                k, v = lm.groups()
+                labels[k] = (v.replace("\\n", "\n").replace('\\"', '"')
+                             .replace("\\\\", "\\"))
+        samples.append((name, labels, value))
+    return types, helps, samples
+
+
+def test_render_prometheus_round_trip_with_hostile_values(reg):
+    """Escaping + exactly-once TYPE/HELP, verified by parsing the
+    exposition back: hostile label values (backslash, quote, newline)
+    and newline-bearing help text survive a round trip."""
+    hostile = 'a\\b"c\nd'
+    c = reg.counter("rt_total", 'help with "quotes", \\ and\nnewline',
+                    labelnames=("tenant",))
+    c.labels(tenant=hostile).inc(3)
+    c.labels(tenant="plain").inc(1)
+    h = reg.histogram("rt_seconds", "hist help", buckets=(0.1, 1.0),
+                      labelnames=("op",))
+    h.labels(op=hostile).observe(0.5)
+    text = reg.render_prometheus()
+    # every line is a comment or a sample; the parser asserts that
+    types, helps, samples = _parse_exposition(text)
+    # TYPE and HELP exactly once per family
+    assert types["rt_total"] == ["counter"]
+    assert types["rt_seconds"] == ["histogram"]
+    assert len(helps["rt_total"]) == 1
+    # help newline/backslash escaped on the wire, recoverable
+    assert "\n" not in helps["rt_total"][0]
+    assert helps["rt_total"][0].replace("\\n", "\n").replace(
+        "\\\\", "\\") == 'help with "quotes", \\ and\nnewline'
+    # hostile label value round-trips exactly
+    got = {(n, l.get("tenant")): v for n, l, v in samples
+           if n == "rt_total"}
+    assert got[("rt_total", hostile)] == "3"
+    assert got[("rt_total", "plain")] == "1"
+    # histogram series parse with the le label intact alongside op
+    le_vals = [l["le"] for n, l, _ in samples
+               if n == "rt_seconds_bucket" and l.get("op") == hostile]
+    assert le_vals == ["0.1", "1", "+Inf"]

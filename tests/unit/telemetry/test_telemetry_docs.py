@@ -1,0 +1,61 @@
+"""Tier-1 enforcement of the docs/TELEMETRY.md metrics catalog
+(scripts/check_telemetry_docs.py): every literal metric name registered
+in the package has a catalog row, and every row names a real metric."""
+
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[3]
+sys.path.insert(0, str(REPO / "scripts"))
+
+import check_telemetry_docs  # noqa: E402
+
+
+def test_extractors_see_the_known_metrics():
+    """Sanity-pin the extractors themselves (an empty set passing the
+    cross-check would mean the regexes rotted, not that docs are
+    perfect)."""
+    code = check_telemetry_docs.registered_metrics(REPO)
+    assert len(code) > 40
+    for expected in ("serving_ttft_seconds", "anomaly_events_total",
+                     "recorder_events_total", "slo_burn_rate",
+                     "xla_compile_events_total",
+                     "inference_kv_blocks_allocated_total"):
+        assert expected in code, expected
+    docs = check_telemetry_docs.documented_metrics(REPO)
+    assert len(docs) > 40
+    # labeled rows parse to the bare family name
+    assert "anomaly_events_total" in docs
+    assert "comm_ops_total" in docs
+
+
+def test_catalog_is_in_sync():
+    undocumented, stale = check_telemetry_docs.check(REPO)
+    assert not undocumented, (
+        f"metrics registered in code but missing from docs/TELEMETRY.md: "
+        f"{sorted(undocumented)} — add catalog rows")
+    assert not stale, (
+        f"docs/TELEMETRY.md rows with no registered metric behind them: "
+        f"{sorted(stale)} — delete or fix the rename")
+
+
+def test_cli_exit_code_reflects_drift(tmp_path):
+    """The standalone script fails loudly on an undocumented metric."""
+    import shutil
+    import subprocess
+    root = tmp_path / "repo"
+    (root / "deepspeed_tpu").mkdir(parents=True)
+    (root / "docs").mkdir()
+    (root / "scripts").mkdir()
+    shutil.copy(REPO / "scripts" / "check_telemetry_docs.py",
+                root / "scripts" / "check_telemetry_docs.py")
+    (root / "deepspeed_tpu" / "m.py").write_text(
+        'reg.counter("shiny_new_total", "undocumented")\n')
+    (root / "docs" / "TELEMETRY.md").write_text(
+        "| `documented_but_gone_total` | counter | | stale |\n")
+    out = subprocess.run(
+        [sys.executable, str(root / "scripts" / "check_telemetry_docs.py")],
+        capture_output=True, text=True)
+    assert out.returncode == 1
+    assert "shiny_new_total" in out.stderr
+    assert "documented_but_gone_total" in out.stderr
