@@ -56,6 +56,17 @@ class RaggedInferenceEngineConfig:
     # bounded; per-row budgets mask shorter tails. 1 = the per-token
     # fallback path.
     decode_window: int = 8
+    # ragged paged attention (PAPERS.md arXiv:2604.15464): serve mixed
+    # prefill+decode compositions through ONE unified program per
+    # (token bucket, row bucket) instead of stitching the separate
+    # prefill/continue/decode program families.
+    #   "auto" — on wherever the ragged program can serve the model
+    #            (today: everywhere; the jnp fallback covers tp/ep,
+    #            alibi and quantized-KV configs the kernel gates off)
+    #   "on"   — force the ragged step path
+    #   "off"  — keep the stitched prefill->continue->decode dispatch
+    #            (the rollback knob; parity-tested against "on")
+    ragged_attention: str = "auto"
     seed: int = 0
 
     @classmethod

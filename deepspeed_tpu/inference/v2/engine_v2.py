@@ -6,13 +6,19 @@ prompts and one next-token per running sequence; the engine returns the
 next-token logits for every entry. KV lives in a blocked (paged) pool
 managed by DSStateManager; sequences are freed with ``flush``.
 
-TPU-native scheduling: prompts run through ``paged_prefill`` (one compiled
-program per prompt-length bucket), multi-token continuations through ONE
-fused ``paged_continue`` chunk pass, and running sequences batch into a
-``paged_decode`` call padded to the next power-of-two bucket — the
-compiled-program cache plays the role the reference's CUDA graphs + atom
-builder play. Mixed puts do the prefills/continuations first, then the
-fused decode batch.
+TPU-native scheduling: with ragged attention enabled (the default,
+``config_v2.ragged_attention``) every put() — mixed prompts,
+continuations and decode rows — packs into ONE RaggedBatch and runs as
+a single unified compiled program per (token bucket, row bucket)
+(``paged_ragged_step`` + ``kernels/ragged_attention.py``), the Ragged
+Paged Attention design (PAPERS.md arXiv:2604.15464). The stitched
+families remain behind ``ragged_attention="off"``: prompts through
+``paged_prefill`` (one compiled program per prompt-length bucket),
+multi-token continuations through ONE fused ``paged_continue`` chunk
+pass, and running sequences batched into a ``paged_decode`` call padded
+to the next power-of-two bucket — the compiled-program cache plays the
+role the reference's CUDA graphs + atom builder play. Stitched mixed
+puts do the prefills/continuations first, then the fused decode batch.
 
 The decode hot loop itself is fused on device (``decode_window`` > 1):
 ``paged_decode_window`` runs up to K decode steps per dispatch — cache
@@ -33,10 +39,13 @@ from ...models.transformer import TransformerConfig
 from ...telemetry import memory as ds_memory
 from ...telemetry import recorder as flight
 from ...telemetry import trace, watchdog
+from ...utils.bucketing import ceil_bucket, pow2_bucket
 from ...utils.logging import log_dist
 from .config_v2 import RaggedInferenceEngineConfig
 from .paged_model import (init_paged_kv_cache, paged_continue, paged_decode,
-                          paged_decode_window, paged_prefill)
+                          paged_decode_window, paged_prefill,
+                          paged_ragged_step)
+from .ragged import batch as ragged_batch
 from .ragged.blocked_allocator import NULL_BLOCK
 from .ragged.ragged_manager import DSStateManager
 
@@ -118,6 +127,14 @@ class InferenceEngineV2:
                 self.params, bits=config.quant_bits)
 
         self.state_manager = DSStateManager(sm)
+        # note: the fresh pool carries no sharding, while every program
+        # returns the donated cache with an explicit NamedSharding — so
+        # a bucket's FIRST call compiles against a different executable
+        # signature than its steady repeats (one respecialization per
+        # bucket, for the stitched families too). Warmup should replay
+        # the bucket set twice before watchdog.mark_steady(); committing
+        # the pool sharded at init was tried and destabilizes unrelated
+        # XLA-CPU executables later in the process (see PR 7 notes)
         self.kv_cache = init_paged_kv_cache(cfg, sm.num_blocks,
                                             sm.block_size, self.dtype,
                                             kv_quant=config.kv_quant)
@@ -213,6 +230,22 @@ class InferenceEngineV2:
             lambda p, ids, s, n, c, b, o, t: paged_continue(
                 cfg, p, ids, s, n, c, b, o, t, sm.block_size, topo=topo),
             donate_argnums=(4,)))
+        # ragged unified step (ROADMAP item 1; kernels/ragged_attention.py
+        # + ragged/batch.py): every mixed prefill+decode composition runs
+        # as ONE program keyed by (token bucket, row bucket, table-width
+        # bucket) — put() and the SplitFuse scheduler route here instead
+        # of sequencing the prefill/continue/decode families. The ragged
+        # kernel shares the decode kernel's gates (bf16 pool tiles, no
+        # alibi, tp=ep=1); gated-off configs serve through the jnp
+        # ragged fallback inside the same unified program.
+        self.ragged_enabled = self._resolve_ragged_mode(
+            config.ragged_attention)
+        self._ragged_jit = watchdog.watch("ragged_step", jax.jit(
+            lambda p, ids, rows, pos, ln, wb, wo, bt, li, c:
+            paged_ragged_step(
+                cfg, p, ids, rows, pos, ln, wb, wo, bt, li, c,
+                sm.block_size, use_kernel=use_kernel_decode, topo=topo),
+            donate_argnums=(9,)))
         # speculative verification: greedy ids for a static window of
         # fed positions from one fused continuation pass (prompt-lookup
         # decoding); one compiled program per window size
@@ -293,6 +326,29 @@ class InferenceEngineV2:
         self._m_fused_time = reg.histogram(
             "inference_fused_window_seconds",
             "fused multi-step decode window wall time", unit="s")
+        self._m_ragged_steps = reg.counter(
+            "inference_ragged_steps_total",
+            "unified ragged steps run (mixed prefill+decode, one "
+            "compiled program per step)")
+        self._m_ragged_tokens = reg.counter(
+            "inference_ragged_tokens_total",
+            "valid tokens run through unified ragged steps")
+        self._m_ragged_prefill_rows = reg.counter(
+            "inference_ragged_prefill_rows_total",
+            "ragged rows carrying prompt/continuation chunks")
+        self._m_ragged_decode_rows = reg.counter(
+            "inference_ragged_decode_rows_total",
+            "ragged rows carrying a single decode token")
+        self._m_ragged_time = reg.histogram(
+            "inference_ragged_step_seconds",
+            "unified ragged step wall time", unit="s")
+        self._m_ragged_pad = reg.gauge(
+            "inference_ragged_pad_fraction",
+            "padding waste of the last ragged step's token bucket")
+        self._m_ragged_host_syncs = reg.counter(
+            "inference_ragged_host_syncs_total",
+            "device->host transfers made by unified ragged steps (one "
+            "per step)")
 
     def _update_pool_telemetry(self):
         sm = self.state_manager
@@ -304,6 +360,27 @@ class InferenceEngineV2:
         if util > self._m_kv_util_peak.value:
             self._m_kv_util_peak.set(util)
         self._m_tracked.set(sm.tracked_sequences())
+
+    # ------------------------------------------------------------------
+    # Ragged mode (config_v2.ragged_attention: auto | on | off)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _resolve_ragged_mode(mode: str) -> bool:
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"ragged_attention must be 'auto', 'on' or 'off' "
+                f"(got {mode!r})")
+        # "auto" is on everywhere today: the unified program's jnp
+        # fallback covers every config the ragged kernel gates off
+        # (tp/ep, alibi, quantized KV), so there is no unsupported case
+        return mode != "off"
+
+    def set_ragged_mode(self, mode: str) -> None:
+        """Flip the ragged/stitched dispatch at runtime
+        (ServingConfig.ragged_attention routes here). Compiled programs
+        for both paths stay cached, so flipping never recompiles."""
+        self.ragged_enabled = self._resolve_ragged_mode(mode)
+        self.config.ragged_attention = mode
 
     # ------------------------------------------------------------------
     # Schedulability (reference engine_v2.py:135 query / :161 can_schedule)
@@ -336,10 +413,14 @@ class InferenceEngineV2:
             sum(lengths) <= self.state_manager.config.max_ragged_batch_size
 
     # ------------------------------------------------------------------
+    # Bucketing (shared rules: utils/bucketing.py — the same helpers key
+    # the RaggedBatch packer, so every layer buckets identically)
+    # ------------------------------------------------------------------
     def _bucket(self, n: int) -> int:
-        b = self.config.prefill_bucket
-        return min(-(-n // b) * b,
-                   -(-self.state_manager.config.max_seq_len // b) * b)
+        """Prefill chunk-length bucket (multiple of prefill_bucket,
+        capped at the max_seq_len bucket)."""
+        return ceil_bucket(n, self.config.prefill_bucket,
+                           cap=self.state_manager.config.max_seq_len)
 
     def _prefill(self, uid: int, tokens: np.ndarray) -> np.ndarray:
         sm = self.state_manager
@@ -536,20 +617,17 @@ class InferenceEngineV2:
                 plain_uids, [outs[row_of[u]][-1] for u in plain_uids]))
         return cur
 
-    @staticmethod
-    def _pow2_bucket(count: int, cap: int) -> int:
-        """Next power-of-two >= count, capped (one compiled program per
-        bucket keeps the jit-cache size logarithmic in the range)."""
-        b = 1
-        while b < count:
-            b *= 2
-        return min(b, cap)
+    # next power-of-two >= count, capped (one compiled program per
+    # bucket keeps the jit-cache size logarithmic in the range); the
+    # shared utils/bucketing rule, kept as a static method for the
+    # existing call sites
+    _pow2_bucket = staticmethod(pow2_bucket)
 
     def _decode_bucket(self, count: int) -> int:
         """Pad the decode batch to the next power-of-two bucket instead of
         always the tracked-sequence cap (one compiled program per bucket);
         fixes the fixed-cap padding waste (round-2 Weak #6)."""
-        return self._pow2_bucket(
+        return pow2_bucket(
             count, self.state_manager.config.max_tracked_sequences)
 
     @staticmethod
@@ -768,10 +846,84 @@ class InferenceEngineV2:
             cap //= 2
         return [min(cap, s) for s in sl]
 
+    # -- ragged unified step --------------------------------------------
+    def step_ragged(self, batch_uids: Sequence[int],
+                    batch_tokens: Sequence[Iterable[int]]) -> np.ndarray:
+        """One compiled launch for a MIXED batch: prompt chunks,
+        continuations and decode rows pack into a single
+        :class:`~.ragged.batch.RaggedBatch` and run through the unified
+        ragged program (paged_model.paged_ragged_step) — the dispatch
+        put() previously sequenced through the prefill / continue /
+        decode program families. Same contract as put(): returns
+        [len(batch_uids), vocab] last-token logits per entry."""
+        sm = self.state_manager
+        entries = [(int(uid), np.atleast_1d(np.asarray(toks, np.int64)))
+                   for uid, toks in zip(batch_uids, batch_tokens)]
+        if not self.can_schedule([u for u, _ in entries],
+                                 [len(t) for _, t in entries]):
+            raise RuntimeError(
+                "batch not schedulable (KV blocks / sequence budget); "
+                "check can_schedule()/query() before put()")
+        for i, (uid, toks) in enumerate(entries):
+            if not sm.known_seq(uid) and len(toks) > 1:
+                # prefix caching: shared full blocks shorten the row to
+                # its unseen suffix (same as the stitched put())
+                _, n_reused = sm.match_prefix(uid, toks)
+                if n_reused:
+                    entries[i] = (uid, toks[n_reused:])
+        # classify rows BEFORE packing mutates allocation state: a
+        # decode row is one token for a sequence with cached history
+        decode_rows = sum(
+            1 for uid, toks in entries
+            if len(toks) == 1 and sm.known_seq(uid)
+            and sm.seqs[uid].seen_tokens > 0)
+        t0 = time.perf_counter()
+        rb = ragged_batch.pack(entries, sm)
+        with trace.span("ragged_step", rows=len(entries),
+                        tokens=rb.total_tokens,
+                        uids=[u for u, _ in entries]):
+            logits, self.kv_cache = self._ragged_jit(
+                self.params, jnp.asarray(rb.ids),
+                jnp.asarray(rb.row_ids), jnp.asarray(rb.positions),
+                jnp.asarray(rb.lengths), jnp.asarray(rb.write_blocks),
+                jnp.asarray(rb.write_offsets),
+                jnp.asarray(rb.block_tables),
+                jnp.asarray(rb.last_index), self.kv_cache)
+            logits = np.asarray(logits)  # blocks: the pass completes here
+        dt = time.perf_counter() - t0
+        log_tokens = sm.config.enable_prefix_caching
+        for uid, toks in entries:
+            seq = sm.seqs[uid]
+            seq.seen_tokens += len(toks)
+            if log_tokens:
+                seq.token_log.extend(map(int, toks))
+        chunk_tokens = rb.total_tokens - decode_rows
+        self._m_ragged_steps.inc()
+        self._m_ragged_tokens.inc(rb.total_tokens)
+        self._m_ragged_prefill_rows.inc(len(entries) - decode_rows)
+        self._m_ragged_decode_rows.inc(decode_rows)
+        self._m_ragged_time.observe(dt)
+        self._m_ragged_pad.set(rb.pad_fraction)
+        self._m_ragged_host_syncs.inc()
+        # the family counters stay comparable across ragged/stitched:
+        # chunk tokens are prefill work wherever they run
+        if chunk_tokens:
+            self._m_prefill_tokens.inc(chunk_tokens)
+        flight.record("ragged_step", rows=len(entries),
+                      tokens=rb.total_tokens, bucket=rb.token_bucket,
+                      dur_s=round(dt, 5))
+        self._update_pool_telemetry()
+        return logits[:len(entries)]
+
     def put(self, batch_uids: Sequence[int],
             batch_tokens: Sequence[Iterable[int]]) -> np.ndarray:
         """Reference engine_v2.put: returns [len(batch_uids), vocab] logits
-        for the last token of each entry."""
+        for the last token of each entry. With ragged attention enabled
+        (config_v2.ragged_attention) the whole batch runs as ONE unified
+        ragged launch; otherwise the stitched dispatch below sequences
+        prefills, continuations and the batched decode."""
+        if self.ragged_enabled:
+            return self.step_ragged(batch_uids, batch_tokens)
         sm = self.state_manager
         entries = [(int(uid), np.atleast_1d(np.asarray(toks, np.int64)))
                    for uid, toks in zip(batch_uids, batch_tokens)]
@@ -868,6 +1020,21 @@ class InferenceEngineV2:
             i32(C), i32(C)).compile()
         programs["prefill"] = ds_memory.record_memory_analysis(
             "prefill", compiled)
+        if self.ragged_enabled:
+            # a representative mixed bucket: one prefill chunk plus a
+            # decode row per batch slot, full table width (the
+            # worst-case ragged program a long sequence pays). The
+            # analyzed bucket geometry rides along in the record so
+            # consumers (perf_gate's per-token normalization) read the
+            # bucket this analysis actually compiled
+            TB = pow2_bucket(self.config.prefill_bucket + N,
+                             sm.config.max_ragged_batch_size)
+            compiled = self._ragged_jit.lower(
+                params, i32(TB), i32(TB), i32(TB), i32(TB), i32(TB),
+                i32(TB), i32(N, MB), i32(N), cache).compile()
+            programs["ragged_step"] = dict(
+                ds_memory.record_memory_analysis("ragged_step", compiled),
+                token_bucket=TB, row_bucket=N)
         return {"programs": programs, "buffers": ds_memory.buffers()}
 
     # convenience: serve-style generation over the ragged engine

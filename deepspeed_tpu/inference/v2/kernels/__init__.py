@@ -1,1 +1,2 @@
 from .paged_attention import paged_attention  # noqa: F401
+from .ragged_attention import ragged_attention  # noqa: F401

@@ -1,0 +1,246 @@
+"""Ragged paged attention — one Pallas TPU kernel for mixed batches.
+
+TPU-native counterpart of the Ragged Paged Attention kernel (PAPERS.md,
+arXiv:2604.15464) and of the reference's blocked-flash + atom-builder
+pair (inference/v2/kernels/ragged_ops/): ONE kernel consumes a ragged
+batch — variable-length prefill chunks, chunked continuations, and
+single-token decode rows — as a flat token buffer with per-row paged
+block tables, and computes causal attention for every token against the
+paged KV pool in a single launch. The prefill/decode split that forced
+two compiled-program families (``paged_prefill`` per prompt bucket x
+``paged_decode`` per batch bucket) disappears at the kernel boundary.
+
+Descriptor layout (built by ``ragged.batch.RaggedBatch``):
+
+* ``q`` ``[T, nh, hd]`` — the flat new-token buffer: every row's fed
+  tokens concatenated, padded to the token bucket ``T``.
+* ``row_ids`` ``[T]`` — which batch row each token belongs to (padding
+  tokens point at row 0; their ``lengths`` entry is 0 so they attend
+  over nothing).
+* ``lengths`` ``[T]`` — per-TOKEN causal bound: how many cache positions
+  (including the token itself) the token may attend to. For a prefill
+  chunk token at absolute position p this is p+1, which is what makes
+  causal masking inside a chunk fall out of the same page walk decode
+  rows use. 0 marks padding.
+* ``block_tables`` ``[R, MB]`` — each row's paged KV block table.
+
+The KV append for the new tokens is the jnp scatter in the surrounding
+jitted layer body (``paged_model.paged_ragged_step``) — the same
+compiled launch; see the design note in paged_model.py for why the
+scatter is XLA's job (it fuses with the qkv projections) while the
+Pallas budget goes to the pool reads, which XLA would otherwise
+materialize as an [T, max_ctx, ...] gather.
+
+Two implementations, mirroring ``paged_attention.py``:
+
+* ``ragged_attention`` (grid ``(T,)``, manual DMA) — the serving path.
+  The pools stay HBM-resident; each token walks only the pages its
+  causal bound covers (``ceil(length/bs)``, a dynamic ``fori_loop``
+  bound) with double-buffered ``make_async_copy``. Decode rows walk
+  their whole context once — identical traffic to the decode kernel —
+  and prefill-chunk tokens walk their causal prefix.
+* ``ragged_attention_pipelined`` (grid ``(T, MB)``) — BlockSpec-indexed
+  variant for interpret-mode parity on CPU (the manual DMA protocol
+  wedges under interpret; same gate as the decode kernel).
+
+Both share ``_page_update`` / ``_finalize`` with the decode kernel, so a
+pure-decode ragged batch is bit-identical to ``paged_attention`` — the
+invariant the engine's ragged/stitched parity tests pin.
+
+Design note — token-grid vs query-tiling: this kernel walks pages per
+TOKEN, which makes decode rows optimal (identical traffic to the decode
+kernel) but re-streams a prefill chunk's shared prefix once per chunk
+token (O(chunk * ctx / bs) page loads instead of O(ctx / bs) per
+q-tile). The published RPA kernel tiles queries per row to amortize
+that; doing the same here means (q-tile, page) grid cells with per-row
+tile maps — the next lever on this path once chip rounds can measure
+it. The SplitFuse chunk budget bounds the waste meanwhile: chunks are
+<= token_budget tokens, and the common mixed step is decode-dominated.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .paged_attention import NEG_INF, _finalize, _interpret, _page_update
+
+
+def _ragged_kernel(row_ref, len_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_sc, m_sc, l_sc, *, bs, n_pages, scale, kvh, group):
+    """Grid (T, MB): BlockSpec-pipelined, token t streams page j of ITS
+    row's table (index map ``bt[row[t], j]``)."""
+    t = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    length = len_ref[t]
+
+    @pl.when(j * bs < length)
+    def _body():
+        _page_update(q_ref, k_ref[0].astype(jnp.float32),
+                     v_ref[0].astype(jnp.float32), j, length,
+                     acc_sc, m_sc, l_sc,
+                     bs=bs, scale=scale, kvh=kvh, group=group)
+
+    @pl.when(j == n_pages - 1)
+    def _finish():
+        _finalize(o_ref, acc_sc, l_sc, kvh=kvh, group=group)
+
+
+def _ragged_dma_kernel(row_ref, len_ref, bt_ref, q_ref, k_hbm, v_hbm,
+                       o_ref, k_sc, v_sc, acc_sc, m_sc, l_sc, sem,
+                       *, bs, scale, kvh, group):
+    """Grid (T,): per token, double-buffered manual DMA over the pages
+    its causal bound covers, out of its row's table. Same protocol as
+    the decode kernel's ``_dma_kernel`` with the table row indirected
+    through ``row_ref``."""
+    t = pl.program_id(0)
+    row = row_ref[t]
+    length = len_ref[t]
+    n_pages = (length + bs - 1) // bs
+
+    acc_sc[:] = jnp.zeros_like(acc_sc)
+    m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+    l_sc[:] = jnp.zeros_like(l_sc)
+
+    def k_dma(slot, j):
+        return pltpu.make_async_copy(
+            k_hbm.at[bt_ref[row, j]], k_sc.at[slot], sem.at[slot, 0])
+
+    def v_dma(slot, j):
+        return pltpu.make_async_copy(
+            v_hbm.at[bt_ref[row, j]], v_sc.at[slot], sem.at[slot, 1])
+
+    @pl.when(n_pages > 0)
+    def _start():
+        k_dma(0, 0).start()
+        v_dma(0, 0).start()
+
+    def body(j, _):
+        slot = jax.lax.rem(j, 2)
+        nxt = jax.lax.rem(j + 1, 2)
+
+        @pl.when(j + 1 < n_pages)
+        def _prefetch():
+            k_dma(nxt, j + 1).start()
+            v_dma(nxt, j + 1).start()
+
+        k_dma(slot, j).wait()
+        v_dma(slot, j).wait()
+        _page_update(q_ref, k_sc[slot].astype(jnp.float32),
+                     v_sc[slot].astype(jnp.float32), j, length,
+                     acc_sc, m_sc, l_sc,
+                     bs=bs, scale=scale, kvh=kvh, group=group)
+        return 0
+
+    jax.lax.fori_loop(0, n_pages, body, 0)
+
+    _finalize(o_ref, acc_sc, l_sc, kvh=kvh, group=group)
+
+
+def ragged_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
+                     v_cache: jnp.ndarray, row_ids: jnp.ndarray,
+                     lengths: jnp.ndarray,
+                     block_tables: jnp.ndarray) -> jnp.ndarray:
+    """Manual-DMA ragged paged attention (serving hot path).
+
+    q [T, nh, hd] flat token buffer; k/v_cache [nb, bs, kvh, hd];
+    row_ids [T] token -> batch row; lengths [T] per-token causal bound
+    (0 = padding); block_tables [R, MB] int32. Returns [T, nh, hd]."""
+    if _interpret():
+        # same gate as the decode kernel: interpret mode does not
+        # reliably simulate the manual DMA/semaphore protocol, and the
+        # pipelined variant is numerically identical
+        return ragged_attention_pipelined(q, k_cache, v_cache, row_ids,
+                                          lengths, block_tables)
+    T, nh, hd = q.shape
+    nb, bs, kvh, _ = k_cache.shape
+    group = nh // kvh
+    scale = 1.0 / (hd ** 0.5)
+    q4 = q.reshape(T, kvh, group, hd)
+
+    kernel = functools.partial(_ragged_dma_kernel, bs=bs, scale=scale,
+                               kvh=kvh, group=group)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, kvh, group, hd),
+                         lambda t, row, ln, bt: (t, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),    # K pool stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),    # V pool stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, kvh, group, hd),
+                               lambda t, row, ln, bt: (t, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, bs, kvh, hd), k_cache.dtype),
+            pltpu.VMEM((2, bs, kvh, hd), v_cache.dtype),
+            pltpu.VMEM((kvh * group, hd), jnp.float32),
+            pltpu.VMEM((kvh * group, 128), jnp.float32),
+            pltpu.VMEM((kvh * group, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, kvh, group, hd), q.dtype),
+        # never interpret: the early return above routed interpret mode
+        # to the pipelined variant
+        interpret=False,
+    )(row_ids.astype(jnp.int32), lengths.astype(jnp.int32),
+      block_tables.astype(jnp.int32), q4, k_cache, v_cache)
+    return out.reshape(T, nh, hd)
+
+
+def ragged_attention_pipelined(q: jnp.ndarray, k_cache: jnp.ndarray,
+                               v_cache: jnp.ndarray, row_ids: jnp.ndarray,
+                               lengths: jnp.ndarray,
+                               block_tables: jnp.ndarray) -> jnp.ndarray:
+    """BlockSpec-pipelined variant (streams all MB table slots per token;
+    kept for interpret-mode coverage). Same signature as
+    :func:`ragged_attention`."""
+    T, nh, hd = q.shape
+    nb, bs, kvh, _ = k_cache.shape
+    MB = block_tables.shape[1]
+    group = nh // kvh
+    scale = 1.0 / (hd ** 0.5)
+    q4 = q.reshape(T, kvh, group, hd)
+
+    kernel = functools.partial(_ragged_kernel, bs=bs, n_pages=MB,
+                               scale=scale, kvh=kvh, group=group)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(T, MB),
+        in_specs=[
+            pl.BlockSpec((1, kvh, group, hd),
+                         lambda t, j, row, ln, bt: (t, 0, 0, 0)),
+            pl.BlockSpec((1, bs, kvh, hd),
+                         lambda t, j, row, ln, bt: (bt[row[t], j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, kvh, hd),
+                         lambda t, j, row, ln, bt: (bt[row[t], j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, kvh, group, hd),
+                               lambda t, j, row, ln, bt: (t, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((kvh * group, hd), jnp.float32),
+            pltpu.VMEM((kvh * group, 128), jnp.float32),
+            pltpu.VMEM((kvh * group, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, kvh, group, hd), q.dtype),
+        interpret=_interpret(),
+    )(row_ids.astype(jnp.int32), lengths.astype(jnp.int32),
+      block_tables.astype(jnp.int32), q4, k_cache, v_cache)
+    return out.reshape(T, nh, hd)

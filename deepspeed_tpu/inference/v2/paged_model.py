@@ -522,6 +522,114 @@ def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# Ragged unified step (mixed prefill + decode, one launch)
+# ---------------------------------------------------------------------------
+def paged_ragged_step(cfg: TransformerConfig, params, ids: jnp.ndarray,
+                      row_ids: jnp.ndarray, pos: jnp.ndarray,
+                      lengths: jnp.ndarray, write_blocks: jnp.ndarray,
+                      write_offsets: jnp.ndarray,
+                      block_tables: jnp.ndarray, last_index: jnp.ndarray,
+                      cache: Dict[str, jnp.ndarray], block_size: int,
+                      use_kernel: bool = True, topo=None
+                      ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """One compiled program for a MIXED batch (the Ragged Paged
+    Attention layout, kernels/ragged_attention.py): prefill chunks,
+    continuations and decode rows arrive as one flat token buffer
+    ``ids`` [TB] with per-token descriptors — ``row_ids`` (token ->
+    batch row), ``pos`` (absolute cache position), ``lengths`` (causal
+    bound = pos+1; 0 for padding) and the KV write-set
+    ``write_blocks``/``write_offsets`` — plus per-row ``block_tables``
+    [RB, MBw] and ``last_index`` [RB] (flat index of each row's last
+    valid token). Replaces the separate paged_prefill / paged_continue /
+    paged_decode dispatches for everything the scheduler composes into a
+    step. Returns ([RB, V] last-token logits per row, cache).
+
+    The new tokens' K/V scatter into the pool inside the scanned layer
+    body (padding tokens land in the null block), then every token
+    attends over ITS row's block table up to its own causal bound —
+    in-chunk causality and cached-prefix attention are the same page
+    walk. Padding rows/tokens produce garbage logits the caller
+    discards; garbage never reaches live rows because tokens only mix
+    through attention, which is row-local by construction."""
+    T = ids.shape[0]
+    RB, MBw = block_tables.shape
+    ctx = MBw * block_size
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    params = _deq_nonlayer(params)
+    x = params["embed"][ids]                                     # [T, H]
+    if cfg.embed_scale != 1.0:
+        x = x * jnp.asarray(cfg.embed_scale, x.dtype)
+    x = _embed_ln(cfg, params, x)
+    if cfg.positional == "learned":
+        x = x + params["pos_embed"][jnp.clip(pos, 0, cfg.max_seq_len - 1)]
+    cos, sin = _rope_at(cfg, pos)                                # [T, half]
+    ctx_pos = jnp.arange(ctx)
+    attn_mask = ctx_pos[None, :] < lengths[:, None]              # [T, ctx]
+
+    def layer_fn(carry, inputs):
+        x, kc, vc, ksc, vsc = carry
+        lp, l = inputs
+        lp = _deq_layer(lp)
+        hn = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
+        q, k, v = qkv_proj(lp, hn)
+        q = q.reshape(T, nh, hd)
+        k = k.reshape(T, nkv, hd)
+        v = v.reshape(T, nkv, hd)
+        if cfg.positional == "rope":
+            q = _rotate(q, cos[:, None], sin[:, None])
+            k = _rotate(k, cos[:, None], sin[:, None])
+        kc, ksc = _kv_write(kc, ksc, l, write_blocks, write_offsets, k)
+        vc, vsc = _kv_write(vc, vsc, l, write_blocks, write_offsets, v)
+        if use_kernel:
+            assert ksc is None, \
+                "kv_quant serves through the gather path (engine gates " \
+                "use_kernel off)"
+            from .kernels.ragged_attention import ragged_attention
+            o = ragged_attention(q, kc[l], vc[l], row_ids, lengths,
+                                 block_tables).reshape(T, nh * hd)
+        else:
+            # gather each ROW's pages once, indirect per token: the
+            # materializing fallback (parity reference + tp/alibi/quant)
+            kpages = _kv_read(kc, ksc, l, block_tables,
+                              x.dtype).reshape(RB, ctx, nkv, hd)
+            vpages = _kv_read(vc, vsc, l, block_tables,
+                              x.dtype).reshape(RB, ctx, nkv, hd)
+            ktok = kpages[row_ids]                      # [T, ctx, nkv, hd]
+            vtok = vpages[row_ids]
+            if nkv != nh:
+                ktok = jnp.repeat(ktok, nh // nkv, axis=2)
+                vtok = jnp.repeat(vtok, nh // nkv, axis=2)
+            scores = jnp.einsum("thd,tchd->thc", q,
+                                ktok).astype(jnp.float32)
+            scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+            if cfg.positional == "alibi":
+                scores = scores + _alibi_row(cfg, ctx_pos)[None, :, 0, :]
+            scores = jnp.where(attn_mask[:, None, :], scores, NEG_INF)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            o = jnp.einsum("thc,tchd->thd", probs,
+                           vtok).reshape(T, nh * hd)
+        if cfg.parallel_residual:
+            # Falcon block: attention and MLP both read the normed input;
+            # one residual add (NeoX parallel_norms norms separately)
+            hn2 = (_norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+                   if cfg.parallel_norms else hn)
+            x = x + out_proj(lp, o) + _mlp(cfg, lp, hn2, topo)
+            return (x, kc, vc, ksc, vsc), None
+        x = x + out_proj(lp, o)
+        hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+        x = x + _mlp(cfg, lp, hn, topo)
+        return (x, kc, vc, ksc, vsc), None
+
+    (x, kc, vc, ksc, vsc), _ = jax.lax.scan(
+        layer_fn, (x, cache["k"], cache["v"],
+                   cache.get("ks"), cache.get("vs")),
+        (params["layers"], jnp.arange(cfg.num_layers)))
+    x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
+    last = x[last_index]                                         # [RB, H]
+    return _logits(cfg, params, last), _cache_dict(kc, vc, ksc, vsc)
+
+
+# ---------------------------------------------------------------------------
 # Fused multi-token decode window
 # ---------------------------------------------------------------------------
 def paged_decode_window(cfg: TransformerConfig, params, toks: jnp.ndarray,

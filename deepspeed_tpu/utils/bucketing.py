@@ -1,0 +1,44 @@
+"""Shared static-shape bucketing helpers.
+
+Every serving hot path compiles one XLA program per input-shape bucket
+(the TPU analogue of the reference's CUDA-graph'd atom sizes), so the
+bucketing rules ARE the compile-cache policy. They used to be duplicated
+across ``engine_v2`` (``_bucket``, ``_pow2_bucket``, ``_decode_bucket``)
+and would have been duplicated again by the ragged batch packer; one
+definition here keeps every layer keying its programs the same way.
+
+Two rules:
+
+* :func:`pow2_bucket` — next power of two, capped. Logarithmic program
+  count over the range; used for decode batch rows, block-table widths,
+  and both axes of the ragged (token x row) layout.
+* :func:`ceil_bucket` — round up to a multiple, capped. Linear program
+  count at the chosen granularity; used for prefill chunk lengths where
+  the scheduler already aligns chunks to the same multiple.
+"""
+
+
+def pow2_bucket(count: int, cap: int) -> int:
+    """Smallest power of two >= ``count`` (min 1), capped at ``cap``.
+
+    ``count`` above ``cap`` clamps to ``cap`` (the caller's hard limit —
+    e.g. max tracked sequences — is itself the final bucket even when it
+    is not a power of two)."""
+    if cap < 1:
+        raise ValueError(f"bucket cap must be >= 1 (got {cap})")
+    b = 1
+    while b < count:
+        b *= 2
+    return min(b, cap)
+
+
+def ceil_bucket(n: int, multiple: int, cap: int = None) -> int:
+    """``n`` rounded up to a multiple of ``multiple``; when ``cap`` is
+    given the result never exceeds ``cap`` rounded up the same way (the
+    bucket for the largest admissible input)."""
+    if multiple < 1:
+        raise ValueError(f"bucket multiple must be >= 1 (got {multiple})")
+    b = -(-n // multiple) * multiple
+    if cap is not None:
+        b = min(b, -(-cap // multiple) * multiple)
+    return b

@@ -19,6 +19,16 @@ Gated metrics (see ``collect()``):
     ZERO compiles after warmup.
   * ``decode_window_flops_per_token`` / ``decode_window_peak_bytes`` —
     XLA cost/memory analysis of the fused decode program.
+  * ``ragged_mixed_compile_events`` / ``stitched_mixed_compile_events``
+    / ``ragged_mixed_programs_saved`` /
+    ``ragged_mixed_steady_recompiles`` — the ragged unified-program
+    invariant: a mixed prefill+decode scheduler sweep must compile
+    strictly fewer programs through the ragged family than through the
+    stitched prefill/continue/decode families (``programs_saved`` is
+    pinned from below), with zero steady-state recompiles.
+  * ``ragged_step_flops_per_token`` / ``ragged_step_peak_bytes`` — XLA
+    cost/memory analysis of the unified ragged program at its
+    representative mixed bucket.
   * ``train_step_flops`` / ``train_step_bytes`` /
     ``train_step_peak_bytes`` — the same for a dp8 ZeRO-2 train step on
     the virtual 8-device CPU mesh.
@@ -169,7 +179,11 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
                 decode_window=decode_window),
             params=params)
         prompts = [[2, 4, 6, 8], [3, 5, 7]]
-        eng.generate(prompts, max_new_tokens=new_tokens)   # warmup
+        # warm twice: the first pass compiles every bucket, the second
+        # absorbs the one-time respecialization of buckets whose first
+        # call ran against the fresh (unsharded) KV pool
+        eng.generate(prompts, max_new_tokens=new_tokens)
+        eng.generate(prompts, max_new_tokens=new_tokens, uids=[20, 21])
         reg = get_registry()
         fam_total = reg.family_total
         base_syncs = fam_total("inference_decode_host_syncs_total")
@@ -195,6 +209,15 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
                  if e["program"] == "decode_window_greedy"]
         metrics["fused_decode_compile_events"] = float(len(fused))
 
+        # -- flight-recorder overhead (always-on black box) ---------------
+        # computed HERE, against the measured generate() only: the AOT
+        # analyses and mixed sweeps below record their own events and
+        # must not skew the serving workload's events-per-step
+        steps = fam_total("inference_decode_steps_total") - base_steps
+        rec_events = get_recorder().stats()["recorded"] - base_rec
+        metrics["recorder_events_per_decode_step"] = (
+            rec_events / steps if steps else 0.0)
+
         rep = eng.memory_report(batch=len(prompts))
         N = eng._decode_bucket(len(prompts))
         prog = rep["programs"]["decode_window_greedy"]
@@ -203,12 +226,77 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
         metrics["decode_window_peak_bytes"] = float(prog["peak_bytes"])
         metrics["kv_pool_utilization_peak"] = reg.gauge(
             "inference_kv_pool_utilization_peak").value
+        # ragged unified program cost (kernels/ragged_attention.py): the
+        # AOT analysis of the representative mixed bucket, normalized
+        # per flat-buffer token
+        rprog = rep["programs"].get("ragged_step")
+        if rprog:
+            # normalize by the bucket the analysis actually compiled
+            # (memory_report reports it) rather than re-deriving it here
+            metrics["ragged_step_flops_per_token"] = (
+                rprog.get("flops", 0.0) / rprog["token_bucket"])
+            metrics["ragged_step_peak_bytes"] = float(
+                rprog["peak_bytes"])
 
-        # -- flight-recorder overhead (always-on black box) ---------------
-        steps = fam_total("inference_decode_steps_total") - base_steps
-        rec_events = get_recorder().stats()["recorded"] - base_rec
-        metrics["recorder_events_per_decode_step"] = (
-            rec_events / steps if steps else 0.0)
+        # -- ragged vs stitched mixed-traffic sweep -----------------------
+        # the ragged acceptance invariant, chip-free: one program family
+        # serves the mixed composition with ZERO steady-state recompiles
+        # and strictly fewer compiled programs than the stitched
+        # prefill+decode families it replaces
+        import numpy as np
+
+        from deepspeed_tpu.inference.v2 import DynamicSplitFuseScheduler
+
+        def _mixed_sweep(mode: str):
+            sweep_eng = InferenceEngineV2(
+                model, RaggedInferenceEngineConfig(
+                    state_manager=DSStateManagerConfig(
+                        max_tracked_sequences=8, max_seq_len=seq_len,
+                        num_blocks=65, block_size=16),
+                    dtype="float32", prefill_bucket=16,
+                    decode_window=decode_window, ragged_attention=mode),
+                params=params)
+            sched = DynamicSplitFuseScheduler(sweep_eng,
+                                              token_budget=24, chunk=16)
+            rng = np.random.default_rng(3)
+            mixed_prompts = [list(map(int, rng.integers(1, 127, n)))
+                             for n in (40, 7, 22, 3, 30, 11)]
+
+            def wave(base: int) -> None:
+                for i, p in enumerate(mixed_prompts[:2]):
+                    sched.submit(base + i, p, 10)
+                for _ in range(3):
+                    sched.step()
+                for i, p in enumerate(mixed_prompts[2:]):
+                    sched.submit(base + 50 + i, p, 10)
+                sched.run()
+
+            ev0 = fam_total("xla_compile_events_total")
+            st0 = fam_total("xla_steady_state_recompiles_total")
+            # two warm waves: a bucket's first call compiles against the
+            # unsharded fresh pool, repeats against the donated sharded
+            # one — the second wave absorbs that one-time
+            # respecialization before steady state is declared
+            wave(100)
+            wave(200)
+            compiled = fam_total("xla_compile_events_total") - ev0
+            watchdog.mark_steady(True)
+            try:
+                wave(300)
+            finally:
+                watchdog.mark_steady(False)
+            steady = fam_total("xla_steady_state_recompiles_total") - st0
+            return compiled, steady
+
+        ragged_compiled, ragged_steady = _mixed_sweep("on")
+        stitched_compiled, _ = _mixed_sweep("off")
+        metrics["ragged_mixed_compile_events"] = ragged_compiled
+        metrics["stitched_mixed_compile_events"] = stitched_compiled
+        metrics["ragged_mixed_programs_saved"] = (stitched_compiled
+                                                  - ragged_compiled)
+        metrics["ragged_mixed_steady_recompiles"] = ragged_steady
+
+        # -- flight-recorder record() cost ---------------------------------
         import time as _time
         bench_rec = FlightRecorder()
         prev_bench = set_recorder(bench_rec)
@@ -277,8 +365,17 @@ def make_baseline(metrics: Dict[str, float]) -> Dict[str, Any]:
     spec: Dict[str, Any] = {}
     for name, value in metrics.items():
         if name in ("steady_state_recompiles", "steady_state_compile_events",
-                    "fused_decode_compile_events"):
+                    "fused_decode_compile_events",
+                    "ragged_mixed_compile_events",
+                    "stitched_mixed_compile_events",
+                    "ragged_mixed_steady_recompiles"):
             spec[name] = {"value": value, "direction": "max",
+                          "abs_tol": 0.0}
+        elif name == "ragged_mixed_programs_saved":
+            # the ragged win itself: the mixed sweep must keep compiling
+            # at least this many FEWER programs than the stitched
+            # families — direction "min" so erosion fails the gate
+            spec[name] = {"value": value, "direction": "min",
                           "abs_tol": 0.0}
         elif name == "decode_host_syncs_per_token":
             spec[name] = {"value": value, "direction": "max",

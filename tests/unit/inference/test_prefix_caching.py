@@ -55,17 +55,15 @@ def test_prefix_reuse_after_flush_exact(tiny):
     sm = eng.state_manager
     assert len(sm._prefix) >= 3          # prompt blocks retained at flush
 
-    calls = {"prefill": 0, "continue": 0}
-    orig_p, orig_c = eng._prefill, eng._continue
-    eng._prefill = lambda *a: calls.__setitem__(
-        "prefill", calls["prefill"] + 1) or orig_p(*a)
-    eng._continue = lambda *a: calls.__setitem__(
-        "continue", calls["continue"] + 1) or orig_c(*a)
+    reused0 = eng.state_manager._m_reused_tokens.value
+    ragged0 = eng._m_ragged_tokens.value
     out2 = eng.generate([prompt], max_new_tokens=6, uids=[2])[0]
     np.testing.assert_array_equal(out2, ref)
-    # 48 of 50 prompt tokens rode the retained blocks: no prefill ran,
-    # the 2-token suffix went through one fused continuation
-    assert calls == {"prefill": 0, "continue": 1}
+    # 48 of 50 prompt tokens rode the retained blocks: the ragged
+    # prompt step fed only the 2-token suffix (decode steps run in the
+    # fused window, not the ragged counter)
+    assert eng._m_ragged_tokens.value - ragged0 == 2
+    assert eng.state_manager._m_reused_tokens.value - reused0 == 48
 
 
 def test_prefix_includes_generated_tokens(tiny):

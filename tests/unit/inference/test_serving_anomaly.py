@@ -73,7 +73,8 @@ def _anomaly_count(kind):
 
 def test_serving_records_request_and_kv_events(tiny, _fresh):
     """The black box covers a request's whole life: admit ->
-    submit -> prefill -> decode windows -> kv alloc/free -> finish."""
+    submit -> ragged prompt step -> decode windows -> kv alloc/free ->
+    finish."""
     model, params = tiny
     eng = _engine(model, params)
 
@@ -87,7 +88,7 @@ def test_serving_records_request_and_kv_events(tiny, _fresh):
 
     asyncio.run(main())
     kinds = {e["kind"] for e in get_recorder().events()}
-    for expected in ("admit", "request_submit", "prefill",
+    for expected in ("admit", "request_submit", "ragged_step",
                      "decode_window", "kv_alloc", "kv_free",
                      "request_finish", "xla_compile",
                      "kv_drain_clean"):
@@ -104,6 +105,10 @@ def test_stalled_decode_loop_trips_watchdog(tiny, _fresh):
 
     model, params = tiny
     eng = _engine(model, params)
+    # pre-compile the workload's buckets: a first-step compile inside
+    # the serving loop would itself outrun the tight 0.2s stall
+    # deadline and burn the verdict before the wedge
+    eng.generate([[2, 4, 6, 8]], max_new_tokens=8)
     release = threading.Event()
 
     async def main():

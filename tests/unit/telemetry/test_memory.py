@@ -64,7 +64,8 @@ def test_engine_memory_report_chip_free(tiny_model, _fresh):
         params=params)
     rep = eng.memory_report(batch=2)
     assert set(rep["programs"]) == {"decode_greedy",
-                                    "decode_window_greedy", "prefill"}
+                                    "decode_window_greedy", "prefill",
+                                    "ragged_step"}
     for rec in rep["programs"].values():
         assert rec["peak_bytes"] > 0
         # every decode/prefill program references the params and pool

@@ -1,0 +1,65 @@
+"""Shared bucketing rules (utils/bucketing.py): the compile-cache policy
+every serving layer keys its programs on — engine_v2's prefill/decode
+buckets and the RaggedBatch (token x row) layout must all round the same
+way, including the edges (0, the cap, exact powers)."""
+
+import pytest
+
+from deepspeed_tpu.utils.bucketing import ceil_bucket, pow2_bucket
+
+
+def test_pow2_bucket_basic():
+    assert pow2_bucket(1, 64) == 1
+    assert pow2_bucket(3, 64) == 4
+    assert pow2_bucket(9, 64) == 16
+    assert pow2_bucket(33, 64) == 64
+
+
+def test_pow2_bucket_exact_powers_are_their_own_bucket():
+    for p in (1, 2, 4, 8, 16, 32, 64):
+        assert pow2_bucket(p, 64) == p
+
+
+def test_pow2_bucket_zero_rounds_to_one():
+    # a zero-count batch still needs a compilable nonzero shape
+    assert pow2_bucket(0, 64) == 1
+
+
+def test_pow2_bucket_cap_clamps_including_non_powers():
+    assert pow2_bucket(100, 64) == 64
+    # the cap itself is the final bucket even when not a power of two
+    assert pow2_bucket(100, 48) == 48
+    assert pow2_bucket(48, 48) == 48
+    assert pow2_bucket(1, 1) == 1
+
+
+def test_pow2_bucket_invalid_cap():
+    with pytest.raises(ValueError):
+        pow2_bucket(4, 0)
+
+
+def test_ceil_bucket_basic():
+    assert ceil_bucket(1, 16) == 16
+    assert ceil_bucket(16, 16) == 16
+    assert ceil_bucket(17, 16) == 32
+    assert ceil_bucket(0, 16) == 0
+
+
+def test_ceil_bucket_cap_rounds_up_to_the_caps_bucket():
+    # cap 100 at multiple 16 -> largest bucket is 112 (the cap's own
+    # bucket), not 100
+    assert ceil_bucket(200, 16, cap=100) == 112
+    assert ceil_bucket(90, 16, cap=100) == 96
+
+
+def test_ceil_bucket_invalid_multiple():
+    with pytest.raises(ValueError):
+        ceil_bucket(4, 0)
+
+
+def test_engine_buckets_delegate_to_shared_rules():
+    """engine_v2's bucket helpers are the shared definitions (the
+    dedupe this module exists for)."""
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    assert InferenceEngineV2._pow2_bucket(9, 64) == pow2_bucket(9, 64)
+    assert InferenceEngineV2._pow2_bucket(48, 48) == 48
