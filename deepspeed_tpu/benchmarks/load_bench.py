@@ -25,6 +25,15 @@ shows what clients of a saturated deployment see: tail latency
 (p50/p95/p99 TTFT and per-request), goodput (completed tokens/s over
 the whole run), and admission rejections. Extra knobs:
   --open [--max-pending 16] [--max-queued-tokens N] [--deadline 0]
+
+``--router N`` (implies open loop) drives the same Poisson trace
+through the ROUTED frontend instead: N in-process engine replicas
+behind the prefix-affinity ReplicaRouter (serve/router.py), reported
+with a per-replica breakdown (requests landed, completions, TTFT
+percentiles, goodput share) plus router-level shed/re-route counts.
+``--placement`` picks the routing policy (affinity | hash |
+round_robin) so the affinity win is measurable against the
+random-placement baseline.
 """
 
 import argparse
@@ -77,15 +86,90 @@ def run_trace(engine, arrivals, prompts, new_tokens, budget, chunk,
     }
 
 
+async def _drive_open_loop(submit, t0, arrivals, prompts, new_tokens,
+                           deadline_s, on_complete=None):
+    """Shared open-loop client driver: one client coroutine per request
+    submits through ``submit(prompt, new_tokens, deadline_s=...)`` at
+    its trace time and drains the returned stream. ``on_complete(
+    stream, ttft_s, n_tokens)`` fires per completed request (the routed
+    mode's per-replica rollup hook). Returns the raw accumulators —
+    ``(stats, ttfts, totals, tpots, good_tokens)`` — so callers can
+    time the drain into the makespan before building the report."""
+    import asyncio
+
+    from ..inference.v2.serve import (DeadlineExceeded, OverloadedError,
+                                      RequestFailed)
+
+    stats = {"rejected": 0, "expired": 0, "errors": 0}
+    ttfts, totals, tpots = [], [], []
+    good = [0]
+
+    async def client(i):
+        await asyncio.sleep(max(0.0, t0 + arrivals[i]
+                                - time.perf_counter()))
+        start = time.perf_counter()
+        try:
+            stream = await submit(prompts[i], new_tokens,
+                                  deadline_s=deadline_s)
+        except OverloadedError:
+            stats["rejected"] += 1
+            return
+        first_t = None
+        try:
+            async for _tok in stream:
+                if first_t is None:
+                    first_t = time.perf_counter()
+        except DeadlineExceeded:
+            stats["expired"] += 1
+            return
+        except RequestFailed:
+            stats["errors"] += 1
+            return
+        end = time.perf_counter()
+        n = len(stream.tokens)
+        good[0] += n
+        ttft = (first_t or end) - start
+        ttfts.append(ttft)
+        totals.append(end - start)
+        if n > 1 and first_t is not None:
+            tpots.append((end - first_t) / (n - 1))
+        if on_complete is not None:
+            on_complete(stream, ttft, n)
+
+    await asyncio.gather(*[client(i) for i in range(len(prompts))])
+    return stats, ttfts, totals, tpots, good[0]
+
+
+def _open_loop_report(stats, ttfts, totals, tpots, good_tokens,
+                      makespan):
+    return {
+        "completed": len(totals),
+        "rejected": stats["rejected"],
+        "expired": stats["expired"],
+        "errors": stats["errors"],
+        "makespan_s": round(makespan, 3),
+        # goodput: tokens of COMPLETED requests over the whole run
+        # (shed/expired work contributes nothing)
+        "goodput_tok_s": round(good_tokens / makespan, 2),
+        "ttft_p50_ms": _pct(ttfts, 50) if ttfts else None,
+        "ttft_p95_ms": _pct(ttfts, 95) if ttfts else None,
+        "ttft_p99_ms": _pct(ttfts, 99) if ttfts else None,
+        "latency_p50_ms": _pct(totals, 50) if totals else None,
+        "latency_p95_ms": _pct(totals, 95) if totals else None,
+        "latency_p99_ms": _pct(totals, 99) if totals else None,
+        "tpot_p50_ms": _pct(tpots, 50) if tpots else None,
+        "tpot_p95_ms": _pct(tpots, 95) if tpots else None,
+    }
+
+
 def run_open_loop(engine, arrivals, prompts, new_tokens, budget, chunk,
                   max_pending, max_queued_tokens=None, deadline_s=None):
     """Open-loop trace through the async serving runtime. Returns the
     tail-latency/goodput/shedding report dict."""
     import asyncio
 
-    from ..inference.v2.serve import (AdmissionConfig, DeadlineExceeded,
-                                      OverloadedError, RequestFailed,
-                                      ServingConfig, ServingEngine)
+    from ..inference.v2.serve import (AdmissionConfig, ServingConfig,
+                                      ServingEngine)
 
     async def drive():
         serving = ServingEngine(engine, ServingConfig(
@@ -95,61 +179,87 @@ def run_open_loop(engine, arrivals, prompts, new_tokens, budget, chunk,
                 max_queued_tokens=max_queued_tokens)))
         await serving.start()
         t0 = time.perf_counter()
-        stats = {"rejected": 0, "expired": 0, "errors": 0}
-        ttfts, totals, tpots = [], [], []
-        good_tokens = 0
-
-        async def client(i):
-            nonlocal good_tokens
-            await asyncio.sleep(max(0.0, t0 + arrivals[i]
-                                    - time.perf_counter()))
-            start = time.perf_counter()
-            try:
-                stream = await serving.submit(
-                    prompts[i], new_tokens, deadline_s=deadline_s)
-            except OverloadedError:
-                stats["rejected"] += 1
-                return
-            first_t = None
-            try:
-                async for _tok in stream:
-                    if first_t is None:
-                        first_t = time.perf_counter()
-            except DeadlineExceeded:
-                stats["expired"] += 1
-                return
-            except RequestFailed:
-                stats["errors"] += 1
-                return
-            end = time.perf_counter()
-            n = len(stream.tokens)
-            good_tokens += n
-            ttfts.append((first_t or end) - start)
-            totals.append(end - start)
-            if n > 1 and first_t is not None:
-                tpots.append((end - first_t) / (n - 1))
-
-        await asyncio.gather(*[client(i) for i in range(len(prompts))])
+        stats, ttfts, totals, tpots, good = await _drive_open_loop(
+            serving.submit, t0, arrivals, prompts, new_tokens,
+            deadline_s)
         await serving.stop(drain=True)
+        return _open_loop_report(stats, ttfts, totals, tpots, good,
+                                 time.perf_counter() - t0)
+
+    return asyncio.run(drive())
+
+
+def make_router(engines, budget, chunk, max_pending,
+                max_queued_tokens=None, placement="affinity"):
+    """Wire N engines up as in-process replicas behind a
+    :class:`~..inference.v2.serve.ReplicaRouter` (the `--router N`
+    frontend; also the tier-1 wiring test's entry point)."""
+    from ..inference.v2.serve import (AdmissionConfig, ReplicaRouter,
+                                      RouterConfig, ServingConfig,
+                                      build_replicas)
+
+    replicas = build_replicas(engines, ServingConfig(
+        token_budget=budget, chunk=chunk,
+        admission=AdmissionConfig(max_pending=max_pending,
+                                  max_queued_tokens=max_queued_tokens)))
+    return ReplicaRouter(replicas, RouterConfig(placement=placement))
+
+
+def run_router_open_loop(engines, arrivals, prompts, new_tokens, budget,
+                         chunk, max_pending, max_queued_tokens=None,
+                         deadline_s=None, placement="affinity"):
+    """Open-loop Poisson trace through the routed frontend; returns the
+    aggregate tail-latency/goodput report plus a per-replica
+    breakdown."""
+    import asyncio
+
+    async def drive():
+        from ..telemetry import get_registry
+        fam = get_registry().family_total
+        # deltas, not process-lifetime totals: earlier routers in this
+        # process (warmups, a prior placement run) must not inflate the
+        # report
+        base = {name: fam(name) for name in
+                ("router_shed_total", "router_reroutes_total",
+                 "router_affinity_hits_total")}
+        router = make_router(engines, budget, chunk, max_pending,
+                             max_queued_tokens, placement)
+        await router.start()
+        per = {r.name: {"completed": 0, "ttfts": [], "tokens": 0}
+               for r in router.replicas}
+
+        def on_complete(stream, ttft, n):
+            if stream.replica in per:
+                per[stream.replica]["completed"] += 1
+                per[stream.replica]["ttfts"].append(ttft)
+                per[stream.replica]["tokens"] += n
+
+        t0 = time.perf_counter()
+        stats, ttfts, totals, tpots, good = await _drive_open_loop(
+            router.submit, t0, arrivals, prompts, new_tokens,
+            deadline_s, on_complete=on_complete)
+        await router.stop(drain=True)
         makespan = time.perf_counter() - t0
-        completed = len(totals)
+
+        per_replica = {
+            name: {
+                "completed": d["completed"],
+                "goodput_tok_s": round(d["tokens"] / makespan, 2),
+                "ttft_p50_ms": _pct(d["ttfts"], 50) if d["ttfts"] else None,
+                "ttft_p95_ms": _pct(d["ttfts"], 95) if d["ttfts"] else None,
+            } for name, d in per.items()}
         return {
-            "completed": completed,
-            "rejected": stats["rejected"],
-            "expired": stats["expired"],
-            "errors": stats["errors"],
-            "makespan_s": round(makespan, 3),
-            # goodput: tokens of COMPLETED requests over the whole run
-            # (shed/expired work contributes nothing)
-            "goodput_tok_s": round(good_tokens / makespan, 2),
-            "ttft_p50_ms": _pct(ttfts, 50) if ttfts else None,
-            "ttft_p95_ms": _pct(ttfts, 95) if ttfts else None,
-            "ttft_p99_ms": _pct(ttfts, 99) if ttfts else None,
-            "latency_p50_ms": _pct(totals, 50) if totals else None,
-            "latency_p95_ms": _pct(totals, 95) if totals else None,
-            "latency_p99_ms": _pct(totals, 99) if totals else None,
-            "tpot_p50_ms": _pct(tpots, 50) if tpots else None,
-            "tpot_p95_ms": _pct(tpots, 95) if tpots else None,
+            "replicas": len(engines),
+            "placement": placement,
+            **_open_loop_report(stats, ttfts, totals, tpots, good,
+                                makespan),
+            "router_shed": fam("router_shed_total")
+            - base["router_shed_total"],
+            "router_reroutes": fam("router_reroutes_total")
+            - base["router_reroutes_total"],
+            "affinity_hits": fam("router_affinity_hits_total")
+            - base["router_affinity_hits_total"],
+            "per_replica": per_replica,
         }
 
     return asyncio.run(drive())
@@ -168,6 +278,15 @@ def main(argv=None) -> int:
     p.add_argument("--open", action="store_true",
                    help="open-loop mode through the async serving "
                         "runtime (admission control + tail latency)")
+    p.add_argument("--router", type=int, default=0, metavar="N",
+                   help="open-loop mode through the ROUTED frontend: "
+                        "N in-process engine replicas behind the "
+                        "prefix-affinity router, with a per-replica "
+                        "TTFT/goodput/shed breakdown")
+    p.add_argument("--placement", default="affinity",
+                   choices=("affinity", "hash", "round_robin"),
+                   help="router mode: placement policy (round_robin is "
+                        "the random-placement baseline)")
     p.add_argument("--max-pending", type=int, default=16,
                    help="open mode: admission queue bound")
     p.add_argument("--max-queued-tokens", type=int, default=0,
@@ -192,14 +311,48 @@ def main(argv=None) -> int:
     prompts = [list(map(int, rng.integers(1, 2047, n))) for n in lens]
     arrivals = np.cumsum(rng.exponential(1.0 / args.rate, args.requests))
 
-    def fresh_engine():
+    def fresh_engine(prefix_caching=False):
         return InferenceEngineV2(model, {
             "dtype": "bfloat16",
             "state_manager": {"max_tracked_sequences": 32,
                               "max_ragged_batch_size": 2048,
                               "max_seq_len": 1024,
-                              "num_blocks": 4096},
+                              "num_blocks": 4096,
+                              "enable_prefix_caching": prefix_caching},
         }, params=params)
+
+    if args.router > 0:
+        # one engine per replica with prefix caching on (so affinity has
+        # something to win), each warmed with a closed-loop pass of the
+        # same LENGTH distribution but DIFFERENT token content: jit
+        # buckets key on shapes so the compile caches warm, while the
+        # prefix indexes stay cold for the measurement prompts — warming
+        # with the trace itself would pre-register every prompt's
+        # prefix on every replica and erase the very placement
+        # difference `--placement` exists to compare
+        warm_rng = np.random.default_rng(10 ** 6)
+        warm_prompts = [list(map(int, warm_rng.integers(1, 2047, n)))
+                        for n in lens]
+        engines = []
+        for _ in range(args.router):
+            eng = fresh_engine(prefix_caching=True)
+            run_trace(eng, arrivals, warm_prompts, args.new, args.budget,
+                      args.chunk, uid_base=10 ** 6)
+            engines.append(eng)
+        report = run_router_open_loop(
+            engines, arrivals, prompts, args.new, args.budget,
+            args.chunk, max_pending=args.max_pending,
+            max_queued_tokens=args.max_queued_tokens or None,
+            deadline_s=args.deadline or None, placement=args.placement)
+        print(json.dumps({
+            "metric": "serving_router_open_loop",
+            "backend": jax.default_backend(),
+            "requests": args.requests, "rate_rps": args.rate,
+            "budget": args.budget, "chunk": args.chunk,
+            "new_tokens": args.new, "max_pending": args.max_pending,
+            **report,
+        }))
+        return 0
 
     if args.open:
         # warm with a closed-loop pass over the same trace (jit caches

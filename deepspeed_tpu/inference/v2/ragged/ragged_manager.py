@@ -26,6 +26,37 @@ from ..config_v2 import DSStateManagerConfig
 from .blocked_allocator import NULL_BLOCK, BlockedAllocator
 from .sequence_descriptor import DSSequenceDescriptor
 
+# seed of the chain-hash: every digest chain starts here, so digests are
+# a pure function of (token content, block size) — stable across
+# processes, engines and replicas
+_DIGEST_SEED = b"prefix"
+
+
+def _chain(digest: bytes, tokens) -> bytes:
+    return hashlib.sha1(
+        digest + np.asarray(tokens, np.int32).tobytes()).digest()
+
+
+def prefix_digest(tokens, block_size: int) -> List[bytes]:
+    """Chain-hash digests of the FULL block-aligned prefixes of
+    ``tokens``: digest ``i`` covers ``tokens[:(i + 1) * block_size]``.
+
+    This is the exact chain the prefix-cache index keys on (register at
+    flush, match at arrival), exported as the STABLE affinity API for
+    the serving router (serve/router.py): the router hashes an incoming
+    prompt with the replica's block size and routes to the replica that
+    last served the longest matching digest — without ever reaching
+    into manager state. Digests depend only on token content and block
+    size (sha1 over int32 bytes), so two processes with the same config
+    compute identical lists."""
+    toks = np.asarray(tokens, np.int64)
+    digest = _DIGEST_SEED
+    out: List[bytes] = []
+    for n in range(0, (len(toks) // block_size) * block_size, block_size):
+        digest = _chain(digest, toks[n:n + block_size])
+        out.append(digest)
+    return out
+
 
 class DSStateManager:
     def __init__(self, config: DSStateManagerConfig):
@@ -65,10 +96,7 @@ class DSStateManager:
             "eviction)")
 
     # -- prefix caching -----------------------------------------------------
-    @staticmethod
-    def _chain(digest: bytes, tokens) -> bytes:
-        return hashlib.sha1(
-            digest + np.asarray(tokens, np.int32).tobytes()).digest()
+    _chain = staticmethod(_chain)
 
     def match_prefix(self, uid: int,
                      tokens: np.ndarray) -> Tuple[List[int], int]:
@@ -82,10 +110,14 @@ class DSStateManager:
         bs = self.block_size
         usable = ((len(tokens) - 1) // bs) * bs
         blocks: List[int] = []
-        digest = b"prefix"
+        digest = _DIGEST_SEED
         n = 0
+        # incremental chain (same rule as prefix_digest, which callers
+        # use for the full list): the lookup stops hashing at the first
+        # missing digest — a cold long prompt costs one sha1, not one
+        # per block
         while n + bs <= usable:
-            digest = self._chain(digest, tokens[n:n + bs])
+            digest = _chain(digest, tokens[n:n + bs])
             blk = self._prefix.get(digest)
             if blk is None:
                 break
@@ -109,10 +141,9 @@ class DSStateManager:
         with the same prefix reuses them (the index holds its own block
         references — retained blocks survive the flush)."""
         bs = self.block_size
-        digest = b"prefix"
         full = min(len(seq.token_log) // bs, len(seq.blocks))
-        for i in range(full):
-            digest = self._chain(digest, seq.token_log[i * bs:(i + 1) * bs])
+        digests = prefix_digest(seq.token_log[:full * bs], bs)
+        for i, digest in enumerate(digests):
             if digest not in self._prefix:
                 self._prefix[digest] = int(seq.blocks[i])
                 self.allocator.share(seq.blocks[i])
@@ -183,6 +214,43 @@ class DSStateManager:
             self._m_alloc.inc(need)
             flight.record("kv_alloc", uid=int(uid), blocks=int(need),
                           free=self.allocator.free_blocks)
+        return seq
+
+    def adopt_sequence(self, uid: int, n_blocks: int, seen_tokens: int,
+                       token_log) -> DSSequenceDescriptor:
+        """Install a sequence restored from a KV handoff
+        (serve/handoff.py): allocate ``n_blocks`` fresh blocks (evicting
+        retained prefix blocks under pressure, like ensure_blocks) and
+        create the descriptor in exactly the state the decode paths and
+        flush-time bookkeeping expect — cache-resident token count plus
+        the fed-token log the prefix index registers at flush. The
+        caller scatters the handed-off KV content into the returned
+        descriptor's blocks."""
+        if uid in self.seqs:
+            raise ValueError(
+                f"cannot adopt uid {uid}: sequence already tracked")
+        if seen_tokens > n_blocks * self.block_size:
+            raise ValueError(
+                f"handoff descriptor inconsistent: {seen_tokens} seen "
+                f"tokens do not fit {n_blocks} blocks of "
+                f"{self.block_size}")
+        if n_blocks > self.allocator.free_blocks:
+            self._evict_retained(n_blocks)
+        # allocate BEFORE creating the descriptor: an exhausted pool
+        # must not leave a blockless tracked sequence behind
+        blocks = [int(b) for b in self.allocator.allocate(n_blocks)]
+        try:
+            seq = self.get_or_create_sequence(uid)
+        except Exception:
+            self.allocator.free(blocks)
+            raise
+        seq.blocks = blocks
+        seq.seen_tokens = int(seen_tokens)
+        if self.config.enable_prefix_caching:
+            seq.token_log = list(map(int, token_log))
+        self._m_alloc.inc(n_blocks)
+        flight.record("kv_alloc", uid=int(uid), blocks=int(n_blocks),
+                      free=self.allocator.free_blocks)
         return seq
 
     def flush_sequence(self, uid: int) -> None:

@@ -195,6 +195,88 @@ class DynamicSplitFuseScheduler:
                       max_new_tokens=int(max_new_tokens))
         self._update_depth_gauges()
 
+    def resume(self, uid: int, prompt: Sequence[int],
+               generated: Sequence[int], max_new_tokens: int,
+               eos_token_id: Optional[int] = None,
+               temperature: float = 0.0, top_p: float = 1.0,
+               top_k: int = 0, rng_state: Optional[dict] = None,
+               on_token: Optional[Callable[[int, int, bool], None]]
+               = None) -> None:
+        """Adopt a request mid-generation (the prefill/decode
+        disaggregation path, serve/handoff.py): the engine already holds
+        the sequence's KV — restored from a prefill replica — and
+        ``generated`` tokens were emitted there (at least the first
+        token, whose logits came from the handed-off prefill). The
+        request enters the RUNNING set directly, its last generated
+        token pending as the next decode input — exactly the state a
+        colocated request is in after its final prompt chunk, which is
+        what makes handed-off streams bit-identical to colocated ones.
+
+        ``rng_state`` is the numpy bit-generator state captured after
+        the prefill side's draws; restoring it keeps SAMPLED streams on
+        the colocated token path too. ``on_token`` fires only for
+        tokens decoded here — the caller already streamed
+        ``generated``."""
+        if uid in self._all:
+            raise ValueError(
+                f"uid {uid} already submitted to this scheduler; "
+                f"resume needs a fresh uid")
+        sm = self.engine.state_manager
+        # same KV-slot precheck submit() enforces: an oversized request
+        # must fail HERE, not mid-decode as a misleading pool error
+        # that would take every in-flight request on this replica down
+        need = len(prompt) + max(int(max_new_tokens) - 1, 0)
+        if need > sm.config.max_seq_len:
+            raise RuntimeError(
+                f"request uid={uid} cannot be resumed: "
+                f"len(prompt)={len(prompt)} + max_new_tokens="
+                f"{max_new_tokens} needs {need} KV slots, over "
+                f"max_seq_len={sm.config.max_seq_len}")
+        if not sm.known_seq(uid):
+            raise ValueError(
+                f"cannot resume uid {uid}: the engine holds no KV for "
+                f"it (restore the handoff first)")
+        if not generated:
+            raise ValueError("resume needs at least the first generated "
+                             "token (emitted by the prefill side)")
+        if len(generated) >= max_new_tokens or (
+                eos_token_id is not None
+                and int(generated[-1]) == eos_token_id):
+            raise ValueError(
+                f"uid {uid} already finished at prefill; nothing to "
+                f"resume")
+        seen = sm.seqs[uid].seen_tokens
+        expect = len(prompt) + len(generated) - 1
+        if seen != expect:
+            # the last emitted token is never fed back, so the cache
+            # must hold exactly prompt + all-but-last generated tokens
+            raise ValueError(
+                f"handoff state inconsistent for uid {uid}: cache holds "
+                f"{seen} tokens, descriptor implies {expect}")
+        rng = np.random.default_rng()
+        if rng_state is not None:
+            rng.bit_generator.state = rng_state
+        now = self.clock()
+        req = _Request(uid, list(map(int, prompt)), max_new_tokens,
+                       eos_token_id, now, temperature=temperature,
+                       top_p=top_p, top_k=top_k, rng=rng,
+                       on_token=on_token,
+                       t_submit_pc=time.perf_counter())
+        req.prefill_sent = len(req.prompt)
+        req.generated = list(map(int, generated))
+        req.next_token = int(generated[-1])
+        req.first_token_t = now        # TTFT was paid on the prefill side
+        req.last_emit_t = now
+        req.t_prefill_pc = req.t_first_tok_pc = time.perf_counter()
+        self._all[uid] = req
+        self._running.append(req)
+        self._m_submitted.inc()
+        flight.record("request_resume", uid=int(uid),
+                      prompt_tokens=len(req.prompt),
+                      generated=len(req.generated),
+                      max_new_tokens=int(max_new_tokens))
+        self._update_depth_gauges()
+
     def pending(self) -> bool:
         return bool(self._queue or self._running)
 

@@ -10,10 +10,19 @@ DeepSpeed-MII persistent deployments over the FastGen engine):
   loop.py      — background thread continuously draining the SplitFuse
                  scheduler (continuous batching) with graceful drain
   api.py       — dependency-free HTTP endpoint: streaming /generate,
-                 /healthz, /metrics (Prometheus text from the registry)
+                 /healthz, /metrics (Prometheus text from the registry);
+                 serves a single engine or the routed front tier
+  router.py    — prefix-affinity replica router: spreads traffic over N
+                 engine replicas, backoff-aware overload re-routing,
+                 drain/failover lifecycle, optional prefill/decode
+                 disaggregation
+  replica.py   — the units behind the router: full serving replicas and
+                 dedicated prefill workers
+  handoff.py   — paged-KV export/serialize/restore between replicas
+                 (the disaggregation transport; parity-pinned)
 
-See docs/SERVING.md ("Async serving runtime") for the architecture and
-the streaming protocol.
+See docs/SERVING.md ("Async serving runtime" and "Routing tier") for
+the architecture and the streaming protocol.
 """
 
 from .admission import (AdmissionConfig, AdmissionController,  # noqa: F401
@@ -22,9 +31,14 @@ from .frontend import (DeadlineExceeded, RequestFailed,  # noqa: F401
                        ServingConfig, ServingEngine, TokenStream)
 from .loop import ServingLoop  # noqa: F401
 from .api import ServingAPI  # noqa: F401
+from .replica import PrefillReplica, Replica, build_replicas  # noqa: F401
+from .router import (ReplicaRouter, RoutedStream,  # noqa: F401
+                     RouterConfig)
 
 __all__ = [
     "AdmissionConfig", "AdmissionController", "OverloadedError",
     "DeadlineExceeded", "RequestFailed", "ServingConfig", "ServingEngine",
     "TokenStream", "ServingLoop", "ServingAPI",
+    "PrefillReplica", "Replica", "build_replicas",
+    "ReplicaRouter", "RoutedStream", "RouterConfig",
 ]

@@ -22,7 +22,7 @@ serving-loop thread — every public method takes the controller lock.
 import threading
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, Dict, Optional
+from typing import Deque, Dict, List, Optional
 
 from ....telemetry import recorder as flight
 
@@ -31,11 +31,17 @@ class OverloadedError(RuntimeError):
     """Explicit admission rejection (HTTP surfaces map it to 429).
 
     ``reason`` is one of ``queue_full`` / ``token_budget`` / ``draining``
-    — the same labels the rejection counter uses."""
+    — the same labels the rejection counter uses. ``retry_after_s`` is a
+    machine-readable backoff hint: the HTTP surface emits it as a
+    ``Retry-After`` header and the replica router uses it to take the
+    rejecting replica out of rotation for exactly that long
+    (backoff-aware re-routing) instead of hammering it."""
 
-    def __init__(self, reason: str, message: str):
+    def __init__(self, reason: str, message: str,
+                 retry_after_s: Optional[float] = None):
         super().__init__(message)
         self.reason = reason
+        self.retry_after_s = retry_after_s
 
 
 @dataclass
@@ -46,6 +52,10 @@ class AdmissionConfig:
     max_queued_tokens: Optional[int] = None
     # per-tenant weights for fair scheduling; tenants not listed get 1.0
     tenant_weights: Dict[str, float] = field(default_factory=dict)
+    # backoff hint attached to every rejection (OverloadedError
+    # .retry_after_s / HTTP Retry-After): how long a shed client should
+    # wait before retrying THIS runtime
+    retry_after_s: float = 0.5
 
 
 def request_cost(entry) -> int:
@@ -99,7 +109,8 @@ class AdmissionController:
         self._m_rejected.labels(reason=reason).inc()
         flight.record("shed", reason=reason, depth=self._depth,
                       queued_tokens=self._tokens)
-        raise OverloadedError(reason, message)
+        raise OverloadedError(reason, message,
+                              retry_after_s=self.config.retry_after_s)
 
     # ------------------------------------------------------------------
     def try_admit(self, entry) -> None:
@@ -197,6 +208,28 @@ class AdmissionController:
                         self._update_gauges()
                         return True
         return False
+
+    def reclaim_pending(self) -> List:
+        """Empty the pending queues and return the reclaimed entries —
+        the dead-replica failover path (serve/router.py): when a
+        replica's heartbeat expires, its queued (not-yet-prefilled)
+        requests are pulled back here and re-enqueued on survivors.
+        Entries are marked ``done`` under the lock so a loop thread that
+        later recovers cannot ALSO run them (it skips done entries at
+        admit time)."""
+        with self._lock:
+            out: List = []
+            for tenant in list(self._queues):
+                q = self._queues[tenant]
+                while q:
+                    entry = q.popleft()
+                    entry.state = "done"
+                    out.append(entry)
+                self._drop_tenant(tenant)
+            self._depth = 0
+            self._tokens = 0
+            self._update_gauges()
+            return out
 
     # ------------------------------------------------------------------
     def close(self) -> None:

@@ -30,8 +30,17 @@ Built on stdlib ``asyncio.start_server`` — no web framework. Endpoints:
     flight-recorder events, anomaly verdicts) and return its path
     (docs/SERVING.md § Post-mortem bundles).
 
-Overload maps to ``429`` with the admission reason; malformed requests
+Overload maps to ``429`` with the admission reason and a ``Retry-After``
+header carrying the admission layer's backoff hint; malformed requests
 to ``400``; unknown routes to ``404``.
+
+Routed frontend mode: constructed over a
+:class:`~.router.ReplicaRouter` instead of a single
+:class:`~.frontend.ServingEngine`, the same endpoints serve an N-replica
+deployment — ``/generate`` streams through the router's placement
+(prefix affinity, overload re-routing, failover) and ``/statusz`` gains
+``router`` + per-replica ``replicas`` sections. The two are
+duck-compatible (``submit`` / ``health``); nothing else changes.
 """
 
 import asyncio
@@ -39,7 +48,7 @@ import json
 from typing import Optional, Tuple
 
 from .admission import OverloadedError
-from .frontend import DeadlineExceeded, RequestFailed, ServingEngine
+from .frontend import DeadlineExceeded, RequestFailed
 
 _MAX_HEADER_BYTES = 64 * 1024
 _MAX_BODY_BYTES = 8 * 1024 * 1024
@@ -71,21 +80,28 @@ async def _read_request(reader: asyncio.StreamReader):
     return method, target, headers, body
 
 
-def _response_head(status: str, content_type: str) -> bytes:
-    return (f"HTTP/1.1 {status}\r\n"
-            f"Content-Type: {content_type}\r\n"
-            f"Connection: close\r\n\r\n").encode()
+def _response_head(status: str, content_type: str,
+                   extra_headers: Optional[dict] = None) -> bytes:
+    lines = [f"HTTP/1.1 {status}",
+             f"Content-Type: {content_type}",
+             "Connection: close"]
+    for name, value in (extra_headers or {}).items():
+        lines.append(f"{name}: {value}")
+    return ("\r\n".join(lines) + "\r\n\r\n").encode()
 
 
-def _json_response(writer: asyncio.StreamWriter, status: str, obj) -> None:
-    writer.write(_response_head(status, "application/json")
+def _json_response(writer: asyncio.StreamWriter, status: str, obj,
+                   extra_headers: Optional[dict] = None) -> None:
+    writer.write(_response_head(status, "application/json", extra_headers)
                  + json.dumps(obj).encode() + b"\n")
 
 
 class ServingAPI:
-    """In-process HTTP server over a :class:`ServingEngine`."""
+    """In-process HTTP server over a :class:`ServingEngine` — or, in
+    routed frontend mode, over a :class:`~.router.ReplicaRouter`
+    (anything with the ``submit``/``health`` surface)."""
 
-    def __init__(self, serving: ServingEngine, host: str = "127.0.0.1",
+    def __init__(self, serving, host: str = "127.0.0.1",
                  port: int = 0, registry=None):
         self.serving = serving
         self.host = host
@@ -183,6 +199,12 @@ class ServingAPI:
             "recorder": get_recorder().stats(),
             "anomalies": {"recent": ds_anomaly.recent(16)},
         }
+        if hasattr(self.serving, "replica_statusz"):
+            # routed frontend mode: the "serving engine" is a
+            # ReplicaRouter — aggregate the per-replica rollups and the
+            # router's own placement state into the same document
+            out["router"] = self.serving.router_statusz()
+            out["replicas"] = self.serving.replica_statusz()
         diag = getattr(self.serving, "diagnostics", None)
         if diag is not None and diag.slo is not None:
             def clean(d):
@@ -244,9 +266,18 @@ class ServingAPI:
         try:
             stream = await self.serving.submit(prompt, max_new, **kw)
         except OverloadedError as e:
+            # Retry-After carries the machine-readable backoff hint the
+            # admission layer attached (integer seconds, ceil'd — the
+            # HTTP header grammar is delta-seconds); the JSON body keeps
+            # the precise float for clients that parse it
+            import math
+            retry = getattr(e, "retry_after_s", None)
+            headers = ({"Retry-After": str(max(1, math.ceil(retry)))}
+                       if retry is not None else None)
             _json_response(writer, "429 Too Many Requests",
                            {"error": "overloaded", "reason": e.reason,
-                            "detail": str(e)})
+                            "retry_after_s": retry,
+                            "detail": str(e)}, extra_headers=headers)
             return
         except ValueError as e:
             _json_response(writer, "400 Bad Request", {"error": str(e)})

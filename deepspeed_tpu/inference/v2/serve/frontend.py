@@ -282,7 +282,58 @@ class ServingEngine:
         self._loop_runner.register(entry)
         return stream
 
+    # -- handoff (prefill/decode disaggregation; serve/handoff.py) ------
+    async def resume(self, pack, *, prompt: Sequence[int],
+                     generated: Sequence[int], max_new_tokens: int,
+                     eos_token_id: Optional[int] = None,
+                     temperature: float = 0.0, top_p: float = 1.0,
+                     top_k: int = 0, rng_state=None,
+                     deadline_s: Optional[float] = None) -> TokenStream:
+        """Adopt a handed-off request: restore the KV ``pack`` exported
+        by a prefill replica and continue decoding it here. The stream
+        yields only the tokens decoded on THIS runtime — the caller
+        already streamed ``generated`` (at least the prefill's first
+        token). Restore and scheduler adoption run on the loop thread
+        (the engine is not thread-safe); a restore failure ends the
+        stream with status 'error'.
+
+        Resumed requests bypass the admission queue — there is no
+        pending phase to queue through; the ROUTER is the admission
+        point for disaggregated traffic and picks the decode replica by
+        its load signals before prefill ever runs."""
+        if self._stopped or self.admission.closed:
+            from .admission import OverloadedError
+            raise OverloadedError(
+                "draining", "serving runtime is draining; not accepting "
+                "handoffs",
+                retry_after_s=self.config.admission.retry_after_s)
+        uid = next(self._uids)
+        stream = TokenStream(self, uid, asyncio.get_running_loop())
+        entry = _Entry(
+            uid=uid, prompt=list(map(int, prompt)),
+            max_new_tokens=int(max_new_tokens),
+            eos_token_id=eos_token_id, temperature=temperature,
+            top_p=top_p, top_k=top_k, seed=None, tenant="handoff",
+            weight=None,
+            deadline_t=(self.clock() + deadline_s
+                        if deadline_s is not None else None),
+            on_token=stream._push_token, on_end=stream._push_end,
+            state="inflight")
+        self._loop_runner.resume(entry, pack,
+                                 generated=list(map(int, generated)),
+                                 rng_state=rng_state)
+        return stream
+
     # -- introspection --------------------------------------------------
+    def heartbeat_age(self) -> Optional[float]:
+        """Seconds since the serving loop's last stall-watchdog
+        heartbeat while mid-step, or None when idle / watchdog off.
+        The replica router's dead-replica detector reads this."""
+        stall = self.diagnostics.stall
+        if stall is None:
+            return None
+        return stall.heartbeat_age("serving_loop")
+
     def health(self) -> dict:
         return {
             "status": ("draining" if (self.admission.closed

@@ -1,0 +1,133 @@
+"""Paged-KV handoff between engine replicas.
+
+The disaggregated serving path (docs/SERVING.md § Routing tier) runs a
+request's prefill on a dedicated prefill replica, then moves the
+sequence to a decode replica: the prefill side **exports** the
+sequence's KV blocks plus a descriptor, the bytes travel (in-process
+today, a wire tomorrow — the payload is a real serialized buffer either
+way so the path is honest about its cost), and the decode side
+**restores** them into its own pool under freshly allocated block ids.
+Because KV content is copied bit-for-bit and the descriptor recreates
+the exact scheduler state a colocated request has after its final
+prompt chunk, handed-off token streams are bit-identical to colocated
+serving — parity-pinned by tests/unit/inference/test_router.py.
+
+Payload layout (``serialize``): one ``.npz`` buffer holding a JSON
+descriptor (uid, seen_tokens, block count/size, fed-token log) and one
+array per KV-pool leaf — ``[num_layers, n_blocks, ...]``, the
+sequence's blocks gathered along the pool's block axis. The int8
+``kv_quant`` pool hands off the same way (its scale leaves are just
+more pool leaves).
+
+Gather/scatter shapes are bucketed (pow2 over the block count, padded
+with the null block) so repeated handoffs of different-length
+sequences reuse compiled programs instead of respecializing per
+length; pad rows carry zeros and land in the null block, which no
+attention read ever sees (reads are masked by position).
+"""
+
+import io
+import json
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....utils.bucketing import pow2_bucket
+from ..ragged.blocked_allocator import NULL_BLOCK
+
+_DESCRIPTOR_KEY = "__descriptor__"
+
+
+@jax.jit
+def _gather_blocks(leaf, idx):
+    return leaf[:, idx]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_blocks(leaf, idx, data):
+    # pad rows all target the null block with identical (zero) data, so
+    # the duplicate-index scatter stays deterministic
+    return leaf.at[:, idx].set(data)
+
+
+def export_sequence(engine, uid: int) -> Dict:
+    """Snapshot ``uid``'s KV blocks and descriptor from ``engine`` into
+    a host-side pack (plain numpy + ints). The sequence stays live on
+    the source engine; callers flush it once the handoff is accepted."""
+    sm = engine.state_manager
+    seq = sm.seqs.get(uid)
+    if seq is None:
+        raise ValueError(f"cannot export uid {uid}: unknown sequence")
+    blocks = [int(b) for b in seq.blocks]
+    nb = len(blocks)
+    bucket = pow2_bucket(max(nb, 1), sm.max_blocks_per_seq)
+    idx = np.full(bucket, NULL_BLOCK, np.int32)
+    idx[:nb] = blocks
+    kv = {key: np.asarray(_gather_blocks(leaf, jnp.asarray(idx)))[:, :nb]
+          for key, leaf in engine.kv_cache.items()}
+    return {
+        "uid": int(uid),
+        "seen_tokens": int(seq.seen_tokens),
+        "n_blocks": nb,
+        "block_size": int(sm.block_size),
+        "token_log": [int(t) for t in seq.token_log],
+        "kv": kv,
+    }
+
+
+def serialize(pack: Dict) -> bytes:
+    """Pack -> one self-describing ``.npz`` buffer (the wire format)."""
+    descriptor = {k: pack[k] for k in
+                  ("uid", "seen_tokens", "n_blocks", "block_size",
+                   "token_log")}
+    bio = io.BytesIO()
+    np.savez(bio,
+             **{_DESCRIPTOR_KEY: np.frombuffer(
+                 json.dumps(descriptor).encode(), np.uint8)},
+             **{f"kv_{key}": arr for key, arr in pack["kv"].items()})
+    return bio.getvalue()
+
+
+def deserialize(buf: bytes) -> Dict:
+    with np.load(io.BytesIO(buf)) as z:
+        pack = json.loads(bytes(z[_DESCRIPTOR_KEY]).decode())
+        pack["kv"] = {name[3:]: z[name] for name in z.files
+                      if name.startswith("kv_")}
+    return pack
+
+
+def restore_sequence(engine, pack: Dict, uid: int) -> None:
+    """Install the handed-off sequence into ``engine`` as ``uid``:
+    allocate fresh blocks, scatter the KV content into them, and adopt
+    a descriptor in exactly the state the decode paths expect."""
+    sm = engine.state_manager
+    if sm.block_size != pack["block_size"]:
+        raise ValueError(
+            f"handoff block-size mismatch: payload has "
+            f"{pack['block_size']}, target pool has {sm.block_size} "
+            f"(disaggregated replicas must share the KV layout)")
+    if set(pack["kv"]) != set(engine.kv_cache):
+        raise ValueError(
+            f"handoff pool-leaf mismatch: payload has "
+            f"{sorted(pack['kv'])}, target pool has "
+            f"{sorted(engine.kv_cache)} (kv_quant must match)")
+    nb = int(pack["n_blocks"])
+    seq = sm.adopt_sequence(uid, nb, pack["seen_tokens"],
+                            pack["token_log"])
+    try:
+        bucket = pow2_bucket(max(nb, 1), sm.max_blocks_per_seq)
+        idx = np.full(bucket, NULL_BLOCK, np.int32)
+        idx[:nb] = seq.blocks
+        for key in list(engine.kv_cache):
+            leaf = engine.kv_cache[key]
+            data = np.zeros((leaf.shape[0], bucket) + leaf.shape[2:],
+                            np.asarray(pack["kv"][key]).dtype)
+            data[:, :nb] = pack["kv"][key]
+            engine.kv_cache[key] = _scatter_blocks(
+                leaf, jnp.asarray(idx), jnp.asarray(data, leaf.dtype))
+    except Exception:
+        sm.flush_sequence(uid)   # do not leak the adopted blocks
+        raise

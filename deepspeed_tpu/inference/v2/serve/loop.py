@@ -81,6 +81,12 @@ class ServingLoop:
     def request_cancel(self, uid: int, status: str = "cancelled") -> None:
         self.post(lambda: self._cancel(uid, status))
 
+    def resume(self, entry, pack, *, generated, rng_state=None) -> None:
+        """Adopt a handed-off request (serve/handoff.py): restore the
+        KV pack into the engine and insert the entry directly into the
+        scheduler's running set, both on the loop thread."""
+        self.post(lambda: self._resume(entry, pack, generated, rng_state))
+
     def request_drain(self) -> None:
         """Graceful drain: admission closes immediately (new submits get
         an explicit rejection); everything already admitted finishes,
@@ -141,6 +147,31 @@ class ServingLoop:
             # a dead client (e.g. its asyncio loop is gone) must not
             # take the serving loop down; the entry is done either way
             pass
+
+    def _resume(self, entry, pack, generated, rng_state) -> None:
+        from . import handoff
+        try:
+            handoff.restore_sequence(self.scheduler.engine, pack,
+                                     uid=entry.uid)
+        except Exception as e:
+            self._end(entry, "error",
+                      f"handoff restore failed: {type(e).__name__}: {e}")
+            return
+        try:
+            self.scheduler.resume(
+                entry.uid, entry.prompt, generated,
+                entry.max_new_tokens, eos_token_id=entry.eos_token_id,
+                temperature=entry.temperature, top_p=entry.top_p,
+                top_k=entry.top_k, rng_state=rng_state,
+                on_token=self._make_on_token(entry))
+        except Exception as e:
+            self.scheduler.engine.flush(entry.uid)
+            self._end(entry, "error", f"{type(e).__name__}: {e}")
+            return
+        entry.state = "inflight"
+        self._entries[entry.uid] = entry
+        if entry.deadline_t is not None:
+            heapq.heappush(self._deadlines, (entry.deadline_t, entry.uid))
 
     def _cancel(self, uid: int, status: str) -> None:
         entry = self._entries.get(uid)

@@ -1,0 +1,177 @@
+"""Engine replicas behind the serving router.
+
+Two replica kinds (docs/SERVING.md § Routing tier):
+
+  * :class:`Replica` — a full serving unit: one
+    :class:`~.frontend.ServingEngine` (admission + continuous-batching
+    loop) over one engine. The router dispatches streaming requests to
+    it, reads its load/heartbeat signals, drains it without dropping
+    in-flight streams, and declares it dead when its stall-watchdog
+    heartbeat expires.
+  * :class:`PrefillReplica` — a dedicated prefill worker for the
+    disaggregated mode: it runs whole-prompt prefill on its own engine,
+    samples the request's FIRST token with the request's own rng (the
+    colocated first-token path, so streams stay bit-identical), exports
+    the sequence's KV for handoff (serve/handoff.py) and immediately
+    flushes — it never decodes, so its pool only ever holds prompts in
+    flight.
+
+Replicas here are in-process (each owns its engine; chip-free on CPU).
+The router only touches the surface defined by these classes —
+``submit``/``resume``, ``health``, ``load``, ``heartbeat_age``,
+``drain`` — so a subprocess- or RPC-backed replica slots in behind the
+same methods.
+"""
+
+import asyncio
+import itertools
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import handoff
+from .frontend import ServingConfig, ServingEngine
+
+
+class Replica:
+    """One in-process serving replica: name + engine + serving runtime.
+
+    ``state`` is router-owned: 'up' (routable) | 'draining' (finishing
+    in-flight work, no new routes) | 'drained' (clean exit) | 'dead'
+    (heartbeat expired or loop thread gone)."""
+
+    def __init__(self, name: str, engine,
+                 config: Optional[ServingConfig] = None, bridge=None):
+        self.name = name
+        self.engine = engine
+        self.serving = ServingEngine(engine, config, bridge=bridge)
+        self.state = "up"
+        self.started = False
+
+    # -- lifecycle ------------------------------------------------------
+    async def start(self) -> "Replica":
+        await self.serving.start()
+        self.started = True
+        return self
+
+    async def drain(self) -> None:
+        """Graceful: new submits are rejected immediately, everything
+        already admitted (including mid-stream decodes) finishes."""
+        await self.serving.stop(drain=True)
+
+    async def stop(self) -> None:
+        """Hard stop: in-flight requests are cancelled (KV released)."""
+        await self.serving.stop(drain=False)
+
+    # -- router signals -------------------------------------------------
+    def alive(self) -> bool:
+        """The loop thread is running (False once drained/stopped, or
+        if the thread died)."""
+        return self.serving.loop_runner.running
+
+    def heartbeat_age(self) -> Optional[float]:
+        """Seconds the loop has been stuck mid-step (None when idle or
+        the stall watchdog is disabled) — the dead-replica signal."""
+        return self.serving.heartbeat_age()
+
+    def load(self) -> float:
+        """Routing load signal: queued future work plus in-flight
+        requests (the admission/token-budget signals the router
+        rebalances on)."""
+        return (self.serving.admission.queued_tokens()
+                + self.serving.scheduler.inflight())
+
+    def health(self) -> dict:
+        return {"name": self.name, "state": self.state,
+                **self.serving.health()}
+
+
+class PrefillReplica:
+    """Dedicated prefill worker (disaggregated mode).
+
+    The engine is not thread-safe, so one lock serializes prefills; the
+    async wrapper runs them in a worker thread to keep the event loop
+    (and every live token stream) unblocked."""
+
+    def __init__(self, name: str, engine):
+        self.name = name
+        self.engine = engine
+        self.state = "up"
+        self._lock = threading.Lock()
+        self._uids = itertools.count(1)
+        from ....telemetry import get_registry
+        reg = get_registry()
+        self._m_prefills = reg.counter(
+            "router_prefill_requests_total",
+            "requests prefilled on dedicated prefill replicas",
+            labelnames=("replica",))
+
+    async def prefill(self, prompt: Sequence[int], max_new_tokens: int, *,
+                      eos_token_id: Optional[int] = None,
+                      temperature: float = 0.0, top_p: float = 1.0,
+                      top_k: int = 0, seed: Optional[int] = None
+                      ) -> Tuple[int, Optional[bytes], Optional[dict],
+                                 bool]:
+        return await asyncio.to_thread(
+            self.prefill_sync, prompt, max_new_tokens,
+            eos_token_id=eos_token_id, temperature=temperature,
+            top_p=top_p, top_k=top_k, seed=seed)
+
+    def prefill_sync(self, prompt: Sequence[int], max_new_tokens: int, *,
+                     eos_token_id: Optional[int] = None,
+                     temperature: float = 0.0, top_p: float = 1.0,
+                     top_k: int = 0, seed: Optional[int] = None
+                     ) -> Tuple[int, Optional[bytes], Optional[dict],
+                                bool]:
+        """Run one whole-prompt prefill and hand the sequence off.
+
+        Returns ``(first_token, payload, rng_state, finished)`` —
+        ``payload`` is the serialized KV handoff (None when the request
+        already finished at its first token: eos, or a 1-token budget),
+        ``rng_state`` the request rng AFTER the first draw, so the
+        decode side continues the exact sampling stream.
+
+        Parity: the first token is ``host_sample`` over the prompt's
+        last-token logits with a fresh per-request rng — precisely what
+        the colocated scheduler's final-prompt-chunk path computes —
+        and chunked-vs-whole prefill is bit-identical (pinned by the
+        serving-runtime parity tests), so the handed-off KV matches the
+        colocated cache bit-for-bit."""
+        from ..sampling import host_sample
+        with self._lock:
+            uid = next(self._uids)
+            logits = self.engine.put(
+                [uid], [np.asarray(list(prompt), np.int64)])
+            rng = np.random.default_rng(seed)
+            tok = int(host_sample(np.asarray(logits[0]), rng,
+                                  temperature, top_p, top_k))
+            finished = (max_new_tokens <= 1
+                        or (eos_token_id is not None
+                            and tok == eos_token_id))
+            payload = None
+            rng_state = None
+            if not finished:
+                payload = handoff.serialize(
+                    handoff.export_sequence(self.engine, uid))
+                rng_state = rng.bit_generator.state
+            self.engine.flush(uid)
+            self._m_prefills.labels(replica=self.name).inc()
+            return tok, payload, rng_state, finished
+
+    def health(self) -> dict:
+        sm = self.engine.state_manager
+        return {"name": self.name, "state": self.state, "role": "prefill",
+                "free_blocks": sm.free_blocks(),
+                "tracked_sequences": sm.tracked_sequences()}
+
+
+def build_replicas(engines: Sequence, config: Optional[ServingConfig]
+                   = None, name_prefix: str = "replica") -> List[Replica]:
+    """Wrap N engines as named replicas sharing one serving config
+    template (each replica gets its OWN config instance — admission
+    state is per replica)."""
+    import copy
+    return [Replica(f"{name_prefix}{i}", eng,
+                    copy.deepcopy(config) if config is not None else None)
+            for i, eng in enumerate(engines)]

@@ -1,0 +1,698 @@
+"""Prefix-affinity replica router — the multi-host serving front tier.
+
+One :class:`ReplicaRouter` spreads streaming requests across N engine
+replicas (serve/replica.py; the production topology the TPU-vs-GPU
+serving study treats as baseline — PAPERS.md arXiv:2605.25645):
+
+  * **Prefix-affinity placement** — an incoming prompt is chain-hashed
+    with the replicas' KV block size (`ragged_manager.prefix_digest`,
+    the exact digests the per-replica prefix caches key on) and routed
+    to the replica that last served the longest matching digest, so
+    shared-prefix traffic (system prompts, few-shot preambles,
+    multi-turn conversations) lands where its KV blocks already are.
+    Affinity is recorded at DISPATCH time, so concurrent same-prefix
+    requests converge on one replica before the first even finishes.
+    No match falls back to a consistent-hash ring (stable under replica
+    death: only the dead node's keys move).
+  * **Backoff-aware rebalancing** — a replica that sheds
+    (:class:`~.admission.OverloadedError`) is taken out of rotation for
+    its ``retry_after_s`` hint and the request re-routes to the
+    next-best (least-loaded) replica; only when EVERY routable replica
+    is overloaded does the router itself shed, with the soonest
+    retry hint attached.
+  * **Lifecycle** — ``drain_replica()`` finishes a replica's in-flight
+    streams while new traffic diverts to survivors;
+    ``check_replicas()`` (run at submit time and by the background
+    monitor) declares a replica DEAD when its stall-watchdog heartbeat
+    expires or its loop thread dies, reclaims its queued
+    (not-yet-prefilled) requests and re-enqueues them on survivors —
+    a request that already streamed tokens fails explicitly instead
+    (its KV lives only on the dead replica).
+  * **Disaggregation** (``RouterConfig.disaggregated``) — dedicated
+    prefill replicas run whole-prompt prefill and hand the paged KV
+    blocks off to a decode replica (serve/handoff.py); token streams
+    stay bit-identical to colocated serving.
+
+The router is asyncio-side only: it owns no engine and touches replicas
+exclusively through their thread-safe serving frontends, so N
+in-process replicas (N loop threads) serve concurrently under one
+event loop — and the same surface maps onto subprocess or multi-host
+replicas.
+"""
+
+import asyncio
+import bisect
+import hashlib
+import itertools
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..ragged.ragged_manager import prefix_digest
+from . import handoff as handoff_mod
+from .admission import OverloadedError
+from .frontend import DeadlineExceeded, RequestFailed
+from .replica import PrefillReplica, Replica
+
+
+@dataclass
+class RouterConfig:
+    # 'affinity' — prefix-digest affinity with consistent-hash fallback
+    # (the default); 'hash' — consistent hash only; 'round_robin' — the
+    # random-placement baseline the perf gate pins affinity against
+    placement: str = "affinity"
+    # digest -> replica map bound (LRU): memory ceiling for the
+    # affinity index, NOT correctness — evicted digests just fall back
+    # to the hash ring
+    affinity_max_entries: int = 8192
+    # dead-replica detection: loop stuck mid-step longer than this (as
+    # reported by the stall-watchdog heartbeat) or a dead loop thread
+    heartbeat_timeout_s: float = 10.0
+    # background monitor cadence (0 disables; check_replicas() also
+    # runs inline on every submit)
+    monitor_interval_s: float = 1.0
+    # backoff for a shedding replica when its rejection carries no
+    # retry_after_s hint
+    default_backoff_s: float = 0.25
+    # prefill/decode disaggregation: prompts prefill on dedicated
+    # prefill replicas, KV hands off to a decode replica
+    disaggregated: bool = False
+    # consistent-hash ring points per replica
+    ring_points: int = 32
+
+
+class RoutedStream:
+    """Async token stream over a routed request (the TokenStream
+    surface: iterate, ``cancel()``, ``drain()``, ``.tokens`` /
+    ``.status`` / ``.uid``), decoupled from any one replica so the
+    router can re-dispatch a queued request when its replica dies.
+    ``replica`` names where the request is (currently) running."""
+
+    def __init__(self, router: "ReplicaRouter", uid: int):
+        self._router = router
+        self._q: asyncio.Queue = asyncio.Queue()
+        self._ended = False
+        self.uid = uid
+        self.replica: Optional[str] = None
+        self.status = "active"
+        self.reason: Optional[str] = None
+        self.tokens: List[int] = []
+        # tokens PUSHED by the router (>= len(tokens), which counts only
+        # what the client consumed): the failover safety check — a
+        # request is only re-runnable elsewhere while nothing was
+        # emitted, consumed or not
+        self.pushed = 0
+
+    # router-side (event loop)
+    def _push_token(self, tok: int) -> None:
+        self.pushed += 1
+        self._q.put_nowait(("tok", int(tok)))
+
+    def _push_end(self, status: str, reason: Optional[str]) -> None:
+        if not self._ended:
+            self._q.put_nowait(("end", status, reason))
+
+    # -- async iterator -------------------------------------------------
+    def __aiter__(self) -> "RoutedStream":
+        return self
+
+    async def __anext__(self) -> int:
+        if self._ended:
+            raise StopAsyncIteration
+        item = await self._q.get()
+        if item[0] == "tok":
+            self.tokens.append(item[1])
+            return item[1]
+        self._ended = True
+        self.status, self.reason = item[1], item[2]
+        if self.status == "expired":
+            raise DeadlineExceeded(
+                f"request {self.uid}: deadline exceeded")
+        if self.status == "error":
+            raise RequestFailed(f"request {self.uid}: {self.reason}")
+        raise StopAsyncIteration
+
+    async def cancel(self) -> None:
+        await self._router.cancel(self.uid)
+
+    async def aclose(self) -> None:
+        if not self._ended and self.status == "active":
+            await self.cancel()
+
+    async def drain(self) -> List[int]:
+        async for _ in self:
+            pass
+        return self.tokens
+
+
+class _RoutedRequest:
+    """Router-side request record: everything needed to (re)dispatch."""
+
+    def __init__(self, uid: int, prompt: List[int], max_new_tokens: int,
+                 kw: dict, deadline_t: Optional[float],
+                 stream: RoutedStream):
+        self.uid = uid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.kw = kw                 # submit() keywords sans deadline_s
+        self.deadline_t = deadline_t  # absolute, router clock
+        self.stream = stream
+        self.replica: Optional[str] = None
+        self.inner = None            # the replica-side TokenStream
+        self.pump: Optional[asyncio.Task] = None
+        self.handed_off = False      # disaggregated: KV moved already
+
+
+class _HashRing:
+    """Consistent hashing over replica names: each node owns K points on
+    a ring; a key routes to the next point clockwise whose node is
+    allowed. Node removal moves only the removed node's keys."""
+
+    def __init__(self, names: Sequence[str], points: int):
+        self._ring: List[tuple] = sorted(
+            (self._h(f"{name}#{i}".encode()), name)
+            for name in names for i in range(points))
+        self._hashes = [h for h, _ in self._ring]
+
+    @staticmethod
+    def _h(key: bytes) -> int:
+        return int.from_bytes(hashlib.sha1(key).digest()[:8], "big")
+
+    def pick(self, key: bytes, allowed) -> Optional[str]:
+        if not self._ring:
+            return None
+        start = bisect.bisect_left(self._hashes, self._h(key))
+        for off in range(len(self._ring)):
+            name = self._ring[(start + off) % len(self._ring)][1]
+            if name in allowed:
+                return name
+        return None
+
+
+class ReplicaRouter:
+    """Front tier over N serving replicas (module docstring).
+
+    Duck-compatible with :class:`~.frontend.ServingEngine` where the
+    HTTP surface needs it (``submit`` / ``health``), so
+    :class:`~.api.ServingAPI` serves routed traffic unchanged — the
+    routed frontend mode."""
+
+    def __init__(self, replicas: Sequence[Replica],
+                 config: Optional[RouterConfig] = None,
+                 prefill_replicas: Sequence[PrefillReplica] = (),
+                 clock=time.monotonic):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        if config is None:
+            config = RouterConfig()
+        if config.placement not in ("affinity", "hash", "round_robin"):
+            raise ValueError(
+                f"placement must be 'affinity', 'hash' or 'round_robin' "
+                f"(got {config.placement!r})")
+        if config.disaggregated and not prefill_replicas:
+            raise ValueError(
+                "disaggregated mode needs at least one prefill replica")
+        self.config = config
+        self.clock = clock
+        self.replicas: List[Replica] = list(replicas)
+        self.prefill_replicas: List[PrefillReplica] = list(prefill_replicas)
+        self._by_name = {r.name: r for r in self.replicas}
+        if len(self._by_name) != len(self.replicas):
+            raise ValueError("replica names must be unique")
+        # every replica must share the KV block geometry: prefix digests
+        # (and disaggregated handoffs) are keyed on it
+        sizes = {r.engine.state_manager.block_size for r in self.replicas}
+        for p in self.prefill_replicas:
+            sizes.add(p.engine.state_manager.block_size)
+        if len(sizes) != 1:
+            raise ValueError(
+                f"replicas disagree on KV block size ({sorted(sizes)}); "
+                f"prefix affinity and handoff require one layout")
+        self.block_size = sizes.pop()
+        self._ring = _HashRing([r.name for r in self.replicas],
+                               config.ring_points)
+        self._affinity: "OrderedDict[bytes, str]" = OrderedDict()
+        self._backoff_until: Dict[str, float] = {}
+        self._rr = itertools.count()          # round-robin cursors
+        self._rr_prefill = itertools.count()
+        self._uids = itertools.count(1)
+        self._requests: Dict[int, _RoutedRequest] = {}
+        self._monitor: Optional[asyncio.Task] = None
+        self._stopped = False
+        self._init_telemetry()
+
+    def _init_telemetry(self):
+        from ....telemetry import get_registry
+        reg = get_registry()
+        self._m_replicas = reg.gauge(
+            "router_replicas", "replicas registered with the router")
+        self._m_requests = reg.counter(
+            "router_requests_total",
+            "requests dispatched to a replica", labelnames=("replica",))
+        self._m_aff_hits = reg.counter(
+            "router_affinity_hits_total",
+            "requests placed by prefix-digest affinity")
+        self._m_aff_miss = reg.counter(
+            "router_affinity_fallback_total",
+            "requests placed by the consistent-hash ring / round robin "
+            "(no affinity match)")
+        self._m_reroutes = reg.counter(
+            "router_reroutes_total",
+            "requests re-routed off an overloaded replica",
+            labelnames=("reason",))
+        self._m_shed = reg.counter(
+            "router_shed_total",
+            "requests shed by the router (every routable replica "
+            "overloaded)")
+        self._m_requeued = reg.counter(
+            "router_requeued_total",
+            "queued requests re-enqueued onto survivors after their "
+            "replica died")
+        self._m_dead = reg.counter(
+            "router_dead_replicas_total",
+            "replicas declared dead (heartbeat expiry / loop exit)")
+        self._m_drains = reg.counter(
+            "router_drains_total", "replica drains initiated")
+        self._m_state = reg.gauge(
+            "router_replica_state",
+            "per-replica lifecycle state (1 up, 0.5 draining, 0 "
+            "drained, -1 dead)", labelnames=("replica",))
+        self._m_dispatch = reg.histogram(
+            "router_dispatch_seconds",
+            "routing decision time (digest + placement, excl. the "
+            "replica submit)", unit="s",
+            buckets=(1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 0.1))
+        self._m_handoffs = reg.counter(
+            "router_handoffs_total",
+            "prefill->decode KV handoffs completed")
+        self._m_handoff_bytes = reg.counter(
+            "router_handoff_bytes_total",
+            "serialized KV handoff payload bytes moved")
+        self._m_replicas.set(len(self.replicas))
+        for r in self.replicas:
+            self._m_state.labels(replica=r.name).set(1)
+
+    # -- lifecycle ------------------------------------------------------
+    async def start(self) -> "ReplicaRouter":
+        for r in self.replicas:
+            await r.start()
+        if self.config.monitor_interval_s > 0:
+            self._monitor = asyncio.ensure_future(self._monitor_loop())
+        return self
+
+    async def stop(self, drain: bool = True) -> None:
+        self._stopped = True
+        if self._monitor is not None:
+            self._monitor.cancel()
+            try:
+                await self._monitor
+            except asyncio.CancelledError:
+                pass
+            self._monitor = None
+        for r in self.replicas:
+            if r.state in ("up", "draining") and r.started:
+                try:
+                    if drain:
+                        await r.drain()
+                    else:
+                        await r.stop()
+                except Exception:
+                    pass
+                r.state = "drained"
+                self._m_state.labels(replica=r.name).set(0)
+            elif r.state == "dead" and r.started:
+                # best-effort: an unwedged dead loop exits on the halt
+                # command; a truly stuck one stays a daemon thread
+                try:
+                    r.serving.loop_runner.request_stop()
+                    await asyncio.to_thread(r.serving.loop_runner.join,
+                                            2.0)
+                except Exception:
+                    pass
+        for rec in list(self._requests.values()):
+            self._finish(rec, "cancelled", None)
+
+    async def __aenter__(self) -> "ReplicaRouter":
+        return await self.start()
+
+    async def __aexit__(self, *exc) -> None:
+        await self.stop(drain=exc == (None, None, None))
+
+    async def _monitor_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.config.monitor_interval_s)
+            try:
+                await self.check_replicas()
+            except Exception:       # monitoring must never kill routing
+                pass
+
+    # -- placement ------------------------------------------------------
+    def _routable(self) -> List[Replica]:
+        now = self.clock()
+        return [r for r in self.replicas
+                if r.state == "up"
+                and self._backoff_until.get(r.name, 0.0) <= now]
+
+    def _record_affinity(self, digests: List[bytes], name: str) -> None:
+        for d in digests:
+            self._affinity[d] = name
+            self._affinity.move_to_end(d)
+        while len(self._affinity) > self.config.affinity_max_entries:
+            self._affinity.popitem(last=False)
+
+    def pick_replica(self, prompt: Sequence[int]) -> tuple:
+        """Placement decision only (no dispatch): returns
+        ``(replica_name, digests, via)`` where ``via`` is 'affinity' |
+        'hash' | 'round_robin'. Exposed for the perf gate's dispatch-
+        overhead probe."""
+        routable = self._routable()
+        if not routable:
+            return None, [], "none"
+        names = {r.name for r in routable}
+        digests: List[bytes] = []
+        if self.config.placement == "affinity":
+            digests = prefix_digest(np.asarray(list(prompt), np.int64),
+                                    self.block_size)
+            # longest matching digest wins: the deepest shared prefix
+            for d in reversed(digests):
+                name = self._affinity.get(d)
+                if name is not None and name in names:
+                    return name, digests, "affinity"
+        if self.config.placement == "round_robin":
+            name = routable[next(self._rr) % len(routable)].name
+            return name, digests, "round_robin"
+        key = np.asarray(list(prompt), np.int64).tobytes()
+        return self._ring.pick(key, names), digests, "hash"
+
+    def _candidates(self, first: str) -> List[Replica]:
+        """The chosen replica, then every other routable one least-
+        loaded first (the overload re-route order)."""
+        rest = sorted((r for r in self._routable() if r.name != first),
+                      key=lambda r: r.load())
+        head = [self._by_name[first]] if first in {
+            r.name for r in self._routable()} else []
+        return head + rest
+
+    # -- submission -----------------------------------------------------
+    async def submit(self, prompt: Sequence[int], max_new_tokens: int,
+                     **kw) -> RoutedStream:
+        """Route and dispatch one streaming request (the ServingEngine
+        submit surface). Raises :class:`OverloadedError` — with the
+        soonest per-replica ``retry_after_s`` hint — only when every
+        routable replica sheds."""
+        if self._stopped:
+            raise OverloadedError("draining", "router is stopped")
+        await self.check_replicas()
+        uid = next(self._uids)
+        stream = RoutedStream(self, uid)
+        deadline_s = kw.pop("deadline_s", None)
+        rec = _RoutedRequest(
+            uid, list(map(int, prompt)), int(max_new_tokens), dict(kw),
+            self.clock() + deadline_s if deadline_s is not None else None,
+            stream)
+        # register BEFORE dispatching: a request that finishes inside
+        # dispatch (finished-at-prefill, handoff error) must find its
+        # record to pop, or it would linger in _requests forever
+        self._requests[uid] = rec
+        try:
+            if self.config.disaggregated:
+                await self._dispatch_disaggregated(rec)
+            else:
+                await self._dispatch(rec)
+        except BaseException:
+            self._requests.pop(uid, None)
+            raise
+        return stream
+
+    def _pick_for(self, rec: _RoutedRequest):
+        t0 = time.perf_counter()
+        name, digests, via = self.pick_replica(rec.prompt)
+        self._m_dispatch.observe(time.perf_counter() - t0)
+        if name is None:
+            self._m_shed.inc()
+            raise OverloadedError(
+                "no_replicas", "no routable replicas (all dead, "
+                "draining or backing off)",
+                retry_after_s=self._soonest_backoff())
+        if via == "affinity":
+            self._m_aff_hits.inc()
+        else:
+            self._m_aff_miss.inc()
+        return name, digests
+
+    def _soonest_backoff(self) -> Optional[float]:
+        now = self.clock()
+        waits = [t - now for r in self.replicas if r.state == "up"
+                 for t in [self._backoff_until.get(r.name, 0.0)]
+                 if t > now]
+        return round(min(waits), 3) if waits else None
+
+    def _remaining_deadline(self, rec: _RoutedRequest) -> Optional[float]:
+        if rec.deadline_t is None:
+            return None
+        return max(rec.deadline_t - self.clock(), 0.001)
+
+    async def _dispatch(self, rec: _RoutedRequest) -> None:
+        """Pick a replica and submit; on shed, back the replica off for
+        its retry hint and try the next-best until one admits."""
+        name, digests = self._pick_for(rec)
+        last_err: Optional[OverloadedError] = None
+        for replica in self._candidates(name):
+            try:
+                inner = await replica.serving.submit(
+                    rec.prompt, rec.max_new_tokens,
+                    deadline_s=self._remaining_deadline(rec), **rec.kw)
+            except OverloadedError as e:
+                last_err = e
+                self._backoff_until[replica.name] = self.clock() + (
+                    e.retry_after_s if e.retry_after_s is not None
+                    else self.config.default_backoff_s)
+                self._m_reroutes.labels(reason=e.reason).inc()
+                continue
+            self._attach(rec, replica.name, inner, digests)
+            return
+        self._m_shed.inc()
+        raise OverloadedError(
+            last_err.reason if last_err else "no_replicas",
+            f"all routable replicas overloaded: "
+            f"{last_err if last_err else 'none routable'}",
+            retry_after_s=(last_err.retry_after_s if last_err
+                           and last_err.retry_after_s is not None
+                           else self._soonest_backoff()))
+
+    async def _dispatch_disaggregated(self, rec: _RoutedRequest) -> None:
+        """Prefill on a dedicated prefill replica, then hand the KV off
+        to a decode replica picked by the normal placement. The decode
+        replica is chosen BEFORE prefill runs (shed-before-compute: an
+        unroutable fleet never burns prefill flops)."""
+        name, digests = self._pick_for(rec)
+        # the decode-side KV-slot precheck, before any prefill flops are
+        # burned (replicas share one layout, so any state manager works)
+        max_seq = self._by_name[name].engine.state_manager.config \
+            .max_seq_len
+        need = len(rec.prompt) + max(rec.max_new_tokens - 1, 0)
+        if need > max_seq:
+            self._finish(
+                rec, "error",
+                f"RuntimeError: request needs {need} KV slots, over "
+                f"max_seq_len={max_seq}; shorten the request")
+            return
+        pw = self.prefill_replicas[
+            next(self._rr_prefill) % len(self.prefill_replicas)]
+        tok, payload, rng_state, finished = await pw.prefill(
+            rec.prompt, rec.max_new_tokens,
+            eos_token_id=rec.kw.get("eos_token_id"),
+            temperature=rec.kw.get("temperature", 0.0),
+            top_p=rec.kw.get("top_p", 1.0),
+            top_k=rec.kw.get("top_k", 0), seed=rec.kw.get("seed"))
+        rec.stream._push_token(tok)
+        if finished:
+            # NO affinity recorded: the decode candidate never received
+            # this KV (the prefill replica flushed it), and an affinity
+            # entry would assert residency that does not exist
+            rec.replica = pw.name
+            self._finish(rec, "completed", None)
+            return
+        pack = await asyncio.to_thread(handoff_mod.deserialize, payload)
+        last_err: Optional[OverloadedError] = None
+        for replica in self._candidates(name):
+            try:
+                inner = await replica.serving.resume(
+                    pack, prompt=rec.prompt, generated=[tok],
+                    max_new_tokens=rec.max_new_tokens,
+                    eos_token_id=rec.kw.get("eos_token_id"),
+                    temperature=rec.kw.get("temperature", 0.0),
+                    top_p=rec.kw.get("top_p", 1.0),
+                    top_k=rec.kw.get("top_k", 0), rng_state=rng_state,
+                    deadline_s=self._remaining_deadline(rec))
+            except OverloadedError as e:
+                last_err = e
+                self._backoff_until[replica.name] = self.clock() + (
+                    e.retry_after_s if e.retry_after_s is not None
+                    else self.config.default_backoff_s)
+                self._m_reroutes.labels(reason=e.reason).inc()
+                continue
+            rec.handed_off = True
+            self._m_handoffs.inc()
+            self._m_handoff_bytes.inc(len(payload))
+            self._attach(rec, replica.name, inner, digests)
+            return
+        self._m_shed.inc()
+        self._finish(rec, "error",
+                     f"no decode replica accepted the handoff: "
+                     f"{last_err}")
+
+    def _attach(self, rec: _RoutedRequest, name: str, inner,
+                digests: List[bytes]) -> None:
+        rec.replica = name
+        rec.stream.replica = name
+        rec.inner = inner
+        self._record_affinity(digests, name)
+        self._m_requests.labels(replica=name).inc()
+        rec.pump = asyncio.ensure_future(self._pump(rec, inner))
+
+    async def _pump(self, rec: _RoutedRequest, inner) -> None:
+        """Forward one replica-side stream into the routed stream."""
+        try:
+            async for tok in inner:
+                rec.stream._push_token(tok)
+            self._finish(rec, inner.status, inner.reason)
+        except DeadlineExceeded:
+            self._finish(rec, "expired", "deadline exceeded")
+        except RequestFailed as e:
+            self._finish(rec, "error", str(e))
+        except asyncio.CancelledError:   # failover/cancel detached us
+            raise
+        except Exception as e:           # never lose a stream silently
+            self._finish(rec, "error", f"{type(e).__name__}: {e}")
+
+    def _finish(self, rec: _RoutedRequest, status: str,
+                reason: Optional[str]) -> None:
+        rec.stream._push_end(status, reason)
+        self._requests.pop(rec.uid, None)
+
+    async def cancel(self, uid: int) -> None:
+        rec = self._requests.get(uid)
+        if rec is None:
+            return
+        if rec.pump is not None:
+            rec.pump.cancel()
+        if rec.inner is not None:
+            try:
+                await rec.inner.cancel()
+            except Exception:
+                pass
+        self._finish(rec, "cancelled", None)
+
+    # -- lifecycle: drain & failover ------------------------------------
+    async def drain_replica(self, name: str) -> None:
+        """Take ``name`` out of rotation and finish its in-flight
+        streams (new traffic diverts immediately; this returns when the
+        replica has fully drained)."""
+        replica = self._by_name[name]
+        if replica.state != "up":
+            return
+        replica.state = "draining"
+        self._m_state.labels(replica=name).set(0.5)
+        self._m_drains.inc()
+        await replica.drain()
+        replica.state = "drained"
+        self._m_state.labels(replica=name).set(0)
+
+    def _is_dead(self, replica: Replica) -> bool:
+        if not replica.started or replica.state != "up":
+            return False
+        if not replica.alive():
+            return True
+        age = replica.heartbeat_age()
+        return (age is not None
+                and age > self.config.heartbeat_timeout_s)
+
+    async def check_replicas(self) -> List[str]:
+        """Declare replicas dead (heartbeat expiry / loop exit) and
+        fail over: queued requests with no tokens yet re-dispatch onto
+        survivors; requests that already streamed tokens end with an
+        explicit error (their KV exists only on the dead replica).
+        Returns the names declared dead this call."""
+        died = [r for r in self.replicas if self._is_dead(r)]
+        for replica in died:
+            replica.state = "dead"
+            self._m_state.labels(replica=replica.name).set(-1)
+            self._m_dead.inc()
+            # empty the dead replica's admission queue so a later
+            # recovery cannot also run the re-enqueued work, tell its
+            # loop to halt (if the thread ever unwedges it cancels
+            # everything and exits instead of lingering as a zombie),
+            # and stop its watchdog thread
+            try:
+                replica.serving.admission.reclaim_pending()
+                replica.serving.loop_runner.request_stop()
+                replica.serving.diagnostics.close()
+            except Exception:
+                pass
+            for rec in [rec for rec in self._requests.values()
+                        if rec.replica == replica.name]:
+                if rec.pump is not None:
+                    rec.pump.cancel()
+                if rec.stream.pushed == 0 and not rec.handed_off:
+                    # queued / not-yet-prefilled: safe to re-run
+                    # elsewhere (prompts are idempotent)
+                    self._m_requeued.inc()
+                    try:
+                        await self._dispatch(rec)
+                    except OverloadedError as e:
+                        self._finish(rec, "error",
+                                     f"re-enqueue after replica death "
+                                     f"shed: {e}")
+                else:
+                    self._finish(
+                        rec, "error",
+                        f"replica {replica.name} died mid-stream "
+                        f"({rec.stream.pushed} tokens emitted)")
+        return [r.name for r in died]
+
+    # -- introspection (the ServingAPI surface) -------------------------
+    def health(self) -> dict:
+        up = [r for r in self.replicas if r.state == "up"]
+        return {
+            "status": "ok" if up and not self._stopped else "draining",
+            "replicas": {r.name: r.health() for r in self.replicas},
+            "queue_depth": sum(r.serving.admission.depth()
+                               for r in self.replicas),
+            "queued_tokens": sum(r.serving.admission.queued_tokens()
+                                 for r in self.replicas),
+            "inflight": sum(r.serving.scheduler.inflight()
+                            for r in self.replicas),
+            "routable": [r.name for r in self._routable()],
+        }
+
+    def replica_statusz(self) -> dict:
+        """Per-replica forensics rollup for the aggregated /statusz."""
+        out = {}
+        for r in self.replicas:
+            age = r.heartbeat_age()
+            out[r.name] = {
+                "state": r.state,
+                "health": r.serving.health(),
+                "load": r.load(),
+                "heartbeat_age_s": (round(age, 3)
+                                    if age is not None else None),
+                "backoff_remaining_s": max(
+                    0.0, round(self._backoff_until.get(r.name, 0.0)
+                               - self.clock(), 3)),
+            }
+        for p in self.prefill_replicas:
+            out[p.name] = p.health()
+        return out
+
+    def router_statusz(self) -> dict:
+        return {
+            "placement": self.config.placement,
+            "disaggregated": self.config.disaggregated,
+            "affinity_entries": len(self._affinity),
+            "inflight_routed": len(self._requests),
+            "replica_states": {r.name: r.state for r in self.replicas},
+        }

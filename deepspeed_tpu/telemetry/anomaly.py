@@ -449,13 +449,18 @@ class SLOBurnRateMonitor:
 # ---------------------------------------------------------------------------
 def thread_stacks(max_frames: int = 20) -> Dict[str, List[str]]:
     """Formatted stack of every live thread (the post-mortem evidence a
-    stall verdict carries: the wedged frame is one of these)."""
+    stall verdict carries: the wedged frame is one of these). Duplicate
+    thread names — N serving replicas each run a 'ds-tpu-serving-loop'
+    thread — are disambiguated with the thread ident so no stack
+    silently overwrites another."""
     names = {t.ident: t.name for t in threading.enumerate()}
     out: Dict[str, List[str]] = {}
     for ident, frame in sys._current_frames().items():
         stack = traceback.format_stack(frame)[-max_frames:]
-        out[names.get(ident, f"thread-{ident}")] = \
-            [line.rstrip() for line in stack]
+        name = names.get(ident, f"thread-{ident}")
+        if name in out:
+            name = f"{name}#{ident}"
+        out[name] = [line.rstrip() for line in stack]
     return out
 
 
@@ -532,6 +537,19 @@ class StallWatchdog:
             if active and not ch.active:
                 ch.last_beat = self.clock()   # arm from now, not history
             ch.active = active
+
+    def heartbeat_age(self, channel: str) -> Optional[float]:
+        """Seconds since the channel's last heartbeat while ARMED, or
+        None when the channel is unknown, idle (idle is silence, not a
+        stall) or has never beaten. The serving router reads this to
+        declare a replica dead: a loop wedged mid-step stays active
+        with a growing age, while an idle loop reads None."""
+        now = self.clock()
+        with self._lock:
+            ch = self._channels.get(channel)
+            if ch is None or ch.last_beat is None or not ch.active:
+                return None
+            return now - ch.last_beat
 
     # -- scanning ------------------------------------------------------
     def check_now(self) -> List[Dict]:

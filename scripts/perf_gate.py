@@ -36,6 +36,15 @@ Gated metrics (see ``collect()``):
     collectives the scheduler left without an overlap window
     (utils/xla_profile.analyze_grad_exchange; the PR-4 regression
     metric).
+  * ``router_affinity_hit_fraction`` / ``router_random_hit_fraction``
+    / ``router_affinity_hit_gain`` / ``router_steady_recompiles`` /
+    ``router_dispatch_ns_per_request`` — the serving routing tier
+    (serve/router.py): on a shared-prefix workload through 2 routed
+    replicas, prefix-affinity placement must keep beating random
+    placement's prefix-cache hit rate (the gain is pinned from below),
+    routed traffic must stay recompile-free per replica after the
+    double warmup, and the routing decision itself (digest chain +
+    placement lookup) must stay out of the hot path.
   * ``recorder_events_per_decode_step`` /
     ``recorder_ns_per_event`` — flight-recorder overhead
     (telemetry/recorder.py): how many black-box events the serving
@@ -296,8 +305,103 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
                                                   - ragged_compiled)
         metrics["ragged_mixed_steady_recompiles"] = ragged_steady
 
-        # -- flight-recorder record() cost ---------------------------------
+        # -- routing tier: affinity win + per-replica steady state ---------
+        # (serve/router.py): a shared-prefix workload through 2 routed
+        # replicas must (a) hit the prefix cache strictly more often
+        # under affinity placement than under round-robin (random
+        # placement), (b) reach zero steady-state recompiles per
+        # replica under routed traffic after the double-warm discipline,
+        # and (c) keep the routing decision itself out of the hot path
+        # (ns/request, wide absolute tolerance like recorder_ns_per_event)
+        import asyncio
+
+        from deepspeed_tpu.inference.v2.serve import (ReplicaRouter,
+                                                      RouterConfig,
+                                                      ServingConfig,
+                                                      build_replicas)
+
+        rng = np.random.default_rng(7)
+        shared_prompts = []
+        for _g in range(2):
+            prefix = list(map(int, rng.integers(1, 127, 32)))
+            for _ in range(3):
+                shared_prompts.append(
+                    prefix + list(map(int, rng.integers(1, 127, 6))))
+
+        def _router_engines():
+            return [InferenceEngineV2(
+                model, RaggedInferenceEngineConfig(
+                    state_manager=DSStateManagerConfig(
+                        max_tracked_sequences=8, max_seq_len=seq_len,
+                        num_blocks=65, block_size=16,
+                        enable_prefix_caching=True),
+                    dtype="float32", prefill_bucket=16,
+                    decode_window=decode_window), params=params)
+                for _ in range(2)]
+
         import time as _time
+
+        def _routed_run(placement: str, waves: int):
+            """Sequential shared-prefix waves through a fresh routed
+            pair; returns (wave-1 hit fraction, steady recompiles,
+            dispatch ns/request) — wave 1 measures hits against fresh
+            prefix indexes, wave 2 absorbs the per-bucket
+            respecialization, wave 3 runs under mark_steady. The
+            dispatch probe times pick_replica over the warmed affinity
+            map (pure host work: digest chain + placement lookup)."""
+
+            async def run():
+                router = ReplicaRouter(
+                    build_replicas(_router_engines(),
+                                   ServingConfig(token_budget=24,
+                                                 chunk=16)),
+                    RouterConfig(placement=placement,
+                                 monitor_interval_s=0.0))
+                await router.start()
+                hits0 = fam_total("inference_prefix_hits_total")
+                hit_frac = steady = 0.0
+                for wave in range(waves):
+                    if wave == 1:
+                        hit_frac = (fam_total(
+                            "inference_prefix_hits_total") - hits0) \
+                            / len(shared_prompts)
+                    if wave == waves - 1 and waves > 1:
+                        st0 = fam_total(
+                            "xla_steady_state_recompiles_total")
+                        watchdog.mark_steady(True)
+                    try:
+                        for p in shared_prompts:
+                            stream = await router.submit(p, 2)
+                            await stream.drain()
+                    finally:
+                        if wave == waves - 1 and waves > 1:
+                            watchdog.mark_steady(False)
+                            steady = fam_total(
+                                "xla_steady_state_recompiles_total") - st0
+                if waves == 1:
+                    hit_frac = (fam_total("inference_prefix_hits_total")
+                                - hits0) / len(shared_prompts)
+                n_pick = 2000
+                t0 = _time.perf_counter()
+                for i in range(n_pick):
+                    router.pick_replica(
+                        shared_prompts[i % len(shared_prompts)])
+                dispatch_ns = ((_time.perf_counter() - t0) / n_pick
+                               * 1e9)
+                await router.stop()
+                return hit_frac, steady, dispatch_ns
+
+            return asyncio.run(run())
+
+        aff_frac, router_steady, dispatch_ns = _routed_run("affinity", 3)
+        rand_frac, _, _ = _routed_run("round_robin", 1)
+        metrics["router_affinity_hit_fraction"] = aff_frac
+        metrics["router_random_hit_fraction"] = rand_frac
+        metrics["router_affinity_hit_gain"] = aff_frac - rand_frac
+        metrics["router_steady_recompiles"] = router_steady
+        metrics["router_dispatch_ns_per_request"] = dispatch_ns
+
+        # -- flight-recorder record() cost ---------------------------------
         bench_rec = FlightRecorder()
         prev_bench = set_recorder(bench_rec)
         try:
@@ -368,9 +472,27 @@ def make_baseline(metrics: Dict[str, float]) -> Dict[str, Any]:
                     "fused_decode_compile_events",
                     "ragged_mixed_compile_events",
                     "stitched_mixed_compile_events",
-                    "ragged_mixed_steady_recompiles"):
+                    "ragged_mixed_steady_recompiles",
+                    "router_steady_recompiles"):
             spec[name] = {"value": value, "direction": "max",
                           "abs_tol": 0.0}
+        elif name in ("router_affinity_hit_fraction",
+                      "router_affinity_hit_gain"):
+            # the routing win itself: affinity must keep beating random
+            # placement — direction "min" so erosion fails the gate
+            spec[name] = {"value": value, "direction": "min",
+                          "abs_tol": 0.05}
+        elif name == "router_random_hit_fraction":
+            # the baseline side of the comparison: pinned both ways so a
+            # workload change can't silently inflate the gain
+            spec[name] = {"value": value, "direction": "both",
+                          "abs_tol": 0.05}
+        elif name == "router_dispatch_ns_per_request":
+            # wall-clock-ish like recorder_ns_per_event: wide absolute
+            # tolerance, guards order-of-magnitude routing-cost
+            # regressions (e.g. hashing the whole prompt per candidate)
+            spec[name] = {"value": value, "direction": "max",
+                          "abs_tol": 20000.0}
         elif name == "ragged_mixed_programs_saved":
             # the ragged win itself: the mixed sweep must keep compiling
             # at least this many FEWER programs than the stitched

@@ -28,3 +28,72 @@ def test_open_loop_bench_reports_tail_latency_and_goodput(capsys):
     assert done > 0 and report["goodput_tok_s"] > 0
     assert report["ttft_p50_ms"] is not None
     assert report["latency_p99_ms"] >= report["latency_p50_ms"]
+
+
+def test_router_flag_wires_up_replicas():
+    """Tier-1 fast path: the `--router N` plumbing (make_router) wires N
+    in-process engine replicas behind the prefix-affinity router —
+    replicas registered, named, routable, and cleanly stopped. The
+    traffic-bearing smoke below is the slow tier."""
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.benchmarks.load_bench import make_router
+    from deepspeed_tpu.benchmarks.serving_bench import build_model
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+
+    model = build_model(2, 64)
+    params = jax.tree.map(lambda x: x.astype(jnp.float32),
+                          model.init_params(jax.random.PRNGKey(0)))
+
+    def engine():
+        return InferenceEngineV2(model, {
+            "dtype": "float32",
+            "state_manager": {"max_tracked_sequences": 8,
+                              "max_seq_len": 128, "num_blocks": 33,
+                              "block_size": 16,
+                              "enable_prefix_caching": True},
+            "prefill_bucket": 16,
+        }, params=params)
+
+    router = make_router([engine(), engine()], budget=64, chunk=16,
+                         max_pending=4)
+    assert len(router.replicas) == 2
+    assert router.config.placement == "affinity"
+
+    async def run():
+        await router.start()
+        health = router.health()
+        assert set(health["replicas"]) == {"replica0", "replica1"}
+        assert health["routable"] == ["replica0", "replica1"]
+        assert health["status"] == "ok"
+        await router.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_router_open_loop_bench_reports_per_replica_breakdown(capsys):
+    """Slow smoke: `--router 2` drives Poisson arrivals through the
+    routed frontend and reports per-replica TTFT/goodput plus
+    router-level shed/re-route counts."""
+    import json as _json
+
+    from deepspeed_tpu.benchmarks.load_bench import main
+
+    rc = main(["--router", "2", "--requests", "10", "--rate", "50.0",
+               "--budget", "64", "--chunk", "16", "--new", "8",
+               "--layers", "2", "--hidden", "64", "--max-pending", "8"])
+    assert rc == 0
+    report = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["metric"] == "serving_router_open_loop"
+    assert report["replicas"] == 2
+    assert set(report["per_replica"]) == {"replica0", "replica1"}
+    done = report["completed"]
+    assert done + report["rejected"] + report["expired"] \
+        + report["errors"] == 10
+    assert done > 0 and report["goodput_tok_s"] > 0
+    assert sum(r["completed"] for r in report["per_replica"].values()) \
+        == done

@@ -229,3 +229,47 @@ def test_prefix_caching_composes_with_kv_quant(tiny):
     out2 = eng.generate([prompt], max_new_tokens=6, uids=[2])[0]
     np.testing.assert_array_equal(out2, ref)
     assert len(eng.state_manager._prefix) >= 3
+
+
+# -- stable prefix-digest export (the router's affinity API) ---------------
+def test_prefix_digest_is_stable_and_matches_index_keys(tiny):
+    """`prefix_digest(tokens, block_size)` is the serving router's
+    affinity key: it must (a) be a pure stable function of token
+    content + block size (pinned against a literal so an accidental
+    algorithm change — which would silently break cross-version
+    affinity — fails loudly), (b) produce exactly the digests the
+    prefix-cache index registers at flush, and (c) differ across block
+    sizes (no accidental cross-config matches)."""
+    from deepspeed_tpu.inference.v2.ragged.ragged_manager import \
+        prefix_digest
+
+    tokens = list(range(1, 41))                  # 40 tokens
+    d16 = prefix_digest(tokens, 16)
+    assert len(d16) == 2                         # only FULL blocks hash
+    # chain property: digest i extends digest i-1, so a shared prefix
+    # shares every leading digest
+    assert prefix_digest(tokens[:16], 16) == d16[:1]
+    assert prefix_digest(tokens + [99], 16)[:2] == d16
+    # pinned literal: sha1 chain over int32 token bytes from b"prefix"
+    assert d16[0].hex() == \
+        "3b8232834b701568fff3e815241088250158347a"
+    # block size is part of the key
+    d8 = prefix_digest(tokens, 8)
+    assert len(d8) == 5
+    assert d8[0] != d16[0]
+    # empty / sub-block inputs produce no digests
+    assert prefix_digest([], 16) == []
+    assert prefix_digest(tokens[:15], 16) == []
+
+    # (b): the digests the manager indexes at flush are the same list
+    model, params = tiny
+    eng = _engine(model, params)
+    rng = np.random.default_rng(9)
+    prompt = list(map(int, rng.integers(1, 127, 50)))
+    eng.generate([prompt], max_new_tokens=6, uids=[1])
+    sm = eng.state_manager
+    # the index holds the prompt's 3 full blocks (generated tokens never
+    # fill block 3 within this budget) — exactly prefix_digest's list
+    indexed = list(sm._prefix)
+    assert indexed == prefix_digest(prompt, sm.block_size)
+    assert len(indexed) == 3

@@ -467,6 +467,51 @@ def test_http_serving_e2e(model_and_params):
     assert eng.state_manager.free_blocks() == free0
 
 
+def test_http_429_carries_retry_after_header(model_and_params):
+    """Overload rejections are machine-actionable: OverloadedError
+    carries ``retry_after_s`` and the HTTP surface emits it as a
+    ``Retry-After`` header (plus the float in the JSON body) — what
+    backoff-aware clients and the replica router key on."""
+    model, params = model_and_params
+    eng = _engine(model, params)
+
+    async def main():
+        serving = ServingEngine(eng, ServingConfig(
+            token_budget=32,
+            admission=AdmissionConfig(max_pending=64, max_queued_tokens=4,
+                                      retry_after_s=2.5)))
+        await serving.start()
+        # the error object itself carries the hint
+        with pytest.raises(OverloadedError) as ei:
+            await serving.submit([1, 2, 3], 64)
+        assert ei.value.retry_after_s == 2.5
+        api = ServingAPI(serving)
+        host, port = await api.start()
+        reader, writer = await asyncio.open_connection(host, port)
+        body = json.dumps({"prompt": [1, 2, 3],
+                           "max_new_tokens": 64}).encode()
+        writer.write((f"POST /generate HTTP/1.1\r\nHost: t\r\n"
+                      f"Content-Length: {len(body)}\r\n\r\n").encode()
+                     + body)
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        head, _, payload = raw.partition(b"\r\n\r\n")
+        headers = {ln.split(":", 1)[0].strip().lower():
+                   ln.split(":", 1)[1].strip()
+                   for ln in head.decode().splitlines()[1:] if ":" in ln}
+        assert b"429" in head.splitlines()[0]
+        # delta-seconds grammar: integer, ceil'd from the float hint
+        assert headers["retry-after"] == "3"
+        tail = json.loads(payload)
+        assert tail["retry_after_s"] == 2.5
+        assert tail["reason"] == "token_budget"
+        await api.stop()
+        await serving.stop(drain=True)
+
+    asyncio.run(main())
+
+
 def test_http_bad_requests(model_and_params):
     model, params = model_and_params
     eng = _engine(model, params)
