@@ -349,6 +349,9 @@ def main(argv=None):
                     round(rec["exposed_collective_fraction"], 4),
                 "exposed_collective_fraction_monolithic":
                     round(rec["exposed_collective_fraction_monolithic"], 4),
+                "exposed_collective_fraction_int8":
+                    round(rec["exposed_collective_fraction_int8"], 4),
+                "quant_wire_ratio": rec["quant_wire_ratio"],
                 "buckets": rec["bucketed"].get("bucket_plan", {}).get(
                     "num_buckets"),
                 "median_overlap_window":

@@ -161,10 +161,15 @@ def grad_overlap_dp8(model_cfg=None, out_dir: Optional[str] = None,
             vocab_size=2048, hidden_size=256, intermediate_size=512,
             num_layers=4, num_heads=4, max_seq_len=128, use_flash=False,
             scan_unroll=4)
+    from ..runtime.grad_overlap import ring_wire_bytes
+
     record: Dict[str, Any] = {"topology": topology_name, "stage": stage,
                               "num_layers": model_cfg.num_layers,
                               "reduce_bucket_size": int(reduce_bucket_size)}
-    for name, mode in (("monolithic", "off"), ("bucketed", "bucketed")):
+    quant_block = 2048
+    for name, mode, qr in (("monolithic", "off", "off"),
+                           ("bucketed", "bucketed", "off"),
+                           ("bucketed_int8", "bucketed", "int8")):
         engine, batch = build_abstract_engine(
             model_cfg,
             {"train_micro_batch_size_per_gpu": 1,
@@ -173,6 +178,8 @@ def grad_overlap_dp8(model_cfg=None, out_dir: Optional[str] = None,
              "zero_optimization": {
                  "stage": stage, "overlap_comm": True,
                  "overlap_grad_reduce": mode,
+                 "quantized_reduce": qr,
+                 "quant_block": quant_block,
                  "reduce_bucket_size": int(reduce_bucket_size),
                  "allgather_bucket_size": int(reduce_bucket_size),
                  "stage3_param_persistence_threshold": 100000},
@@ -186,11 +193,24 @@ def grad_overlap_dp8(model_cfg=None, out_dir: Optional[str] = None,
                       if k != "bare_ops"}
         if engine.grad_bucket_plan is not None:
             rec["bucket_plan"] = engine.grad_bucket_plan.to_dict()
+            dp = engine.ds_config.dp_world_size
+            rec["ring_wire_bytes_fp32"] = ring_wire_bytes(
+                engine.grad_bucket_plan, dp)
+            rec["ring_wire_bytes_quant"] = ring_wire_bytes(
+                engine.grad_bucket_plan, dp, quantized=True,
+                quant_block=quant_block)
         record[name] = rec
     record["exposed_collective_fraction"] = \
         record["bucketed"]["exposed_collective_fraction"]
     record["exposed_collective_fraction_monolithic"] = \
         record["monolithic"]["exposed_collective_fraction"]
+    record["exposed_collective_fraction_int8"] = \
+        record["bucketed_int8"]["exposed_collective_fraction"]
+    qrec = record["bucketed_int8"]
+    record["quant_wire_ratio"] = (
+        round(qrec["ring_wire_bytes_fp32"]
+              / qrec["ring_wire_bytes_quant"], 3)
+        if qrec.get("ring_wire_bytes_quant") else None)
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)
         with open(os.path.join(out_dir, "grad_overlap_dp8.json"), "w") as fh:
@@ -425,6 +445,8 @@ def main(argv=None) -> int:
                 rec["exposed_collective_fraction"],
             "monolithic":
                 rec["exposed_collective_fraction_monolithic"],
+            "int8": rec["exposed_collective_fraction_int8"],
+            "quant_wire_ratio": rec["quant_wire_ratio"],
             "buckets": rec["bucketed"].get(
                 "bucket_plan", {}).get("num_buckets")}}))
     if not args.skip_7b:

@@ -66,7 +66,8 @@ def run_op(op: str, size_bytes: int, trials: int = 20, warmups: int = 3,
 def run_bucket_sweep(total_pw: int = 22, bucket_pws=(16, 18, 20, 22),
                      trials: int = 10, warmups: int = 2,
                      axis: str = "data", n_leaves: int = 32,
-                     dtype=jnp.float32) -> List[Dict]:
+                     dtype=jnp.float32, quantized: str = None,
+                     quant_block: int = 2048) -> List[Dict]:
     """Sweep ``reduce_bucket_size`` over a synthetic gradient tree and
     report achieved bandwidth per bucket layout.
 
@@ -76,12 +77,20 @@ def run_bucket_sweep(total_pw: int = 22, bucket_pws=(16, 18, 20, 22),
     latency-bound collectives; large caps mean fewer, bandwidth-bound ones
     but a later start for the first reduce — this sweep is how a deployment
     picks the knob for its interconnect.
+
+    ``quantized`` ("int8"|"fp8") ALSO runs each cap through the
+    block-quantized ring (error-feedback state threaded, zeros) and adds
+    the quantized step time, per-device wire bytes of both transports and
+    their ratio — the bytes-on-wire story the ``quantized_reduce`` knob
+    buys on this workload.
     """
     from jax.sharding import Mesh, PartitionSpec as P
 
     from ..runtime.grad_overlap import (ALL_REDUCE, GradUnit,
                                         apply_bucketed_reduction,
-                                        build_bucket_plan)
+                                        build_bucket_plan,
+                                        quant_reduce_layout,
+                                        ring_wire_bytes)
     from ..utils.comms_logging import calc_bw_log
 
     n = jax.device_count()
@@ -91,6 +100,16 @@ def run_bucket_sweep(total_pw: int = 22, bucket_pws=(16, 18, 20, 22),
     leaves = [jnp.ones((leaf_elems,), dtype) for _ in range(n_leaves)]
     total_bytes = leaf_elems * itemsize * n_leaves
     rows: List[Dict] = []
+
+    def timed(fn, *args):
+        for _ in range(warmups):
+            jax.block_until_ready(fn(*args))
+        t0 = time.perf_counter()
+        for _ in range(trials):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / trials
+
     for pw in bucket_pws:
         cap = max((1 << pw) // itemsize, 1)
         units = [GradUnit(i, -1, leaf_elems, f"leaf{i}", ALL_REDUCE)
@@ -107,19 +126,46 @@ def run_bucket_sweep(total_pw: int = 22, bucket_pws=(16, 18, 20, 22),
         fn = jax.jit(shard_map_unchecked(
             body, mesh, in_specs=(P(),) * n_leaves,
             out_specs=(P(),) * n_leaves))
-        for _ in range(warmups):
-            jax.block_until_ready(fn(*leaves))
-        t0 = time.perf_counter()
-        for _ in range(trials):
-            out = fn(*leaves)
-        jax.block_until_ready(out)
-        lat = (time.perf_counter() - t0) / trials
+        lat = timed(fn, *leaves)
         algbw, busbw = calc_bw_log("all_reduce", total_bytes, lat, n)
-        rows.append({"bucket_bytes": cap * itemsize,
-                     "num_buckets": plan.num_buckets,
-                     "total_bytes": total_bytes,
-                     "latency_us": lat * 1e6,
-                     "algbw_gbps": algbw, "busbw_gbps": busbw})
+        row = {"bucket_bytes": cap * itemsize,
+               "num_buckets": plan.num_buckets,
+               "total_bytes": total_bytes,
+               "latency_us": lat * 1e6,
+               "algbw_gbps": algbw, "busbw_gbps": busbw}
+        if quantized:
+            layout = quant_reduce_layout(plan, (axis,), n, {axis: n})
+            qspecs = {k: {kk: P(*((axis,) + (None,) * len(shape)))
+                          for kk, shape in v.items()}
+                      for k, v in layout.items()}
+            qzero = {k: {kk: jnp.zeros((n,) + shape, jnp.float32)
+                         for kk, shape in v.items()}
+                     for k, v in layout.items()}
+
+            def body_q(qstate, *ls):
+                qin = {k: {kk: a[0] for kk, a in v.items()}
+                       for k, v in qstate.items()}
+                out, qerr = apply_bucketed_reduction(
+                    list(ls), plan, [0] * n_leaves, (axis,), (), n, 1,
+                    axis_sizes={axis: n}, quant_reduce=quantized,
+                    quant_reduce_block=quant_block, qstate=qin)
+                return tuple(out), {k: {kk: a[None] for kk, a in v.items()}
+                                    for k, v in qerr.items()}
+
+            fn_q = jax.jit(shard_map_unchecked(
+                body_q, mesh, in_specs=(qspecs,) + (P(),) * n_leaves,
+                out_specs=((P(),) * n_leaves, qspecs)))
+            lat_q = timed(fn_q, qzero, *leaves)
+            wb = ring_wire_bytes(plan, n)
+            wb_q = ring_wire_bytes(plan, n, quantized=True,
+                                   quant_block=quant_block)
+            row.update({
+                "quantized": quantized,
+                "quant_latency_us": lat_q * 1e6,
+                "wire_bytes_fp32": wb,
+                "wire_bytes_quant": wb_q,
+                "wire_ratio": round(wb / wb_q, 3) if wb_q else None})
+        rows.append(row)
     return rows
 
 
@@ -141,19 +187,37 @@ def main(argv=None):
     p.add_argument("--sweep-buckets", type=int, nargs="+",
                    default=[16, 18, 20, 22],
                    help="bucket caps to sweep, powers of two (bytes)")
+    p.add_argument("--quantized", nargs="?", const="int8",
+                   choices=["int8", "fp8"], default=None,
+                   help="with --bucket-sweep: also run each cap through "
+                        "the block-quantized ring reducer "
+                        "(zero_optimization.quantized_reduce transport) "
+                        "and report wire bytes + step time vs the fp32 "
+                        "ring")
+    p.add_argument("--quant-block", type=int, default=2048)
     args = p.parse_args(argv)
     if args.bucket_sweep:
         print(f"devices: {jax.device_count()} x "
               f"{getattr(jax.devices()[0], 'device_kind', '?')}")
+        qcols = (f" {'qlat(us)':>10} {'wireMB':>8} {'qwireMB':>8} "
+                 f"{'ratio':>6}" if args.quantized else "")
         print(f"{'bucket':>12} {'n_buckets':>10} {'lat(us)':>10} "
-              f"{'algbw(GB/s)':>12} {'busbw(GB/s)':>12}")
+              f"{'algbw(GB/s)':>12} {'busbw(GB/s)':>12}" + qcols)
         rows = run_bucket_sweep(total_pw=args.sweep_total,
                                 bucket_pws=tuple(args.sweep_buckets),
-                                trials=args.trials, axis=args.mesh_axis)
+                                trials=args.trials, axis=args.mesh_axis,
+                                quantized=args.quantized,
+                                quant_block=args.quant_block)
         for r in rows:
+            extra = ""
+            if args.quantized:
+                extra = (f" {r['quant_latency_us']:>10.1f} "
+                         f"{r['wire_bytes_fp32'] / 2 ** 20:>8.2f} "
+                         f"{r['wire_bytes_quant'] / 2 ** 20:>8.2f} "
+                         f"{r['wire_ratio'] or 0.0:>6.2f}")
             print(f"{r['bucket_bytes']:>12} {r['num_buckets']:>10} "
                   f"{r['latency_us']:>10.1f} {r['algbw_gbps']:>12.2f} "
-                  f"{r['busbw_gbps']:>12.2f}")
+                  f"{r['busbw_gbps']:>12.2f}" + extra)
         return rows
     print(f"devices: {jax.device_count()} x "
           f"{getattr(jax.devices()[0], 'device_kind', '?')}")

@@ -60,6 +60,13 @@ def bench_dense(model, params, prompts: np.ndarray, new_tokens: int,
     return {"tok_s": B * new_tokens / dt, "warmup_s": warmup_s}
 
 
+# pool geometry the paged benches run with — kv_capacity_report must
+# describe the SAME pool bench_paged actually builds, or the --kv-quant
+# capacity math silently drifts from the tok/s measured next to it
+POOL_NUM_BLOCKS = 4096
+POOL_BLOCK_SIZE = 64  # KVCacheConfig.block_size default
+
+
 def _hist_delta(registry, name, before):
     """(count, sum) advance of a histogram family since ``before``."""
     fam = registry.get(name)
@@ -69,9 +76,35 @@ def _hist_delta(registry, name, before):
     return fam.count - c0, fam.sum - s0
 
 
+def kv_capacity_report(model_cfg, block_size: int, num_blocks: int,
+                       max_seq_len: int, pool_dtype_bytes: int = 2) -> dict:
+    """Capacity math of the int8 KV pool vs the same pool at the serving
+    dtype: bytes per block both ways, and the max concurrent
+    max_seq_len-length sequences a FIXED byte budget (the unquantized
+    pool's size) admits under each layout — the 'how many more sequences
+    before admission control sheds load' number."""
+    L, kvh, hd = (model_cfg.num_layers, model_cfg.kv_heads,
+                  model_cfg.head_dim)
+    per_block = 2 * L * block_size * kvh * hd          # k + v elements
+    block_bytes = per_block * pool_dtype_bytes
+    block_bytes_q = per_block + 2 * L * kvh * 4        # int8 + scales
+    pool_budget = num_blocks * block_bytes
+    blocks_per_seq = -(-max_seq_len // block_size)
+    return {
+        "block_bytes": block_bytes,
+        "block_bytes_quant": block_bytes_q,
+        "pool_bytes_budget": pool_budget,
+        "capacity_gain": round(block_bytes / block_bytes_q, 3),
+        "max_seqs_fixed_bytes": (pool_budget // block_bytes)
+        // blocks_per_seq,
+        "max_seqs_fixed_bytes_quant": (pool_budget // block_bytes_q)
+        // blocks_per_seq,
+    }
+
+
 def bench_paged(model, params, prompts: np.ndarray, new_tokens: int,
                 repeats: int, decode_window: int = 8,
-                uid_base: int = 1000) -> dict:
+                uid_base: int = 1000, kv_quant: bool = False) -> dict:
     """Measure the v2 engine THROUGH the telemetry registry: the engine's
     own decode-step/TTFT series are the timers (the registry numbers ARE
     what a production scrape sees), not ad-hoc stopwatches around the
@@ -89,9 +122,11 @@ def bench_paged(model, params, prompts: np.ndarray, new_tokens: int,
     eng = InferenceEngineV2(model, {
         "dtype": "bfloat16",
         "decode_window": decode_window,
+        "kv_quant": kv_quant,
         "state_manager": {"max_tracked_sequences": max(B, 8),
                           "max_ragged_batch_size": max(B * S, 512),
-                          "num_blocks": 4096},
+                          "num_blocks": POOL_NUM_BLOCKS,
+                          "block_size": POOL_BLOCK_SIZE},
     }, params=params)
     prompt_list = [list(map(int, p)) for p in prompts]
     w0 = time.perf_counter()
@@ -316,6 +351,13 @@ def main(argv=None) -> int:
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--window", type=int, default=8,
                    help="fused decode window K (1 = per-token only)")
+    p.add_argument("--kv-quant", action="store_true",
+                   help="serve through the int8 KV pool (per-block "
+                        "scales, in-kernel dequant): adds pool-capacity "
+                        "math (max concurrent sequences at the bf16 "
+                        "pool's byte budget), quantized-kernel decode "
+                        "tok/s and steady-state recompiles under the "
+                        "double-warm bucket discipline")
     p.add_argument("--mixed", action="store_true",
                    help="mixed-traffic mode: concurrent prefill+decode "
                         "through the SplitFuse scheduler, ragged vs "
@@ -343,9 +385,10 @@ def main(argv=None) -> int:
     # the same config: their ratio is the dispatch-overhead win the fused
     # decode loop exists for
     paged = bench_paged(model, params, prompts, args.new, args.repeats,
-                        decode_window=args.window)
+                        decode_window=args.window, kv_quant=args.kv_quant)
     per_tok = (bench_paged(model, params, prompts, args.new, args.repeats,
-                           decode_window=1, uid_base=500000)
+                           decode_window=1, uid_base=500000,
+                           kv_quant=args.kv_quant)
                if args.window > 1 else paged)
     dense = bench_dense(model, params, prompts, args.new, args.repeats)
     paged_tok_s = paged["tok_s"]
@@ -411,6 +454,17 @@ def main(argv=None) -> int:
                                    else None),
         "decode_peak_bytes": paged["decode_peak_bytes"],
         "steady_state_recompiles": paged["steady_state_recompiles"],
+        # --kv-quant: the capacity story (same pool BYTE budget, how
+        # many max_seq_len sequences fit each layout) next to the
+        # quantized-kernel throughput and the watchdog's recompile
+        # verdict above — the "2x concurrency without leaving the fast
+        # path" artifact
+        **({"kv_quant": True,
+            **{f"kv_{k}": v for k, v in kv_capacity_report(
+                model.cfg, block_size=POOL_BLOCK_SIZE,
+                num_blocks=POOL_NUM_BLOCKS,
+                max_seq_len=min(1024, model.cfg.max_seq_len)).items()}}
+           if args.kv_quant else {}),
         # active-observability summary (this PR): black-box coverage,
         # overhead, histogram-quantile TTFT percentiles, and any
         # anomaly verdict raised during the run

@@ -23,9 +23,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.quantizer import _blocked as quantizer_blocked
 from ..ops.quantizer import quantize_symmetric
 
 AxisNames = Union[str, Tuple[str, ...]]
+
+# fp8 e4m3 wire format: same 1 byte/element as int8, but the exponent
+# absorbs per-element dynamic range so block outliers clip less
+FP8_MAX = 448.0  # largest finite float8_e4m3fn
 
 
 def shard_map_unchecked(f, mesh, in_specs, out_specs, axis_names=None):
@@ -151,6 +156,118 @@ def reduce_scatter_leaf(grad: jnp.ndarray, dim: int, axes: AxisNames,
     if mean:
         out = out / _axis_size(axes)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Block-quantized ring transport (EQuARX-style, arXiv:2506.17615): the
+# ppermute ring grad_overlap.py uses for async overlap, with every hop's
+# payload shrunk to 1 byte/element + per-block fp32 scales. Each function
+# ALSO returns the quantization error this device introduced (sender-side
+# knowledge: dequant is deterministic, so the sender knows exactly what the
+# receivers reconstruct) — the error-feedback residual the caller carries
+# across steps so transport error does not bias convergence.
+# ---------------------------------------------------------------------------
+def _quantize_wire(x: jnp.ndarray, block: int, mode: str):
+    """Flat [M] f32 -> (q [nb, block] int8|float8, scales [nb, 1] f32).
+    The block clamps to the message size: shipping a 2048-padded block
+    for a 100-element bucket would put more padding than payload on the
+    wire (``quant_wire_bytes`` mirrors the clamp)."""
+    block = max(1, min(int(block), int(x.size)))
+    if mode == "fp8":
+        blocks, _ = quantizer_blocked(x.astype(jnp.float32), block)
+        absmax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+        scale = jnp.where(absmax > 0, absmax / FP8_MAX, 1.0)
+        return (blocks / scale).astype(jnp.float8_e4m3fn), scale
+    return quantize_symmetric(x, block=block, bits=8)
+
+
+def _dequantize_wire(q: jnp.ndarray, scale: jnp.ndarray,
+                     numel: int) -> jnp.ndarray:
+    """(q, scales) -> flat [numel] f32 (deterministic: sender and every
+    receiver reconstruct the same values)."""
+    return (q.astype(jnp.float32) * scale).reshape(-1)[:numel]
+
+
+def ring_reduce_scatter_quant(buf: jnp.ndarray, axis: str, world: int,
+                              block: int = 2048, mode: str = "int8"):
+    """Quantized-wire ring reduce-scatter of [world, M] row partials.
+
+    Same hop structure as grad_overlap._ring_reduce_rows (async ppermute
+    the latency-hiding scheduler can overlap), but each hop ships the
+    running partial as 1-byte values + per-block scales instead of fp32 —
+    ~4x fewer wire bytes. The partial changes every hop, so it is
+    requantized per hop (the EQuARX in-collective requant); the sender
+    accumulates the error it introduced into the row it was carrying.
+
+    Returns ``(row, err)``: device r's fully-summed row r [M] (never
+    quantized on the final local add), and err [world, M] — THIS device's
+    per-row quantization error, to be fed back into the next step's
+    partials. Must run inside shard_map over ``axis``.
+    """
+    if world == 1:
+        return buf[0], jnp.zeros_like(buf)
+    M = buf.shape[1]
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    idx = jax.lax.axis_index(axis)
+
+    def take(b):
+        return jax.lax.dynamic_index_in_dim(buf, b % world, 0,
+                                            keepdims=False)
+
+    err = jnp.zeros_like(buf)
+    acc = take(idx - 1)
+    for s in range(world - 1):
+        q, scale = _quantize_wire(acc, block, mode)
+        deq = _dequantize_wire(q, scale, M)
+        # the row this device is about to send: its quantization error is
+        # local knowledge (each row is quantized at most once per device,
+        # so plain dynamic updates never collide)
+        err = jax.lax.dynamic_update_index_in_dim(
+            err, acc - deq, jnp.mod(idx - s - 1, world), 0)
+        q = jax.lax.ppermute(q, axis, perm)
+        scale = jax.lax.ppermute(scale, axis, perm)
+        acc = _dequantize_wire(q, scale, M) + take(idx - s - 2)
+    return acc, err
+
+
+def ring_all_gather_quant(row: jnp.ndarray, axis: str, world: int,
+                          block: int = 2048, mode: str = "int8"):
+    """Quantized-wire ring all-gather of a per-device [M] row.
+
+    The row never changes in flight, so it is quantized ONCE at the
+    source and the same (q, scales) payload circulates world-1 hops.
+    Every device — INCLUDING the source — reconstructs the dequantized
+    values, so the gathered result stays replicated-identical across the
+    ring (a source keeping its exact fp32 row would silently diverge the
+    replicas). Returns ``(full [world, M], err [M])`` with err the
+    source's own quantization error (the all-gather EF residual).
+    """
+    M = row.shape[0]
+    if world == 1:
+        return row[None], jnp.zeros_like(row)
+    perm = [(i, (i + 1) % world) for i in range(world)]
+    idx = jax.lax.axis_index(axis)
+    q, scale = _quantize_wire(row, block, mode)
+    deq = _dequantize_wire(q, scale, M)
+    err = row - deq
+    out = jnp.zeros((world, M), row.dtype)
+    out = jax.lax.dynamic_update_index_in_dim(out, deq, idx, 0)
+    for s in range(world - 1):
+        q = jax.lax.ppermute(q, axis, perm)
+        scale = jax.lax.ppermute(scale, axis, perm)
+        out = jax.lax.dynamic_update_index_in_dim(
+            out, _dequantize_wire(q, scale, M),
+            jnp.mod(idx - s - 1, world), 0)
+    return out, err
+
+
+def quant_wire_bytes(numel: int, block: int = 2048) -> int:
+    """Bytes on the wire for one quantized hop of a [numel] message:
+    1 byte/element (block-padded) + fp32 scale per block, with the block
+    clamped to the message size like ``_quantize_wire``."""
+    block = max(1, min(int(block), int(numel)))
+    nb = -(-int(numel) // block)
+    return nb * block + nb * 4
 
 
 def make_zero3_gather(dim: int, axes: AxisNames, fwd_quantized: bool,

@@ -43,9 +43,13 @@ class RaggedInferenceEngineConfig:
     # as the v1 engine, inference/quantization.py) — halves/quarters
     # weight HBM, freeing KV-pool headroom
     quant_bits: int = 0
-    # int8 KV-cache pool (~0.53x bf16 bytes -> ~1.9x tokens in the same
-    # HBM): writes quantize per (slot, head), reads dequantize; serves
-    # through the gather path (Pallas decode kernels are bf16-tile)
+    # int8 KV-cache pool (~0.5x bf16 bytes -> ~2x tokens, i.e. ~2x
+    # concurrent sequences at a fixed pool budget): writes quantize
+    # against a running per-(block, kv-head) absmax, reads dequantize.
+    # Serves through the SAME Pallas decode/ragged kernels as bf16 — the
+    # quant kernel variants stream int8 pages + scale rows and
+    # dequantize in VMEM — so fused decode windows, the ragged unified
+    # program and the SplitFuse fast path all keep their compiled shape.
     kv_quant: bool = False
     # fused multi-token decode: up to K decode steps run in ONE jitted
     # device loop (cache write, paged attention, sampling, EOS masking,

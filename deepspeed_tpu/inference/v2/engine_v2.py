@@ -151,14 +151,15 @@ class InferenceEngineV2:
         # GSPMD-partitionable, so sharded-param (tp>1) serving keeps the
         # jnp paths, which the partitioner splits over the head axis (same
         # gate as the v1 decode kernel, models/transformer.py). kv_quant
-        # additionally disables only the DECODE kernel (it streams bf16
-        # pool tiles; int8 pages + scale tiles would need a variant) —
-        # the flash PREFILL kernel attends over the in-chunk
-        # full-precision q/k/v and never reads the pool, so it stays on
+        # no longer gates the decode/ragged kernels: the quant kernel
+        # variants stream the int8 pages + per-(block, head) scale rows
+        # and dequantize in VMEM (kernels/paged_attention.py,
+        # kernels/ragged_attention.py), so 2x KV capacity keeps the whole
+        # Pallas fast path — fused decode windows and the ragged family
+        # included
         use_kernel = (config.use_paged_kernel and tp == 1 and ep == 1
                       and cfg.positional != "alibi")  # kernels carry no
         # alibi bias; the jnp paths add the softmax-invariant row
-        use_kernel_decode = use_kernel and not config.kv_quant
         topo = self.topology if ep > 1 else None
         # every compile point below is watchdog-wrapped: the power-of-two
         # bucketing is SUPPOSED to make steady-state serving compile-free,
@@ -166,7 +167,7 @@ class InferenceEngineV2:
         self._decode_jit = watchdog.watch("decode", jax.jit(
             lambda p, t, pos, bt, c, a: paged_decode(
                 cfg, p, t, pos, bt, c, a, sm.block_size,
-                use_kernel=use_kernel_decode, topo=topo),
+                use_kernel=use_kernel, topo=topo),
             donate_argnums=(4,)))
 
         def _decode_tok(p, t, pos, bt, c, a):
@@ -175,7 +176,7 @@ class InferenceEngineV2:
             # (the reference's sampler also runs device-side)
             logits, c = paged_decode(cfg, p, t, pos, bt, c, a,
                                      sm.block_size,
-                                     use_kernel=use_kernel_decode,
+                                     use_kernel=use_kernel,
                                      topo=topo)
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), c
 
@@ -191,7 +192,7 @@ class InferenceEngineV2:
             from .sampling import fold_in_rows, sample_tokens_rowwise
             logits, c = paged_decode(cfg, p, t, pos, bt, c, a,
                                      sm.block_size,
-                                     use_kernel=use_kernel_decode,
+                                     use_kernel=use_kernel,
                                      topo=topo)
             keys = fold_in_rows(rng, seeds, gidx)
             return sample_tokens_rowwise(logits, keys, temp, topp,
@@ -210,7 +211,7 @@ class InferenceEngineV2:
         self._fused_greedy_jit = watchdog.watch("decode_window_greedy", jax.jit(
             lambda p, t, pos, bt, c, sl, eos: paged_decode_window(
                 cfg, p, t, pos, bt, c, sl, eos, sm.block_size,
-                self.decode_window, use_kernel=use_kernel_decode,
+                self.decode_window, use_kernel=use_kernel,
                 topo=topo),
             donate_argnums=(4,)))
         self._fused_sample_jit = watchdog.watch("decode_window_sample", jax.jit(
@@ -219,7 +220,7 @@ class InferenceEngineV2:
                 cfg, p, t, pos, bt, c, sl, eos, sm.block_size,
                 self.decode_window, rng=rng, row_seeds=seeds, gen_idx0=g0,
                 temp=temp, topp=topp, topk=topk,
-                use_kernel=use_kernel_decode, topo=topo),
+                use_kernel=use_kernel, topo=topo),
             donate_argnums=(4,)))
         self._prefill_jit = watchdog.watch("prefill", jax.jit(
             lambda p, ids, n, c, b, o: paged_prefill(
@@ -235,16 +236,17 @@ class InferenceEngineV2:
         # as ONE program keyed by (token bucket, row bucket, table-width
         # bucket) — put() and the SplitFuse scheduler route here instead
         # of sequencing the prefill/continue/decode families. The ragged
-        # kernel shares the decode kernel's gates (bf16 pool tiles, no
-        # alibi, tp=ep=1); gated-off configs serve through the jnp
-        # ragged fallback inside the same unified program.
+        # kernel shares the decode kernel's gates (no alibi, tp=ep=1;
+        # int8 kv_quant pools ride the quant kernel variants); gated-off
+        # configs serve through the jnp ragged fallback inside the same
+        # unified program.
         self.ragged_enabled = self._resolve_ragged_mode(
             config.ragged_attention)
         self._ragged_jit = watchdog.watch("ragged_step", jax.jit(
             lambda p, ids, rows, pos, ln, wb, wo, bt, li, c:
             paged_ragged_step(
                 cfg, p, ids, rows, pos, ln, wb, wo, bt, li, c,
-                sm.block_size, use_kernel=use_kernel_decode, topo=topo),
+                sm.block_size, use_kernel=use_kernel, topo=topo),
             donate_argnums=(9,)))
         # speculative verification: greedy ids for a static window of
         # fed positions from one fused continuation pass (prompt-lookup
@@ -262,6 +264,16 @@ class InferenceEngineV2:
             return self._continue_spec_jits[window]
 
         self._spec_jit = _spec_jit
+        if config.kv_quant:
+            # the capacity win, as a live gauge: pool bytes the int8
+            # layout frees vs the same (num_blocks x block_size) pool at
+            # the serving dtype
+            unquant = 2 * (cfg.num_layers * sm.num_blocks * sm.block_size
+                           * cfg.kv_heads * cfg.head_dim
+                           * jnp.dtype(self.dtype).itemsize)
+            quant = sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                        for v in self.kv_cache.values())
+            self._m_kv_quant_saved.set(max(unquant - quant, 0))
         try:  # HBM accounting (telemetry/memory.py): the two big
             # long-lived buffers every decode program references
             ds_memory.record_buffer("kv_pool",
@@ -349,6 +361,11 @@ class InferenceEngineV2:
             "inference_ragged_host_syncs_total",
             "device->host transfers made by unified ragged steps (one "
             "per step)")
+        self._m_kv_quant_saved = reg.gauge(
+            "inference_kv_pool_quant_bytes_saved",
+            "HBM the int8 KV pool frees vs the same pool at the serving "
+            "dtype (0 when kv_quant is off) — the capacity headroom that "
+            "admits ~2x concurrent sequences", unit="bytes")
 
     def _update_pool_telemetry(self):
         sm = self.state_manager
@@ -372,7 +389,8 @@ class InferenceEngineV2:
                 f"(got {mode!r})")
         # "auto" is on everywhere today: the unified program's jnp
         # fallback covers every config the ragged kernel gates off
-        # (tp/ep, alibi, quantized KV), so there is no unsupported case
+        # (tp/ep, alibi), and quantized KV runs the kernel's quant
+        # variant — there is no unsupported case
         return mode != "off"
 
     def set_ragged_mode(self, mode: str) -> None:

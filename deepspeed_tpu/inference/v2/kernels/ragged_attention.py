@@ -65,7 +65,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .paged_attention import NEG_INF, _finalize, _interpret, _page_update
+from .paged_attention import (NEG_INF, _dequant_tile, _finalize, _interpret,
+                              _page_update)
 
 
 def _ragged_kernel(row_ref, len_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
@@ -146,29 +147,137 @@ def _ragged_dma_kernel(row_ref, len_ref, bt_ref, q_ref, k_hbm, v_hbm,
     _finalize(o_ref, acc_sc, l_sc, kvh=kvh, group=group)
 
 
+def _ragged_dma_kernel_quant(row_ref, len_ref, bt_ref, q_ref, k_hbm, v_hbm,
+                             ks_hbm, vs_hbm, o_ref, k_sc, v_sc, ks_sc,
+                             vs_sc, acc_sc, m_sc, l_sc, sem,
+                             *, bs, scale, kvh, group, io_dtype):
+    """Quantized-pool variant of ``_ragged_dma_kernel``: each walked
+    page's int8 tiles AND (kvh,) per-block scale rows stream from HBM;
+    dequant happens in VMEM before the shared update. sem (2, 4)."""
+    t = pl.program_id(0)
+    row = row_ref[t]
+    length = len_ref[t]
+    n_pages = (length + bs - 1) // bs
+
+    acc_sc[:] = jnp.zeros_like(acc_sc)
+    m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+    l_sc[:] = jnp.zeros_like(l_sc)
+
+    def dmas(slot, j):
+        page = bt_ref[row, j]
+        return (pltpu.make_async_copy(k_hbm.at[page], k_sc.at[slot],
+                                      sem.at[slot, 0]),
+                pltpu.make_async_copy(v_hbm.at[page], v_sc.at[slot],
+                                      sem.at[slot, 1]),
+                pltpu.make_async_copy(ks_hbm.at[page], ks_sc.at[slot],
+                                      sem.at[slot, 2]),
+                pltpu.make_async_copy(vs_hbm.at[page], vs_sc.at[slot],
+                                      sem.at[slot, 3]))
+
+    @pl.when(n_pages > 0)
+    def _start():
+        for d in dmas(0, 0):
+            d.start()
+
+    def body(j, _):
+        slot = jax.lax.rem(j, 2)
+        nxt = jax.lax.rem(j + 1, 2)
+
+        @pl.when(j + 1 < n_pages)
+        def _prefetch():
+            for d in dmas(nxt, j + 1):
+                d.start()
+
+        for d in dmas(slot, j):
+            d.wait()
+        _page_update(q_ref,
+                     _dequant_tile(k_sc[slot], ks_sc[slot], io_dtype),
+                     _dequant_tile(v_sc[slot], vs_sc[slot], io_dtype),
+                     j, length, acc_sc, m_sc, l_sc,
+                     bs=bs, scale=scale, kvh=kvh, group=group)
+        return 0
+
+    jax.lax.fori_loop(0, n_pages, body, 0)
+
+    _finalize(o_ref, acc_sc, l_sc, kvh=kvh, group=group)
+
+
+def _ragged_kernel_quant(row_ref, len_ref, bt_ref, q_ref, k_ref, v_ref,
+                         ks_ref, vs_ref, o_ref, acc_sc, m_sc, l_sc,
+                         *, bs, n_pages, scale, kvh, group, io_dtype):
+    """Quantized-pool variant of ``_ragged_kernel`` (BlockSpec pipeline
+    also streams the page's (1, kvh) scale rows)."""
+    t = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+        m_sc[:] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+
+    length = len_ref[t]
+
+    @pl.when(j * bs < length)
+    def _body():
+        _page_update(q_ref,
+                     _dequant_tile(k_ref[0], ks_ref[0], io_dtype),
+                     _dequant_tile(v_ref[0], vs_ref[0], io_dtype),
+                     j, length, acc_sc, m_sc, l_sc,
+                     bs=bs, scale=scale, kvh=kvh, group=group)
+
+    @pl.when(j == n_pages - 1)
+    def _finish():
+        _finalize(o_ref, acc_sc, l_sc, kvh=kvh, group=group)
+
+
 def ragged_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                      v_cache: jnp.ndarray, row_ids: jnp.ndarray,
                      lengths: jnp.ndarray,
-                     block_tables: jnp.ndarray) -> jnp.ndarray:
+                     block_tables: jnp.ndarray,
+                     k_scale: jnp.ndarray = None,
+                     v_scale: jnp.ndarray = None) -> jnp.ndarray:
     """Manual-DMA ragged paged attention (serving hot path).
 
     q [T, nh, hd] flat token buffer; k/v_cache [nb, bs, kvh, hd];
     row_ids [T] token -> batch row; lengths [T] per-token causal bound
-    (0 = padding); block_tables [R, MB] int32. Returns [T, nh, hd]."""
+    (0 = padding); block_tables [R, MB] int32. For the int8 ``kv_quant``
+    pool, ``k_scale``/``v_scale`` [nb, kvh] are the per-(block, head)
+    dequant scales — the kernel dequantizes in VMEM, so quantized KV
+    serves through the SAME one-program ragged family. Returns
+    [T, nh, hd]."""
     if _interpret():
         # same gate as the decode kernel: interpret mode does not
         # reliably simulate the manual DMA/semaphore protocol, and the
         # pipelined variant is numerically identical
         return ragged_attention_pipelined(q, k_cache, v_cache, row_ids,
-                                          lengths, block_tables)
+                                          lengths, block_tables,
+                                          k_scale=k_scale,
+                                          v_scale=v_scale)
     T, nh, hd = q.shape
     nb, bs, kvh, _ = k_cache.shape
     group = nh // kvh
     scale = 1.0 / (hd ** 0.5)
     q4 = q.reshape(T, kvh, group, hd)
+    quant = k_scale is not None
 
-    kernel = functools.partial(_ragged_dma_kernel, bs=bs, scale=scale,
-                               kvh=kvh, group=group)
+    if quant:
+        kernel = functools.partial(_ragged_dma_kernel_quant, bs=bs,
+                                   scale=scale, kvh=kvh, group=group,
+                                   io_dtype=q.dtype)
+        extra_in = [pl.BlockSpec(memory_space=pltpu.ANY),   # K scales
+                    pl.BlockSpec(memory_space=pltpu.ANY)]   # V scales
+        extra_scratch = [pltpu.VMEM((2, kvh), jnp.float32),
+                         pltpu.VMEM((2, kvh), jnp.float32)]
+        sem = pltpu.SemaphoreType.DMA((2, 4))
+        operands = (q4, k_cache, v_cache, k_scale.astype(jnp.float32),
+                    v_scale.astype(jnp.float32))
+    else:
+        kernel = functools.partial(_ragged_dma_kernel, bs=bs, scale=scale,
+                                   kvh=kvh, group=group)
+        extra_in, extra_scratch = [], []
+        sem = pltpu.SemaphoreType.DMA((2, 2))
+        operands = (q4, k_cache, v_cache)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(T,),
@@ -177,16 +286,17 @@ def ragged_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
                          lambda t, row, ln, bt: (t, 0, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),    # K pool stays in HBM
             pl.BlockSpec(memory_space=pltpu.ANY),    # V pool stays in HBM
-        ],
+        ] + extra_in,
         out_specs=pl.BlockSpec((1, kvh, group, hd),
                                lambda t, row, ln, bt: (t, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((2, bs, kvh, hd), k_cache.dtype),
             pltpu.VMEM((2, bs, kvh, hd), v_cache.dtype),
+        ] + extra_scratch + [
             pltpu.VMEM((kvh * group, hd), jnp.float32),
             pltpu.VMEM((kvh * group, 128), jnp.float32),
             pltpu.VMEM((kvh * group, 128), jnp.float32),
-            pltpu.SemaphoreType.DMA((2, 2)),
+            sem,
         ],
     )
     out = pl.pallas_call(
@@ -197,14 +307,16 @@ def ragged_attention(q: jnp.ndarray, k_cache: jnp.ndarray,
         # to the pipelined variant
         interpret=False,
     )(row_ids.astype(jnp.int32), lengths.astype(jnp.int32),
-      block_tables.astype(jnp.int32), q4, k_cache, v_cache)
+      block_tables.astype(jnp.int32), *operands)
     return out.reshape(T, nh, hd)
 
 
 def ragged_attention_pipelined(q: jnp.ndarray, k_cache: jnp.ndarray,
                                v_cache: jnp.ndarray, row_ids: jnp.ndarray,
                                lengths: jnp.ndarray,
-                               block_tables: jnp.ndarray) -> jnp.ndarray:
+                               block_tables: jnp.ndarray,
+                               k_scale: jnp.ndarray = None,
+                               v_scale: jnp.ndarray = None) -> jnp.ndarray:
     """BlockSpec-pipelined variant (streams all MB table slots per token;
     kept for interpret-mode coverage). Same signature as
     :func:`ragged_attention`."""
@@ -214,9 +326,24 @@ def ragged_attention_pipelined(q: jnp.ndarray, k_cache: jnp.ndarray,
     group = nh // kvh
     scale = 1.0 / (hd ** 0.5)
     q4 = q.reshape(T, kvh, group, hd)
+    quant = k_scale is not None
 
-    kernel = functools.partial(_ragged_kernel, bs=bs, n_pages=MB,
-                               scale=scale, kvh=kvh, group=group)
+    if quant:
+        kernel = functools.partial(_ragged_kernel_quant, bs=bs, n_pages=MB,
+                                   scale=scale, kvh=kvh, group=group,
+                                   io_dtype=q.dtype)
+        extra_in = [
+            pl.BlockSpec((1, kvh),
+                         lambda t, j, row, ln, bt: (bt[row[t], j], 0)),
+            pl.BlockSpec((1, kvh),
+                         lambda t, j, row, ln, bt: (bt[row[t], j], 0))]
+        operands = (q4, k_cache, v_cache, k_scale.astype(jnp.float32),
+                    v_scale.astype(jnp.float32))
+    else:
+        kernel = functools.partial(_ragged_kernel, bs=bs, n_pages=MB,
+                                   scale=scale, kvh=kvh, group=group)
+        extra_in = []
+        operands = (q4, k_cache, v_cache)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(T, MB),
@@ -227,7 +354,7 @@ def ragged_attention_pipelined(q: jnp.ndarray, k_cache: jnp.ndarray,
                          lambda t, j, row, ln, bt: (bt[row[t], j], 0, 0, 0)),
             pl.BlockSpec((1, bs, kvh, hd),
                          lambda t, j, row, ln, bt: (bt[row[t], j], 0, 0, 0)),
-        ],
+        ] + extra_in,
         out_specs=pl.BlockSpec((1, kvh, group, hd),
                                lambda t, j, row, ln, bt: (t, 0, 0, 0)),
         scratch_shapes=[
@@ -242,5 +369,5 @@ def ragged_attention_pipelined(q: jnp.ndarray, k_cache: jnp.ndarray,
         out_shape=jax.ShapeDtypeStruct((T, kvh, group, hd), q.dtype),
         interpret=_interpret(),
     )(row_ids.astype(jnp.int32), lengths.astype(jnp.int32),
-      block_tables.astype(jnp.int32), q4, k_cache, v_cache)
+      block_tables.astype(jnp.int32), *operands)
     return out.reshape(T, nh, hd)

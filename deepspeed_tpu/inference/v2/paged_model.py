@@ -45,40 +45,66 @@ NEG_INF = -1e30
 def init_paged_kv_cache(cfg: TransformerConfig, num_blocks: int,
                         block_size: int, dtype,
                         kv_quant: bool = False) -> Dict[str, jnp.ndarray]:
-    """``kv_quant`` stores the pool int8 with per-(slot, head) fp32
-    scales — ~0.53x the bf16 bytes, so the same HBM holds ~1.9x the
-    tokens (a capacity lever the reference's fp16/bf16-only blocked KV
-    does not have). Writes quantize, reads dequantize; the Pallas decode
-    kernels are bypassed under quant (engine gates use_kernel)."""
+    """``kv_quant`` stores the pool int8 with PER-BLOCK (page x kv-head)
+    fp32 scales — ~0.5x the bf16 bytes (scale overhead 4/(bs*hd) per
+    element instead of the old per-slot 4/hd), so the same HBM holds
+    ~2x the tokens. Writes quantize against a running per-block absmax
+    (requantizing the block's earlier content when the scale grows);
+    reads dequantize. The per-block granularity is what lets the Pallas
+    decode/ragged kernels dequantize IN-KERNEL: one (kvh,) scale row per
+    streamed page tile, so int8 KV serves through the same one-program
+    kernel family as bf16 (kernels/paged_attention.py ragged_attention.py
+    quant variants). Scales init to 0 = "nothing written"."""
     assert cfg.is_causal and cfg.norm_scheme == "pre", \
         "paged serving requires a causal pre-LN model (the MLM/post-LN " \
         "encoder family does not decode)"
     shape = (cfg.num_layers, num_blocks, block_size, cfg.kv_heads,
              cfg.head_dim)
     if kv_quant:
-        sshape = shape[:-1]
+        sshape = (cfg.num_layers, num_blocks, cfg.kv_heads)
         return {"k": jnp.zeros(shape, jnp.int8),
                 "v": jnp.zeros(shape, jnp.int8),
-                "ks": jnp.ones(sshape, jnp.float32),
-                "vs": jnp.ones(sshape, jnp.float32)}
+                "ks": jnp.zeros(sshape, jnp.float32),
+                "vs": jnp.zeros(sshape, jnp.float32)}
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def _kv_q(x):
-    """[..., hd] -> (int8 [..., hd], fp32 absmax scale [...])."""
-    xf = x.astype(jnp.float32)
-    scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0
-    scale = jnp.where(scale > 0, scale, 1.0)
-    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
-    return q.astype(jnp.int8), scale
-
-
 def _kv_write(kc, ksc, l, blocks, offs, k):
-    """Scatter one write-set into the pool, quantizing when scales exist."""
+    """Scatter one write-set into the pool. Under kv_quant the pool is
+    int8 with per-(block, kv-head) scales: the block scale is a running
+    absmax over everything written to the block, so a write whose
+    magnitude exceeds the current scale first rescales the block's
+    existing int8 content to the grown scale (deterministic
+    round-to-nearest requant — grow-only, so earlier tokens only ever
+    lose up to half an LSB per growth), then quantizes the new tokens.
+    Duplicate block indices in one write-set (a prefill chunk spanning a
+    block) scatter identical per-block values, so the duplicate-index
+    writes stay deterministic; the final per-slot writes are unique."""
     if ksc is None:
         return kc.at[l, blocks, offs].set(k.astype(kc.dtype)), None
-    q, s = _kv_q(k)
-    return kc.at[l, blocks, offs].set(q), ksc.at[l, blocks, offs].set(s)
+    xf = k.astype(jnp.float32)                          # [C, kvh, hd]
+    tok_scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0   # [C, kvh]
+    old = ksc[l]                                        # [nb, kvh]
+    new = old.at[blocks].max(tok_scale)                 # running absmax
+
+    def _requant(c):
+        ratio = jnp.where(new > 0, old / jnp.where(new > 0, new, 1.0), 0.0)
+        r_tok = ratio[blocks]                           # [C, kvh]
+        pages = c[l, blocks].astype(jnp.float32)        # [C, bs, kvh, hd]
+        pages = jnp.round(pages * r_tok[:, None, :, None])
+        return c.at[l, blocks].set(pages.astype(jnp.int8))
+
+    # steady-state decode almost never grows a block's absmax, so the
+    # full-page rescale RMW is condition-gated: a ratio-1 requant is the
+    # identity on the (integer-valued) int8 content, and never-written
+    # blocks keep scale 0 (dequant reads 0 either way) — skipping is
+    # bit-identical, and only the slot write below touches the pool
+    kc = jax.lax.cond(jnp.any(tok_scale > old[blocks]), _requant,
+                      lambda c: c, kc)
+    s_tok = jnp.where(new > 0, new, 1.0)[blocks]        # [C, kvh]
+    q = jnp.clip(jnp.round(xf / s_tok[..., None]), -127, 127)
+    kc = kc.at[l, blocks, offs].set(q.astype(jnp.int8))
+    return kc, ksc.at[l].set(new)
 
 
 def _cache_dict(kc, vc, ksc, vsc):
@@ -89,12 +115,15 @@ def _cache_dict(kc, vc, ksc, vsc):
 
 
 def _kv_read(kc, ksc, l, table, dtype):
-    """Gather pages [*, bs, kvh, hd], dequantizing when scales exist."""
+    """Gather pages [*, bs, kvh, hd], dequantizing when scales exist
+    (per-block scale row broadcast over the page's slot and head-dim
+    axes — the same multiply the kernels' quant variants run per tile,
+    so kernel and gather dequant agree bit-for-bit at fp32)."""
     pages = kc[l][table]
     if ksc is None:
         return pages
     return (pages.astype(jnp.float32)
-            * ksc[l][table][..., None]).astype(dtype)
+            * ksc[l][table][..., None, :, None]).astype(dtype)
 
 
 def _norm(cfg, x, w, b=None):
@@ -479,12 +508,11 @@ def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
         kc, ksc = _kv_write(kc, ksc, l, blk, off, k)
         vc, vsc = _kv_write(vc, vsc, l, blk, off, v)
         if use_kernel:
-            assert ksc is None, \
-                "kv_quant serves through the gather path (engine gates " \
-                "use_kernel off)"
             from .kernels.paged_attention import paged_attention
-            o = paged_attention(q, kc[l], vc[l], block_tables,
-                                pos + 1).reshape(N, nh * hd)
+            o = paged_attention(
+                q, kc[l], vc[l], block_tables, pos + 1,
+                k_scale=None if ksc is None else ksc[l],
+                v_scale=None if vsc is None else vsc[l]).reshape(N, nh * hd)
         else:
             # gather this sequence's pages: [N, MB, bs, nkv, hd] -> [N, ctx, ..]
             kpages = _kv_read(kc, ksc, l, block_tables,
@@ -581,12 +609,11 @@ def paged_ragged_step(cfg: TransformerConfig, params, ids: jnp.ndarray,
         kc, ksc = _kv_write(kc, ksc, l, write_blocks, write_offsets, k)
         vc, vsc = _kv_write(vc, vsc, l, write_blocks, write_offsets, v)
         if use_kernel:
-            assert ksc is None, \
-                "kv_quant serves through the gather path (engine gates " \
-                "use_kernel off)"
             from .kernels.ragged_attention import ragged_attention
-            o = ragged_attention(q, kc[l], vc[l], row_ids, lengths,
-                                 block_tables).reshape(T, nh * hd)
+            o = ragged_attention(
+                q, kc[l], vc[l], row_ids, lengths, block_tables,
+                k_scale=None if ksc is None else ksc[l],
+                v_scale=None if vsc is None else vsc[l]).reshape(T, nh * hd)
         else:
             # gather each ROW's pages once, indirect per token: the
             # materializing fallback (parity reference + tp/alibi/quant)

@@ -16,8 +16,11 @@ Payload layout (``serialize``): one ``.npz`` buffer holding a JSON
 descriptor (uid, seen_tokens, block count/size, fed-token log) and one
 array per KV-pool leaf — ``[num_layers, n_blocks, ...]``, the
 sequence's blocks gathered along the pool's block axis. The int8
-``kv_quant`` pool hands off the same way (its scale leaves are just
-more pool leaves).
+``kv_quant`` pool hands off the same way (its per-(block, kv-head)
+scale leaves — ``[L, n_blocks, kvh]`` — are just more pool leaves;
+restore overwrites the destination blocks' scales, so the int8 content
+pairs with its exact scales and the roundtrip is bit-exact — pinned by
+tests/unit/inference/test_kv_quant_serving.py).
 
 Gather/scatter shapes are bucketed (pow2 over the block count, padded
 with the null block) so repeated handoffs of different-length

@@ -88,6 +88,16 @@ class ZeroConfig:
     zero_hpz_partition_size: int = 1
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
+    # block-quantized ring gradient reduction (EQuARX, arXiv:2506.17615;
+    # runtime/grad_overlap.py): every hop of the bucketed ppermute-ring
+    # reduce ships int8/fp8 + per-block fp32 scales instead of fp32
+    # (~4x fewer collective bytes), with per-bucket ERROR FEEDBACK
+    # residuals carried across steps so transport error does not bias
+    # convergence. Stages 0-2 (stage-3 grads reduce inside the gather
+    # VJP); forces the bucketed overlap program; mutually exclusive with
+    # zero_quantized_gradients (qgZ already quantizes those buckets).
+    quantized_reduce: str = "off"   # off | int8 | fp8
+    quant_block: int = 2048         # elements per wire-quantization block
     # MiCS-style shard group (reference runtime/zero/mics.py)
     mics_shard_size: int = -1
     mics_hierarchical_params_gather: bool = False
@@ -107,6 +117,25 @@ class ZeroConfig:
             raise ConfigError(
                 "zero_optimization.overlap_grad_reduce must be one of "
                 f"'auto'|'bucketed'|'off', got {self.overlap_grad_reduce!r}")
+        if self.quantized_reduce not in ("off", "int8", "fp8"):
+            raise ConfigError(
+                "zero_optimization.quantized_reduce must be one of "
+                f"'off'|'int8'|'fp8', got {self.quantized_reduce!r}")
+        if self.quant_block <= 0:
+            raise ConfigError(
+                f"zero_optimization.quant_block must be > 0, got "
+                f"{self.quant_block}")
+        if self.quantized_reduce != "off":
+            if self.stage == 3:
+                raise ConfigError(
+                    "zero_optimization.quantized_reduce targets stages 0-2 "
+                    "(stage-3 gradients reduce inside the parameter "
+                    "gather's VJP; use zero_quantized_gradients for the "
+                    "qgZ int8 all-to-all there)")
+            if self.zero_quantized_gradients:
+                raise ConfigError(
+                    "quantized_reduce and zero_quantized_gradients both "
+                    "quantize the gradient exchange — pick one transport")
         if self.zero_hpz_partition_size > 1 and self.stage != 3:
             # hpZ is a stage-3 feature (secondary partition of the COMPUTE
             # params; reference zero/config.py:256-272) — rejecting loudly

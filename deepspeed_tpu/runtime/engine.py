@@ -162,6 +162,12 @@ class DeepSpeedTpuEngine:
         # step; offload/onebit/infinity paths keep the legacy reduction
         self.grad_overlap_mode = "off"
         self.grad_bucket_plan = None
+        # error-feedback residuals of the quantized ring reduction
+        # (zero_optimization.quantized_reduce); threaded through the
+        # jitted step like the rest of the train state. Deliberately NOT
+        # checkpointed: losing a residual on restart costs one step of
+        # transient quantization bias, not correctness.
+        self.quant_reduce_state = None
 
         # collective-overlap XLA knobs (async collective fusion +
         # latency-hiding scheduler) ride LIBTPU_INIT_ARGS; only the TPU
@@ -311,6 +317,18 @@ class DeepSpeedTpuEngine:
         # --- state init under sharding constraints (zero.Init equivalent:
         # params materialize directly into their shards, partition_parameters.py:723)
         self._init_state(seed)
+        if (self.config.zero_optimization.quantized_reduce != "off"
+                and (self.offload_device or self.onebit_mode
+                     or self.param_offload_nvme)):
+            # those paths build their own steps that never consult the
+            # knob — running full-precision wire while the config claims
+            # int8 would be a silent no-op, so reject like the stage-3
+            # and qgZ conflicts (config.py validates those at load)
+            from .config import ConfigError
+            raise ConfigError(
+                "zero_optimization.quantized_reduce requires the standard "
+                "jitted step: ZeRO-Offload, ZeRO-Infinity and 1-bit "
+                "optimizers keep their own gradient transports")
         if self.offload_device or self.onebit_mode:
             fm = getattr(self.model, "frozen_mask", None)
             if (fm() if callable(fm) else fm) is not None:
@@ -394,8 +412,25 @@ class DeepSpeedTpuEngine:
         self._tm_bucket_bytes = reg.gauge(
             "training_reduce_bucket_bytes",
             "largest gradient-reduction bucket", unit="bytes")
+        self._tm_quant_bytes = reg.gauge(
+            "training_reduce_quantized_bytes",
+            "per-device wire bytes per step of the quantized ring "
+            "gradient reduction (0 when quantized_reduce is off)",
+            unit="bytes")
+        self._tm_quant_err = reg.gauge(
+            "training_quant_error_feedback_norm",
+            "global norm of the carried quantized-reduce error-feedback "
+            "residuals after the last step")
         if self.grad_bucket_plan is not None:
             self._tm_bucket_bytes.set(self.grad_bucket_plan.max_bucket_bytes)
+            if self.quant_reduce_state is not None:
+                from .grad_overlap import ring_wire_bytes
+                zc = self.config.zero_optimization
+                dp = int(np.prod([self.topology.sizes[a]
+                                  for a in self.topology.dp_axes]))
+                self._tm_quant_bytes.set(ring_wire_bytes(
+                    self.grad_bucket_plan, dp, quantized=True,
+                    quant_block=zc.quant_block))
         if self.monitor is not None and self.monitor.enabled:
             self.telemetry_bridge = self.monitor.attach_telemetry(
                 reg, flush_interval=tcfg.flush_interval)
@@ -475,6 +510,8 @@ class DeepSpeedTpuEngine:
         self._tm_lr.set(float(metrics["lr"]))
         if "loss_scale" in metrics:
             self._tm_scale.set(float(metrics["loss_scale"]))
+        if "quant_error_norm" in metrics:
+            self._tm_quant_err.set(float(metrics["quant_error_norm"]))
         if skipped:
             self._tm_skipped.inc()
         else:
@@ -833,9 +870,23 @@ class DeepSpeedTpuEngine:
         zpp_w = zc.zero_quantized_weights and self.zero_stage == 3
         zpp_g = zc.zero_quantized_gradients and self.zero_stage >= 2
         use_zeropp = zpp_w or zpp_g
-        self.grad_overlap_mode = resolve_overlap_mode(self, use_zeropp)
+        # quantized_reduce rides the manual bucketed program like ZeRO++
+        # (its collectives cannot be compiler-inserted)
+        qr_on = zc.quantized_reduce != "off"
+        if qr_on and self.ds_config.dp_world_size <= 1:
+            # nothing rides the ring at dp=1 — stay loud instead of
+            # silently forcing the manual program with zero quantized
+            # buckets (a single-device debug run of a prod config)
+            log_dist(
+                "quantized_reduce is inert without data parallelism "
+                "(dp world 1): no ring transport to quantize — running "
+                "unquantized", ranks=[0])
+            qr_on = False
+        self.grad_overlap_mode = resolve_overlap_mode(
+            self, use_zeropp or qr_on)
         use_manual = self.grad_overlap_mode == "bucketed"
         self.grad_bucket_plan = None
+        use_qr = False
         if use_manual:
             # the manual program gathers from DEVICE shards; host-streamed
             # params would need its own H2D stage
@@ -853,16 +904,42 @@ class DeepSpeedTpuEngine:
             # expert/pipe would need manual programs of their own inside
             # the shard_map.
             for ax in ("expert", "pipe"):
+                if qr_on and self.topology.axis_size(ax) != 1:
+                    from .config import ConfigError
+                    raise ConfigError(
+                        f"zero_optimization.quantized_reduce does not "
+                        f"compose with {ax} parallelism: the quantized "
+                        f"ring rides the manual data-parallel program")
                 assert self.topology.axis_size(ax) == 1, \
                     f"the manual gradient program composes with dp/tp/sp " \
                     f"only (got {ax} size {self.topology.axis_size(ax)})"
-            manual_grad_fn, self.grad_bucket_plan = \
+            manual_grad_fn, self.grad_bucket_plan, qtemplate = \
                 make_overlapped_grad_fn(self, zpp_w, zpp_g)
+            use_qr = qtemplate is not None
+            if use_qr:
+                # allocate (or describe, under abstract_init) the EF
+                # residual state: zeros, sharded over the dp axes like
+                # the shard_map's qstate specs expect
+                from jax.sharding import NamedSharding
+
+                def _mk_qleaf(shape, spec):
+                    sh = NamedSharding(self.mesh, spec)
+                    if self._abstract_init:
+                        return jax.ShapeDtypeStruct(shape, jnp.float32,
+                                                    sharding=sh)
+                    return jax.device_put(jnp.zeros(shape, jnp.float32),
+                                          sh)
+
+                self.quant_reduce_state = {
+                    k: {kk: _mk_qleaf(shape, spec)
+                        for kk, (shape, spec) in v.items()}
+                    for k, v in qtemplate.items()}
             log_dist(
                 f"grad overlap: bucketed reduction "
                 f"({self.grad_bucket_plan.num_buckets} buckets, "
                 f"{len(self.grad_bucket_plan.vjp_leaves)} vjp-reduced "
-                f"leaves, quantized={zpp_g})", ranks=[0])
+                f"leaves, quantized={zpp_g}, "
+                f"quantized_reduce={zc.quantized_reduce})", ranks=[0])
 
         pipeline_mode = self.topology.axis_size("pipe") > 1
         # the 1F1B path computes unscaled grads, so fp16 loss scaling falls
@@ -920,9 +997,11 @@ class DeepSpeedTpuEngine:
         fm = getattr(self.model, "frozen_mask", None)
         frozen_mask = fm() if callable(fm) else fm
 
-        def train_step(params, master, opt_state, scale_state, step, rng, batch):
+        def train_step(params, master, opt_state, scale_state, step, rng,
+                       batch, qstate):
             lr = lr_fn(step)
             scale = scale_state["loss_scale"] if fp16 else jnp.asarray(1.0, jnp.float32)
+            new_qstate = qstate
 
             if pipe_own_grads:
                 # the 1F1B pipeline IS the gradient computation (bounded
@@ -952,7 +1031,11 @@ class DeepSpeedTpuEngine:
                 inv = 1.0 / scale
             elif use_manual:
                 rng, sub = jax.random.split(rng)
-                grads, loss = manual_grad_fn(params, sub, batch, scale)
+                if use_qr:
+                    grads, loss, new_qstate = manual_grad_fn(
+                        params, sub, batch, scale, qstate)
+                else:
+                    grads, loss = manual_grad_fn(params, sub, batch, scale)
                 grads = constrain(grads, grad_sh)
                 inv = 1.0 / (gas * scale)
             else:
@@ -985,6 +1068,13 @@ class DeepSpeedTpuEngine:
             else:
                 grads, finite, gnorm = unscale_clip_check(
                     grads, inv, clip, fp16, frozen_mask)
+            if use_qr:
+                # a skipped (non-finite) step's grads are garbage and so
+                # are their transport errors — the EF residual must not
+                # absorb them (NaN would poison every later step)
+                new_qstate = jax.tree.map(
+                    lambda n, o: jnp.where(finite, n, o), new_qstate,
+                    qstate)
             target = master if has_master else params
             new_target, new_opt, new_step = apply_update_with_skip(
                 optimizer, target, grads, opt_state, step, lr, finite,
@@ -1020,7 +1110,15 @@ class DeepSpeedTpuEngine:
                 metrics["loss_scale"] = scale
             if grad_attribution:
                 metrics["grad_leaf_sqnorms"] = leaf_sq
-            return new_params, new_master, new_opt, new_scale_state, new_step, rng, metrics
+            qleaves = jax.tree.leaves(new_qstate) if use_qr else []
+            if qleaves:
+                # global norm of the carried residuals: the live measure
+                # of how much transport error EF is compensating
+                metrics["quant_error_norm"] = jnp.sqrt(
+                    sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                        for x in qleaves))
+            return (new_params, new_master, new_opt, new_scale_state,
+                    new_step, rng, metrics, new_qstate)
 
         # [gas, global_micro, ...]: shard dim 1 over data axes
         self._batch_sharding_fn = self._default_batch_sharding_fn()
@@ -1035,15 +1133,26 @@ class DeepSpeedTpuEngine:
         # SPMD partitioner RET_CHECKs on the unsharded scalar annotations —
         # rely on the in-step with_sharding_constraints instead (params are
         # constrained already; master/opt propagate elementwise)
+        # the EF residual state is pinned to its init shardings on BOTH
+        # sides: with None the executable would key on whatever sharding
+        # the previous step's output carried and respecialize once (the
+        # same class of silent recompile as the serving KV pool)
+        q_sh = (jax.tree.map(lambda x: x.sharding, self.quant_reduce_state)
+                if use_qr else None)
         self._train_step = jax.jit(
             train_step,
             in_shardings=(param_store_sh,
                           master_sh if has_master else None,
-                          opt_sh, scale_sh, repl, repl, None),
+                          opt_sh, scale_sh, repl, repl, None, q_sh),
             out_shardings=(None if self.param_offload else
                            (param_sh,
                             master_sh if has_master else None,
-                            opt_sh, scale_sh, repl, repl, metrics_sh)),
+                            opt_sh, scale_sh, repl, repl, metrics_sh,
+                            q_sh)),
+            # the EF residual state is NOT donated: its output layout
+            # (shard_map out_specs) differs from the committed input
+            # placement, so donation only produces "unusable buffer"
+            # warnings for a few KB of residuals
             donate_argnums=(0, 1, 2, 3),
         )
 
@@ -1251,7 +1360,7 @@ class DeepSpeedTpuEngine:
                     if self.offload_device else
                     (self.params, self.master_params, self.opt_state,
                      self.scale_state, self._step_arr, self._model_rng,
-                     dev_batch))
+                     dev_batch, self.quant_reduce_state))
             ca = fn.lower(*args).compile().cost_analysis()
             if isinstance(ca, (list, tuple)):
                 ca = ca[0] if ca else {}
@@ -1340,7 +1449,8 @@ class DeepSpeedTpuEngine:
                 compiler_options = dict(COLLECTIVE_OVERLAP_COMPILER_OPTIONS)
         lowered = self._train_step.lower(
             self.params, self.master_params, self.opt_state,
-            self.scale_state, self._step_arr, self._model_rng, dev_batch)
+            self.scale_state, self._step_arr, self._model_rng, dev_batch,
+            self.quant_reduce_state)
         t0 = time.perf_counter()
         compiled = (lowered.compile(compiler_options=compiler_options)
                     if compiler_options else lowered.compile())
@@ -1434,10 +1544,10 @@ class DeepSpeedTpuEngine:
                 else:
                     (self.params, self.master_params, self.opt_state,
                      self.scale_state, self._step_arr, self._model_rng,
-                     metrics) = self._train_step(
+                     metrics, self.quant_reduce_state) = self._train_step(
                         self.params, self.master_params, self.opt_state,
                         self.scale_state, self._step_arr, self._model_rng,
-                        dev_batch)
+                        dev_batch, self.quant_reduce_state)
                 self._relocate_params_to_storage()
             # the loss fetch blocks on the async-dispatched device step, so
             # it belongs inside the span/timer (XLA programs complete here)

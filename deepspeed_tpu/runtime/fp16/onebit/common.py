@@ -203,7 +203,12 @@ def build_compressed_train_step(engine, impl):
         in_specs=(repl_specs, repl_specs, P(), P(), P(None, bt)) + state_specs,
         out_specs=(repl_specs, repl_specs, P(), P(), P()) + state_specs)
 
-    def train_step(params, master, opt_state, scale_state, step, rng, batch):
+    def train_step(params, master, opt_state, scale_state, step, rng, batch,
+                   qstate=None):
+        # qstate: the quantized-reduce error-feedback residuals of the
+        # bucketed program — the compressed optimizers keep their own
+        # gradient transport, so it is always None here and passes through
+        # untouched (train_batch threads it for every step variant)
         master_in = params if master is None else master
         out = sm(params, master_in, step, rng, batch,
                  *(opt_state[k] for k in state_keys))
@@ -211,6 +216,6 @@ def build_compressed_train_step(engine, impl):
         new_state = dict(zip(state_keys, out[5:]))
         master_out = None if master is None else new_master
         return (params, master_out, new_state, scale_state, step, rng,
-                metrics)
+                metrics, qstate)
 
     return jax.jit(train_step, donate_argnums=(0, 1, 2)), init_state()

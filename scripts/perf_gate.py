@@ -36,6 +36,17 @@ Gated metrics (see ``collect()``):
     collectives the scheduler left without an overlap window
     (utils/xla_profile.analyze_grad_exchange; the PR-4 regression
     metric).
+  * ``train_quant_reduce_wire_ratio`` /
+    ``train_quant_grad_exposed_collective_fraction`` — the quantized
+    ring reduction (``zero_optimization.quantized_reduce``): fp32-ring
+    wire bytes over quantized-ring wire bytes on the dp8 proxy's plan
+    (pinned from below at 3.5x), and the quantized program's own
+    exposed fraction (the int8 hops must keep the PR-4 overlap bound).
+  * ``kv_quant_steady_state_recompiles`` /
+    ``kv_quant_ragged_flops_per_token`` / ``kv_quant_ragged_peak_bytes``
+    — int8 KV serving through the quant kernel family: zero recompiles
+    after the double warmup, and the quantized ragged program's
+    cost/memory analysis pinned like the bf16 one.
   * ``router_affinity_hit_fraction`` / ``router_random_hit_fraction``
     / ``router_affinity_hit_gain`` / ``router_steady_recompiles`` /
     ``router_dispatch_ns_per_request`` — the serving routing tier
@@ -305,6 +316,39 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
                                                   - ragged_compiled)
         metrics["ragged_mixed_steady_recompiles"] = ragged_steady
 
+        # -- int8 KV pool through the quant kernel family ------------------
+        # the kv_quant acceptance invariant, chip-free: quantized KV
+        # serves through the SAME Pallas ragged/decode programs (the
+        # engine gate is gone) with zero steady-state recompiles after
+        # the double-warm discipline, and the quantized ragged program's
+        # cost/memory analysis is pinned like the bf16 one
+        qeng = InferenceEngineV2(
+            model, RaggedInferenceEngineConfig(
+                state_manager=DSStateManagerConfig(
+                    max_tracked_sequences=8, max_seq_len=seq_len,
+                    num_blocks=65, block_size=16),
+                dtype="float32", prefill_bucket=16,
+                decode_window=decode_window, kv_quant=True),
+            params=params)
+        qeng.generate(prompts, max_new_tokens=new_tokens)
+        qeng.generate(prompts, max_new_tokens=new_tokens, uids=[30, 31])
+        st0 = fam_total("xla_steady_state_recompiles_total")
+        watchdog.mark_steady(True)
+        try:
+            qeng.generate(prompts, max_new_tokens=new_tokens,
+                          uids=[40, 41])
+        finally:
+            watchdog.mark_steady(False)
+        metrics["kv_quant_steady_state_recompiles"] = fam_total(
+            "xla_steady_state_recompiles_total") - st0
+        qprog = qeng.memory_report(
+            batch=len(prompts))["programs"].get("ragged_step")
+        if qprog:
+            metrics["kv_quant_ragged_flops_per_token"] = (
+                qprog.get("flops", 0.0) / qprog["token_bucket"])
+            metrics["kv_quant_ragged_peak_bytes"] = float(
+                qprog["peak_bytes"])
+
         # -- routing tier: affinity win + per-replica steady state ---------
         # (serve/router.py): a shared-prefix workload through 2 routed
         # replicas must (a) hit the prefix cache strictly more often
@@ -451,6 +495,33 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
             if ma:
                 metrics["train_step_peak_bytes"] = float(
                     ma["peak_bytes"])
+            # the quantized ring (zero_optimization.quantized_reduce):
+            # its wire bytes must stay >= 3.5x below the fp32 ring on
+            # the same plan, and its exposed fraction must hold the
+            # PR-4 overlap bound (the quantized hops are still async
+            # ppermute pairs the scheduler can cover)
+            from deepspeed_tpu.runtime.grad_overlap import \
+                ring_wire_bytes
+            engine_q, batch_q = aot_scale.build_abstract_engine(
+                tcfg, {"train_micro_batch_size_per_gpu": 1,
+                       "bf16": {"enabled": True},
+                       "optimizer": {"type": "adamw",
+                                     "params": {"lr": 1e-3}},
+                       "zero_optimization": {
+                           "stage": 2, "overlap_comm": True,
+                           "overlap_grad_reduce": "bucketed",
+                           "quantized_reduce": "int8",
+                           "reduce_bucket_size": 1 << 18}})
+            compiled_q = engine_q.lower_train_step(batch_q)
+            gxq = grad_exchange_report_from_compiled(compiled_q)
+            metrics["train_quant_grad_exposed_collective_fraction"] = \
+                gxq.exposed_fraction
+            plan = engine_q.grad_bucket_plan
+            dp = engine_q.ds_config.dp_world_size
+            wb_q = ring_wire_bytes(plan, dp, quantized=True,
+                                   quant_block=2048)
+            metrics["train_quant_reduce_wire_ratio"] = (
+                ring_wire_bytes(plan, dp) / wb_q if wb_q else None)
         except Exception as e:
             print(f"perf_gate: training AOT metrics skipped: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
@@ -473,9 +544,17 @@ def make_baseline(metrics: Dict[str, float]) -> Dict[str, Any]:
                     "ragged_mixed_compile_events",
                     "stitched_mixed_compile_events",
                     "ragged_mixed_steady_recompiles",
-                    "router_steady_recompiles"):
+                    "router_steady_recompiles",
+                    "kv_quant_steady_state_recompiles"):
             spec[name] = {"value": value, "direction": "max",
                           "abs_tol": 0.0}
+        elif name == "train_quant_reduce_wire_ratio":
+            # the wire-compression pin: quantized ring bytes must stay
+            # >= 3.5x below the fp32 ring (direction "min" with the slack
+            # eating exactly the headroom above 3.5)
+            spec[name] = {"value": value, "direction": "min",
+                          "abs_tol": round(max(value - 3.5, 0.0), 6),
+                          "optional": True}
         elif name in ("router_affinity_hit_fraction",
                       "router_affinity_hit_gain"):
             # the routing win itself: affinity must keep beating random

@@ -70,3 +70,16 @@ def test_monolithic_baseline_is_sync(dp8_record):
     m = dp8_record["monolithic"]
     assert sum(m["sync_ops"].values()) >= 1
     assert not m["async_ops"]
+
+
+def test_quantized_ring_keeps_overlap_and_shrinks_wire(dp8_record):
+    """quantized_reduce=int8 on the same proxy: the int8 hops are still
+    async ppermute pairs the scheduler overlaps (exposed fraction holds
+    the PR-4 bar), and the plan's quantized wire bytes sit >= 3.5x below
+    the fp32 ring's (the EQuARX compression bar)."""
+    q = dp8_record["bucketed_int8"]
+    assert dp8_record["exposed_collective_fraction_int8"] <= 0.5, q
+    assert sum(q["async_ops"].values()) >= 7
+    assert q["ring_wire_bytes_quant"] > 0
+    assert dp8_record["quant_wire_ratio"] >= 3.5, dp8_record[
+        "quant_wire_ratio"]
