@@ -17,6 +17,15 @@ Prints ONE JSON line. Usage:
 decode through the SplitFuse scheduler, run twice — ragged unified
 program vs stitched prefill/decode families — reporting compiled-program
 counts, steady-state recompiles (watchdog-pinned zero) and tokens/s.
+
+``--router N`` switches to the routed fleet sweep: a shared-prefix
+workload through N in-process replicas behind the prefix-affinity
+router (``--disagg`` adds a dedicated prefill replica and the KV
+handoff path), reporting routed tokens/s, affinity hits, handoffs and
+steady-state recompiles. With ``--trace-out`` the run writes the
+STITCHED fleet timeline — one Chrome-trace process row per lane
+(router + each replica), every request's hops correlated by its
+distributed trace id (docs/PROFILING.md § Distributed tracing).
 """
 
 import argparse
@@ -299,6 +308,136 @@ def bench_mixed(model, params, *, requests: int, prompt: int,
         set_recorder(prev_rec)
 
 
+def bench_routed(model, params, *, replicas_n: int, requests: int,
+                 prompt: int, new_tokens: int, budget: int,
+                 disaggregated: bool, trace_out=None) -> dict:
+    """Routed fleet sweep: a shared-prefix workload through N replicas
+    behind the affinity router, double-warmed (every bucket compiles on
+    wave 1, respecializes once on wave 2) before a steady wave under
+    ``watchdog.mark_steady``. Runs in an isolated registry/recorder.
+    ``trace_out`` writes the stitched fleet timeline of the run."""
+    import asyncio
+
+    from ..inference.v2.engine_v2 import InferenceEngineV2
+    from ..inference.v2.serve import (PrefillReplica, ReplicaRouter,
+                                      RouterConfig, ServingConfig,
+                                      build_replicas)
+    from ..telemetry import (FlightRecorder, MetricsRegistry,
+                             get_registry, set_recorder, set_registry,
+                             timeline, watchdog)
+
+    def _engine():
+        return InferenceEngineV2(model, {
+            "dtype": "bfloat16",
+            "state_manager": {"max_tracked_sequences": max(requests, 8),
+                              "max_ragged_batch_size": 512,
+                              "num_blocks": POOL_NUM_BLOCKS,
+                              "block_size": POOL_BLOCK_SIZE,
+                              "enable_prefix_caching": True},
+        }, params=params)
+
+    # shared-prefix traffic (the workload affinity placement exists
+    # for): one block-aligned prefix per group, distinct tails
+    rng = np.random.default_rng(0)
+    prompts = []
+    for _g in range(max(replicas_n, 2)):
+        prefix = list(map(int, rng.integers(0, 2047, prompt)))
+        for _ in range(max(requests // max(replicas_n, 2), 1)):
+            prompts.append(prefix
+                           + list(map(int, rng.integers(0, 2047, 8))))
+
+    prev = set_registry(MetricsRegistry())
+    prev_rec = set_recorder(FlightRecorder())
+    watchdog.reset()
+    try:
+        async def run():
+            replicas = build_replicas(
+                [_engine() for _ in range(replicas_n)],
+                ServingConfig(token_budget=budget))
+            pws = ([PrefillReplica("prefill0", _engine())]
+                   if disaggregated else [])
+            router = ReplicaRouter(
+                replicas,
+                RouterConfig(disaggregated=disaggregated,
+                             monitor_interval_s=0.0),
+                prefill_replicas=pws)
+            await router.start()
+            reg = get_registry()
+
+            async def wave():
+                streams = [await router.submit(p, new_tokens)
+                           for p in prompts]
+                for s in streams:
+                    await s.drain()
+
+            w0 = time.perf_counter()
+            await wave()
+            await wave()
+            warmup_s = time.perf_counter() - w0
+            st0 = reg.family_total("xla_steady_state_recompiles_total")
+            watchdog.mark_steady(True)
+            try:
+                t0 = time.perf_counter()
+                await wave()
+                dt = time.perf_counter() - t0
+            finally:
+                watchdog.mark_steady(False)
+            out = {
+                "replicas": replicas_n,
+                "disaggregated": disaggregated,
+                # the ACTUAL per-wave request count (group-rounded from
+                # the requested batch), which tok_s is computed over
+                "requests": len(prompts),
+                "tok_s": len(prompts) * new_tokens / dt,
+                "warmup_s": warmup_s,
+                "steady_state_recompiles": reg.family_total(
+                    "xla_steady_state_recompiles_total") - st0,
+                "requests_per_replica": {
+                    v[0]: s.value for v, s in
+                    (reg.get("router_requests_total").series()
+                     if reg.get("router_requests_total") else ())},
+                "affinity_hits": reg.family_total(
+                    "router_affinity_hits_total"),
+                "handoffs": reg.family_total("router_handoffs_total"),
+                "trace_contexts": reg.family_total(
+                    "trace_contexts_total"),
+            }
+            if trace_out:
+                # the stitched fleet form: every lane (router + each
+                # replica) a process row, spans carrying trace ids
+                out["trace_out"] = timeline.write_fleet_trace(trace_out)
+            await router.stop()
+            return out
+
+        return asyncio.run(run())
+    finally:
+        watchdog.reset()
+        set_registry(prev)
+        set_recorder(prev_rec)
+
+
+def main_router(args) -> int:
+    """--router mode: the routed fleet sweep, one JSON line."""
+    import jax
+
+    model = build_model(args.layers, args.hidden)
+    params = model.init_params(jax.random.PRNGKey(0))
+    res = bench_routed(model, params, replicas_n=args.router,
+                       requests=args.batch, prompt=args.prompt,
+                       new_tokens=args.new, budget=args.budget,
+                       disaggregated=args.disagg,
+                       trace_out=args.trace_out)
+    print(json.dumps({
+        "metric": "serving_routed_tokens_per_sec",
+        "backend": jax.default_backend(),
+        "requests": args.batch, "prompt": args.prompt,
+        "new_tokens": args.new,
+        **{k: (round(v, 2) if isinstance(v, float) else v)
+           for k, v in res.items()},
+    }))
+    return 0
+
+
 def main_mixed(args) -> int:
     """--mixed mode: the ragged-vs-stitched comparison under concurrent
     prefill+decode traffic, one JSON line."""
@@ -364,15 +503,30 @@ def main(argv=None) -> int:
                         "stitched — reports compiled-program counts, "
                         "steady-state recompiles and tokens/s")
     p.add_argument("--budget", type=int, default=256,
-                   help="scheduler token budget per step (--mixed)")
+                   help="scheduler token budget per step "
+                        "(--mixed/--router)")
+    p.add_argument("--router", type=int, default=0, metavar="N",
+                   help="routed fleet mode: shared-prefix traffic "
+                        "through N in-process replicas behind the "
+                        "prefix-affinity router — reports routed tok/s, "
+                        "affinity hits, handoffs and steady-state "
+                        "recompiles")
+    p.add_argument("--disagg", action="store_true",
+                   help="with --router: add a dedicated prefill replica "
+                        "and route through the prefill->handoff->decode "
+                        "disaggregated path")
     p.add_argument("--trace-out", default=None, metavar="PATH",
                    help="write the run's telemetry spans (request "
                         "lifelines, decode windows) as Chrome-trace-event "
-                        "JSON to PATH (open in Perfetto)")
+                        "JSON to PATH (open in Perfetto); with --router "
+                        "this is the STITCHED fleet timeline — a process "
+                        "row per lane, spans correlated by trace id")
     args = p.parse_args(argv)
 
     if args.mixed:
         return main_mixed(args)
+    if args.router:
+        return main_router(args)
 
     import jax
 

@@ -146,6 +146,11 @@ class InferenceEngineV2:
         # lookup O(ngram) per round instead of re-scanning the history
         # window; same lifecycle as the miss streaks
         self._draft_index: Dict[int, object] = {}
+        # per-uid distributed-trace ids (telemetry/context.py): the
+        # scheduler binds them at submit/resume so batch-level spans
+        # (decode_step/decode_window/ragged_step) carry the trace ids of
+        # every request they served; cleared on flush()
+        self._uid_traces: Dict[int, str] = {}
         self._init_telemetry()
         # Pallas kernels only at tp=1: a bare pallas_call is not
         # GSPMD-partitionable, so sharded-param (tp>1) serving keeps the
@@ -458,7 +463,8 @@ class InferenceEngineV2:
         table = np.full(C, NULL_BLOCK, np.int32)
         valid = positions < n
         table[valid] = np.asarray(seq.blocks, np.int32)[block_idx[valid]]
-        with trace.span("prefill", uid=int(uid), tokens=int(n)):
+        with trace.span("prefill", uid=int(uid), tokens=int(n),
+                        **self._trace_attr(uid)):
             logits, self.kv_cache = self._prefill_jit(
                 self.params, jnp.asarray(ids), jnp.asarray(n),
                 self.kv_cache, jnp.asarray(table), jnp.asarray(offs))
@@ -495,7 +501,7 @@ class InferenceEngineV2:
         jit_fn = (self._spec_jit(all_logits) if all_logits
                   else self._continue_jit)
         with trace.span("continue", uid=int(uid), tokens=int(n),
-                        spec=bool(all_logits)):
+                        spec=bool(all_logits), **self._trace_attr(uid)):
             logits, self.kv_cache = jit_fn(
                 self.params, jnp.asarray(ids), jnp.asarray(start),
                 jnp.asarray(n), self.kv_cache, jnp.asarray(table),
@@ -694,7 +700,8 @@ class InferenceEngineV2:
         sm = self.state_manager
         t0 = time.perf_counter()
         with trace.span("decode_step", batch=len(uids),
-                        uids=[int(u) for u in uids]):
+                        uids=[int(u) for u in uids],
+                        **self._trace_attrs(uids)):
             toks, pos, tables, active = self._build_decode_inputs(uids,
                                                                   tokens)
             vals, self.kv_cache = jit_fn(
@@ -771,7 +778,8 @@ class InferenceEngineV2:
         t0 = time.perf_counter()
         with trace.span("decode_window", batch=len(uids),
                         window=self.decode_window,
-                        uids=[int(u) for u in uids]):
+                        uids=[int(u) for u in uids],
+                        **self._trace_attrs(uids)):
             # block pre-allocation contract: every block row i can write
             # during its steps_left[i] steps is allocated HERE, so the
             # device loop never needs the host mid-window (block-table
@@ -899,7 +907,8 @@ class InferenceEngineV2:
         rb = ragged_batch.pack(entries, sm)
         with trace.span("ragged_step", rows=len(entries),
                         tokens=rb.total_tokens,
-                        uids=[u for u, _ in entries]):
+                        uids=[u for u, _ in entries],
+                        **self._trace_attrs(u for u, _ in entries)):
             logits, self.kv_cache = self._ragged_jit(
                 self.params, jnp.asarray(rb.ids),
                 jnp.asarray(rb.row_ids), jnp.asarray(rb.positions),
@@ -980,6 +989,27 @@ class InferenceEngineV2:
                 results.update(self._decode_batch(chunk_u, chunk_t))
         return np.stack([results[uid] for uid, _ in entries])
 
+    # -- distributed tracing (telemetry/context.py) ---------------------
+    def bind_trace(self, uid: int, trace_id: str) -> None:
+        """Correlate ``uid``'s engine spans with a distributed trace:
+        until flush(uid), every span that serves the uid carries the
+        trace id (single-request spans as ``trace_id``, batch spans as
+        a ``trace_ids`` list) — the stitched fleet timeline selects on
+        it (timeline.trace_spans)."""
+        self._uid_traces[int(uid)] = str(trace_id)
+
+    def _trace_attr(self, uid: int) -> Dict[str, str]:
+        tid = self._uid_traces.get(int(uid))
+        return {"trace_id": tid} if tid is not None else {}
+
+    def _trace_attrs(self, uids) -> Dict[str, List[str]]:
+        seen: List[str] = []
+        for u in uids:
+            tid = self._uid_traces.get(int(u))
+            if tid is not None and tid not in seen:
+                seen.append(tid)
+        return {"trace_ids": seen} if seen else {}
+
     def flush(self, uid: int) -> None:
         """Release a finished sequence's KV blocks (reference flush).
         Also forgets the uid's speculative cold-streak state: uids are
@@ -987,6 +1017,7 @@ class InferenceEngineV2:
         independent requests would permanently ban drafting for them."""
         self._spec_miss_streak.pop(uid, None)
         self._draft_index.pop(uid, None)
+        self._uid_traces.pop(int(uid), None)
         self.state_manager.flush_sequence(uid)
         self._update_pool_telemetry()
 

@@ -67,6 +67,14 @@ class _Request:
     t_submit_pc: float = 0.0
     t_prefill_pc: Optional[float] = None
     t_first_tok_pc: Optional[float] = None
+    # distributed trace id (telemetry/context.py): lifeline spans and
+    # flight events carry it so the stitched fleet timeline follows the
+    # request across router dispatch / prefill / handoff / decode hops
+    trace_id: Optional[str] = None
+
+    def trace_attr(self) -> Dict[str, str]:
+        return ({"trace_id": self.trace_id}
+                if self.trace_id is not None else {})
 
     def pick(self, logits_row: np.ndarray) -> int:
         from .sampling import host_sample
@@ -150,13 +158,17 @@ class DynamicSplitFuseScheduler:
                temperature: float = 0.0, top_p: float = 1.0,
                top_k: int = 0, seed: Optional[int] = None,
                on_token: Optional[Callable[[int, int, bool], None]]
-               = None) -> None:
+               = None, trace_ctx=None) -> None:
         """temperature/top_p/seed are PER REQUEST (the MII SamplingParams
         surface): mixed greedy and sampled requests compose into the same
         steps; a SEEDED request's tokens are deterministic (independent
         of batch composition — the rng is per request), an unseeded one
         draws fresh OS entropy. ``on_token(uid, token, finished)`` fires
-        for every emitted token (the serve/ streaming hook)."""
+        for every emitted token (the serve/ streaming hook).
+        ``trace_ctx`` (a :class:`~...telemetry.context.TraceContext`)
+        correlates the request's lifeline spans — and, via
+        ``engine.bind_trace``, the engine's batch spans — with its
+        distributed trace."""
         if uid in self._all:
             # results()/metrics() are keyed by uid: admitting a second
             # request under a live key would silently cross their
@@ -187,12 +199,14 @@ class DynamicSplitFuseScheduler:
                        temperature=temperature, top_p=top_p, top_k=top_k,
                        rng=np.random.default_rng(seed), on_token=on_token,
                        t_submit_pc=time.perf_counter())
+        self._bind_trace(req, trace_ctx)
         self._all[uid] = req
         self._queue.append(req)
         self._m_submitted.inc()
         flight.record("request_submit", uid=int(uid),
                       prompt_tokens=len(req.prompt),
-                      max_new_tokens=int(max_new_tokens))
+                      max_new_tokens=int(max_new_tokens),
+                      **req.trace_attr())
         self._update_depth_gauges()
 
     def resume(self, uid: int, prompt: Sequence[int],
@@ -201,7 +215,7 @@ class DynamicSplitFuseScheduler:
                temperature: float = 0.0, top_p: float = 1.0,
                top_k: int = 0, rng_state: Optional[dict] = None,
                on_token: Optional[Callable[[int, int, bool], None]]
-               = None) -> None:
+               = None, trace_ctx=None) -> None:
         """Adopt a request mid-generation (the prefill/decode
         disaggregation path, serve/handoff.py): the engine already holds
         the sequence's KV — restored from a prefill replica — and
@@ -262,6 +276,7 @@ class DynamicSplitFuseScheduler:
                        top_p=top_p, top_k=top_k, rng=rng,
                        on_token=on_token,
                        t_submit_pc=time.perf_counter())
+        self._bind_trace(req, trace_ctx)
         req.prefill_sent = len(req.prompt)
         req.generated = list(map(int, generated))
         req.next_token = int(generated[-1])
@@ -274,8 +289,20 @@ class DynamicSplitFuseScheduler:
         flight.record("request_resume", uid=int(uid),
                       prompt_tokens=len(req.prompt),
                       generated=len(req.generated),
-                      max_new_tokens=int(max_new_tokens))
+                      max_new_tokens=int(max_new_tokens),
+                      **req.trace_attr())
         self._update_depth_gauges()
+
+    def _bind_trace(self, req: _Request, trace_ctx) -> None:
+        """Record the request's distributed trace id and mirror it into
+        the engine's per-uid binding so batch-level engine spans
+        (ragged_step / decode_window / ...) carry it too."""
+        if trace_ctx is None:
+            return
+        req.trace_id = str(trace_ctx.trace_id)
+        bind = getattr(self.engine, "bind_trace", None)
+        if bind is not None:
+            bind(req.uid, req.trace_id)
 
     def pending(self) -> bool:
         return bool(self._queue or self._running)
@@ -307,7 +334,8 @@ class DynamicSplitFuseScheduler:
         now_pc = time.perf_counter()
         t0 = req.t_submit_pc or now_pc
         trace.record("request", t0, now_pc - t0, uid=req.uid,
-                     tokens=len(req.generated), status="cancelled")
+                     tokens=len(req.generated), status="cancelled",
+                     **req.trace_attr())
         if req in self._running:
             self._running.remove(req)
         if req in self._queue:
@@ -336,10 +364,12 @@ class DynamicSplitFuseScheduler:
         now_pc = time.perf_counter()
         start = req.t_first_tok_pc or now_pc
         trace.record("request_decode", start, now_pc - start,
-                     uid=req.uid, tokens=len(req.generated))
+                     uid=req.uid, tokens=len(req.generated),
+                     **req.trace_attr())
         t0 = req.t_submit_pc or start
         trace.record("request", t0, now_pc - t0, uid=req.uid,
-                     tokens=len(req.generated), status="completed")
+                     tokens=len(req.generated), status="completed",
+                     **req.trace_attr())
         self.engine.flush(req.uid)
         if req in self._running:
             self._running.remove(req)
@@ -351,7 +381,8 @@ class DynamicSplitFuseScheduler:
         flight.record("request_finish", uid=int(req.uid),
                       tokens=len(req.generated),
                       ttft_s=round(ttft, 4),
-                      total_s=round(req.finish_t - req.submit_t, 4))
+                      total_s=round(req.finish_t - req.submit_t, 4),
+                      **req.trace_attr())
         self._update_depth_gauges()
 
     def _evict_partial_prefill(self, exclude=()) -> bool:
@@ -429,7 +460,7 @@ class DynamicSplitFuseScheduler:
                 req.t_prefill_pc = time.perf_counter()
                 trace.record("request_queue", req.t_submit_pc,
                              req.t_prefill_pc - req.t_submit_pc,
-                             uid=req.uid)
+                             uid=req.uid, **req.trace_attr())
             uids.append(req.uid)
             toks.append(piece)
             req.prefill_sent += take
@@ -529,7 +560,8 @@ class DynamicSplitFuseScheduler:
                 start = req.t_prefill_pc or req.t_first_tok_pc
                 trace.record("request_prefill", start,
                              req.t_first_tok_pc - start, uid=req.uid,
-                             prompt_tokens=len(req.prompt))
+                             prompt_tokens=len(req.prompt),
+                             **req.trace_attr())
                 self._queue.remove(req)
                 if req.max_new_tokens <= 0:
                     self._finish(req)

@@ -17,14 +17,21 @@ Built on stdlib ``asyncio.start_server`` — no web framework. Endpoints:
   * ``GET /metrics`` — Prometheus text exposition rendered from the
     telemetry registry (queue depth, admission rejections, TTFT/TPOT
     histograms, ... — see docs/TELEMETRY.md).
-  * ``GET /debug/timeline[?uid=N]`` — the telemetry span ring buffer as
-    Chrome-trace-event JSON (load in chrome://tracing or Perfetto);
-    ``uid`` filters to one request's lifeline (queue -> prefill ->
-    decode windows -> finish). See docs/PROFILING.md.
-  * ``GET /statusz`` — one-call forensics snapshot: runtime health plus
-    the recompile-watchdog rollup, the device-memory report, recent
-    anomaly verdicts, and SLO state (p50/p95/p99 TTFT/TPOT from
-    histogram quantiles plus the fast/slow burn rates).
+  * ``GET /debug/timeline[?uid=N][&trace=ID]`` — the telemetry span
+    ring buffer as Chrome-trace-event JSON (load in chrome://tracing or
+    Perfetto); ``uid`` filters to one request's lifeline (queue ->
+    prefill -> decode windows -> finish), ``trace`` to one distributed
+    trace id. In routed mode the body is the STITCHED fleet timeline —
+    one process row per lane (router + each replica) — so
+    ``?trace=<id>`` shows a single request's dispatch -> prefill ->
+    handoff -> decode hops across the fleet. See docs/PROFILING.md.
+  * ``GET /statusz[?format=json]`` — one-call forensics snapshot:
+    runtime health plus the recompile-watchdog rollup, the
+    device-memory report, recent anomaly verdicts, and SLO state
+    (p50/p95/p99 TTFT/TPOT from histogram quantiles plus the fast/slow
+    burn rates). The document is JSON either way; ``format=json`` is
+    the explicit machine-readable contract (other values are a 400, so
+    a dashboard typo cannot silently read the wrong shape).
   * ``POST /debug/postmortem`` — write a post-mortem bundle (metrics
     snapshot, timeline, memory report, compiler fingerprint, last-N
     flight-recorder events, anomaly verdicts) and return its path
@@ -38,9 +45,18 @@ Routed frontend mode: constructed over a
 :class:`~.router.ReplicaRouter` instead of a single
 :class:`~.frontend.ServingEngine`, the same endpoints serve an N-replica
 deployment — ``/generate`` streams through the router's placement
-(prefix affinity, overload re-routing, failover) and ``/statusz`` gains
-``router`` + per-replica ``replicas`` sections. The two are
-duck-compatible (``submit`` / ``health``); nothing else changes.
+(prefix affinity, overload re-routing, failover), ``/statusz`` gains
+``router`` + per-replica ``replicas`` sections, ``/debug/timeline``
+serves the stitched fleet trace and ``/metrics`` federates per-replica
+registries under a ``replica`` label. The two are duck-compatible
+(``submit`` / ``health``); nothing else changes.
+
+Distributed tracing (telemetry/context.py): ``POST /generate`` honors
+the W3C ``traceparent`` (+ ``baggage``) request headers — the request's
+spans on every hop continue the CALLER's trace — or mints a root
+context when absent. The response echoes ``traceparent`` (the request's
+trace id, the server's span id) and the final NDJSON line carries
+``trace_id``, so clients can fetch ``/debug/timeline?trace=<id>``.
 """
 
 import asyncio
@@ -129,7 +145,7 @@ class ServingAPI:
                       writer: asyncio.StreamWriter) -> None:
         try:
             try:
-                method, target, _headers, body = await _read_request(reader)
+                method, target, headers, body = await _read_request(reader)
             except ConnectionError:
                 return
             except (ValueError, asyncio.IncompleteReadError):
@@ -140,17 +156,23 @@ class ServingAPI:
             if method == "GET" and target == "/healthz":
                 _json_response(writer, "200 OK", self.serving.health())
             elif method == "GET" and target == "/metrics":
+                # routed frontend mode: federate per-replica registries
+                # under a `replica` label (falls back to the plain
+                # process-default exposition when replicas share it)
+                fed = getattr(self.serving, "federated_metrics", None)
+                text = (fed() if fed is not None
+                        else self.registry.render_prometheus())
                 writer.write(_response_head(
                     "200 OK", "text/plain; version=0.0.4; charset=utf-8")
-                    + self.registry.render_prometheus().encode())
+                    + text.encode())
             elif method == "GET" and target == "/debug/timeline":
                 self._timeline(writer, query)
             elif method == "GET" and target == "/statusz":
-                _json_response(writer, "200 OK", self._statusz())
+                self._statusz_response(writer, query)
             elif method == "POST" and target == "/debug/postmortem":
                 await self._postmortem(writer)
             elif method == "POST" and target == "/generate":
-                await self._generate(reader, writer, body)
+                await self._generate(reader, writer, body, headers)
             else:
                 _json_response(writer, "404 Not Found",
                                {"error": f"no route {method} {target}"})
@@ -166,21 +188,51 @@ class ServingAPI:
 
     def _timeline(self, writer, query: str) -> None:
         """Chrome-trace JSON of the span ring buffer (``?uid=N`` filters
-        to one request's correlated spans)."""
+        to one request's correlated spans, ``?trace=ID`` to one
+        distributed trace). Routed mode serves the STITCHED fleet form
+        — a process row per lane — via the router's
+        :meth:`~.router.ReplicaRouter.fleet_timeline`."""
         from urllib.parse import parse_qs
 
         from ....telemetry import timeline
         from ....telemetry import trace as ds_trace
+        params = parse_qs(query)
+        trace_id = params.get("trace", [None])[0]
+        fleet = getattr(self.serving, "fleet_timeline", None)
+        if fleet is not None:
+            if params.get("uid"):
+                _json_response(
+                    writer, "400 Bad Request",
+                    {"error": "routed timeline filters by ?trace=<id> "
+                              "(uids are per replica, not fleet-wide)"})
+                return
+            _json_response(writer, "200 OK", fleet(trace_id=trace_id))
+            return
         spans = ds_trace.export()
         try:
-            uid = parse_qs(query).get("uid")
+            uid = params.get("uid")
             if uid:
                 spans = timeline.request_spans(int(uid[0]), spans)
         except (TypeError, ValueError):
             _json_response(writer, "400 Bad Request",
                            {"error": "uid must be an integer"})
             return
+        if trace_id:
+            spans = timeline.trace_spans(trace_id, spans)
         _json_response(writer, "200 OK", timeline.to_chrome_trace(spans))
+
+    def _statusz_response(self, writer, query: str) -> None:
+        """``/statusz`` with the explicit ``?format=json`` contract:
+        the document is JSON either way, but an unknown format is a 400
+        instead of a silently-ignored parameter."""
+        from urllib.parse import parse_qs
+        fmt = parse_qs(query).get("format", ["json"])[0]
+        if fmt != "json":
+            _json_response(writer, "400 Bad Request",
+                           {"error": f"unsupported format {fmt!r} "
+                                     f"(only 'json')"})
+            return
+        _json_response(writer, "200 OK", self._statusz())
 
     def _statusz(self) -> dict:
         import math
@@ -242,7 +294,9 @@ class ServingAPI:
             _json_response(writer, "500 Internal Server Error",
                            {"error": f"{type(e).__name__}: {e}"})
 
-    async def _generate(self, reader, writer, body: bytes) -> None:
+    async def _generate(self, reader, writer, body: bytes,
+                        headers: Optional[dict] = None) -> None:
+        from ....telemetry import context as trace_context
         # coerce every field up front: an unchecked value (e.g.
         # temperature="hot") would only blow up inside scheduler.step(),
         # where _step_error fails EVERY in-flight request
@@ -263,8 +317,20 @@ class ServingAPI:
                                      "list of token ids (and numeric "
                                      "sampling/deadline fields)"})
             return
+        # distributed tracing: continue the caller's W3C traceparent
+        # (+ baggage) headers, or mint the root HERE — binding before
+        # submit means both the single-engine frontend and the router
+        # continue ONE identity, and the API layer can echo it back.
+        # child() keeps the caller's trace id but mints THIS server's
+        # span id, so the echoed traceparent never hands the caller its
+        # own span back (a client parenting follow-ups on it would
+        # self-parent)
+        upstream = trace_context.from_headers(headers or {})
+        ctx = (upstream.child() if upstream is not None
+               else trace_context.new_context())
         try:
-            stream = await self.serving.submit(prompt, max_new, **kw)
+            with trace_context.use(ctx):
+                stream = await self.serving.submit(prompt, max_new, **kw)
         except OverloadedError as e:
             # Retry-After carries the machine-readable backoff hint the
             # admission layer attached (integer seconds, ceil'd — the
@@ -283,7 +349,9 @@ class ServingAPI:
             _json_response(writer, "400 Bad Request", {"error": str(e)})
             return
 
-        writer.write(_response_head("200 OK", "application/x-ndjson"))
+        writer.write(_response_head(
+            "200 OK", "application/x-ndjson",
+            {"traceparent": ctx.to_traceparent()}))
         # with Connection: close the client sends nothing more; read()
         # completing means it hung up — cancel so the KV blocks free
         hangup = asyncio.ensure_future(reader.read(1))
@@ -311,7 +379,8 @@ class ServingAPI:
                 writer.write(json.dumps({"token": tok}).encode() + b"\n")
                 await writer.drain()
             tail = {"done": True, "status": status, "uid": stream.uid,
-                    "n": len(stream.tokens), "tokens": stream.tokens}
+                    "n": len(stream.tokens), "tokens": stream.tokens,
+                    "trace_id": ctx.trace_id}
             if detail:
                 tail["detail"] = detail
             writer.write(json.dumps(tail).encode() + b"\n")

@@ -24,6 +24,7 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
+from ....telemetry import context as trace_context
 from ....telemetry.anomaly import (DiagnosticsConfig, KVLeakDetector,
                                    SLOBurnRateMonitor, StallWatchdog)
 from ....telemetry.recorder import get_recorder
@@ -103,6 +104,10 @@ class _Entry:
     on_token: object = None
     on_end: object = None
     state: str = "pending"
+    # distributed TraceContext (telemetry/context.py), captured on the
+    # asyncio side: the serving-loop thread does not share the asyncio
+    # contextvar context, so the entry carries it across that boundary
+    trace_ctx: object = None
 
 
 class TokenStream:
@@ -194,10 +199,16 @@ class ServingEngine:
     """
 
     def __init__(self, engine, config: Optional[ServingConfig] = None,
-                 clock=time.perf_counter, bridge=None):
+                 clock=time.perf_counter, bridge=None,
+                 lane: Optional[str] = None):
         """``bridge``: optional :class:`~...telemetry.TelemetryBridge`;
         the loop final-flushes (``close()``) it on drain/stop so the last
-        partial flush interval reaches the monitor backends."""
+        partial flush interval reaches the monitor backends.
+
+        ``lane``: fleet lane name for the serving loop's spans (the
+        replica name under a router; see telemetry/trace.py
+        ``set_lane``) — the stitched fleet timeline groups spans into
+        one process row per lane."""
         self.config = config or ServingConfig()
         self.clock = clock
         if self.config.ragged_attention is not None:
@@ -211,7 +222,7 @@ class ServingEngine:
             self.scheduler, self.admission,
             max_inflight=self.config.max_inflight,
             idle_wait_s=self.config.idle_wait_s, clock=clock,
-            bridge=bridge, diagnostics=self.diagnostics)
+            bridge=bridge, diagnostics=self.diagnostics, lane=lane)
         self._uids = itertools.count(1)
         self._stopped = False
 
@@ -268,6 +279,11 @@ class ServingEngine:
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         uid = next(self._uids)
+        # distributed tracing: continue the caller's context (bound by
+        # the HTTP layer from a traceparent header, or by the router at
+        # dispatch) or mint a fresh root — every request has ONE trace
+        # identity from here to its last decode token
+        ctx = trace_context.get_or_new()
         stream = TokenStream(self, uid, asyncio.get_running_loop())
         entry = _Entry(
             uid=uid, prompt=list(map(int, prompt)),
@@ -277,7 +293,8 @@ class ServingEngine:
             weight=weight,
             deadline_t=(self.clock() + deadline_s
                         if deadline_s is not None else None),
-            on_token=stream._push_token, on_end=stream._push_end)
+            on_token=stream._push_token, on_end=stream._push_end,
+            trace_ctx=ctx)
         self.admission.try_admit(entry)     # raises OverloadedError
         self._loop_runner.register(entry)
         return stream
@@ -288,7 +305,8 @@ class ServingEngine:
                      eos_token_id: Optional[int] = None,
                      temperature: float = 0.0, top_p: float = 1.0,
                      top_k: int = 0, rng_state=None,
-                     deadline_s: Optional[float] = None) -> TokenStream:
+                     deadline_s: Optional[float] = None,
+                     trace_ctx=None) -> TokenStream:
         """Adopt a handed-off request: restore the KV ``pack`` exported
         by a prefill replica and continue decoding it here. The stream
         yields only the tokens decoded on THIS runtime — the caller
@@ -296,6 +314,12 @@ class ServingEngine:
         token). Restore and scheduler adoption run on the loop thread
         (the engine is not thread-safe); a restore failure ends the
         stream with status 'error'.
+
+        ``trace_ctx`` continues the request's distributed trace across
+        the handoff; when omitted, the pack's wire payload (embedded by
+        the prefill side — serve/handoff.py) or the caller's bound
+        context is used, so the decode hop lands in the SAME trace as
+        router dispatch and prefill.
 
         Resumed requests bypass the admission queue — there is no
         pending phase to queue through; the ROUTER is the admission
@@ -318,7 +342,10 @@ class ServingEngine:
             deadline_t=(self.clock() + deadline_s
                         if deadline_s is not None else None),
             on_token=stream._push_token, on_end=stream._push_end,
-            state="inflight")
+            state="inflight",
+            trace_ctx=(trace_ctx if trace_ctx is not None
+                       else trace_context.from_wire(pack.get("trace"))
+                       or trace_context.current()))
         self._loop_runner.resume(entry, pack,
                                  generated=list(map(int, generated)),
                                  rng_state=rng_state)

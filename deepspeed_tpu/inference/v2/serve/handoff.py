@@ -56,10 +56,15 @@ def _scatter_blocks(leaf, idx, data):
     return leaf.at[:, idx].set(data)
 
 
-def export_sequence(engine, uid: int) -> Dict:
+def export_sequence(engine, uid: int, trace_ctx=None) -> Dict:
     """Snapshot ``uid``'s KV blocks and descriptor from ``engine`` into
     a host-side pack (plain numpy + ints). The sequence stays live on
-    the source engine; callers flush it once the handoff is accepted."""
+    the source engine; callers flush it once the handoff is accepted.
+
+    ``trace_ctx`` (telemetry/context.py) rides the descriptor as a wire
+    payload, so the decode side CONTINUES the prefill side's
+    distributed trace — the trace id must cross the process boundary
+    inside the handoff itself for remote replicas, not alongside it."""
     sm = engine.state_manager
     seq = sm.seqs.get(uid)
     if seq is None:
@@ -71,7 +76,7 @@ def export_sequence(engine, uid: int) -> Dict:
     idx[:nb] = blocks
     kv = {key: np.asarray(_gather_blocks(leaf, jnp.asarray(idx)))[:, :nb]
           for key, leaf in engine.kv_cache.items()}
-    return {
+    pack = {
         "uid": int(uid),
         "seen_tokens": int(seq.seen_tokens),
         "n_blocks": nb,
@@ -79,26 +84,58 @@ def export_sequence(engine, uid: int) -> Dict:
         "token_log": [int(t) for t in seq.token_log],
         "kv": kv,
     }
+    if trace_ctx is not None:
+        pack["trace"] = trace_ctx.to_wire()
+    return pack
 
 
 def serialize(pack: Dict) -> bytes:
     """Pack -> one self-describing ``.npz`` buffer (the wire format)."""
     descriptor = {k: pack[k] for k in
                   ("uid", "seen_tokens", "n_blocks", "block_size",
-                   "token_log")}
+                   "token_log", "trace") if k in pack}
+    kv_wire = {}
+    kv_dtypes = {}
+    for key, arr in pack["kv"].items():
+        arr = np.ascontiguousarray(arr)
+        kv_dtypes[key] = arr.dtype.name
+        if arr.dtype.kind == "V":
+            # numpy cannot round-trip ml_dtypes leaves (bfloat16, fp8)
+            # through .npz — np.load hands back an opaque void dtype —
+            # so ship the raw bytes and view them back on the far side
+            arr = arr.view(np.uint8)
+        kv_wire[f"kv_{key}"] = arr
+    descriptor["kv_dtypes"] = kv_dtypes
     bio = io.BytesIO()
     np.savez(bio,
              **{_DESCRIPTOR_KEY: np.frombuffer(
                  json.dumps(descriptor).encode(), np.uint8)},
-             **{f"kv_{key}": arr for key, arr in pack["kv"].items()})
+             **kv_wire)
     return bio.getvalue()
+
+
+def _wire_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
 
 
 def deserialize(buf: bytes) -> Dict:
     with np.load(io.BytesIO(buf)) as z:
         pack = json.loads(bytes(z[_DESCRIPTOR_KEY]).decode())
-        pack["kv"] = {name[3:]: z[name] for name in z.files
-                      if name.startswith("kv_")}
+        dtypes = pack.pop("kv_dtypes", {})
+        kv = {}
+        for name in z.files:
+            if not name.startswith("kv_"):
+                continue
+            key, arr = name[3:], z[name]
+            want = dtypes.get(key)
+            if want and arr.dtype.name != want:
+                arr = arr.view(_wire_dtype(want))
+            kv[key] = arr
+        pack["kv"] = kv
     return pack
 
 

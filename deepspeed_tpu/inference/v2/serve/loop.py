@@ -33,9 +33,15 @@ class ServingLoop:
     def __init__(self, scheduler, admission, *,
                  max_inflight: Optional[int] = None,
                  idle_wait_s: float = 0.002, clock=time.perf_counter,
-                 bridge=None, diagnostics=None):
+                 bridge=None, diagnostics=None,
+                 lane: Optional[str] = None):
         self.scheduler = scheduler
         self.admission = admission
+        # fleet lane name (telemetry/trace.py set_lane): the loop thread
+        # names its spans' lane once at start, so N in-process replica
+        # loops sharing one trace ring stay distinguishable and the
+        # stitched fleet timeline gives each its own process row
+        self.lane = lane
         # optional TelemetryBridge: final-flushed (close()) when the loop
         # exits, so a drain's last partial flush interval isn't dropped
         self.bridge = bridge
@@ -163,7 +169,8 @@ class ServingLoop:
                 entry.max_new_tokens, eos_token_id=entry.eos_token_id,
                 temperature=entry.temperature, top_p=entry.top_p,
                 top_k=entry.top_k, rng_state=rng_state,
-                on_token=self._make_on_token(entry))
+                on_token=self._make_on_token(entry),
+                trace_ctx=getattr(entry, "trace_ctx", None))
         except Exception as e:
             self.scheduler.engine.flush(entry.uid)
             self._end(entry, "error", f"{type(e).__name__}: {e}")
@@ -231,7 +238,8 @@ class ServingLoop:
                     eos_token_id=entry.eos_token_id,
                     temperature=entry.temperature, top_p=entry.top_p,
                     top_k=entry.top_k, seed=entry.seed,
-                    on_token=self._make_on_token(entry))
+                    on_token=self._make_on_token(entry),
+                    trace_ctx=getattr(entry, "trace_ctx", None))
             except Exception as e:   # e.g. prompt exceeds max_seq_len
                 self._end(entry, "error", f"{type(e).__name__}: {e}")
                 continue
@@ -316,6 +324,9 @@ class ServingLoop:
                 self._end(entry, "cancelled")
 
     def _run(self) -> None:
+        if self.lane is not None:
+            from ....telemetry import trace
+            trace.set_lane(self.lane)
         while not self._stop:
             self._run_cmds()
             if self._stop:

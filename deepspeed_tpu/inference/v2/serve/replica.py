@@ -24,12 +24,15 @@ same methods.
 """
 
 import asyncio
+import contextlib
 import itertools
 import threading
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ....telemetry import trace
+from ....telemetry.registry import scoped_registry
 from . import handoff
 from .frontend import ServingConfig, ServingEngine
 
@@ -39,13 +42,26 @@ class Replica:
 
     ``state`` is router-owned: 'up' (routable) | 'draining' (finishing
     in-flight work, no new routes) | 'drained' (clean exit) | 'dead'
-    (heartbeat expired or loop thread gone)."""
+    (heartbeat expired or loop thread gone).
+
+    ``registry``: optional per-replica
+    :class:`~....telemetry.MetricsRegistry` — the serving stack
+    (scheduler, admission, loop, diagnostics) is then BUILT inside a
+    ``scoped_registry`` block so its series land there instead of the
+    process default, and the router's ``/metrics`` federates every
+    replica registry under a ``replica`` label. The engine was
+    constructed earlier, so engine-level series stay process-global."""
 
     def __init__(self, name: str, engine,
-                 config: Optional[ServingConfig] = None, bridge=None):
+                 config: Optional[ServingConfig] = None, bridge=None,
+                 registry=None):
         self.name = name
         self.engine = engine
-        self.serving = ServingEngine(engine, config, bridge=bridge)
+        self.registry = registry
+        with (scoped_registry(registry) if registry is not None
+              else contextlib.nullcontext()):
+            self.serving = ServingEngine(engine, config, bridge=bridge,
+                                         lane=name)
         self.state = "up"
         self.started = False
 
@@ -110,18 +126,20 @@ class PrefillReplica:
     async def prefill(self, prompt: Sequence[int], max_new_tokens: int, *,
                       eos_token_id: Optional[int] = None,
                       temperature: float = 0.0, top_p: float = 1.0,
-                      top_k: int = 0, seed: Optional[int] = None
+                      top_k: int = 0, seed: Optional[int] = None,
+                      trace_ctx=None
                       ) -> Tuple[int, Optional[bytes], Optional[dict],
                                  bool]:
         return await asyncio.to_thread(
             self.prefill_sync, prompt, max_new_tokens,
             eos_token_id=eos_token_id, temperature=temperature,
-            top_p=top_p, top_k=top_k, seed=seed)
+            top_p=top_p, top_k=top_k, seed=seed, trace_ctx=trace_ctx)
 
     def prefill_sync(self, prompt: Sequence[int], max_new_tokens: int, *,
                      eos_token_id: Optional[int] = None,
                      temperature: float = 0.0, top_p: float = 1.0,
-                     top_k: int = 0, seed: Optional[int] = None
+                     top_k: int = 0, seed: Optional[int] = None,
+                     trace_ctx=None
                      ) -> Tuple[int, Optional[bytes], Optional[dict],
                                 bool]:
         """Run one whole-prompt prefill and hand the sequence off.
@@ -140,24 +158,34 @@ class PrefillReplica:
         colocated cache bit-for-bit."""
         from ..sampling import host_sample
         with self._lock:
-            uid = next(self._uids)
-            logits = self.engine.put(
-                [uid], [np.asarray(list(prompt), np.int64)])
-            rng = np.random.default_rng(seed)
-            tok = int(host_sample(np.asarray(logits[0]), rng,
-                                  temperature, top_p, top_k))
-            finished = (max_new_tokens <= 1
-                        or (eos_token_id is not None
-                            and tok == eos_token_id))
-            payload = None
-            rng_state = None
-            if not finished:
-                payload = handoff.serialize(
-                    handoff.export_sequence(self.engine, uid))
-                rng_state = rng.bit_generator.state
-            self.engine.flush(uid)
-            self._m_prefills.labels(replica=self.name).inc()
-            return tok, payload, rng_state, finished
+            # asyncio.to_thread runs this on a pooled worker thread:
+            # name its fleet lane for the duration so the engine's
+            # prefill span lands in THIS replica's timeline row
+            prev_lane = trace.current_lane()
+            trace.set_lane(self.name)
+            try:
+                uid = next(self._uids)
+                if trace_ctx is not None:
+                    self.engine.bind_trace(uid, trace_ctx.trace_id)
+                logits = self.engine.put(
+                    [uid], [np.asarray(list(prompt), np.int64)])
+                rng = np.random.default_rng(seed)
+                tok = int(host_sample(np.asarray(logits[0]), rng,
+                                      temperature, top_p, top_k))
+                finished = (max_new_tokens <= 1
+                            or (eos_token_id is not None
+                                and tok == eos_token_id))
+                payload = None
+                rng_state = None
+                if not finished:
+                    payload = handoff.serialize(handoff.export_sequence(
+                        self.engine, uid, trace_ctx=trace_ctx))
+                    rng_state = rng.bit_generator.state
+                self.engine.flush(uid)
+                self._m_prefills.labels(replica=self.name).inc()
+                return tok, payload, rng_state, finished
+            finally:
+                trace.set_lane(prev_lane)
 
     def health(self) -> dict:
         sm = self.engine.state_manager
@@ -167,11 +195,17 @@ class PrefillReplica:
 
 
 def build_replicas(engines: Sequence, config: Optional[ServingConfig]
-                   = None, name_prefix: str = "replica") -> List[Replica]:
+                   = None, name_prefix: str = "replica",
+                   own_registries: bool = False) -> List[Replica]:
     """Wrap N engines as named replicas sharing one serving config
     template (each replica gets its OWN config instance — admission
-    state is per replica)."""
+    state is per replica). ``own_registries=True`` gives every replica
+    its own :class:`MetricsRegistry` (the federation unit the router's
+    ``/metrics`` labels per replica)."""
     import copy
+
+    from ....telemetry.registry import MetricsRegistry
     return [Replica(f"{name_prefix}{i}", eng,
-                    copy.deepcopy(config) if config is not None else None)
+                    copy.deepcopy(config) if config is not None else None,
+                    registry=MetricsRegistry() if own_registries else None)
             for i, eng in enumerate(engines)]

@@ -46,16 +46,21 @@ import hashlib
 import itertools
 import time
 from collections import OrderedDict
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ....telemetry import context as trace_context
+from ....telemetry import trace
+from ....telemetry.anomaly import DiagnosticsConfig, SLOBurnRateMonitor
 from ..ragged.ragged_manager import prefix_digest
 from . import handoff as handoff_mod
 from .admission import OverloadedError
 from .frontend import DeadlineExceeded, RequestFailed
 from .replica import PrefillReplica, Replica
+
+_ROUTER_LANE = "router"
 
 
 @dataclass
@@ -82,6 +87,13 @@ class RouterConfig:
     disaggregated: bool = False
     # consistent-hash ring points per replica
     ring_points: int = 32
+    # fleet-level diagnostics (telemetry/anomaly.py): the router runs an
+    # SLO burn monitor over the AGGREGATED replica histograms
+    # (fleet_slo_burn_rate gauges / fleet_slo_burn verdicts) and — when
+    # postmortem_on_anomaly — answers any replica's anomaly verdict
+    # with ONE fleet post-mortem bundle (postmortem.write_fleet_bundle)
+    diagnostics: DiagnosticsConfig = field(
+        default_factory=DiagnosticsConfig)
 
 
 class RoutedStream:
@@ -153,17 +165,22 @@ class _RoutedRequest:
 
     def __init__(self, uid: int, prompt: List[int], max_new_tokens: int,
                  kw: dict, deadline_t: Optional[float],
-                 stream: RoutedStream):
+                 stream: RoutedStream, ctx=None):
         self.uid = uid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.kw = kw                 # submit() keywords sans deadline_s
         self.deadline_t = deadline_t  # absolute, router clock
         self.stream = stream
+        self.ctx = ctx               # distributed TraceContext
         self.replica: Optional[str] = None
         self.inner = None            # the replica-side TokenStream
         self.pump: Optional[asyncio.Task] = None
         self.handed_off = False      # disaggregated: KV moved already
+
+    def trace_attr(self) -> dict:
+        return ({"trace_id": self.ctx.trace_id}
+                if self.ctx is not None else {})
 
 
 class _HashRing:
@@ -243,6 +260,26 @@ class ReplicaRouter:
         self._monitor: Optional[asyncio.Task] = None
         self._stopped = False
         self._init_telemetry()
+        # fleet SLO burn monitor: burns over the replica registries'
+        # aggregated TTFT/TPOT histograms (one registry per replica
+        # when Replica(registry=...) is used; otherwise the shared
+        # process registry already aggregates the fleet). Distinct
+        # gauge/verdict names so per-replica monitors never collide.
+        regs = [r.registry for r in self.replicas
+                if getattr(r, "registry", None) is not None]
+        self.fleet_slo: Optional[SLOBurnRateMonitor] = None
+        if config.diagnostics.enabled:
+            self.fleet_slo = SLOBurnRateMonitor(
+                config.diagnostics, registries=regs or None,
+                gauge_name="fleet_slo_burn_rate",
+                verdict_kind="fleet_slo_burn")
+        # fleet post-mortem trigger state: per KIND, the wall clock of
+        # the newest anomaly verdict whose bundle attempt ran (a failed
+        # write must leave its verdicts un-consumed for the next tick)
+        self._fleet_pm_start = time.time()
+        self._fleet_pm_seen: Dict[str, float] = {}
+        self._last_fleet_bundle: Optional[str] = None
+        self._fleet_bundle_paths: set = set()
 
     def _init_telemetry(self):
         from ....telemetry import get_registry
@@ -291,6 +328,24 @@ class ReplicaRouter:
         self._m_handoff_bytes = reg.counter(
             "router_handoff_bytes_total",
             "serialized KV handoff payload bytes moved")
+        # ONE source for the per-replica heartbeat signal: /statusz,
+        # check_replicas() and dashboards all read this gauge (fed by
+        # StallWatchdog.heartbeat_age via replica_heartbeat_age())
+        self._m_heartbeat = reg.gauge(
+            "router_replica_heartbeat_age_seconds",
+            "seconds each replica's serving loop has been stuck "
+            "mid-step (0 when idle / healthy; the dead-replica "
+            "detector fires past heartbeat_timeout_s)",
+            labelnames=("replica",))
+        # labeled series resolved once: replica_heartbeat_age() runs on
+        # the per-request dispatch path (check_replicas -> _is_dead),
+        # which must not pay a registry-lock labels() lookup per probe
+        self._hb_series = {r.name: self._m_heartbeat.labels(replica=r.name)
+                           for r in self.replicas}
+        self._m_fleet_bundles = reg.counter(
+            "router_fleet_postmortems_total",
+            "fleet post-mortem bundles written in answer to a replica "
+            "anomaly verdict")
         self._m_replicas.set(len(self.replicas))
         for r in self.replicas:
             self._m_state.labels(replica=r.name).set(1)
@@ -348,6 +403,51 @@ class ReplicaRouter:
                 await self.check_replicas()
             except Exception:       # monitoring must never kill routing
                 pass
+            try:
+                if self.fleet_slo is not None:
+                    self.fleet_slo.tick()
+            except Exception:
+                pass
+            try:
+                await self._maybe_fleet_postmortem()
+            except Exception:
+                pass
+
+    async def _maybe_fleet_postmortem(self) -> None:
+        """Answer any NEW anomaly verdict (raised by any replica's
+        detectors — they share the process ledger — or the fleet SLO
+        monitor) with one fleet bundle: every replica's evidence plus
+        the router's routing state under a cross-replica manifest.
+        Per-kind rate-limited like single-process bundles."""
+        if not self.config.diagnostics.postmortem_on_anomaly:
+            return
+        from ....telemetry import anomaly as ds_anomaly
+        from ....telemetry import postmortem as ds_postmortem
+        # one bundle attempt per DISTINCT fresh kind: collapsing to the
+        # newest verdict would let a chatty kind suppress the others at
+        # the trigger level — the very failure the per-kind rate limit
+        # exists to prevent. The watermark advances per kind and only
+        # AFTER its attempt ran, so a failed write (disk full) leaves
+        # the incident's verdicts fresh for the next monitor tick.
+        by_kind: Dict[str, float] = {}
+        for v in ds_anomaly.recent():
+            kind, wall = v.get("kind"), v.get("wall", 0.0)
+            if wall > self._fleet_pm_seen.get(kind, self._fleet_pm_start):
+                by_kind[kind] = max(by_kind.get(kind, 0.0), wall)
+        for kind, wall in by_kind.items():
+            # bundle writing is disk I/O at exactly the wrong moment —
+            # keep it off the event loop so live streams never stall
+            # behind it
+            path = await asyncio.to_thread(
+                ds_postmortem.maybe_write_fleet_bundle, kind, self,
+                self.config.diagnostics)
+            self._fleet_pm_seen[kind] = wall
+            if path is not None and path not in self._fleet_bundle_paths:
+                # rate-limited calls return the previous bundle's path —
+                # only a NEW directory counts as a bundle written
+                self._fleet_bundle_paths.add(path)
+                self._last_fleet_bundle = path
+                self._m_fleet_bundles.inc()
 
     # -- placement ------------------------------------------------------
     def _routable(self) -> List[Replica]:
@@ -407,12 +507,16 @@ class ReplicaRouter:
             raise OverloadedError("draining", "router is stopped")
         await self.check_replicas()
         uid = next(self._uids)
+        # one trace identity from router dispatch to the last decode
+        # token: continue the HTTP layer's bound context (traceparent
+        # header) or mint the root here — the router IS the fleet entry
+        ctx = trace_context.get_or_new()
         stream = RoutedStream(self, uid)
         deadline_s = kw.pop("deadline_s", None)
         rec = _RoutedRequest(
             uid, list(map(int, prompt)), int(max_new_tokens), dict(kw),
             self.clock() + deadline_s if deadline_s is not None else None,
-            stream)
+            stream, ctx=ctx)
         # register BEFORE dispatching: a request that finishes inside
         # dispatch (finished-at-prefill, handoff error) must find its
         # record to pop, or it would linger in _requests forever
@@ -458,23 +562,43 @@ class ReplicaRouter:
     async def _dispatch(self, rec: _RoutedRequest) -> None:
         """Pick a replica and submit; on shed, back the replica off for
         its retry hint and try the next-best until one admits."""
+        t0 = time.perf_counter()
         name, digests = self._pick_for(rec)
         last_err: Optional[OverloadedError] = None
         for replica in self._candidates(name):
             try:
-                inner = await replica.serving.submit(
-                    rec.prompt, rec.max_new_tokens,
-                    deadline_s=self._remaining_deadline(rec), **rec.kw)
+                # bind the request's trace context around the replica
+                # submit: the replica frontend CONTINUES it (get_or_new
+                # reads the contextvar) instead of minting a new root —
+                # one trace id from dispatch to the last decode token
+                with trace_context.use(rec.ctx):
+                    inner = await replica.serving.submit(
+                        rec.prompt, rec.max_new_tokens,
+                        deadline_s=self._remaining_deadline(rec),
+                        **rec.kw)
             except OverloadedError as e:
                 last_err = e
-                self._backoff_until[replica.name] = self.clock() + (
-                    e.retry_after_s if e.retry_after_s is not None
-                    else self.config.default_backoff_s)
+                backoff = (e.retry_after_s if e.retry_after_s is not None
+                           else self.config.default_backoff_s)
+                self._backoff_until[replica.name] = self.clock() + backoff
                 self._m_reroutes.labels(reason=e.reason).inc()
+                trace.record("router_reroute", time.perf_counter(), 0.0,
+                             lane=_ROUTER_LANE, uid=rec.uid,
+                             replica=replica.name, reason=e.reason,
+                             backoff_s=round(backoff, 3),
+                             **rec.trace_attr())
                 continue
             self._attach(rec, replica.name, inner, digests)
+            trace.record("router_dispatch", t0,
+                         time.perf_counter() - t0, lane=_ROUTER_LANE,
+                         uid=rec.uid, replica=replica.name,
+                         **rec.trace_attr())
             return
         self._m_shed.inc()
+        trace.record("router_shed", t0, time.perf_counter() - t0,
+                     lane=_ROUTER_LANE, uid=rec.uid,
+                     reason=last_err.reason if last_err else
+                     "no_replicas", **rec.trace_attr())
         raise OverloadedError(
             last_err.reason if last_err else "no_replicas",
             f"all routable replicas overloaded: "
@@ -488,6 +612,7 @@ class ReplicaRouter:
         to a decode replica picked by the normal placement. The decode
         replica is chosen BEFORE prefill runs (shed-before-compute: an
         unroutable fleet never burns prefill flops)."""
+        t0 = time.perf_counter()
         name, digests = self._pick_for(rec)
         # the decode-side KV-slot precheck, before any prefill flops are
         # burned (replicas share one layout, so any state manager works)
@@ -502,12 +627,20 @@ class ReplicaRouter:
             return
         pw = self.prefill_replicas[
             next(self._rr_prefill) % len(self.prefill_replicas)]
+        # the dispatch span closes at the routing DECISION (decode
+        # candidate + prefill worker chosen), before any prefill flops —
+        # the first hop of the request's distributed trace
+        trace.record("router_dispatch", t0, time.perf_counter() - t0,
+                     lane=_ROUTER_LANE, uid=rec.uid, replica=name,
+                     prefill_replica=pw.name, disaggregated=True,
+                     **rec.trace_attr())
         tok, payload, rng_state, finished = await pw.prefill(
             rec.prompt, rec.max_new_tokens,
             eos_token_id=rec.kw.get("eos_token_id"),
             temperature=rec.kw.get("temperature", 0.0),
             top_p=rec.kw.get("top_p", 1.0),
-            top_k=rec.kw.get("top_k", 0), seed=rec.kw.get("seed"))
+            top_k=rec.kw.get("top_k", 0), seed=rec.kw.get("seed"),
+            trace_ctx=rec.ctx)
         rec.stream._push_token(tok)
         if finished:
             # NO affinity recorded: the decode candidate never received
@@ -516,18 +649,21 @@ class ReplicaRouter:
             rec.replica = pw.name
             self._finish(rec, "completed", None)
             return
+        t_h = time.perf_counter()
         pack = await asyncio.to_thread(handoff_mod.deserialize, payload)
         last_err: Optional[OverloadedError] = None
         for replica in self._candidates(name):
             try:
-                inner = await replica.serving.resume(
-                    pack, prompt=rec.prompt, generated=[tok],
-                    max_new_tokens=rec.max_new_tokens,
-                    eos_token_id=rec.kw.get("eos_token_id"),
-                    temperature=rec.kw.get("temperature", 0.0),
-                    top_p=rec.kw.get("top_p", 1.0),
-                    top_k=rec.kw.get("top_k", 0), rng_state=rng_state,
-                    deadline_s=self._remaining_deadline(rec))
+                with trace_context.use(rec.ctx):
+                    inner = await replica.serving.resume(
+                        pack, prompt=rec.prompt, generated=[tok],
+                        max_new_tokens=rec.max_new_tokens,
+                        eos_token_id=rec.kw.get("eos_token_id"),
+                        temperature=rec.kw.get("temperature", 0.0),
+                        top_p=rec.kw.get("top_p", 1.0),
+                        top_k=rec.kw.get("top_k", 0),
+                        rng_state=rng_state,
+                        deadline_s=self._remaining_deadline(rec))
             except OverloadedError as e:
                 last_err = e
                 self._backoff_until[replica.name] = self.clock() + (
@@ -538,6 +674,13 @@ class ReplicaRouter:
             rec.handed_off = True
             self._m_handoffs.inc()
             self._m_handoff_bytes.inc(len(payload))
+            # the KV transfer hop: wire deserialize -> decode-side
+            # restore/adopt, between the prefill span (prefill lane) and
+            # the first decode span (decode lane)
+            trace.record("router_handoff", t_h,
+                         time.perf_counter() - t_h, lane=_ROUTER_LANE,
+                         uid=rec.uid, src=pw.name, dst=replica.name,
+                         payload_bytes=len(payload), **rec.trace_attr())
             self._attach(rec, replica.name, inner, digests)
             return
         self._m_shed.inc()
@@ -602,12 +745,27 @@ class ReplicaRouter:
         replica.state = "drained"
         self._m_state.labels(replica=name).set(0)
 
+    def replica_heartbeat_age(self, replica: Replica) -> Optional[float]:
+        """THE source for the per-replica heartbeat signal: reads the
+        stall watchdog's ``heartbeat_age``, publishes it as the
+        ``router_replica_heartbeat_age_seconds`` gauge (0 = idle or
+        healthy) and returns it — ``check_replicas()``, ``/statusz``
+        and dashboards all read this one probe instead of each asking
+        the watchdog themselves."""
+        age = replica.heartbeat_age()
+        series = self._hb_series.get(replica.name)
+        if series is None:       # replica added after _init_telemetry
+            series = self._m_heartbeat.labels(replica=replica.name)
+            self._hb_series[replica.name] = series
+        series.set(age if age is not None else 0.0)
+        return age
+
     def _is_dead(self, replica: Replica) -> bool:
         if not replica.started or replica.state != "up":
             return False
         if not replica.alive():
             return True
-        age = replica.heartbeat_age()
+        age = self.replica_heartbeat_age(replica)
         return (age is not None
                 and age > self.config.heartbeat_timeout_s)
 
@@ -619,6 +777,8 @@ class ReplicaRouter:
         Returns the names declared dead this call."""
         died = [r for r in self.replicas if self._is_dead(r)]
         for replica in died:
+            t0 = time.perf_counter()
+            requeued = failed = 0
             replica.state = "dead"
             self._m_state.labels(replica=replica.name).set(-1)
             self._m_dead.inc()
@@ -641,6 +801,7 @@ class ReplicaRouter:
                     # queued / not-yet-prefilled: safe to re-run
                     # elsewhere (prompts are idempotent)
                     self._m_requeued.inc()
+                    requeued += 1
                     try:
                         await self._dispatch(rec)
                     except OverloadedError as e:
@@ -648,10 +809,15 @@ class ReplicaRouter:
                                      f"re-enqueue after replica death "
                                      f"shed: {e}")
                 else:
+                    failed += 1
                     self._finish(
                         rec, "error",
                         f"replica {replica.name} died mid-stream "
                         f"({rec.stream.pushed} tokens emitted)")
+            trace.record("router_failover", t0,
+                         time.perf_counter() - t0, lane=_ROUTER_LANE,
+                         replica=replica.name, requeued=requeued,
+                         failed_mid_stream=failed)
         return [r.name for r in died]
 
     # -- introspection (the ServingAPI surface) -------------------------
@@ -673,7 +839,9 @@ class ReplicaRouter:
         """Per-replica forensics rollup for the aggregated /statusz."""
         out = {}
         for r in self.replicas:
-            age = r.heartbeat_age()
+            # one probe feeds the gauge AND this document (satellite:
+            # dashboards, check_replicas and /statusz share the source)
+            age = self.replica_heartbeat_age(r)
             out[r.name] = {
                 "state": r.state,
                 "health": r.serving.health(),
@@ -695,4 +863,29 @@ class ReplicaRouter:
             "affinity_entries": len(self._affinity),
             "inflight_routed": len(self._requests),
             "replica_states": {r.name: r.state for r in self.replicas},
+            "last_fleet_bundle": self._last_fleet_bundle,
         }
+
+    # -- fleet observability surfaces -----------------------------------
+    def fleet_timeline(self, trace_id: Optional[str] = None) -> dict:
+        """The stitched fleet Chrome trace: one process row per lane —
+        the router plus every replica (in-process replicas share the
+        ring; spans are lane-tagged). ``trace_id`` filters to one
+        request's hops across the whole fleet (the router-level
+        ``GET /debug/timeline?trace=<id>`` body)."""
+        from ....telemetry import timeline
+        return timeline.stitch_fleet(trace_id=trace_id)
+
+    def federated_metrics(self) -> str:
+        """The router-level ``/metrics`` exposition: when replicas own
+        registries (``Replica(registry=...)``), every replica's series
+        is federated under a ``replica`` label next to the router's own
+        (process-default) series; with shared registries the process
+        default already aggregates the fleet and renders unchanged."""
+        from ....telemetry import get_registry
+        from ....telemetry.registry import render_federated
+        own = [(r.name, r.registry) for r in self.replicas
+               if r.registry is not None]
+        if not own:
+            return get_registry().render_prometheus()
+        return render_federated([("router", get_registry())] + own)

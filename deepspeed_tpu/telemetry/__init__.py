@@ -21,17 +21,20 @@ accepts). See docs/TELEMETRY.md for the metrics catalog.
 """
 
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
-                       get_registry, set_registry)
+                       get_registry, render_federated, scoped_registry,
+                       set_registry)
 from .bridge import TelemetryBridge
-from . import anomaly, memory, postmortem, recorder, timeline, trace, \
-    watchdog
+from . import anomaly, context, memory, postmortem, recorder, timeline, \
+    trace, watchdog
 from .anomaly import DiagnosticsConfig
+from .context import TraceContext
 from .recorder import FlightRecorder, get_recorder, set_recorder
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "get_registry", "set_registry", "TelemetryBridge", "trace",
-    "timeline", "watchdog", "memory", "recorder", "anomaly",
-    "postmortem", "DiagnosticsConfig", "FlightRecorder", "get_recorder",
-    "set_recorder",
+    "get_registry", "set_registry", "scoped_registry",
+    "render_federated", "TelemetryBridge", "trace", "timeline",
+    "watchdog", "memory", "recorder", "anomaly", "postmortem", "context",
+    "TraceContext", "DiagnosticsConfig", "FlightRecorder",
+    "get_recorder", "set_recorder",
 ]

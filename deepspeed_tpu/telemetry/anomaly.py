@@ -329,14 +329,30 @@ class SLOBurnRateMonitor:
 
     Burn rate 1.0 = consuming error budget exactly at the sustainable
     rate; e.g. with ``slo_target=0.99``, 3% of requests over the bound
-    is a burn rate of 3. No traffic in a window reads as burn 0."""
+    is a burn rate of 3. No traffic in a window reads as burn 0.
+
+    **Fleet mode**: pass ``registries`` (N per-replica registries) and
+    the monitor burns over the AGGREGATED histograms — bucket counts
+    summed across every replica's TTFT/TPOT series — so the alert fires
+    on the fleet's attainment, not any one replica's. The router owns
+    one (``fleet_slo_burn_rate`` gauges, ``fleet_slo_burn`` verdicts,
+    distinct names so per-replica monitors sharing a registry never
+    collide with it)."""
 
     def __init__(self, config: Optional[DiagnosticsConfig] = None,
                  registry=None, clock=time.monotonic,
                  signals: Optional[Iterable[Tuple[str, str, float]]]
-                 = None):
+                 = None, registries: Optional[Iterable] = None,
+                 gauge_name: str = "slo_burn_rate",
+                 verdict_kind: str = "slo_burn"):
         self.config = config or DiagnosticsConfig()
         self.registry = registry or get_registry()
+        # the registries the latency histograms are READ from (fleet
+        # mode: one per replica); gauges/verdicts always publish into
+        # self.registry / the process ledger
+        self.registries = (list(registries) if registries is not None
+                           else [self.registry])
+        self.verdict_kind = verdict_kind
         self.clock = clock
         cfg = self.config
         self.signals = list(signals) if signals is not None else [
@@ -350,18 +366,62 @@ class SLOBurnRateMonitor:
         # tick() runs on the serving-loop thread AND on /statusz's
         # asyncio thread; the snapshot rings need one owner at a time
         self._lock = threading.Lock()
-        self._gauge = self.registry.gauge(
-            "slo_burn_rate",
-            "SLO error-budget burn rate per signal and window "
-            "(1.0 = consuming budget exactly at the sustainable rate)",
-            labelnames=("signal", "window"))
+        # literal registrations for the two known names keep
+        # scripts/check_telemetry_docs.py's literal scan honest (a
+        # variable name would read as an unregistered catalog row)
+        if gauge_name == "fleet_slo_burn_rate":
+            self._gauge = self.registry.gauge(
+                "fleet_slo_burn_rate",
+                "SLO error-budget burn rate per signal and window, "
+                "aggregated across the replica fleet's histograms "
+                "(1.0 = consuming budget exactly at the sustainable "
+                "rate)", labelnames=("signal", "window"))
+        elif gauge_name == "slo_burn_rate":
+            self._gauge = self.registry.gauge(
+                "slo_burn_rate",
+                "SLO error-budget burn rate per signal and window "
+                "(1.0 = consuming budget exactly at the sustainable "
+                "rate)", labelnames=("signal", "window"))
+        else:
+            self._gauge = self.registry.gauge(
+                gauge_name,
+                "SLO error-budget burn rate per signal and window "
+                "(1.0 = consuming budget exactly at the sustainable "
+                "rate)", labelnames=("signal", "window"))
 
-    def _series(self, metric: str):
-        fam = self.registry.get(metric)
+    @staticmethod
+    def _family_series(reg, metric: str):
+        fam = reg.get(metric)
         if fam is None:
             return None
         return fam._series.get(()) or next(
             (s for _, s in fam.series()), None)
+
+    def _series(self, metric: str):
+        """The metric's histogram series — or, in fleet mode, a merged
+        view with bucket counts summed across every source registry
+        (sources whose bucket bounds disagree are skipped: summing
+        misaligned bins would fabricate a distribution)."""
+        found = []
+        for reg in self.registries:
+            s = self._family_series(reg, metric)
+            if s is not None:
+                found.append(s)
+        if not found:
+            return None
+        if len(found) == 1:
+            return found[0]
+        from .registry import _HistogramSeries
+        merged = _HistogramSeries(found[0].bounds)
+        for s in found:
+            if tuple(s.bounds) != tuple(merged.bounds):
+                continue
+            merged.bucket_counts = [
+                a + b for a, b in zip(merged.bucket_counts,
+                                      s.bucket_counts)]
+            merged.sum += s.sum
+            merged.count += s.count
+        return merged
 
     def _window_burn(self, snaps: deque, now: float, window_s: float,
                      budget: float) -> float:
@@ -411,7 +471,7 @@ class SLOBurnRateMonitor:
                     and slow > cfg.burn_threshold)
             if over and not self._alerting[name]:
                 self._alerting[name] = True
-                report("slo_burn",
+                report(self.verdict_kind,
                        f"{name} SLO burn rate {fast:.1f}x (fast) / "
                        f"{slow:.1f}x (slow) exceeds "
                        f"{cfg.burn_threshold}x of the "

@@ -23,7 +23,8 @@ family and raises only on a kind/labelnames mismatch.
 
 import threading
 from bisect import bisect_left
-from typing import Dict, Iterable, List, Optional, Tuple
+from contextlib import contextmanager
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
 # Prometheus default buckets suit request latencies in seconds; the
 # sub-millisecond tail matters for per-step decode timings on TPU.
@@ -382,3 +383,77 @@ def set_registry(registry: MetricsRegistry) -> MetricsRegistry:
         prev = _default_registry
         _default_registry = registry
     return prev
+
+
+@contextmanager
+def scoped_registry(registry: MetricsRegistry) -> Iterator[MetricsRegistry]:
+    """Make ``registry`` the process default for the enclosed block.
+
+    Construction-time scoping for per-replica registries: serving
+    components resolve (and cache) their series via ``get_registry()``
+    when they are BUILT, so building a replica's serving stack inside
+    this scope lands its metrics in the replica's own registry — the
+    unit the router's /metrics federation labels. The swap is process-
+    global, so scope construction, not steady-state traffic."""
+    prev = set_registry(registry)
+    try:
+        yield registry
+    finally:
+        set_registry(prev)
+
+
+def render_federated(sources: Iterable[Tuple[str, MetricsRegistry]],
+                     label: str = "replica") -> str:
+    """One Prometheus exposition over N registries, each source's series
+    labeled ``{label}="<name>"`` — the router's federated /metrics view
+    of its replica fleet (same text format contract as
+    :meth:`MetricsRegistry.render_prometheus`: TYPE/HELP exactly once
+    per family even when several sources register it).
+
+    Sources listing the SAME registry object are deduplicated (shared-
+    registry replicas are already covered by the first source naming
+    it); families whose kind/labels disagree across sources keep the
+    first definition and skip the conflicting series."""
+    sources = list(sources)
+    seen_regs: Dict[int, str] = {}
+    merged: "Dict[str, Tuple[_Family, List[Tuple[str, _Family]]]]" = {}
+    order: List[str] = []
+    for src_name, reg in sources:
+        if id(reg) in seen_regs:
+            continue
+        seen_regs[id(reg)] = src_name
+        for fam in reg.families():
+            if fam.name not in merged:
+                merged[fam.name] = (fam, [])
+                order.append(fam.name)
+            first, members = merged[fam.name]
+            if (fam.kind == first.kind
+                    and fam.labelnames == first.labelnames):
+                members.append((src_name, fam))
+    lines: List[str] = []
+    for name in order:
+        first, members = merged[name]
+        if first.help:
+            lines.append(f"# HELP {name} {_escape_help(first.help)}")
+        lines.append(f"# TYPE {name} {first.kind}")
+        for src_name, fam in members:
+            names = (label,) + fam.labelnames
+            for values, s in fam.series():
+                vals = (src_name,) + values
+                label_s = _label_str(names, vals)
+                if fam.kind == "histogram":
+                    acc = 0
+                    for b, c in zip(list(s.bounds) + [_INF],
+                                    s.bucket_counts):
+                        acc += c
+                        le = f'le="{_format_value(b)}"'
+                        lines.append(f"{name}_bucket"
+                                     f"{_label_str(names, vals, le)}"
+                                     f" {acc}")
+                    lines.append(f"{name}_sum{label_s} "
+                                 f"{_format_value(s.sum)}")
+                    lines.append(f"{name}_count{label_s} {s.count}")
+                else:
+                    lines.append(f"{name}{label_s} "
+                                 f"{_format_value(s.value)}")
+    return "\n".join(lines) + "\n"

@@ -16,6 +16,14 @@ events). :func:`record` appends a RETROACTIVE span from saved
 timestamps (e.g. a request's queue wait, measured between two scheduler
 events rather than around a ``with`` block).
 
+Fleet serving adds a second grouping axis: the ``lane`` — which serving
+REPLICA (or the router) recorded the span. In-process replicas share
+this one ring buffer, so each replica's loop thread names its lane once
+(:func:`set_lane`; the router passes ``lane=`` explicitly) and the
+fleet timeline export groups lanes into per-replica process rows —
+exactly the shape N remote rings would stitch into. Spans without a
+lane belong to no replica (single-engine serving, training).
+
 ``enable_xla_annotations(True)`` mirrors every span into a
 ``jax.profiler.TraceAnnotation`` so spans line up with device activity
 in a TensorBoard/XProf trace captured via
@@ -65,9 +73,21 @@ def current_track() -> str:
     return track if track is not None else threading.current_thread().name
 
 
+def set_lane(name: Optional[str]) -> None:
+    """Name this thread's fleet lane (the replica whose spans it
+    records; None clears it). Lanes map to process rows in the stitched
+    fleet timeline (:func:`timeline.stitch_fleet`)."""
+    _local.lane = name
+
+
+def current_lane() -> Optional[str]:
+    return getattr(_local, "lane", None)
+
+
 @contextmanager
-def span(name: str, **attrs):
-    """Record a wall-clock span; nests (depth reflects enclosing spans)."""
+def span(name: str, lane: Optional[str] = None, **attrs):
+    """Record a wall-clock span; nests (depth reflects enclosing spans).
+    ``lane`` overrides the thread's fleet lane for this span."""
     depth = getattr(_local, "depth", 0)
     parent = getattr(_local, "span_id", None)
     span_id = next(_ids)
@@ -93,6 +113,9 @@ def span(name: str, **attrs):
         rec = {"name": name, "start": start, "duration_s": dur,
                "depth": depth, "id": span_id, "parent": parent,
                "track": current_track()}
+        ln = lane if lane is not None else current_lane()
+        if ln is not None:
+            rec["lane"] = ln
         if attrs:
             rec["attrs"] = attrs
         # under _lock: export() snapshots the deque while other threads
@@ -102,18 +125,22 @@ def span(name: str, **attrs):
 
 
 def record(name: str, start: float, duration_s: float,
-           track: Optional[str] = None, **attrs) -> None:
+           track: Optional[str] = None, lane: Optional[str] = None,
+           **attrs) -> None:
     """Append a retroactive span from saved ``perf_counter`` timestamps.
 
     For phases whose boundaries are events rather than a ``with`` block
     (a request's queue wait between submit and first prefill chunk, its
     decode phase between first token and finish). Retroactive spans are
     top-level (no parent) on ``track`` (default: the calling thread's
-    track)."""
+    track) in fleet lane ``lane`` (default: the thread's lane)."""
     rec = {"name": name, "start": float(start),
            "duration_s": float(duration_s), "depth": 0, "id": next(_ids),
            "parent": None,
            "track": track if track is not None else current_track()}
+    ln = lane if lane is not None else current_lane()
+    if ln is not None:
+        rec["lane"] = ln
     if attrs:
         rec["attrs"] = attrs
     with _lock:

@@ -65,6 +65,15 @@ Gated metrics (see ``collect()``):
     absolute tolerance — it guards against order-of-magnitude
     regressions like snapshotting state per event, not scheduler
     jitter).
+  * ``trace_ns_per_span`` / ``routed_trace_steady_recompiles`` —
+    distributed-tracing overhead (telemetry/context.py,
+    telemetry/trace.py): the per-span record cost with a trace-id attr
+    attached (same wide absolute tolerance as
+    ``recorder_ns_per_event``), and a routed steady wave where every
+    request continues an explicit upstream TraceContext — trace attrs
+    ride span metadata on the host, so tracing-on traffic must stay at
+    ZERO steady-state recompiles (a trace id leaking into a compiled
+    program's shape signature would show up here).
 
 Usage::
 
@@ -388,11 +397,16 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
         def _routed_run(placement: str, waves: int):
             """Sequential shared-prefix waves through a fresh routed
             pair; returns (wave-1 hit fraction, steady recompiles,
-            dispatch ns/request) — wave 1 measures hits against fresh
-            prefix indexes, wave 2 absorbs the per-bucket
-            respecialization, wave 3 runs under mark_steady. The
-            dispatch probe times pick_replica over the warmed affinity
-            map (pure host work: digest chain + placement lookup)."""
+            traced steady recompiles, dispatch ns/request) — wave 1
+            measures hits against fresh prefix indexes, wave 2 absorbs
+            the per-bucket respecialization, wave 3 runs under
+            mark_steady, and a final steady wave binds an explicit
+            TraceContext per request (the header-continued distributed-
+            tracing path) to pin that trace attrs never leak into a
+            compiled program's shape signature. The dispatch probe
+            times pick_replica over the warmed affinity map (pure host
+            work: digest chain + placement lookup)."""
+            from deepspeed_tpu.telemetry import context as trace_context
 
             async def run():
                 router = ReplicaRouter(
@@ -425,6 +439,21 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
                 if waves == 1:
                     hit_frac = (fam_total("inference_prefix_hits_total")
                                 - hits0) / len(shared_prompts)
+                traced_steady = 0.0
+                if waves > 1:
+                    st0 = fam_total("xla_steady_state_recompiles_total")
+                    watchdog.mark_steady(True)
+                    try:
+                        for p in shared_prompts:
+                            with trace_context.use(
+                                    trace_context.new_context(
+                                        tenant="perf-gate")):
+                                stream = await router.submit(p, 2)
+                            await stream.drain()
+                    finally:
+                        watchdog.mark_steady(False)
+                    traced_steady = fam_total(
+                        "xla_steady_state_recompiles_total") - st0
                 n_pick = 2000
                 t0 = _time.perf_counter()
                 for i in range(n_pick):
@@ -433,16 +462,18 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
                 dispatch_ns = ((_time.perf_counter() - t0) / n_pick
                                * 1e9)
                 await router.stop()
-                return hit_frac, steady, dispatch_ns
+                return hit_frac, steady, traced_steady, dispatch_ns
 
             return asyncio.run(run())
 
-        aff_frac, router_steady, dispatch_ns = _routed_run("affinity", 3)
-        rand_frac, _, _ = _routed_run("round_robin", 1)
+        aff_frac, router_steady, traced_steady, dispatch_ns = \
+            _routed_run("affinity", 3)
+        rand_frac, _, _, _ = _routed_run("round_robin", 1)
         metrics["router_affinity_hit_fraction"] = aff_frac
         metrics["router_random_hit_fraction"] = rand_frac
         metrics["router_affinity_hit_gain"] = aff_frac - rand_frac
         metrics["router_steady_recompiles"] = router_steady
+        metrics["routed_trace_steady_recompiles"] = traced_steady
         metrics["router_dispatch_ns_per_request"] = dispatch_ns
 
         # -- flight-recorder record() cost ---------------------------------
@@ -458,6 +489,22 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
                 (_time.perf_counter() - t0) / n * 1e9)
         finally:
             set_recorder(prev_bench)
+
+        # -- span-trace cost with a trace id attached ----------------------
+        # (telemetry/trace.py under distributed tracing): the per-span
+        # ring append including the trace_id attr every traced request
+        # now carries — the tracing layer's analogue of
+        # recorder_ns_per_event
+        from deepspeed_tpu.telemetry import trace as ds_trace
+        n = 20000
+        gate_tid = "cafe" * 8
+        t0 = _time.perf_counter()
+        for i in range(n):
+            with ds_trace.span("gate_bench_span", uid=i,
+                               trace_id=gate_tid):
+                pass
+        metrics["trace_ns_per_span"] = (
+            (_time.perf_counter() - t0) / n * 1e9)
 
         # -- training side: the REAL dp8 bucketed-overlap train step,
         # AOT-compiled against a v5e:2x4 topology with the libtpu host
@@ -545,6 +592,7 @@ def make_baseline(metrics: Dict[str, float]) -> Dict[str, Any]:
                     "stitched_mixed_compile_events",
                     "ragged_mixed_steady_recompiles",
                     "router_steady_recompiles",
+                    "routed_trace_steady_recompiles",
                     "kv_quant_steady_state_recompiles"):
             spec[name] = {"value": value, "direction": "max",
                           "abs_tol": 0.0}
@@ -586,7 +634,7 @@ def make_baseline(metrics: Dict[str, float]) -> Dict[str, Any]:
             # sites, not the machine — small absolute slack only
             spec[name] = {"value": value, "direction": "max",
                           "abs_tol": 2.0}
-        elif name == "recorder_ns_per_event":
+        elif name in ("recorder_ns_per_event", "trace_ns_per_span"):
             # wall-clock-ish: wide absolute tolerance so scheduler
             # jitter never flaps the gate, but an order-of-magnitude
             # regression (per-event snapshotting, lock convoy) fails

@@ -171,6 +171,48 @@ def test_burn_rate_rises_on_degradation_and_recovers(_fresh):
     assert _anomaly_count("slo_burn") == 2
 
 
+def test_fleet_mode_burns_over_aggregated_replica_histograms(_fresh):
+    """PR 10: the router's fleet monitor sums bucket counts across N
+    replica registries — the alert fires on the FLEET's attainment
+    (each replica alone is inside budget here), publishes distinct
+    fleet_slo_burn_rate gauges and raises fleet_slo_burn verdicts."""
+    from deepspeed_tpu.telemetry import MetricsRegistry
+    reg = get_registry()
+    r0, r1 = MetricsRegistry(), MetricsRegistry()
+    h0 = r0.histogram("serving_ttft_seconds", unit="s")
+    h1 = r1.histogram("serving_ttft_seconds", unit="s")
+    clock = {"t": 0.0}
+    cfg = DiagnosticsConfig(ttft_slo_s=0.5, slo_target=0.99,
+                            burn_threshold=2.0, slo_fast_window_s=10.0,
+                            slo_slow_window_s=60.0, slo_min_samples=10)
+    mon = SLOBurnRateMonitor(cfg, registry=reg, registries=[r0, r1],
+                             clock=lambda: clock["t"],
+                             signals=[("ttft", "serving_ttft_seconds",
+                                       0.5)],
+                             gauge_name="fleet_slo_burn_rate",
+                             verdict_kind="fleet_slo_burn")
+    # replica0 healthy, replica1 degraded: 10% of FLEET traffic blows
+    # the bound (each tick: 90 good on r0, 5 good + 5 bad on r1)
+    for _ in range(70):
+        clock["t"] += 1.0
+        for _ in range(90):
+            h0.observe(0.05)
+        for _ in range(5):
+            h1.observe(0.05)
+        for _ in range(5):
+            h1.observe(2.0)
+        burns = mon.tick()
+    assert burns["ttft"]["fast"] == pytest.approx(5.0, rel=0.2)
+    assert _anomaly_count("fleet_slo_burn") == 1
+    assert _anomaly_count("slo_burn") == 0
+    # gauges live under the FLEET name in the router's registry
+    g = reg.get("fleet_slo_burn_rate")
+    assert g.labels(signal="ttft", window="fast").value > 2.0
+    assert reg.get("slo_burn_rate") is None
+    # quantiles come from the merged view too
+    assert mon.quantiles()["ttft"]["count"] == 70 * 100
+
+
 def test_no_traffic_is_zero_burn(_fresh):
     reg = get_registry()
     reg.histogram("serving_ttft_seconds", unit="s")

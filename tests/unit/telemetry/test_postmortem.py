@@ -65,19 +65,30 @@ def test_bundle_layout_and_contents(tmp_path, _fresh):
     assert postmortem.last_bundle() == path
 
 
-def test_rate_limit_and_force(tmp_path, _fresh):
+def test_rate_limit_is_per_reason_kind_and_force(tmp_path, _fresh):
     cfg = DiagnosticsConfig(postmortem_min_interval_s=3600)
-    p1 = postmortem.write_bundle("first", config=cfg,
+    p1 = postmortem.write_bundle("slo_burn", config=cfg,
                                  out_dir=str(tmp_path))
-    # rate-limited call returns the previous bundle instead of writing
-    p2 = postmortem.maybe_write_bundle("second", config=cfg,
+    # same kind inside the window defers to the previous bundle
+    p2 = postmortem.maybe_write_bundle("slo_burn", config=cfg,
                                        out_dir=str(tmp_path))
     assert p2 == p1
     assert len(os.listdir(tmp_path)) == 1
-    # force always writes
-    p3 = postmortem.write_bundle("third", config=cfg,
+    # a DIFFERENT kind inside the window still writes (PR 10 satellite:
+    # a chatty slo_burn must never suppress the bundle for a subsequent
+    # nan_loss/stall verdict — each kind owns its own interval)
+    p3 = postmortem.maybe_write_bundle("nan_loss", config=cfg,
+                                       out_dir=str(tmp_path))
+    assert p3 is not None and p3 != p1
+    assert len(os.listdir(tmp_path)) == 2
+    # ... and that kind now rate-limits independently
+    p4 = postmortem.maybe_write_bundle("nan_loss", config=cfg,
+                                       out_dir=str(tmp_path))
+    assert p4 == p3 and len(os.listdir(tmp_path)) == 2
+    # force always writes, even inside the kind's window
+    p5 = postmortem.write_bundle("slo_burn", config=cfg,
                                  out_dir=str(tmp_path))
-    assert p3 != p1 and len(os.listdir(tmp_path)) == 2
+    assert p5 != p1 and len(os.listdir(tmp_path)) == 3
 
 
 def test_hostile_reason_is_sanitized(tmp_path, _fresh):
@@ -138,3 +149,80 @@ def test_atexit_writes_only_after_anomalies(tmp_path, _fresh):
     assert out.returncode == 0
     bundles = os.listdir(dirty)
     assert len(bundles) == 1 and "atexit_with_anomalies" in bundles[0]
+
+
+# -- fleet bundles (PR 10: router-collected cross-replica evidence) ---------
+class _FakeReplica:
+    def __init__(self, name, registry=None):
+        self.name, self.state, self.registry = name, "up", registry
+
+
+class _FakeRouter:
+    """The write_fleet_bundle duck surface of ReplicaRouter."""
+
+    def __init__(self, replicas):
+        self.replicas = replicas
+
+    def health(self):
+        return {"replicas": [r.name for r in self.replicas]}
+
+    def router_statusz(self):
+        return {"placement": "affinity", "inflight_routed": 0}
+
+    def replica_statusz(self):
+        return {r.name: {"state": r.state} for r in self.replicas}
+
+
+def test_fleet_bundle_layout_and_per_kind_rate_limit(tmp_path, _fresh):
+    from deepspeed_tpu.telemetry import trace
+    trace.set_capacity(4096)
+    trace.clear()
+    r_reg = MetricsRegistry()
+    r_reg.counter("serving_requests_total", "per-replica probe").inc(3)
+    router = _FakeRouter([_FakeReplica("replica0", r_reg),
+                          _FakeReplica("replica1")])
+    trace.record("ragged_step", 1.0, 0.01, lane="replica0", uids=[1])
+    trace.record("router_dispatch", 0.9, 0.001, lane="router", uid=1)
+    anomaly.report("stall", "wedged mid-step")
+    cfg = DiagnosticsConfig(postmortem_min_interval_s=3600)
+
+    path = postmortem.write_fleet_bundle("stall", router, config=cfg,
+                                         out_dir=str(tmp_path))
+    assert os.path.basename(path).startswith("fleet-")
+    manifest = _load(path, "manifest")
+    assert manifest["kind"] == "fleet" and manifest["reason"] == "stall"
+    assert manifest["replicas"] == {"replica0": {"state": "up"},
+                                    "replica1": {"state": "up"}}
+    assert "collection_errors" not in manifest
+    # router state + shared artifacts
+    assert _load(path, "router")["routing"]["placement"] == "affinity"
+    for section in ("metrics", "timeline", "recorder", "anomalies",
+                    "fingerprint"):
+        assert os.path.exists(os.path.join(path, f"{section}.json"))
+    # stitched fleet timeline has a process row per lane
+    rows = {e["args"]["name"]
+            for e in _load(path, "timeline")["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {"router", "replica0"} <= rows
+    # per-replica sections: own-registry metrics only where one exists,
+    # and each replica's lane of the trace ring
+    own = _load(os.path.join(path, "replica0"), "metrics")
+    assert own["metrics"]["serving_requests_total"]["series"][0][
+        "value"] == 3
+    assert not os.path.exists(
+        os.path.join(path, "replica1", "metrics.json"))
+    assert os.path.exists(os.path.join(path, "replica0", "timeline.json"))
+    assert _load(path, "anomalies")[-1]["kind"] == "stall"
+
+    # fleet bundles rate-limit per reason kind, independent of the
+    # single-process bundles of the same reason
+    p2 = postmortem.maybe_write_fleet_bundle("stall", router, config=cfg,
+                                             out_dir=str(tmp_path))
+    assert p2 == path
+    p3 = postmortem.maybe_write_bundle("stall", config=cfg,
+                                       out_dir=str(tmp_path))
+    assert p3 != path, "fleet and single-process windows are distinct"
+    p4 = postmortem.maybe_write_fleet_bundle("kv_leak", router,
+                                             config=cfg,
+                                             out_dir=str(tmp_path))
+    assert p4 is not None and p4 != path

@@ -257,3 +257,55 @@ def test_render_prometheus_round_trip_with_hostile_values(reg):
     le_vals = [l["le"] for n, l, _ in samples
                if n == "rt_seconds_bucket" and l.get("op") == hostile]
     assert le_vals == ["0.1", "1", "+Inf"]
+
+# -- federated exposition (PR 10: routed /metrics) --------------------------
+def test_render_federated_labels_each_source(reg):
+    from deepspeed_tpu.telemetry.registry import render_federated
+    r0, r1 = MetricsRegistry(), MetricsRegistry()
+    reg.gauge("router_replicas", "fleet size").set(2)
+    for i, r in enumerate((r0, r1)):
+        r.counter("serving_requests_total", "per-replica").inc(i + 1)
+        r.histogram("serving_ttft_seconds", "ttft",
+                    buckets=(0.1, 1.0)).observe(0.5)
+    text = render_federated([("router", reg), ("replica0", r0),
+                             ("replica1", r1)])
+    types, helps, samples = _parse_exposition(text)
+    # TYPE/HELP exactly once even though two sources register the family
+    assert types["serving_requests_total"] == ["counter"]
+    assert len(helps["serving_requests_total"]) == 1
+    got = {l["replica"]: v for n, l, v in samples
+           if n == "serving_requests_total"}
+    assert got == {"replica0": "1", "replica1": "2"}
+    # histogram series carry the replica label on bucket/sum/count lines
+    counts = {l["replica"]: v for n, l, v in samples
+              if n == "serving_ttft_seconds_count"}
+    assert counts == {"replica0": "1", "replica1": "1"}
+    assert {l["replica"] for n, l, v in samples
+            if n == "router_replicas"} == {"router"}
+
+
+def test_render_federated_dedups_shared_registries_and_conflicts(reg):
+    from deepspeed_tpu.telemetry.registry import render_federated
+    other = MetricsRegistry()
+    reg.counter("shared_total", "x").inc(5)
+    # a replica listing the SAME registry object must not double-count
+    other.gauge("shared_total", "conflicting kind").set(9)
+    text = render_federated([("router", reg), ("replica0", reg),
+                             ("replica1", other)])
+    types, _, samples = _parse_exposition(text)
+    assert types["shared_total"] == ["counter"]   # first definition wins
+    rows = [(l["replica"], v) for n, l, v in samples
+            if n == "shared_total"]
+    assert rows == [("router", "5")]
+
+
+def test_scoped_registry_restores_previous_default():
+    from deepspeed_tpu.telemetry import get_registry
+    from deepspeed_tpu.telemetry.registry import scoped_registry
+    prev = get_registry()
+    mine = MetricsRegistry()
+    with scoped_registry(mine) as r:
+        assert r is mine and get_registry() is mine
+        mine.counter("scoped_total").inc()
+    assert get_registry() is prev
+    assert mine.family_total("scoped_total") == 1.0

@@ -1,0 +1,237 @@
+"""Distributed trace context + fleet stitching (`telemetry/context.py`,
+`telemetry/timeline.py` PR 10): the W3C-traceparent / handoff-wire /
+contextvar codecs, lane-grouped fleet stitching, and trace-ring
+behavior under concurrent multi-lane writers through wraparound."""
+
+import json
+import threading
+
+import pytest
+
+from deepspeed_tpu.telemetry import context as trace_context
+from deepspeed_tpu.telemetry import timeline, trace
+from deepspeed_tpu.telemetry.registry import MetricsRegistry, set_registry
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    prev = set_registry(MetricsRegistry())
+    trace.set_capacity(4096)
+    trace.clear()
+    trace.set_lane(None)
+    yield
+    trace.set_capacity(4096)
+    trace.clear()
+    trace.set_lane(None)
+    set_registry(prev)
+
+
+# -- codecs -----------------------------------------------------------------
+def test_traceparent_roundtrip_and_baggage():
+    ctx = trace_context.new_context(tenant="acme", arm="b")
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    header = ctx.to_traceparent()
+    assert header == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+    back = trace_context.from_traceparent(header, ctx.to_baggage_header())
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled is True
+    assert dict(back.baggage) == {"tenant": "acme", "arm": "b"}
+    # unsampled flag survives
+    off = trace_context.TraceContext(ctx.trace_id, ctx.span_id,
+                                     sampled=False)
+    assert trace_context.from_traceparent(
+        off.to_traceparent()).sampled is False
+
+
+@pytest.mark.parametrize("header", [
+    None, "", "garbage", "00-short-abc-01",
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",     # all-zero trace id
+    "00-" + "1" * 32 + "-" + "0" * 16 + "-01",     # all-zero span id
+    "00-" + "g" * 32 + "-" + "1" * 16 + "-01",     # non-hex
+    "ff-" + "1" * 32 + "-" + "1" * 16 + "-01",     # invalid version ff
+])
+def test_malformed_traceparent_degrades_to_none(header):
+    assert trace_context.from_traceparent(header) is None
+
+
+def test_wire_roundtrip_and_invalid_payloads():
+    ctx = trace_context.new_context(tenant="t1")
+    back = trace_context.from_wire(json.loads(json.dumps(ctx.to_wire())))
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert dict(back.baggage) == {"tenant": "t1"}
+    for bad in (None, {}, {"trace_id": "short", "span_id": "x"},
+                {"trace_id": "a" * 32}, 42, "str"):
+        assert trace_context.from_wire(bad) is None
+
+
+def test_child_keeps_trace_fresh_span():
+    ctx = trace_context.new_context()
+    kid = ctx.child()
+    assert kid.trace_id == ctx.trace_id
+    assert kid.span_id != ctx.span_id
+
+
+def test_contextvar_use_and_get_or_new():
+    assert trace_context.current() is None
+    outer = trace_context.new_context()
+    with trace_context.use(outer):
+        assert trace_context.current() is outer
+        assert trace_context.get_or_new() is outer
+        inner = trace_context.new_context()
+        with trace_context.use(inner):
+            assert trace_context.current() is inner
+        assert trace_context.current() is outer
+    assert trace_context.current() is None
+    # unbound: get_or_new mints a fresh root
+    assert trace_context.get_or_new().trace_id != outer.trace_id
+
+
+def test_origin_counter_counts_new_header_wire():
+    from deepspeed_tpu.telemetry import get_registry
+    ctx = trace_context.new_context()
+    trace_context.from_traceparent(ctx.to_traceparent())
+    trace_context.from_wire(ctx.to_wire())
+    fam = get_registry().get("trace_contexts_total")
+    counts = {v[0]: s.value for v, s in fam.series()}
+    assert counts == {"new": 1, "header": 1, "wire": 1}
+
+
+# -- fleet stitching --------------------------------------------------------
+def test_stitch_fleet_groups_lanes_into_process_rows():
+    tid = "ab" * 16
+    trace.record("router_dispatch", 1.0, 0.001, lane="router",
+                 uid=1, trace_id=tid)
+    trace.set_lane("replica0")
+    with trace.span("ragged_step", uids=[1], trace_ids=[tid]):
+        pass
+    trace.set_lane(None)
+    trace.record("other", 2.0, 0.001, uid=9)       # lane-less
+    obj = timeline.stitch_fleet()
+    rows = {e["args"]["name"] for e in obj["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {"router", "replica0", "host"} <= rows
+    # trace filter keeps only the correlated spans, causally ordered
+    obj = timeline.stitch_fleet(trace_id=tid)
+    xs = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in xs] == ["router_dispatch", "ragged_step"]
+    assert xs[0]["ts"] <= xs[1]["ts"]
+    json.loads(json.dumps(obj))                    # serializes cleanly
+
+
+def test_trace_spans_matches_single_and_batch_attrs():
+    tid = "cd" * 16
+    trace.record("request_queue", 1.0, 0.01, uid=3, trace_id=tid)
+    trace.record("decode_window", 1.1, 0.01, uids=[3, 4],
+                 trace_ids=[tid, "ee" * 16])
+    trace.record("unrelated", 1.2, 0.01, uid=5, trace_id="ff" * 16)
+    names = [s["name"] for s in timeline.trace_spans(tid)]
+    assert names == ["request_queue", "decode_window"]
+
+
+def test_explicit_rings_stitch_remote_shape():
+    """N per-replica rings (the remote-replica shape) merge on one
+    clock with the span's own lane winning over its ring name."""
+    rings = {
+        "router": [{"name": "router_dispatch", "start": 5.0,
+                    "duration_s": 0.001, "attrs": {"trace_id": "x"}}],
+        "replicaA": [{"name": "ragged_step", "start": 5.01,
+                      "duration_s": 0.02},
+                     {"name": "drain", "start": 5.2, "duration_s": 0.01,
+                      "lane": "override"}],
+    }
+    obj = timeline.stitch_fleet(rings)
+    rows = {e["args"]["name"] for e in obj["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"}
+    assert rows == {"router", "replicaA", "override"}
+    ts = [e["ts"] for e in obj["traceEvents"] if e["ph"] == "X"]
+    assert min(ts) == 0.0                          # rebased to earliest
+
+
+# -- concurrent multi-lane writers through wraparound (satellite) -----------
+def _emit_traced_hops(uid, tid, t0):
+    """One routed request's hop set the way the fleet records it."""
+    trace.record("router_dispatch", t0, 0.001, lane="router", uid=uid,
+                 trace_id=tid)
+    trace.record("ragged_step", t0 + 0.002, 0.01, lane="prefill0",
+                 uids=[uid], trace_ids=[tid])
+    trace.record("router_handoff", t0 + 0.013, 0.002, lane="router",
+                 uid=uid, trace_id=tid)
+    trace.record("decode_window", t0 + 0.016, 0.01, lane="replica0",
+                 uids=[uid], trace_ids=[tid])
+    trace.record("request", t0, 0.03, lane="replica0", uid=uid,
+                 tokens=4, status="completed", trace_id=tid)
+
+
+def test_concurrent_lane_writers_wraparound_keeps_traces_unbroken():
+    """Router-lane and N replica-lane writers race through a small ring;
+    the stitched export stays well-formed throughout, and the newest
+    fully-recorded trace keeps ALL its hops (per-trace lifelines
+    unbroken across eviction: spans of one trace are recorded oldest-
+    first, so the retained window never holds a later hop while missing
+    an earlier one of the SAME completed trace)."""
+    trace.set_capacity(256)
+    stop = threading.Event()
+    errors = []
+
+    def fleet_writer(worker):
+        try:
+            i = 0
+            while not stop.is_set():
+                uid = worker * 1_000_000 + i
+                _emit_traced_hops(uid, f"{uid:032x}", float(i))
+                i += 1
+        except Exception as e:   # pragma: no cover
+            errors.append(e)
+
+    def replica_loop_writer(name):
+        def run():
+            try:
+                trace.set_lane(name)
+                i = 0
+                while not stop.is_set():
+                    with trace.span("ragged_step", uids=[i],
+                                    trace_ids=[f"{i:032x}"]):
+                        pass
+                    i += 1
+            except Exception as e:   # pragma: no cover
+                errors.append(e)
+        return run
+
+    def reader():
+        try:
+            for _ in range(100):
+                obj = timeline.stitch_fleet()
+                json.loads(json.dumps(obj))
+        except Exception as e:   # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=fleet_writer, args=(w,))
+               for w in (1, 2)]
+    threads += [threading.Thread(target=replica_loop_writer(n))
+                for n in ("replica1", "replica2")]
+    reader_t = threading.Thread(target=reader)
+    threads.append(reader_t)
+    for t in threads:
+        t.start()
+    reader_t.join()
+    stop.set()
+    for t in threads[:-1]:
+        t.join()
+    assert not errors, errors
+
+    spans = trace.export()
+    assert len(spans) == 256
+    # newest completed trace in the window has its whole hop set
+    done = [s for s in spans if s["name"] == "request"]
+    assert done, "no complete request span retained"
+    tid = done[-1]["attrs"]["trace_id"]
+    hops = [s["name"] for s in timeline.trace_spans(tid)]
+    assert hops == ["router_dispatch", "ragged_step", "router_handoff",
+                    "decode_window", "request"], hops
+    # and the stitched per-trace view keeps its lanes as process rows
+    obj = timeline.stitch_fleet(trace_id=tid)
+    rows = {e["args"]["name"] for e in obj["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "process_name"}
+    assert rows == {"router", "prefill0", "replica0"}
