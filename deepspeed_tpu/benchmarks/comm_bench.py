@@ -67,7 +67,8 @@ def run_bucket_sweep(total_pw: int = 22, bucket_pws=(16, 18, 20, 22),
                      trials: int = 10, warmups: int = 2,
                      axis: str = "data", n_leaves: int = 32,
                      dtype=jnp.float32, quantized: str = None,
-                     quant_block: int = 2048) -> List[Dict]:
+                     quant_block: int = 2048,
+                     hierarchy: int = 0) -> List[Dict]:
     """Sweep ``reduce_bucket_size`` over a synthetic gradient tree and
     report achieved bandwidth per bucket layout.
 
@@ -83,6 +84,13 @@ def run_bucket_sweep(total_pw: int = 22, bucket_pws=(16, 18, 20, 22),
     the quantized step time, per-device wire bytes of both transports and
     their ratio — the bytes-on-wire story the ``quantized_reduce`` knob
     buys on this workload.
+
+    ``hierarchy`` > 1 (with ``quantized``) runs the quantized leg
+    through the two-level hierarchical rings
+    (``zero_optimization.quantized_reduce_hierarchy`` — ``hierarchy``
+    hosts, intra-host fp32 / inter-host quantized) and ASSERTS the
+    inter-host wire-bytes ratio over the flat fp32 ring clears the
+    quantization win (``comm.quantized.hier_wire_bytes``).
     """
     from jax.sharding import Mesh, PartitionSpec as P
 
@@ -148,7 +156,8 @@ def run_bucket_sweep(total_pw: int = 22, bucket_pws=(16, 18, 20, 22),
                 out, qerr = apply_bucketed_reduction(
                     list(ls), plan, [0] * n_leaves, (axis,), (), n, 1,
                     axis_sizes={axis: n}, quant_reduce=quantized,
-                    quant_reduce_block=quant_block, qstate=qin)
+                    quant_reduce_block=quant_block,
+                    quant_reduce_groups=hierarchy, qstate=qin)
                 return tuple(out), {k: {kk: a[None] for kk, a in v.items()}
                                     for k, v in qerr.items()}
 
@@ -165,6 +174,30 @@ def run_bucket_sweep(total_pw: int = 22, bucket_pws=(16, 18, 20, 22),
                 "wire_bytes_fp32": wb,
                 "wire_bytes_quant": wb_q,
                 "wire_ratio": round(wb / wb_q, 3) if wb_q else None})
+            if hierarchy > 1:
+                from ..comm.quantized import hier_wire_bytes
+                # per-bucket message size on the ring (rows of M elems)
+                hier = {"inter_fp32_flat": 0, "inter_quant": 0}
+                for b in plan.buckets:
+                    M = sum(-(-plan.units[u].numel // n)
+                            for u in b.indices)
+                    hwb = hier_wire_bytes(M, n, hierarchy,
+                                          block=quant_block)
+                    # ALL_REDUCE buckets pay RS + AG phases
+                    hier["inter_fp32_flat"] += \
+                        2 * hwb["inter_bytes_fp32_flat"]
+                    hier["inter_quant"] += 2 * hwb["inter_bytes_quant"]
+                ratio = (hier["inter_fp32_flat"] / hier["inter_quant"]
+                         if hier["inter_quant"] else float("inf"))
+                assert ratio >= 3.5, (
+                    f"hierarchical ring inter-host wire ratio {ratio:.2f}"
+                    f" lost the quantization win (bucket "
+                    f"{cap * itemsize}B)")
+                row.update({
+                    "hierarchy": hierarchy,
+                    "inter_wire_bytes_fp32_flat": hier["inter_fp32_flat"],
+                    "inter_wire_bytes_quant": hier["inter_quant"],
+                    "inter_wire_ratio": round(ratio, 3)})
         rows.append(row)
     return rows
 
@@ -195,6 +228,12 @@ def main(argv=None):
                         "and report wire bytes + step time vs the fp32 "
                         "ring")
     p.add_argument("--quant-block", type=int, default=2048)
+    p.add_argument("--hierarchy", type=int, default=0,
+                   help="with --bucket-sweep --quantized: run the "
+                        "two-level hierarchical ring (this many hosts, "
+                        "intra-host fp32 / inter-host quantized — the "
+                        "quantized_reduce_hierarchy knob) and assert "
+                        "the inter-host wire-bytes win")
     args = p.parse_args(argv)
     if args.bucket_sweep:
         print(f"devices: {jax.device_count()} x "
@@ -207,7 +246,8 @@ def main(argv=None):
                                 bucket_pws=tuple(args.sweep_buckets),
                                 trials=args.trials, axis=args.mesh_axis,
                                 quantized=args.quantized,
-                                quant_block=args.quant_block)
+                                quant_block=args.quant_block,
+                                hierarchy=args.hierarchy)
         for r in rows:
             extra = ""
             if args.quantized:

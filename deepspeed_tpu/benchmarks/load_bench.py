@@ -207,10 +207,14 @@ def make_router(engines, budget, chunk, max_pending,
 
 def run_router_open_loop(engines, arrivals, prompts, new_tokens, budget,
                          chunk, max_pending, max_queued_tokens=None,
-                         deadline_s=None, placement="affinity"):
+                         deadline_s=None, placement="affinity",
+                         engine_factory=None, autoscale_max=0):
     """Open-loop Poisson trace through the routed frontend; returns the
     aggregate tail-latency/goodput report plus a per-replica
-    breakdown."""
+    breakdown. ``autoscale_max`` > len(engines) attaches an
+    :class:`~..inference.v2.serve.Autoscaler` (spawning in-process
+    replicas via ``engine_factory``) so the trace exercises scale-up
+    under shed pressure; the report then carries the scale events."""
     import asyncio
 
     async def drive():
@@ -221,23 +225,52 @@ def run_router_open_loop(engines, arrivals, prompts, new_tokens, budget,
         # report
         base = {name: fam(name) for name in
                 ("router_shed_total", "router_reroutes_total",
-                 "router_affinity_hits_total")}
+                 "router_affinity_hits_total",
+                 "router_autoscale_up_total",
+                 "router_autoscale_down_total")}
         router = make_router(engines, budget, chunk, max_pending,
                              max_queued_tokens, placement)
         await router.start()
+        scaler = None
+        if autoscale_max > len(engines):
+            from ..inference.v2.serve import (AdmissionConfig,
+                                              Autoscaler,
+                                              AutoscalerConfig, Replica,
+                                              ServingConfig)
+
+            async def spawn(name):
+                return Replica(name, engine_factory(), ServingConfig(
+                    token_budget=budget, chunk=chunk,
+                    admission=AdmissionConfig(
+                        max_pending=max_pending,
+                        max_queued_tokens=max_queued_tokens)))
+
+            scaler = Autoscaler(
+                router, spawn,
+                AutoscalerConfig(min_replicas=len(engines),
+                                 max_replicas=autoscale_max,
+                                 scale_up_after_ticks=1,
+                                 interval_s=0.2,
+                                 cooldown_s=0.5)).start()
         per = {r.name: {"completed": 0, "ttfts": [], "tokens": 0}
                for r in router.replicas}
 
         def on_complete(stream, ttft, n):
-            if stream.replica in per:
-                per[stream.replica]["completed"] += 1
-                per[stream.replica]["ttfts"].append(ttft)
-                per[stream.replica]["tokens"] += n
+            if stream.replica is None:
+                return
+            d = per.setdefault(stream.replica,
+                               {"completed": 0, "ttfts": [],
+                                "tokens": 0})
+            d["completed"] += 1
+            d["ttfts"].append(ttft)
+            d["tokens"] += n
 
         t0 = time.perf_counter()
         stats, ttfts, totals, tpots, good = await _drive_open_loop(
             router.submit, t0, arrivals, prompts, new_tokens,
             deadline_s, on_complete=on_complete)
+        if scaler is not None:
+            await scaler.stop()
         await router.stop(drain=True)
         makespan = time.perf_counter() - t0
 
@@ -259,6 +292,11 @@ def run_router_open_loop(engines, arrivals, prompts, new_tokens, budget,
             - base["router_reroutes_total"],
             "affinity_hits": fam("router_affinity_hits_total")
             - base["router_affinity_hits_total"],
+            "autoscale_up": fam("router_autoscale_up_total")
+            - base["router_autoscale_up_total"],
+            "autoscale_down": fam("router_autoscale_down_total")
+            - base["router_autoscale_down_total"],
+            "final_replicas": len(router.replicas),
             "per_replica": per_replica,
         }
 
@@ -287,6 +325,12 @@ def main(argv=None) -> int:
                    choices=("affinity", "hash", "round_robin"),
                    help="router mode: placement policy (round_robin is "
                         "the random-placement baseline)")
+    p.add_argument("--autoscale", type=int, default=0, metavar="MAX",
+                   help="router mode: attach the autoscaler "
+                        "(serve/autoscaler.py), growing the fleet up "
+                        "to MAX replicas under shed pressure and "
+                        "draining back on idle; the report carries "
+                        "the scale events")
     p.add_argument("--max-pending", type=int, default=16,
                    help="open mode: admission queue bound")
     p.add_argument("--max-queued-tokens", type=int, default=0,
@@ -343,7 +387,9 @@ def main(argv=None) -> int:
             engines, arrivals, prompts, args.new, args.budget,
             args.chunk, max_pending=args.max_pending,
             max_queued_tokens=args.max_queued_tokens or None,
-            deadline_s=args.deadline or None, placement=args.placement)
+            deadline_s=args.deadline or None, placement=args.placement,
+            engine_factory=lambda: fresh_engine(prefix_caching=True),
+            autoscale_max=args.autoscale)
         print(json.dumps({
             "metric": "serving_router_open_loop",
             "backend": jax.default_backend(),

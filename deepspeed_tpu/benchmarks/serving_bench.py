@@ -310,16 +310,23 @@ def bench_mixed(model, params, *, requests: int, prompt: int,
 
 def bench_routed(model, params, *, replicas_n: int, requests: int,
                  prompt: int, new_tokens: int, budget: int,
-                 disaggregated: bool, trace_out=None) -> dict:
+                 disaggregated: bool, trace_out=None,
+                 remote: bool = False, chunk_blocks: int = 4) -> dict:
     """Routed fleet sweep: a shared-prefix workload through N replicas
     behind the affinity router, double-warmed (every bucket compiles on
     wave 1, respecializes once on wave 2) before a steady wave under
     ``watchdog.mark_steady``. Runs in an isolated registry/recorder.
-    ``trace_out`` writes the stitched fleet timeline of the run."""
+    ``trace_out`` writes the stitched fleet timeline of the run.
+    ``remote=True`` puts every replica behind a LOOPBACK socket (an
+    in-process worker + RemoteReplica shim — the remote serving plane's
+    wire without subprocess spawn cost); ``chunk_blocks`` sets the
+    streaming-handoff chunk width for the disaggregated path (0 = the
+    legacy blocking transport)."""
     import asyncio
 
     from ..inference.v2.engine_v2 import InferenceEngineV2
-    from ..inference.v2.serve import (PrefillReplica, ReplicaRouter,
+    from ..inference.v2.serve import (PrefillReplica, RemoteReplica,
+                                      ReplicaRouter, ReplicaWorker,
                                       RouterConfig, ServingConfig,
                                       build_replicas)
     from ..telemetry import (FlightRecorder, MetricsRegistry,
@@ -351,14 +358,27 @@ def bench_routed(model, params, *, replicas_n: int, requests: int,
     watchdog.reset()
     try:
         async def run():
-            replicas = build_replicas(
-                [_engine() for _ in range(replicas_n)],
-                ServingConfig(token_budget=budget))
+            workers = []
+            if remote:
+                replicas = []
+                for i in range(replicas_n):
+                    worker = ReplicaWorker(
+                        _engine(), ServingConfig(token_budget=budget),
+                        name=f"replica{i}")
+                    host, port = await worker.start()
+                    workers.append(worker)
+                    replicas.append(RemoteReplica(f"replica{i}", host,
+                                                  port))
+            else:
+                replicas = build_replicas(
+                    [_engine() for _ in range(replicas_n)],
+                    ServingConfig(token_budget=budget))
             pws = ([PrefillReplica("prefill0", _engine())]
                    if disaggregated else [])
             router = ReplicaRouter(
                 replicas,
                 RouterConfig(disaggregated=disaggregated,
+                             handoff_chunk_blocks=chunk_blocks,
                              monitor_interval_s=0.0),
                 prefill_replicas=pws)
             await router.start()
@@ -384,7 +404,11 @@ def bench_routed(model, params, *, replicas_n: int, requests: int,
                 watchdog.mark_steady(False)
             out = {
                 "replicas": replicas_n,
+                "remote": remote,
                 "disaggregated": disaggregated,
+                "handoff_chunk_blocks": chunk_blocks,
+                "handoff_chunks": reg.family_total(
+                    "handoff_chunks_total"),
                 # the ACTUAL per-wave request count (group-rounded from
                 # the requested batch), which tok_s is computed over
                 "requests": len(prompts),
@@ -407,6 +431,8 @@ def bench_routed(model, params, *, replicas_n: int, requests: int,
                 # replica) a process row, spans carrying trace ids
                 out["trace_out"] = timeline.write_fleet_trace(trace_out)
             await router.stop()
+            for worker in workers:
+                await worker.stop()
             return out
 
         return asyncio.run(run())
@@ -426,7 +452,8 @@ def main_router(args) -> int:
                        requests=args.batch, prompt=args.prompt,
                        new_tokens=args.new, budget=args.budget,
                        disaggregated=args.disagg,
-                       trace_out=args.trace_out)
+                       trace_out=args.trace_out, remote=args.remote,
+                       chunk_blocks=args.chunk_blocks)
     print(json.dumps({
         "metric": "serving_routed_tokens_per_sec",
         "backend": jax.default_backend(),
@@ -511,6 +538,14 @@ def main(argv=None) -> int:
                         "prefix-affinity router — reports routed tok/s, "
                         "affinity hits, handoffs and steady-state "
                         "recompiles")
+    p.add_argument("--remote", action="store_true",
+                   help="with --router: put every replica behind a "
+                        "loopback socket (worker + RemoteReplica shim — "
+                        "the remote serving plane's wire)")
+    p.add_argument("--chunk-blocks", type=int, default=4,
+                   help="with --router --disagg: KV blocks per chunk of "
+                        "the streaming handoff (0 = legacy blocking "
+                        "whole-sequence transport)")
     p.add_argument("--disagg", action="store_true",
                    help="with --router: add a dedicated prefill replica "
                         "and route through the prefill->handoff->decode "

@@ -261,6 +261,173 @@ def ring_all_gather_quant(row: jnp.ndarray, axis: str, world: int,
     return out, err
 
 
+# ---------------------------------------------------------------------------
+# Hierarchical (two-level) quantized rings — the EQuARX multi-pod shape
+# (arXiv:2506.17615 §multi-pod): a dp world of ``world`` devices laid out
+# as ``groups`` hosts x ``world // groups`` devices per host. Intra-host
+# legs ride the fast wire and stay fp32 (exact, no error); ONLY the
+# inter-host legs — the slow wire the quantization exists for — carry
+# the 1-byte payload. Groups are contiguous index ranges (device
+# g*H + h is member h of host g), matching how pods enumerate hosts.
+# Selected by ``zero_optimization.quantized_reduce_hierarchy`` (the
+# number of hosts; 0/1 = the flat single-level ring).
+# ---------------------------------------------------------------------------
+def _hier_shape(world: int, groups: int):
+    groups = int(groups)
+    if groups < 1 or world % groups != 0:
+        raise ValueError(
+            f"hierarchical ring needs groups to divide world "
+            f"(got world={world}, groups={groups})")
+    return groups, world // groups
+
+
+def ring_reduce_scatter_hier(buf: jnp.ndarray, axis: str, world: int,
+                             groups: int, block: int = 2048,
+                             mode: str = "int8"):
+    """Two-level ring reduce-scatter of [world, M] row partials.
+
+    Phase 1 reduces each target row WITHIN the host at fp32 (an
+    intra-host ppermute ring over the ``H = world // groups`` members,
+    payload ``[groups, M]`` — the rows destined for this member index
+    across every target host); phase 2 finishes the sum ACROSS hosts on
+    a quantized ring over the ``groups`` same-member peers, requantizing
+    the running partial per hop like :func:`ring_reduce_scatter_quant`.
+
+    Same contract as the flat ring: returns ``(row, err)`` — device
+    ``idx``'s fully-summed row (the final local add is never quantized)
+    and err ``[world, M]``, THIS device's per-row quantization error
+    (nonzero only at the ``groups - 1`` rows it quantized; zero
+    everywhere when ``groups == 1`` — nothing rode the slow wire).
+    ``groups == world`` degenerates to the flat quantized ring
+    bit-for-bit. Must run inside shard_map over ``axis``.
+    """
+    G, H = _hier_shape(world, groups)
+    if world == 1:
+        return buf[0], jnp.zeros_like(buf)
+    M = buf.shape[1]
+    idx = jax.lax.axis_index(axis)
+    g, h = idx // H, idx % H
+    grouped = buf.reshape(G, H, M)
+
+    def take_member(m):
+        # rows destined for member m of EVERY target host: [G, M]
+        return jax.lax.dynamic_index_in_dim(grouped, m % H, 1,
+                                            keepdims=False)
+
+    # phase 1: intra-host fp32 ring reduce-scatter over members
+    perm_intra = [(gg * H + hh, gg * H + (hh + 1) % H)
+                  for gg in range(G) for hh in range(H)]
+    acc = take_member(h - 1)
+    for s in range(H - 1):
+        acc = jax.lax.ppermute(acc, axis, perm_intra) \
+            + take_member(h - s - 2)
+    # acc[gt] = sum over this host's members of row (gt*H + h)
+    err = jnp.zeros_like(buf)
+    if G == 1:
+        return acc[0], err
+    # phase 2: inter-host quantized ring over same-member peers
+    perm_inter = [(gg * H + hh, ((gg + 1) % G) * H + hh)
+                  for gg in range(G) for hh in range(H)]
+
+    def take_group(b):
+        return jax.lax.dynamic_index_in_dim(acc, b % G, 0,
+                                            keepdims=False)
+
+    err_g = jnp.zeros((G, M), buf.dtype)
+    acc2 = take_group(g - 1)
+    for s in range(G - 1):
+        q, scale = _quantize_wire(acc2, block, mode)
+        deq = _dequantize_wire(q, scale, M)
+        err_g = jax.lax.dynamic_update_index_in_dim(
+            err_g, acc2 - deq, jnp.mod(g - s - 1, G), 0)
+        q = jax.lax.ppermute(q, axis, perm_inter)
+        scale = jax.lax.ppermute(scale, axis, perm_inter)
+        acc2 = _dequantize_wire(q, scale, M) + take_group(g - s - 2)
+    # scatter this device's group-row errors back to global rows
+    # gt*H + h — the [world, M] layout the EF residual state uses
+    err = err.at[jnp.arange(G) * H + h].set(err_g)
+    return acc2, err
+
+
+def ring_all_gather_hier(row: jnp.ndarray, axis: str, world: int,
+                         groups: int, block: int = 2048,
+                         mode: str = "int8"):
+    """Two-level ring all-gather of a per-device [M] row.
+
+    Phase 1 gathers same-member rows ACROSS hosts on a quantized ring
+    (each row quantized ONCE at its source; every device — including
+    the source — uses the dequantized values, preserving the
+    replicated-identical invariant of :func:`ring_all_gather_quant`);
+    phase 2 gathers the per-member ``[groups, M]`` blocks WITHIN the
+    host at fp32. Returns ``(full [world, M], err [M])`` with err the
+    source's own quantization error (zero when ``groups == 1``).
+    """
+    G, H = _hier_shape(world, groups)
+    M = row.shape[0]
+    if world == 1:
+        return row[None], jnp.zeros_like(row)
+    idx = jax.lax.axis_index(axis)
+    g, h = idx // H, idx % H
+    if G == 1:
+        deq_rows = row[None]                      # [1, M]
+        err = jnp.zeros_like(row)
+    else:
+        perm_inter = [(gg * H + hh, ((gg + 1) % G) * H + hh)
+                      for gg in range(G) for hh in range(H)]
+        q, scale = _quantize_wire(row, block, mode)
+        deq = _dequantize_wire(q, scale, M)
+        err = row - deq
+        deq_rows = jnp.zeros((G, M), row.dtype)
+        deq_rows = jax.lax.dynamic_update_index_in_dim(deq_rows, deq,
+                                                       g, 0)
+        for s in range(G - 1):
+            q = jax.lax.ppermute(q, axis, perm_inter)
+            scale = jax.lax.ppermute(scale, axis, perm_inter)
+            deq_rows = jax.lax.dynamic_update_index_in_dim(
+                deq_rows, _dequantize_wire(q, scale, M),
+                jnp.mod(g - s - 1, G), 0)
+    # deq_rows[gt] = row of device (gt, h); gather across members fp32
+    out = jnp.zeros((H, G, M), row.dtype)
+    out = jax.lax.dynamic_update_index_in_dim(out, deq_rows, h, 0)
+    if H > 1:
+        perm_intra = [(gg * H + hh, gg * H + (hh + 1) % H)
+                      for gg in range(G) for hh in range(H)]
+        payload = deq_rows
+        for s in range(H - 1):
+            payload = jax.lax.ppermute(payload, axis, perm_intra)
+            out = jax.lax.dynamic_update_index_in_dim(
+                out, payload, jnp.mod(h - s - 1, H), 0)
+    # out[ht, gt] = row of device (gt, ht) -> [world, M] global order
+    full = jnp.moveaxis(out, 0, 1).reshape(world, M)
+    return full, err
+
+
+def hier_wire_bytes(numel: int, world: int, groups: int,
+                    block: int = 2048) -> dict:
+    """Aggregate wire bytes of ONE [world, numel]-row reduce-scatter,
+    split by wire class — the comm_bench assertion that the hierarchy
+    actually moves the quantization win onto the slow wire.
+
+    Flat fp32 ring: every device ships its running partial every hop;
+    with contiguous host grouping, ``groups`` of the ring's edges cross
+    hosts, so per full reduce ``(world-1) hops x groups crossing
+    messages x numel x 4`` bytes ride the slow wire. Hierarchical:
+    every device does ``groups - 1`` quantized inter-host hops of
+    :func:`quant_wire_bytes` each, and ``H - 1`` fp32 intra-host hops
+    of ``groups x numel x 4``.
+    """
+    G, H = _hier_shape(world, groups)
+    inter_fp32_flat = (world - 1) * G * numel * 4
+    inter_quant = world * (G - 1) * quant_wire_bytes(numel, block)
+    return {
+        "inter_bytes_fp32_flat": inter_fp32_flat,
+        "inter_bytes_quant": inter_quant,
+        "intra_bytes_fp32": world * (H - 1) * G * numel * 4,
+        "ratio": (inter_fp32_flat / inter_quant
+                  if inter_quant else float("inf")),
+    }
+
+
 def quant_wire_bytes(numel: int, block: int = 2048) -> int:
     """Bytes on the wire for one quantized hop of a [numel] message:
     1 byte/element (block-padded) + fp32 scale per block, with the block

@@ -19,12 +19,21 @@ DeepSpeed-MII persistent deployments over the FastGen engine):
   replica.py   — the units behind the router: full serving replicas and
                  dedicated prefill workers
   handoff.py   — paged-KV export/serialize/restore between replicas
-                 (the disaggregation transport; parity-pinned)
+                 (the disaggregation transport; parity-pinned), plus the
+                 chunked streaming protocol that overlaps transfer with
+                 the decode replica's running batch
+  remote.py    — RemoteReplica: the Replica protocol over a socket
+                 (HTTP client shim onto a worker process)
+  worker.py    — the replica worker process behind RemoteReplica
+                 (python -m deepspeed_tpu.inference.v2.serve.worker)
+  autoscaler.py— spawn/drain replicas off the router's load, shed,
+                 SLO-burn and heartbeat signals
 
-See docs/SERVING.md ("Async serving runtime" and "Routing tier") for
-the architecture and the streaming protocol.
+See docs/SERVING.md ("Async serving runtime", "Routing tier" and
+"Remote replicas & autoscaling") for the architecture and protocols.
 """
 
+from . import handoff  # noqa: F401
 from .admission import (AdmissionConfig, AdmissionController,  # noqa: F401
                         OverloadedError)
 from .frontend import (DeadlineExceeded, RequestFailed,  # noqa: F401
@@ -34,6 +43,9 @@ from .api import ServingAPI  # noqa: F401
 from .replica import PrefillReplica, Replica, build_replicas  # noqa: F401
 from .router import (ReplicaRouter, RoutedStream,  # noqa: F401
                      RouterConfig)
+from .remote import RemoteReplica, RemoteStream  # noqa: F401
+from .worker import ReplicaWorker, WorkerAPI  # noqa: F401
+from .autoscaler import Autoscaler, AutoscalerConfig  # noqa: F401
 
 __all__ = [
     "AdmissionConfig", "AdmissionController", "OverloadedError",
@@ -41,4 +53,6 @@ __all__ = [
     "TokenStream", "ServingLoop", "ServingAPI",
     "PrefillReplica", "Replica", "build_replicas",
     "ReplicaRouter", "RoutedStream", "RouterConfig",
+    "RemoteReplica", "RemoteStream", "ReplicaWorker", "WorkerAPI",
+    "Autoscaler", "AutoscalerConfig", "handoff",
 ]

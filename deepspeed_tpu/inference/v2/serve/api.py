@@ -158,21 +158,34 @@ class ServingAPI:
             elif method == "GET" and target == "/metrics":
                 # routed frontend mode: federate per-replica registries
                 # under a `replica` label (falls back to the plain
-                # process-default exposition when replicas share it)
-                fed = getattr(self.serving, "federated_metrics", None)
-                text = (fed() if fed is not None
-                        else self.registry.render_prometheus())
+                # process-default exposition when replicas share it).
+                # Remote replicas make federation async (their series
+                # arrive over HTTP) — prefer the async form when the
+                # router exposes one.
+                fed = (getattr(self.serving, "federated_metrics_async",
+                               None)
+                       or getattr(self.serving, "federated_metrics",
+                                  None))
+                if fed is None:
+                    text = self.registry.render_prometheus()
+                else:
+                    text = fed()
+                    if asyncio.iscoroutine(text):
+                        text = await text
                 writer.write(_response_head(
                     "200 OK", "text/plain; version=0.0.4; charset=utf-8")
                     + text.encode())
             elif method == "GET" and target == "/debug/timeline":
-                self._timeline(writer, query)
+                await self._timeline(writer, query)
             elif method == "GET" and target == "/statusz":
                 self._statusz_response(writer, query)
             elif method == "POST" and target == "/debug/postmortem":
                 await self._postmortem(writer)
             elif method == "POST" and target == "/generate":
                 await self._generate(reader, writer, body, headers)
+            elif await self._route_extra(method, target, query, headers,
+                                         body, reader, writer):
+                pass
             else:
                 _json_response(writer, "404 Not Found",
                                {"error": f"no route {method} {target}"})
@@ -186,7 +199,14 @@ class ServingAPI:
                 pass
             writer.close()
 
-    def _timeline(self, writer, query: str) -> None:
+    async def _route_extra(self, method: str, target: str, query: str,
+                           headers, body, reader, writer) -> bool:
+        """Subclass hook for extra endpoints (the replica worker's
+        lifecycle + handoff routes, serve/worker.py); returns True when
+        the request was handled."""
+        return False
+
+    async def _timeline(self, writer, query: str) -> None:
         """Chrome-trace JSON of the span ring buffer (``?uid=N`` filters
         to one request's correlated spans, ``?trace=ID`` to one
         distributed trace). Routed mode serves the STITCHED fleet form
@@ -206,7 +226,11 @@ class ServingAPI:
                     {"error": "routed timeline filters by ?trace=<id> "
                               "(uids are per replica, not fleet-wide)"})
                 return
-            _json_response(writer, "200 OK", fleet(trace_id=trace_id))
+            doc = fleet(trace_id=trace_id)
+            if asyncio.iscoroutine(doc):
+                # remote replicas: their span rings arrive over HTTP
+                doc = await doc
+            _json_response(writer, "200 OK", doc)
             return
         spans = ds_trace.export()
         try:
@@ -352,8 +376,13 @@ class ServingAPI:
         writer.write(_response_head(
             "200 OK", "application/x-ndjson",
             {"traceparent": ctx.to_traceparent()}))
-        # with Connection: close the client sends nothing more; read()
-        # completing means it hung up — cancel so the KV blocks free
+        await self._stream_tokens(reader, writer, stream, ctx)
+
+    async def _stream_tokens(self, reader, writer, stream, ctx) -> None:
+        """Pump one token stream as NDJSON lines + the tail summary
+        (shared by /generate and the worker's /handoff response).
+        With Connection: close the client sends nothing more; read()
+        completing means it hung up — cancel so the KV blocks free."""
         hangup = asyncio.ensure_future(reader.read(1))
         status, detail = "completed", None
         try:

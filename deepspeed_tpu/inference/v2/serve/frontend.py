@@ -351,6 +351,28 @@ class ServingEngine:
                                  rng_state=rng_state)
         return stream
 
+    async def begin_handoff(self, header_chunk: bytes) -> "ChunkedHandoff":
+        """Open a chunked streaming handoff (serve/handoff.py chunk
+        protocol): parse the header chunk, adopt the destination blocks
+        on the loop thread, and return the feed/commit/abort handle.
+        Each fed chunk applies BETWEEN scheduler steps, so the transfer
+        overlaps this runtime's running batch. Raises
+        :class:`~.admission.OverloadedError` while draining (the
+        caller re-routes, like ``resume``)."""
+        from . import handoff as handoff_mod
+        if self._stopped or self.admission.closed:
+            from .admission import OverloadedError
+            raise OverloadedError(
+                "draining", "serving runtime is draining; not accepting "
+                "handoffs",
+                retry_after_s=self.config.admission.retry_after_s)
+        header = await asyncio.to_thread(handoff_mod.parse_header,
+                                         header_chunk)
+        uid = next(self._uids)
+        await self._loop_runner.run_on_loop(
+            lambda: self._loop_runner.begin_restore(uid, header))
+        return ChunkedHandoff(self, uid, header)
+
     # -- introspection --------------------------------------------------
     def heartbeat_age(self) -> Optional[float]:
         """Seconds since the serving loop's last stall-watchdog
@@ -362,6 +384,7 @@ class ServingEngine:
         return stall.heartbeat_age("serving_loop")
 
     def health(self) -> dict:
+        age = self.heartbeat_age()
         return {
             "status": ("draining" if (self.admission.closed
                                       or self._stopped) else "ok"),
@@ -369,4 +392,110 @@ class ServingEngine:
             "queued_tokens": self.admission.queued_tokens(),
             "inflight": self.scheduler.inflight(),
             "loop_alive": self._loop_runner.running,
+            # the replica-surface signals a remote router shim maps
+            # from one /healthz poll (serve/remote.py)
+            "load": (self.admission.queued_tokens()
+                     + self.scheduler.inflight()),
+            "heartbeat_age_s": age,
+            "block_size": int(
+                self.scheduler.engine.state_manager.block_size),
+            "max_seq_len": int(
+                self.scheduler.engine.state_manager.config.max_seq_len),
         }
+
+
+class ChunkedHandoff:
+    """Client handle for one streaming handoff into a
+    :class:`ServingEngine` (``begin_handoff``): ``feed`` each KV chunk
+    (awaiting the ack paces the wire and lets scheduler steps
+    interleave), then ``commit`` with the decode parameters to get the
+    token stream — or ``abort`` to free the partially-streamed blocks."""
+
+    def __init__(self, serving: ServingEngine, uid: int, header: dict):
+        self._serving = serving
+        self.uid = uid
+        self.header = header
+        self._open = True
+
+    async def feed(self, chunk: bytes) -> None:
+        from . import handoff as handoff_mod
+        parsed = await asyncio.to_thread(handoff_mod.parse_chunk, chunk)
+        loop = self._serving._loop_runner
+        try:
+            await loop.run_on_loop(
+                lambda: loop.apply_restore(self.uid, parsed, len(chunk)))
+        except asyncio.CancelledError:
+            # the AWAIT was cancelled, not the apply — the loop-side
+            # restore may still be live, so the handle stays open and
+            # abort()/__del__ can free it (closing here would leak the
+            # blocks and wedge graceful drain)
+            raise
+        except BaseException:
+            # the loop already freed the blocks on an apply failure
+            self._open = False
+            raise
+
+    async def commit(self, *, prompt: Sequence[int],
+                     generated: Sequence[int], max_new_tokens: int,
+                     eos_token_id: Optional[int] = None,
+                     temperature: float = 0.0, top_p: float = 1.0,
+                     top_k: int = 0, rng_state=None,
+                     deadline_s: Optional[float] = None,
+                     trace_ctx=None) -> TokenStream:
+        """Verify every chunk arrived and resume decoding here — the
+        chunked counterpart of :meth:`ServingEngine.resume` (same
+        parameters, same bit-identical-to-colocated contract)."""
+        serving = self._serving
+        stream = TokenStream(serving, self.uid,
+                             asyncio.get_running_loop())
+        entry = _Entry(
+            uid=self.uid, prompt=list(map(int, prompt)),
+            max_new_tokens=int(max_new_tokens),
+            eos_token_id=eos_token_id, temperature=temperature,
+            top_p=top_p, top_k=top_k, seed=None, tenant="handoff",
+            weight=None,
+            deadline_t=(serving.clock() + deadline_s
+                        if deadline_s is not None else None),
+            on_token=stream._push_token, on_end=stream._push_end,
+            state="inflight",
+            trace_ctx=(trace_ctx if trace_ctx is not None
+                       else trace_context.current()
+                       or trace_context.from_wire(
+                           self.header.get("trace"))))
+        loop = self._serving._loop_runner
+        try:
+            await loop.run_on_loop(
+                lambda: loop.commit_restore(
+                    entry, list(map(int, generated)), rng_state))
+        except asyncio.CancelledError:
+            # await cancelled mid-commit: leave the handle open so
+            # abort() can still free an uncommitted restore (abort is
+            # a no-op if the loop-side commit did run)
+            raise
+        except BaseException:
+            self._open = False   # loop-side commit failed: already
+            raise                # aborted there
+        self._open = False
+        return stream
+
+    async def abort(self) -> None:
+        if not self._open:
+            return
+        self._open = False
+        loop = self._serving._loop_runner
+        try:
+            await loop.run_on_loop(
+                lambda: loop._abort_restore(self.uid))
+        except Exception:
+            pass
+
+    def __del__(self):
+        # GC net: a dropped handle must not wedge drain holding blocks
+        # (_abort_restore only touches loop-thread state via post())
+        if self._open:
+            try:
+                self._serving._loop_runner.post(
+                    lambda: self._serving._loop_runner._abort_restore(
+                        self.uid))
+            except Exception:
+                pass

@@ -27,12 +27,28 @@ with the null block) so repeated handoffs of different-length
 sequences reuse compiled programs instead of respecializing per
 length; pad rows carry zeros and land in the null block, which no
 attention read ever sees (reads are masked by position).
+
+**Chunked streaming protocol** (ISSUE 12): :func:`export_chunks`
+splits the same payload into one HEADER chunk (the descriptor plus the
+chunk manifest: ranges and per-chunk CRCs) and N per-page-range KV
+chunks, each an independent ``.npz`` buffer. The decode side drives a
+:class:`ChunkedRestore`: ``begin`` adopts the blocks, ``apply``
+scatters ONE range (CRC-checked, idempotent on retransmit — the
+resumability unit), ``commit_check`` verifies every range arrived, and
+``abort`` frees the partially-filled blocks WITHOUT registering their
+content in the prefix index (a partial block must never be reused as a
+cached prefix). Because each ``apply`` is one small scatter executed
+between the serving loop's scheduler steps, the transfer overlaps the
+decode replica's running batch instead of stalling it — the
+``handoff_chunk_*`` metrics and the perf gate's
+``handoff_decode_stall_fraction`` pin that overlap.
 """
 
 import io
 import json
+import zlib
 from functools import partial
-from typing import Dict
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -137,6 +153,210 @@ def deserialize(buf: bytes) -> Dict:
             kv[key] = arr
         pack["kv"] = kv
     return pack
+
+
+# ---------------------------------------------------------------------------
+# chunked streaming protocol (module docstring)
+# ---------------------------------------------------------------------------
+def _leaf_wire_bytes(arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    return (arr.view(np.uint8) if arr.dtype.kind == "V" else arr) \
+        .tobytes()
+
+
+def _chunk_crc(kv: Dict[str, np.ndarray]) -> int:
+    crc = 0
+    for key in sorted(kv):
+        crc = zlib.crc32(_leaf_wire_bytes(kv[key]), crc)
+    return crc
+
+
+def _npz_chunk(descriptor: Dict, kv: Dict[str, np.ndarray]) -> bytes:
+    """One self-describing chunk buffer (same ml_dtypes raw-bytes trick
+    as :func:`serialize`)."""
+    kv_wire, kv_dtypes = {}, {}
+    for key, arr in kv.items():
+        arr = np.ascontiguousarray(arr)
+        kv_dtypes[key] = arr.dtype.name
+        if arr.dtype.kind == "V":
+            arr = arr.view(np.uint8)
+        kv_wire[f"kv_{key}"] = arr
+    descriptor = dict(descriptor, kv_dtypes=kv_dtypes)
+    bio = io.BytesIO()
+    np.savez(bio,
+             **{_DESCRIPTOR_KEY: np.frombuffer(
+                 json.dumps(descriptor).encode(), np.uint8)},
+             **kv_wire)
+    return bio.getvalue()
+
+
+def parse_chunk(buf: bytes) -> Dict:
+    """Chunk buffer -> ``{"descriptor": ..., "kv": {...}}`` with wire
+    dtypes restored."""
+    with np.load(io.BytesIO(buf)) as z:
+        descriptor = json.loads(bytes(z[_DESCRIPTOR_KEY]).decode())
+        dtypes = descriptor.pop("kv_dtypes", {})
+        kv = {}
+        for name in z.files:
+            if not name.startswith("kv_"):
+                continue
+            key, arr = name[3:], z[name]
+            want = dtypes.get(key)
+            if want and arr.dtype.name != want:
+                arr = arr.view(_wire_dtype(want))
+            kv[key] = arr
+    return {"descriptor": descriptor, "kv": kv}
+
+
+def chunk_pack(pack: Dict, chunk_blocks: int) -> List[bytes]:
+    """Split one exported pack into ``[header, kv-chunk...]`` buffers:
+    the header carries the descriptor plus the chunk manifest (ranges +
+    CRCs), each KV chunk one ``chunk_blocks``-wide block range."""
+    chunk_blocks = max(1, int(chunk_blocks))
+    nb = int(pack["n_blocks"])
+    ranges = [(i, min(i + chunk_blocks, nb))
+              for i in range(0, nb, chunk_blocks)]
+    chunks: List[bytes] = []
+    crcs: List[int] = []
+    for seq, (i, j) in enumerate(ranges):
+        kv = {key: np.ascontiguousarray(arr[:, i:j])
+              for key, arr in pack["kv"].items()}
+        crc = _chunk_crc(kv)
+        crcs.append(crc)
+        chunks.append(_npz_chunk(
+            {"kind": "kv", "uid": int(pack["uid"]), "seq": seq,
+             "block_start": i, "block_end": j, "crc32": crc}, kv))
+    header = {k: pack[k] for k in
+              ("uid", "seen_tokens", "n_blocks", "block_size",
+               "token_log", "trace") if k in pack}
+    header.update({
+        "kind": "header", "chunk_blocks": chunk_blocks,
+        "n_chunks": len(ranges),
+        "chunk_ranges": [[i, j] for i, j in ranges],
+        "chunk_crcs": crcs,
+        "leaves": sorted(pack["kv"]),
+        "leaf_dtypes": {k: np.ascontiguousarray(v).dtype.name
+                        for k, v in pack["kv"].items()},
+    })
+    return [_npz_chunk(header, {})] + chunks
+
+
+def export_chunks(engine, uid: int, chunk_blocks: int = 4,
+                  trace_ctx=None) -> List[bytes]:
+    """Snapshot ``uid``'s KV and serialize it as the chunked wire form
+    (``[header, kv-chunk...]``) — the streaming counterpart of
+    ``serialize(export_sequence(...))``."""
+    return chunk_pack(export_sequence(engine, uid, trace_ctx=trace_ctx),
+                      chunk_blocks)
+
+
+def parse_header(buf: bytes) -> Dict:
+    chunk = parse_chunk(buf)
+    d = chunk["descriptor"]
+    if d.get("kind") != "header":
+        raise ValueError(
+            f"chunked handoff must start with the header chunk "
+            f"(got kind={d.get('kind')!r})")
+    return d
+
+
+class ChunkedRestore:
+    """Decode-side state machine for one streaming handoff.
+
+    All methods run on the serving-loop thread (they touch the engine).
+    ``apply`` is idempotent per chunk sequence number — a retransmitted
+    chunk re-scatters identical content — which is what makes the
+    transfer resumable over a flaky wire."""
+
+    def __init__(self, engine, uid: int, header: Dict):
+        self.engine = engine
+        self.uid = int(uid)
+        self.header = header
+        self.received: set = set()
+        self._begun = False
+        self._done = False
+
+    def begin(self) -> None:
+        """Validate the layout and adopt the destination blocks."""
+        sm = self.engine.state_manager
+        h = self.header
+        if sm.block_size != h["block_size"]:
+            raise ValueError(
+                f"handoff block-size mismatch: payload has "
+                f"{h['block_size']}, target pool has {sm.block_size} "
+                f"(disaggregated replicas must share the KV layout)")
+        if set(h["leaves"]) != set(self.engine.kv_cache):
+            raise ValueError(
+                f"handoff pool-leaf mismatch: payload has "
+                f"{sorted(h['leaves'])}, target pool has "
+                f"{sorted(self.engine.kv_cache)} (kv_quant must match)")
+        self.seq = sm.adopt_sequence(self.uid, int(h["n_blocks"]),
+                                     h["seen_tokens"], h["token_log"])
+        self._begun = True
+
+    def apply(self, chunk: Dict) -> None:
+        """Integrity-check and scatter ONE block-range chunk."""
+        d = chunk["descriptor"]
+        if d.get("kind") != "kv":
+            raise ValueError(f"expected a kv chunk, got "
+                             f"{d.get('kind')!r}")
+        seq_no = int(d["seq"])
+        if not 0 <= seq_no < self.header["n_chunks"]:
+            raise ValueError(f"chunk seq {seq_no} outside the header's "
+                             f"{self.header['n_chunks']} chunks")
+        i, j = int(d["block_start"]), int(d["block_end"])
+        if [i, j] != list(self.header["chunk_ranges"][seq_no]):
+            raise ValueError(
+                f"chunk {seq_no} range [{i},{j}) disagrees with the "
+                f"header manifest "
+                f"{self.header['chunk_ranges'][seq_no]}")
+        crc = _chunk_crc(chunk["kv"])
+        if crc != int(d["crc32"]) \
+                or crc != int(self.header["chunk_crcs"][seq_no]):
+            raise ValueError(
+                f"chunk {seq_no} failed its crc32 integrity check "
+                f"(corrupted in transfer)")
+        if set(chunk["kv"]) != set(self.engine.kv_cache):
+            raise ValueError("chunk leaf set disagrees with the pool")
+        blocks = self.seq.blocks[i:j]
+        nb = len(blocks)
+        bucket = pow2_bucket(max(nb, 1),
+                             self.engine.state_manager.max_blocks_per_seq)
+        idx = np.full(bucket, NULL_BLOCK, np.int32)
+        idx[:nb] = blocks
+        for key in list(self.engine.kv_cache):
+            leaf = self.engine.kv_cache[key]
+            data = np.zeros((leaf.shape[0], bucket) + leaf.shape[2:],
+                            np.asarray(chunk["kv"][key]).dtype)
+            data[:, :nb] = chunk["kv"][key]
+            self.engine.kv_cache[key] = _scatter_blocks(
+                leaf, jnp.asarray(idx), jnp.asarray(data, leaf.dtype))
+        self.received.add(seq_no)
+
+    def missing(self) -> List[int]:
+        return [s for s in range(int(self.header["n_chunks"]))
+                if s not in self.received]
+
+    def commit_check(self) -> None:
+        gaps = self.missing()
+        if gaps:
+            raise ValueError(
+                f"handoff incomplete: missing chunks {gaps} of "
+                f"{self.header['n_chunks']}")
+        self._done = True
+
+    def abort(self) -> None:
+        """Free the adopted blocks. The token log is cleared FIRST so
+        flush cannot register partially-filled blocks in the prefix
+        index (a later request must never reuse garbage as a cached
+        prefix)."""
+        if self._begun and not self._done:
+            sm = self.engine.state_manager
+            seq = sm.seqs.get(self.uid)
+            if seq is not None:
+                seq.token_log = []
+                sm.flush_sequence(self.uid)
+        self._done = True
 
 
 def restore_sequence(engine, pack: Dict, uid: int) -> None:

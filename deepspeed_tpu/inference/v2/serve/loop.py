@@ -14,6 +14,7 @@ through each entry's (thread-safe) callbacks. Every scheduler/engine
 touch happens on the loop thread.
 """
 
+import asyncio
 import heapq
 import threading
 import time
@@ -59,6 +60,9 @@ class ServingLoop:
         self.idle_wait_s = idle_wait_s
         self.clock = clock
         self._cmds: deque = deque()      # callables run on the loop thread
+        # set just before the loop's FINAL command drain: commands
+        # posted after it may never run (run_on_loop fails fast on it)
+        self._cmds_closed = False
         self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._stop = False
@@ -67,10 +71,42 @@ class ServingLoop:
         self._deadlines: List = []              # heap of (deadline_t, uid)
         self._just_finished: List = []          # entries finished in step()
         self._dead: List[int] = []              # uids whose on_token raised
+        # chunked streaming KV handoffs in flight (serve/handoff.py
+        # ChunkedRestore, keyed by destination uid): each chunk applies
+        # between scheduler steps, so the transfer overlaps the running
+        # batch; drain waits for them and hard-stop aborts them
+        self._restores: Dict[int, object] = {}
+        # scheduler steps completed since start — the overlap evidence
+        # the chunked-handoff tests and perf gate read
+        self.steps_done = 0
         from ....telemetry import get_registry
-        self._m_expired = get_registry().counter(
+        reg = get_registry()
+        self._m_expired = reg.counter(
             "serving_deadline_expired_total",
             "requests cancelled because their deadline passed")
+        self._m_chunks = reg.counter(
+            "handoff_chunks_total",
+            "chunked-handoff KV chunks applied to this runtime's pool")
+        self._m_chunk_bytes = reg.counter(
+            "handoff_chunk_bytes_total",
+            "serialized chunked-handoff bytes applied")
+        self._m_chunk_apply = reg.histogram(
+            "handoff_chunk_apply_seconds",
+            "per-chunk integrity check + scatter time on the loop "
+            "thread", unit="s",
+            buckets=(1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0))
+        self._m_chunk_aborts = reg.counter(
+            "handoff_chunk_aborts_total",
+            "chunked handoffs aborted mid-transfer (client hangup, "
+            "integrity failure, drain)")
+        self._m_chunk_inflight = reg.gauge(
+            "handoff_chunk_inflight",
+            "chunked handoffs currently streaming into this runtime")
+        self._m_overlap_steps = reg.counter(
+            "handoff_chunk_overlap_steps_total",
+            "scheduler steps completed while >=1 chunked handoff was "
+            "in flight (the transfer/compute overlap the protocol "
+            "buys)")
 
     # -- cross-thread surface (any thread) ------------------------------
     def post(self, fn: Callable[[], None]) -> None:
@@ -92,6 +128,45 @@ class ServingLoop:
         KV pack into the engine and insert the entry directly into the
         scheduler's running set, both on the loop thread."""
         self.post(lambda: self._resume(entry, pack, generated, rng_state))
+
+    def run_on_loop(self, fn: Callable[[], object]) -> "asyncio.Future":
+        """Run ``fn`` on the loop thread and resolve an asyncio future
+        with its result (or exception) — the chunked-handoff surface's
+        ack channel. Must be called from a running event loop."""
+        aio = asyncio.get_running_loop()
+        fut: asyncio.Future = aio.create_future()
+
+        def done(result, exc) -> None:
+            if fut.done():
+                return
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
+
+        def wrapped() -> None:
+            try:
+                result = fn()
+            except BaseException as e:   # noqa: BLE001 — forwarded
+                result, exc = None, e
+            else:
+                exc = None
+            try:
+                aio.call_soon_threadsafe(done, result, exc)
+            except RuntimeError:
+                # the client's event loop is gone (closed between post
+                # and execution): drop the ack — it must not kill the
+                # serving-loop thread mid-drain
+                pass
+
+        self.post(wrapped)
+        if self._cmds_closed or not self.running:
+            # a dead (or exiting: _cmds_closed set before the final
+            # drain) loop never processes this command — fail fast
+            # instead of awaiting forever (wrapped() may still run via
+            # the final drain; done() is idempotent either way)
+            done(None, RuntimeError("serving loop is not running"))
+        return fut
 
     def request_drain(self) -> None:
         """Graceful drain: admission closes immediately (new submits get
@@ -163,6 +238,12 @@ class ServingLoop:
             self._end(entry, "error",
                       f"handoff restore failed: {type(e).__name__}: {e}")
             return
+        self._adopt(entry, generated, rng_state)
+
+    def _adopt(self, entry, generated, rng_state) -> None:
+        """Insert an entry whose KV is already in the pool into the
+        scheduler's running set (shared by the blocking and chunked
+        handoff paths)."""
         try:
             self.scheduler.resume(
                 entry.uid, entry.prompt, generated,
@@ -179,6 +260,58 @@ class ServingLoop:
         self._entries[entry.uid] = entry
         if entry.deadline_t is not None:
             heapq.heappush(self._deadlines, (entry.deadline_t, entry.uid))
+
+    # -- chunked streaming handoff (loop thread; serve/handoff.py) ------
+    def begin_restore(self, uid: int, header) -> None:
+        """Adopt the destination blocks for a streaming handoff
+        (raises through run_on_loop's future on layout mismatch /
+        pool exhaustion)."""
+        from . import handoff
+        if self._stop or self._draining:
+            raise RuntimeError("serving loop is draining")
+        restore = handoff.ChunkedRestore(self.scheduler.engine, uid,
+                                         header)
+        restore.begin()
+        self._restores[uid] = restore
+        self._m_chunk_inflight.set(len(self._restores))
+
+    def apply_restore(self, uid: int, chunk, nbytes: int) -> None:
+        restore = self._restores.get(uid)
+        if restore is None:
+            raise ValueError(f"no chunked handoff in flight for uid "
+                             f"{uid}")
+        t0 = time.perf_counter()
+        try:
+            restore.apply(chunk)
+        except Exception:
+            # integrity/protocol failure: free the partial blocks NOW —
+            # the client learns from the raised ack either way
+            self._abort_restore(uid)
+            raise
+        self._m_chunks.inc()
+        self._m_chunk_bytes.inc(nbytes)
+        self._m_chunk_apply.observe(time.perf_counter() - t0)
+
+    def commit_restore(self, entry, generated, rng_state) -> None:
+        restore = self._restores.get(entry.uid)
+        if restore is None:
+            raise ValueError(f"no chunked handoff in flight for uid "
+                             f"{entry.uid}")
+        try:
+            restore.commit_check()
+        except Exception:
+            self._abort_restore(entry.uid)
+            raise
+        del self._restores[entry.uid]
+        self._m_chunk_inflight.set(len(self._restores))
+        self._adopt(entry, generated, rng_state)
+
+    def _abort_restore(self, uid: int) -> None:
+        restore = self._restores.pop(uid, None)
+        if restore is not None:
+            restore.abort()
+            self._m_chunk_aborts.inc()
+            self._m_chunk_inflight.set(len(self._restores))
 
     def _cancel(self, uid: int, status: str) -> None:
         entry = self._entries.get(uid)
@@ -317,6 +450,8 @@ class ServingLoop:
             pass
 
     def _abort_remaining(self) -> None:
+        for uid in list(self._restores):
+            self._abort_restore(uid)     # free partially-streamed KV
         for entry in list(self._entries.values()):
             self._cancel(entry.uid, "cancelled")
         while (entry := self.admission.pop()) is not None:
@@ -338,6 +473,11 @@ class ServingLoop:
                     self._diag_step(self.scheduler.step)
                 except Exception as e:
                     self._step_error(e)
+                self.steps_done += 1
+                if self._restores:
+                    # a chunked handoff is streaming in AND the batch
+                    # kept stepping — the overlap the protocol buys
+                    self._m_overlap_steps.inc()
                 self._cancel_dead()
                 self._flush_finished()
                 continue
@@ -350,6 +490,7 @@ class ServingLoop:
             # last busy-time values after traffic stops
             self._diag_tick()
             if (self._draining and not self._entries
+                    and not self._restores
                     and self.admission.empty() and not self._cmds):
                 break
             # idle: block until woken (every external command calls
@@ -368,6 +509,7 @@ class ServingLoop:
                 timeout = 1.0 if timeout is None else min(timeout, 1.0)
             self._wake.wait(timeout)
             self._wake.clear()
+        self._cmds_closed = True
         self._run_cmds()
         self._abort_remaining()
         self._diag_drain()

@@ -1,0 +1,493 @@
+"""Socket-backed replicas: the Replica protocol over HTTP.
+
+:class:`RemoteReplica` is the client half of the remote serving plane
+(docs/SERVING.md § Remote replicas & autoscaling): it satisfies the
+exact surface :class:`~.router.ReplicaRouter` routes through —
+``submit`` / ``resume_handoff`` / ``health`` / ``load`` /
+``heartbeat_age`` / ``drain`` / ``stop`` — by speaking to a replica
+worker process (serve/worker.py, spawnable via ``python -m
+deepspeed_tpu.inference.v2.serve.worker``) over its HTTP API:
+
+  * ``submit`` → ``POST /generate`` with W3C ``traceparent`` (+
+    ``baggage``) request headers, parsed as a streaming-NDJSON
+    :class:`RemoteStream` (the TokenStream surface; closing the client
+    write side cancels the remote request and frees its KV);
+  * ``health`` / ``load`` / ``heartbeat_age`` → ``GET /healthz``
+    snapshots, cached between :meth:`refresh` polls so the router's
+    per-submit dead-replica check never pays a blocking probe;
+  * ``drain`` / ``stop`` → ``POST /drain`` / ``POST /stop`` lifecycle
+    endpoints;
+  * ``resume_handoff`` → ``POST /handoff``, streaming the chunked KV
+    payload as length-prefixed frames (serve/handoff.py wire format)
+    that the worker applies BETWEEN its decode steps — the transfer
+    overlaps the remote replica's running batch — then reading the
+    decode token stream back on the same connection;
+  * ``metrics_text`` / ``fetch_spans`` → ``GET /metrics`` and
+    ``GET /debug/spans``, so federated ``/metrics`` and the stitched
+    fleet timeline keep working when replicas leave the process
+    (remote span clocks are rebased onto this process's
+    ``perf_counter`` via the worker's wall-clock anchor).
+
+Everything is stdlib asyncio — no HTTP client dependency — and every
+connection is ``Connection: close``, matching serve/api.py's protocol.
+"""
+
+import asyncio
+import json
+import time
+from typing import List, Optional
+
+from ....telemetry import context as trace_context
+from .admission import OverloadedError
+from .frontend import DeadlineExceeded, RequestFailed
+
+# ---------------------------------------------------------------------------
+# /handoff frame protocol: after the request headers, the client streams
+# [1-byte type][4-byte big-endian length][payload] frames —
+#   C  one chunk of a chunked KV handoff (serve/handoff.py chunk .npz)
+#   B  one whole legacy blocking payload (handoff.serialize bytes)
+#   P  terminal JSON params frame (decode parameters + rng state);
+#      the worker commits the restore and streams NDJSON tokens back
+# ---------------------------------------------------------------------------
+FRAME_CHUNK = b"C"
+FRAME_BLOCKING = b"B"
+FRAME_PARAMS = b"P"
+_MAX_FRAME_BYTES = 256 * 1024 * 1024
+
+
+def write_frame(writer: asyncio.StreamWriter, kind: bytes,
+                payload: bytes) -> None:
+    writer.write(kind + len(payload).to_bytes(4, "big") + payload)
+
+
+async def read_frame(reader: asyncio.StreamReader):
+    """Returns ``(kind, payload)``; raises
+    :class:`asyncio.IncompleteReadError` on EOF mid-frame (the
+    mid-transfer-abort signal the worker handles)."""
+    head = await reader.readexactly(5)
+    kind, n = head[:1], int.from_bytes(head[1:], "big")
+    if n > _MAX_FRAME_BYTES:
+        raise ValueError(f"handoff frame too large ({n} bytes)")
+    return kind, await reader.readexactly(n)
+
+
+# ---------------------------------------------------------------------------
+# minimal HTTP/1.1 client for the Connection: close API
+# ---------------------------------------------------------------------------
+async def _open_request(host: str, port: int, method: str, target: str,
+                        headers: Optional[dict] = None, body: bytes = b"",
+                        timeout: float = 5.0):
+    """Send one request and parse the response head; returns
+    ``(status_code, resp_headers, reader, writer)`` with the body left
+    on ``reader`` (the streaming endpoints keep reading it)."""
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), timeout)
+    lines = [f"{method} {target} HTTP/1.1", f"Host: {host}:{port}",
+             "Connection: close", f"Content-Length: {len(body)}"]
+    for k, v in (headers or {}).items():
+        lines.append(f"{k}: {v}")
+    writer.write(("\r\n".join(lines) + "\r\n\r\n").encode() + body)
+    await writer.drain()
+    status_line = await asyncio.wait_for(reader.readline(), timeout)
+    if not status_line:
+        raise ConnectionError(f"empty response from {host}:{port}")
+    parts = status_line.decode("latin-1").split(None, 2)
+    code = int(parts[1])
+    resp_headers = {}
+    while True:
+        line = await reader.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+        name, _, value = line.decode("latin-1").partition(":")
+        resp_headers[name.strip().lower()] = value.strip()
+    return code, resp_headers, reader, writer
+
+
+async def _request_json(host: str, port: int, method: str, target: str,
+                        body: Optional[dict] = None, timeout: float = 5.0):
+    """One-shot JSON request/response; returns ``(code, obj)``."""
+    payload = json.dumps(body).encode() if body is not None else b""
+    code, _, reader, writer = await _open_request(
+        host, port, method, target,
+        headers={"Content-Type": "application/json"} if body else None,
+        body=payload, timeout=timeout)
+    try:
+        data = await asyncio.wait_for(reader.read(), timeout)
+    finally:
+        writer.close()
+    try:
+        return code, json.loads(data.decode() or "null")
+    except json.JSONDecodeError:
+        return code, None
+
+
+def _trace_headers() -> dict:
+    """The W3C trace headers for the current bound context — every hop
+    a RemoteReplica makes carries the request's ONE trace identity."""
+    ctx = trace_context.current()
+    if ctx is None:
+        return {}
+    out = {"traceparent": ctx.to_traceparent()}
+    if ctx.baggage:
+        out["baggage"] = ctx.to_baggage_header()
+    return out
+
+
+class RemoteStream:
+    """Async token stream over one remote NDJSON response — the
+    TokenStream surface (iterate / ``cancel()`` / ``drain()`` /
+    ``.tokens`` / ``.status`` / ``.reason`` / ``.uid``). ``uid`` is the
+    REMOTE runtime's uid, filled in by the tail summary line."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self._reader = reader
+        self._writer = writer
+        self._ended = False
+        self.uid: Optional[int] = None
+        self.status = "active"
+        self.reason: Optional[str] = None
+        self.trace_id: Optional[str] = None
+        self.tokens: List[int] = []
+
+    def __aiter__(self) -> "RemoteStream":
+        return self
+
+    async def __anext__(self) -> int:
+        if self._ended:
+            raise StopAsyncIteration
+        while True:
+            try:
+                line = await self._reader.readline()
+            except (ConnectionResetError, BrokenPipeError, OSError) as e:
+                self._finish("error", f"connection lost: {e}")
+                raise RequestFailed(f"remote stream: {self.reason}")
+            if not line:
+                self._finish(self.status if self._ended else "error",
+                             "connection closed mid-stream")
+                raise RequestFailed(f"remote stream: {self.reason}")
+            line = line.strip()
+            if not line:
+                continue
+            obj = json.loads(line)
+            if "token" in obj:
+                tok = int(obj["token"])
+                self.tokens.append(tok)
+                return tok
+            # tail summary line
+            self.uid = obj.get("uid")
+            self.trace_id = obj.get("trace_id")
+            self._finish(obj.get("status", "completed"),
+                         obj.get("detail"))
+            if self.status == "expired":
+                raise DeadlineExceeded("remote request: deadline "
+                                       "exceeded")
+            if self.status == "error":
+                raise RequestFailed(f"remote request: {self.reason}")
+            raise StopAsyncIteration
+
+    def _finish(self, status: str, reason: Optional[str]) -> None:
+        self._ended = True
+        self.status, self.reason = status, reason
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+
+    async def cancel(self) -> None:
+        """Close the client write side — the worker reads the hangup
+        (serve/api.py's EOF protocol) and cancels the request, freeing
+        its KV blocks on the remote pool."""
+        if not self._ended:
+            self._finish("cancelled", None)
+
+    async def aclose(self) -> None:
+        await self.cancel()
+
+    async def drain(self) -> List[int]:
+        async for _ in self:
+            pass
+        return self.tokens
+
+
+class RemoteReplica:
+    """A serving replica living in another process, addressed by
+    ``host:port`` — the Replica protocol over the worker HTTP API.
+
+    ``state`` stays router-owned exactly like the in-process
+    :class:`~.replica.Replica`. Health/load/heartbeat signals come from
+    cached ``GET /healthz`` snapshots refreshed by :meth:`refresh`
+    (the router polls it from ``check_replicas``); a refresh that
+    cannot reach the worker marks the replica not-alive, which the
+    router's dead-replica detector treats like a dead loop thread."""
+
+    registry = None          # metrics federate via /metrics text instead
+
+    def __init__(self, name: str, host: str, port: int, *,
+                 probe_timeout_s: float = 5.0,
+                 probe_interval_s: float = 0.25, clock=time.monotonic):
+        self.name = name
+        self.host = host
+        self.port = int(port)
+        self.state = "up"
+        self.started = False
+        self.probe_timeout_s = probe_timeout_s
+        self.probe_interval_s = probe_interval_s
+        self.clock = clock
+        self._health: dict = {"name": name, "state": "unknown"}
+        self._reachable = False
+        self._last_probe = -1.0
+        self._last_metrics: Optional[str] = None
+        self.block_size: Optional[int] = None
+        self.max_seq_len: Optional[int] = None
+
+    # -- lifecycle ------------------------------------------------------
+    async def start(self) -> "RemoteReplica":
+        await self.refresh(force=True)
+        if not self._reachable:
+            raise ConnectionError(
+                f"remote replica {self.name}: no worker reachable at "
+                f"{self.host}:{self.port}")
+        self.started = True
+        return self
+
+    async def drain(self) -> None:
+        """Graceful: the worker rejects new submits immediately and
+        finishes everything admitted before returning."""
+        code, _ = await _request_json(
+            self.host, self.port, "POST", "/drain",
+            timeout=max(self.probe_timeout_s, 60.0))
+        if code != 200:
+            raise RuntimeError(
+                f"remote replica {self.name}: drain returned {code}")
+
+    async def stop(self) -> None:
+        """Hard stop: in-flight requests are cancelled, then the worker
+        process exits. Unreachable workers are treated as already
+        stopped (the autoscaler kills what it cannot drain)."""
+        try:
+            await _request_json(self.host, self.port, "POST", "/stop",
+                                timeout=self.probe_timeout_s)
+        except (OSError, ConnectionError, asyncio.TimeoutError):
+            pass
+
+    async def kill(self) -> None:
+        await self.stop()
+
+    def reap(self) -> None:
+        """Dead-replica cleanup: nothing to reclaim client-side — the
+        router re-dispatches its own queued records; the worker (if it
+        ever recovers) is told to halt on the next lifecycle call."""
+
+    # -- router signals -------------------------------------------------
+    async def refresh(self, force: bool = False) -> None:
+        """Re-poll ``GET /healthz`` (rate-limited to
+        ``probe_interval_s`` unless forced) — the ONE source for this
+        replica's health/load/heartbeat signals between polls."""
+        now = self.clock()
+        if not force and self._last_probe >= 0 \
+                and now - self._last_probe < self.probe_interval_s:
+            return
+        self._last_probe = now
+        try:
+            code, obj = await _request_json(
+                self.host, self.port, "GET", "/healthz",
+                timeout=self.probe_timeout_s)
+            self._reachable = code == 200 and isinstance(obj, dict)
+            if self._reachable:
+                self._health = obj
+                if obj.get("block_size") is not None:
+                    self.block_size = int(obj["block_size"])
+                if obj.get("max_seq_len") is not None:
+                    self.max_seq_len = int(obj["max_seq_len"])
+        except (OSError, ConnectionError, asyncio.TimeoutError,
+                ValueError):
+            self._reachable = False
+
+    def alive(self) -> bool:
+        return self._reachable and bool(self._health.get("loop_alive",
+                                                         False))
+
+    def heartbeat_age(self) -> Optional[float]:
+        age = self._health.get("heartbeat_age_s")
+        return float(age) if age is not None else None
+
+    def load(self) -> float:
+        return float(self._health.get("load", 0.0))
+
+    def health(self) -> dict:
+        return {**self._health, "name": self.name, "state": self.state,
+                "remote": f"{self.host}:{self.port}",
+                "reachable": self._reachable}
+
+    # -- submission -----------------------------------------------------
+    async def submit(self, prompt, max_new_tokens: int,
+                     **kw) -> RemoteStream:
+        body = {"prompt": [int(t) for t in prompt],
+                "max_new_tokens": int(max_new_tokens)}
+        body.update({k: v for k, v in kw.items() if v is not None})
+        payload = json.dumps(body).encode()
+        code, headers, reader, writer = await _open_request(
+            self.host, self.port, "POST", "/generate",
+            headers={"Content-Type": "application/json",
+                     **_trace_headers()},
+            body=payload, timeout=self.probe_timeout_s)
+        if code == 429:
+            data = await reader.read()
+            writer.close()
+            try:
+                obj = json.loads(data.decode() or "{}")
+            except json.JSONDecodeError:
+                obj = {}
+            raise OverloadedError(
+                obj.get("reason", "overloaded"),
+                obj.get("detail", f"remote replica {self.name} shed"),
+                retry_after_s=obj.get("retry_after_s"))
+        if code != 200:
+            data = await reader.read()
+            writer.close()
+            raise RequestFailed(
+                f"remote replica {self.name}: /generate returned "
+                f"{code}: {data[:200]!r}")
+        return RemoteStream(reader, writer)
+
+    # -- handoff (disaggregated decode side) ----------------------------
+    async def resume_handoff(self, payloads: List[bytes], *, chunked:
+                             bool, prompt, generated, max_new_tokens:
+                             int, eos_token_id=None, temperature=0.0,
+                             top_p=1.0, top_k=0, rng_state=None,
+                             deadline_s=None) -> RemoteStream:
+        """Stream a KV handoff to the worker and return the remote
+        decode token stream. Chunked payloads go as one frame each —
+        the worker applies frame i between its decode steps while
+        frame i+1 is still in flight, so the transfer overlaps the
+        remote replica's running batch."""
+        # the worker answers only after the terminal params frame, so
+        # the request head and every frame go out BEFORE any response
+        # read (an _open_request-style head-first read would deadlock)
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(self.host, self.port),
+            self.probe_timeout_s)
+        lines = ["POST /handoff HTTP/1.1",
+                 f"Host: {self.host}:{self.port}",
+                 "Connection: close", "Content-Length: 0"]
+        for k, v in _trace_headers().items():
+            lines.append(f"{k}: {v}")
+        writer.write(("\r\n".join(lines) + "\r\n\r\n").encode())
+        transfer_err: Optional[Exception] = None
+        try:
+            kind = FRAME_CHUNK if chunked else FRAME_BLOCKING
+            for p in payloads:
+                write_frame(writer, kind, p)
+                # drain between frames: the worker ingests at its own
+                # pace, so backpressure (not buffering) paces the wire
+                await writer.drain()
+            params = {
+                "prompt": [int(t) for t in prompt],
+                "generated": [int(t) for t in generated],
+                "max_new_tokens": int(max_new_tokens),
+                "eos_token_id": eos_token_id,
+                "temperature": temperature, "top_p": top_p,
+                "top_k": top_k, "rng_state": rng_state,
+                "deadline_s": deadline_s,
+            }
+            write_frame(writer, FRAME_PARAMS, json.dumps(params).encode())
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            # a mid-transfer write failure usually means the worker
+            # REJECTED the handoff (draining/overload verdict written,
+            # then socket closed) while frames were still in flight —
+            # fall through and try to read that verdict, so the router
+            # can re-route instead of failing the request; only when no
+            # verdict is readable is this a transfer failure
+            transfer_err = e
+        # now the response: status line + headers, then the verdict
+        # NDJSON line, then the token stream
+        try:
+            status_line = await reader.readline()
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            status_line = b""
+        if not status_line:
+            writer.close()
+            detail = (f"transfer failed: {transfer_err}" if transfer_err
+                      else "closed without a response")
+            raise RequestFailed(
+                f"remote replica {self.name}: handoff {detail}")
+        code = int(status_line.decode("latin-1").split(None, 2)[1])
+        while True:
+            hline = await reader.readline()
+            if hline in (b"\r\n", b"\n", b""):
+                break
+        if code != 200:
+            data = await reader.read()
+            writer.close()
+            if code == 429:
+                try:
+                    obj = json.loads(data.decode() or "{}")
+                except json.JSONDecodeError:
+                    obj = {}
+                raise OverloadedError(
+                    obj.get("reason", "overloaded"),
+                    obj.get("detail", "remote handoff shed"),
+                    retry_after_s=obj.get("retry_after_s"))
+            raise RequestFailed(
+                f"remote replica {self.name}: /handoff returned {code}")
+        line = await reader.readline()
+        try:
+            verdict = json.loads(line.decode() or "{}")
+        except json.JSONDecodeError:
+            verdict = {}
+        if not verdict.get("ok"):
+            writer.close()
+            reason = verdict.get("reason", "error")
+            if reason == "draining":
+                raise OverloadedError(
+                    "draining", verdict.get("detail", "remote handoff "
+                                            "rejected: draining"),
+                    retry_after_s=verdict.get("retry_after_s"))
+            raise RequestFailed(
+                f"remote handoff rejected: "
+                f"{verdict.get('detail', repr(line[:200]))}")
+        return RemoteStream(reader, writer)
+
+    # -- fleet observability --------------------------------------------
+    def metrics_text(self) -> Optional[str]:
+        """Last-fetched Prometheus exposition (refreshed by
+        :meth:`fetch_metrics`; the router's monitor keeps it current)."""
+        return self._last_metrics
+
+    async def fetch_metrics(self) -> Optional[str]:
+        try:
+            code, _, reader, writer = await _open_request(
+                self.host, self.port, "GET", "/metrics",
+                timeout=self.probe_timeout_s)
+            data = await reader.read()
+            writer.close()
+            if code == 200:
+                self._last_metrics = data.decode()
+        except (OSError, ConnectionError, asyncio.TimeoutError):
+            pass
+        return self._last_metrics
+
+    async def fetch_spans(self) -> List[dict]:
+        """The worker's span ring, rebased onto THIS process's
+        ``perf_counter`` clock through the worker's wall-clock anchor —
+        what :meth:`~.router.ReplicaRouter.fleet_timeline` stitches."""
+        try:
+            code, obj = await _request_json(
+                self.host, self.port, "GET", "/debug/spans",
+                timeout=self.probe_timeout_s)
+        except (OSError, ConnectionError, asyncio.TimeoutError):
+            return []
+        if code != 200 or not isinstance(obj, dict):
+            return []
+        # remote perf_counter -> wall clock -> local perf_counter
+        offset = ((obj.get("wall_now", 0.0) - obj.get("perf_now", 0.0))
+                  - (time.time() - time.perf_counter()))
+        spans = []
+        for s in obj.get("spans", []):
+            s = dict(s)
+            s["start"] = s.get("start", 0.0) + offset
+            s.setdefault("lane", self.name)
+            spans.append(s)
+        return spans

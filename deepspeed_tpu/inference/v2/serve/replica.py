@@ -18,9 +18,12 @@ Two replica kinds (docs/SERVING.md § Routing tier):
 
 Replicas here are in-process (each owns its engine; chip-free on CPU).
 The router only touches the surface defined by these classes —
-``submit``/``resume``, ``health``, ``load``, ``heartbeat_age``,
-``drain`` — so a subprocess- or RPC-backed replica slots in behind the
-same methods.
+``submit`` / ``resume_handoff`` / ``health`` / ``load`` /
+``heartbeat_age`` / ``refresh`` / ``drain`` / ``stop`` / ``reap`` /
+``kill`` / ``block_size`` — and serve/remote.py's
+:class:`~.remote.RemoteReplica` implements the same surface over a
+worker process's HTTP API, so socket-backed replicas slot in behind
+the identical router.
 """
 
 import asyncio
@@ -77,8 +80,38 @@ class Replica:
         await self.serving.stop(drain=True)
 
     async def stop(self) -> None:
-        """Hard stop: in-flight requests are cancelled (KV released)."""
+        """Hard stop: in-flight requests are cancelled (KV released).
+        Idempotent — the autoscaler's drain-then-stop calls it after a
+        drain already stopped the runtime."""
+        if self.serving._stopped and not self.serving.loop_runner.running:
+            return
         await self.serving.stop(drain=False)
+
+    def reap(self) -> None:
+        """Dead-replica cleanup (the router declared this replica
+        dead): empty the admission queue so a later recovery cannot
+        also run the re-enqueued work, tell the loop to halt, and stop
+        the watchdog thread."""
+        try:
+            self.serving.admission.reclaim_pending()
+            self.serving.loop_runner.request_stop()
+            self.serving.diagnostics.close()
+        except Exception:
+            pass
+
+    async def kill(self) -> None:
+        """Best-effort terminate a dead replica's loop thread: an
+        unwedged loop exits on the halt command; a truly stuck one
+        stays a daemon thread."""
+        try:
+            self.serving.loop_runner.request_stop()
+            await asyncio.to_thread(self.serving.loop_runner.join, 2.0)
+        except Exception:
+            pass
+
+    async def refresh(self, force: bool = False) -> None:
+        """In-process signals are always fresh (the remote counterpart
+        re-polls /healthz here)."""
 
     # -- router signals -------------------------------------------------
     def alive(self) -> bool:
@@ -101,6 +134,59 @@ class Replica:
     def health(self) -> dict:
         return {"name": self.name, "state": self.state,
                 **self.serving.health()}
+
+    @property
+    def block_size(self) -> int:
+        return int(self.engine.state_manager.block_size)
+
+    @property
+    def max_seq_len(self) -> int:
+        return int(self.engine.state_manager.config.max_seq_len)
+
+    @property
+    def diagnostics(self):
+        return self.serving.diagnostics
+
+    def metrics_text(self) -> Optional[str]:
+        """In-process replicas federate via their registries (None =
+        the router reads ``self.registry`` directly)."""
+        return None
+
+    # -- traffic --------------------------------------------------------
+    async def submit(self, prompt: Sequence[int], max_new_tokens: int,
+                     **kw):
+        return await self.serving.submit(prompt, max_new_tokens, **kw)
+
+    async def resume_handoff(self, payloads: Sequence[bytes], *,
+                             chunked: bool, prompt: Sequence[int],
+                             generated: Sequence[int],
+                             max_new_tokens: int, eos_token_id=None,
+                             temperature: float = 0.0,
+                             top_p: float = 1.0, top_k: int = 0,
+                             rng_state=None, deadline_s=None):
+        """Adopt a handed-off request from its serialized payloads —
+        the ONE handoff entry point the router uses for both transports
+        (``chunked=False``: one blocking ``handoff.serialize`` buffer;
+        ``chunked=True``: ``[header, kv-chunk...]``, each chunk applied
+        between this replica's scheduler steps)."""
+        kw = dict(max_new_tokens=max_new_tokens,
+                  eos_token_id=eos_token_id, temperature=temperature,
+                  top_p=top_p, top_k=top_k, rng_state=rng_state,
+                  deadline_s=deadline_s)
+        if not chunked:
+            pack = await asyncio.to_thread(handoff.deserialize,
+                                           payloads[0])
+            return await self.serving.resume(pack, prompt=prompt,
+                                             generated=generated, **kw)
+        handle = await self.serving.begin_handoff(payloads[0])
+        try:
+            for chunk in payloads[1:]:
+                await handle.feed(chunk)
+            return await handle.commit(prompt=prompt,
+                                       generated=generated, **kw)
+        except BaseException:
+            await handle.abort()
+            raise
 
 
 class PrefillReplica:
@@ -127,27 +213,31 @@ class PrefillReplica:
                       eos_token_id: Optional[int] = None,
                       temperature: float = 0.0, top_p: float = 1.0,
                       top_k: int = 0, seed: Optional[int] = None,
-                      trace_ctx=None
-                      ) -> Tuple[int, Optional[bytes], Optional[dict],
-                                 bool]:
+                      trace_ctx=None, chunk_blocks: int = 0
+                      ) -> Tuple[int, Optional[List[bytes]],
+                                 Optional[dict], bool]:
         return await asyncio.to_thread(
             self.prefill_sync, prompt, max_new_tokens,
             eos_token_id=eos_token_id, temperature=temperature,
-            top_p=top_p, top_k=top_k, seed=seed, trace_ctx=trace_ctx)
+            top_p=top_p, top_k=top_k, seed=seed, trace_ctx=trace_ctx,
+            chunk_blocks=chunk_blocks)
 
     def prefill_sync(self, prompt: Sequence[int], max_new_tokens: int, *,
                      eos_token_id: Optional[int] = None,
                      temperature: float = 0.0, top_p: float = 1.0,
                      top_k: int = 0, seed: Optional[int] = None,
-                     trace_ctx=None
-                     ) -> Tuple[int, Optional[bytes], Optional[dict],
-                                bool]:
+                     trace_ctx=None, chunk_blocks: int = 0
+                     ) -> Tuple[int, Optional[List[bytes]],
+                                Optional[dict], bool]:
         """Run one whole-prompt prefill and hand the sequence off.
 
-        Returns ``(first_token, payload, rng_state, finished)`` —
-        ``payload`` is the serialized KV handoff (None when the request
-        already finished at its first token: eos, or a 1-token budget),
-        ``rng_state`` the request rng AFTER the first draw, so the
+        Returns ``(first_token, payloads, rng_state, finished)`` —
+        ``payloads`` is the serialized KV handoff (None when the
+        request already finished at its first token: eos, or a 1-token
+        budget): ``[serialize(pack)]`` when ``chunk_blocks == 0`` (the
+        blocking transport) or the chunked wire form ``[header,
+        kv-chunk...]`` with ``chunk_blocks`` KV blocks per chunk;
+        ``rng_state`` is the request rng AFTER the first draw, so the
         decode side continues the exact sampling stream.
 
         Parity: the first token is ``host_sample`` over the prompt's
@@ -175,15 +265,19 @@ class PrefillReplica:
                 finished = (max_new_tokens <= 1
                             or (eos_token_id is not None
                                 and tok == eos_token_id))
-                payload = None
+                payloads = None
                 rng_state = None
                 if not finished:
-                    payload = handoff.serialize(handoff.export_sequence(
-                        self.engine, uid, trace_ctx=trace_ctx))
+                    pack = handoff.export_sequence(self.engine, uid,
+                                                   trace_ctx=trace_ctx)
+                    if chunk_blocks > 0:
+                        payloads = handoff.chunk_pack(pack, chunk_blocks)
+                    else:
+                        payloads = [handoff.serialize(pack)]
                     rng_state = rng.bit_generator.state
                 self.engine.flush(uid)
                 self._m_prefills.labels(replica=self.name).inc()
-                return tok, payload, rng_state, finished
+                return tok, payloads, rng_state, finished
             finally:
                 trace.set_lane(prev_lane)
 

@@ -55,12 +55,35 @@ from ....telemetry import context as trace_context
 from ....telemetry import trace
 from ....telemetry.anomaly import DiagnosticsConfig, SLOBurnRateMonitor
 from ..ragged.ragged_manager import prefix_digest
-from . import handoff as handoff_mod
 from .admission import OverloadedError
 from .frontend import DeadlineExceeded, RequestFailed
 from .replica import PrefillReplica, Replica
 
 _ROUTER_LANE = "router"
+
+
+def _relabel_exposition(text: str, label: str, value: str) -> str:
+    """Inject ``label="value"`` into every sample line of a Prometheus
+    text exposition fetched from a remote replica, so its series
+    federate next to the local registries' (comment lines are dropped —
+    the local render already emitted TYPE/HELP for shared families, and
+    duplicating them would violate the exactly-once contract)."""
+    esc = value.replace("\\", r"\\").replace('"', r'\"')
+    out = []
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("#"):
+            continue
+        brace, space = line.find("{"), line.find(" ")
+        if brace != -1 and (space == -1 or brace < space):
+            # labeled sample: label values may contain spaces, so split
+            # on the braces (the value after '}' never contains one)
+            close = line.rfind("}")
+            out.append(f'{line[:brace]}{{{label}="{esc}",'
+                       f'{line[brace + 1:close]}}}{line[close + 1:]}')
+        else:
+            name, _, rest = line.partition(" ")
+            out.append(f'{name}{{{label}="{esc}"}} {rest}')
+    return "\n".join(out) + ("\n" if out else "")
 
 
 @dataclass
@@ -85,6 +108,11 @@ class RouterConfig:
     # prefill/decode disaggregation: prompts prefill on dedicated
     # prefill replicas, KV hands off to a decode replica
     disaggregated: bool = False
+    # KV blocks per chunk of the streaming handoff (serve/handoff.py
+    # chunk protocol): each chunk applies between the decode replica's
+    # scheduler steps, so the transfer overlaps its running batch.
+    # 0 = the legacy blocking whole-sequence transport.
+    handoff_chunk_blocks: int = 4
     # consistent-hash ring points per replica
     ring_points: int = 32
     # fleet-level diagnostics (telemetry/anomaly.py): the router runs an
@@ -240,15 +268,18 @@ class ReplicaRouter:
         if len(self._by_name) != len(self.replicas):
             raise ValueError("replica names must be unique")
         # every replica must share the KV block geometry: prefix digests
-        # (and disaggregated handoffs) are keyed on it
-        sizes = {r.engine.state_manager.block_size for r in self.replicas}
+        # (and disaggregated handoffs) are keyed on it. Remote replicas
+        # report their block size only after start()'s first /healthz
+        # probe (None here) — start() re-verifies them.
+        sizes = {r.block_size for r in self.replicas
+                 if r.block_size is not None}
         for p in self.prefill_replicas:
             sizes.add(p.engine.state_manager.block_size)
-        if len(sizes) != 1:
+        if len(sizes) > 1:
             raise ValueError(
                 f"replicas disagree on KV block size ({sorted(sizes)}); "
                 f"prefix affinity and handoff require one layout")
-        self.block_size = sizes.pop()
+        self.block_size = sizes.pop() if sizes else None
         self._ring = _HashRing([r.name for r in self.replicas],
                                config.ring_points)
         self._affinity: "OrderedDict[bytes, str]" = OrderedDict()
@@ -351,12 +382,97 @@ class ReplicaRouter:
             self._m_state.labels(replica=r.name).set(1)
 
     # -- lifecycle ------------------------------------------------------
+    def _check_block_size(self, replica) -> None:
+        bs = replica.block_size
+        if bs is None:
+            raise ValueError(
+                f"replica {replica.name} reports no KV block size "
+                f"(remote replica not started?)")
+        if self.block_size is None:
+            self.block_size = int(bs)
+        elif int(bs) != self.block_size:
+            raise ValueError(
+                f"replica {replica.name} has KV block size {bs}, the "
+                f"fleet uses {self.block_size}; prefix affinity and "
+                f"handoff require one layout")
+        # disaggregated mode pre-checks KV-slot need against the
+        # PREFILL side's max_seq_len before burning prefill flops — a
+        # decode replica with a smaller pool would defeat that check
+        # after the work was already done, so require one geometry
+        msl = getattr(replica, "max_seq_len", None)
+        if self.prefill_replicas and msl is not None:
+            want = self.prefill_replicas[0].engine.state_manager \
+                .config.max_seq_len
+            if int(msl) != int(want):
+                raise ValueError(
+                    f"replica {replica.name} has max_seq_len {msl}, "
+                    f"the prefill replicas use {want}; disaggregated "
+                    f"replicas must share the KV geometry")
+
     async def start(self) -> "ReplicaRouter":
         for r in self.replicas:
             await r.start()
+            self._check_block_size(r)
         if self.config.monitor_interval_s > 0:
             self._monitor = asyncio.ensure_future(self._monitor_loop())
         return self
+
+    # -- dynamic membership (the autoscaler's surface) ------------------
+    def _rebuild_ring(self) -> None:
+        """Rebuild the consistent-hash ring from the current member
+        names. Point hashes are deterministic per name, so surviving
+        replicas keep their ring positions — only keys owned by a
+        removed (or claimed by an added) node remap."""
+        self._ring = _HashRing([r.name for r in self.replicas],
+                               self.config.ring_points)
+
+    async def add_replica(self, replica, start: bool = True) -> None:
+        """Grow the fleet: start the replica (unless already started),
+        verify the shared KV layout, and rebuild the ring so it takes
+        traffic immediately."""
+        if self._stopped:
+            raise RuntimeError("router is stopped")
+        if replica.name in self._by_name:
+            raise ValueError(f"replica name {replica.name!r} already "
+                             f"registered")
+        if start and not replica.started:
+            await replica.start()
+        self._check_block_size(replica)
+        self.replicas.append(replica)
+        self._by_name[replica.name] = replica
+        self._rebuild_ring()
+        self._m_replicas.set(len(self.replicas))
+        self._m_state.labels(replica=replica.name).set(1)
+        trace.record("router_membership", time.perf_counter(), 0.0,
+                     lane=_ROUTER_LANE, action="add",
+                     replica=replica.name)
+
+    def remove_replica(self, name: str) -> None:
+        """Shrink the fleet: pure membership removal — the replica must
+        already be drained or dead (``drain_replica`` first; the
+        autoscaler's drain-then-stop does). Ring and affinity entries
+        remap; in-flight failover bookkeeping is untouched (a dead
+        replica's requests were already re-enqueued by
+        ``check_replicas``)."""
+        replica = self._by_name.get(name)
+        if replica is None:
+            raise KeyError(f"no replica named {name!r}")
+        if replica.state == "up":
+            raise RuntimeError(
+                f"replica {name} is still 'up': drain it (or let the "
+                f"death check reap it) before removing")
+        del self._by_name[name]
+        self.replicas = [r for r in self.replicas if r.name != name]
+        self._rebuild_ring()
+        # affinity remap: purge the removed replica's digests so a
+        # future same-name replica never inherits stale residency claims
+        for digest in [d for d, n in self._affinity.items() if n == name]:
+            del self._affinity[digest]
+        self._backoff_until.pop(name, None)
+        self._hb_series.pop(name, None)
+        self._m_replicas.set(len(self.replicas))
+        trace.record("router_membership", time.perf_counter(), 0.0,
+                     lane=_ROUTER_LANE, action="remove", replica=name)
 
     async def stop(self, drain: bool = True) -> None:
         self._stopped = True
@@ -382,9 +498,7 @@ class ReplicaRouter:
                 # best-effort: an unwedged dead loop exits on the halt
                 # command; a truly stuck one stays a daemon thread
                 try:
-                    r.serving.loop_runner.request_stop()
-                    await asyncio.to_thread(r.serving.loop_runner.join,
-                                            2.0)
+                    await r.kill()
                 except Exception:
                     pass
         for rec in list(self._requests.values()):
@@ -572,7 +686,7 @@ class ReplicaRouter:
                 # reads the contextvar) instead of minting a new root —
                 # one trace id from dispatch to the last decode token
                 with trace_context.use(rec.ctx):
-                    inner = await replica.serving.submit(
+                    inner = await replica.submit(
                         rec.prompt, rec.max_new_tokens,
                         deadline_s=self._remaining_deadline(rec),
                         **rec.kw)
@@ -615,8 +729,9 @@ class ReplicaRouter:
         t0 = time.perf_counter()
         name, digests = self._pick_for(rec)
         # the decode-side KV-slot precheck, before any prefill flops are
-        # burned (replicas share one layout, so any state manager works)
-        max_seq = self._by_name[name].engine.state_manager.config \
+        # burned (replicas share one layout — the prefill side's state
+        # manager speaks for remote decode replicas too)
+        max_seq = self.prefill_replicas[0].engine.state_manager.config \
             .max_seq_len
         need = len(rec.prompt) + max(rec.max_new_tokens - 1, 0)
         if need > max_seq:
@@ -634,13 +749,14 @@ class ReplicaRouter:
                      lane=_ROUTER_LANE, uid=rec.uid, replica=name,
                      prefill_replica=pw.name, disaggregated=True,
                      **rec.trace_attr())
-        tok, payload, rng_state, finished = await pw.prefill(
+        chunk_blocks = max(int(self.config.handoff_chunk_blocks), 0)
+        tok, payloads, rng_state, finished = await pw.prefill(
             rec.prompt, rec.max_new_tokens,
             eos_token_id=rec.kw.get("eos_token_id"),
             temperature=rec.kw.get("temperature", 0.0),
             top_p=rec.kw.get("top_p", 1.0),
             top_k=rec.kw.get("top_k", 0), seed=rec.kw.get("seed"),
-            trace_ctx=rec.ctx)
+            trace_ctx=rec.ctx, chunk_blocks=chunk_blocks)
         rec.stream._push_token(tok)
         if finished:
             # NO affinity recorded: the decode candidate never received
@@ -650,13 +766,14 @@ class ReplicaRouter:
             self._finish(rec, "completed", None)
             return
         t_h = time.perf_counter()
-        pack = await asyncio.to_thread(handoff_mod.deserialize, payload)
+        payload_bytes = sum(len(p) for p in payloads)
         last_err: Optional[OverloadedError] = None
         for replica in self._candidates(name):
             try:
                 with trace_context.use(rec.ctx):
-                    inner = await replica.serving.resume(
-                        pack, prompt=rec.prompt, generated=[tok],
+                    inner = await replica.resume_handoff(
+                        payloads, chunked=chunk_blocks > 0,
+                        prompt=rec.prompt, generated=[tok],
                         max_new_tokens=rec.max_new_tokens,
                         eos_token_id=rec.kw.get("eos_token_id"),
                         temperature=rec.kw.get("temperature", 0.0),
@@ -673,14 +790,16 @@ class ReplicaRouter:
                 continue
             rec.handed_off = True
             self._m_handoffs.inc()
-            self._m_handoff_bytes.inc(len(payload))
-            # the KV transfer hop: wire deserialize -> decode-side
+            self._m_handoff_bytes.inc(payload_bytes)
+            # the KV transfer hop: wire (de)serialize -> decode-side
             # restore/adopt, between the prefill span (prefill lane) and
             # the first decode span (decode lane)
             trace.record("router_handoff", t_h,
                          time.perf_counter() - t_h, lane=_ROUTER_LANE,
                          uid=rec.uid, src=pw.name, dst=replica.name,
-                         payload_bytes=len(payload), **rec.trace_attr())
+                         payload_bytes=payload_bytes,
+                         chunks=(len(payloads) - 1 if chunk_blocks
+                                 else 0), **rec.trace_attr())
             self._attach(rec, replica.name, inner, digests)
             return
         self._m_shed.inc()
@@ -775,6 +894,12 @@ class ReplicaRouter:
         survivors; requests that already streamed tokens end with an
         explicit error (their KV exists only on the dead replica).
         Returns the names declared dead this call."""
+        # remote replicas: re-poll /healthz (rate-limited client-side)
+        # so alive()/heartbeat_age() read fresh state
+        await asyncio.gather(
+            *(r.refresh() for r in self.replicas
+              if r.started and r.state == "up"),
+            return_exceptions=True)
         died = [r for r in self.replicas if self._is_dead(r)]
         for replica in died:
             t0 = time.perf_counter()
@@ -788,9 +913,7 @@ class ReplicaRouter:
             # everything and exits instead of lingering as a zombie),
             # and stop its watchdog thread
             try:
-                replica.serving.admission.reclaim_pending()
-                replica.serving.loop_runner.request_stop()
-                replica.serving.diagnostics.close()
+                replica.reap()
             except Exception:
                 pass
             for rec in [rec for rec in self._requests.values()
@@ -823,15 +946,16 @@ class ReplicaRouter:
     # -- introspection (the ServingAPI surface) -------------------------
     def health(self) -> dict:
         up = [r for r in self.replicas if r.state == "up"]
+        healths = {r.name: r.health() for r in self.replicas}
         return {
             "status": "ok" if up and not self._stopped else "draining",
-            "replicas": {r.name: r.health() for r in self.replicas},
-            "queue_depth": sum(r.serving.admission.depth()
-                               for r in self.replicas),
-            "queued_tokens": sum(r.serving.admission.queued_tokens()
-                                 for r in self.replicas),
-            "inflight": sum(r.serving.scheduler.inflight()
-                            for r in self.replicas),
+            "replicas": healths,
+            "queue_depth": sum(h.get("queue_depth", 0)
+                               for h in healths.values()),
+            "queued_tokens": sum(h.get("queued_tokens", 0)
+                                 for h in healths.values()),
+            "inflight": sum(h.get("inflight", 0)
+                            for h in healths.values()),
             "routable": [r.name for r in self._routable()],
         }
 
@@ -844,7 +968,7 @@ class ReplicaRouter:
             age = self.replica_heartbeat_age(r)
             out[r.name] = {
                 "state": r.state,
-                "health": r.serving.health(),
+                "health": r.health(),
                 "load": r.load(),
                 "heartbeat_age_s": (round(age, 3)
                                     if age is not None else None),
@@ -867,25 +991,63 @@ class ReplicaRouter:
         }
 
     # -- fleet observability surfaces -----------------------------------
-    def fleet_timeline(self, trace_id: Optional[str] = None) -> dict:
+    def _remote_replicas(self) -> List:
+        return [r for r in self.replicas if hasattr(r, "fetch_spans")]
+
+    def fleet_timeline(self, trace_id: Optional[str] = None):
         """The stitched fleet Chrome trace: one process row per lane —
         the router plus every replica (in-process replicas share the
-        ring; spans are lane-tagged). ``trace_id`` filters to one
-        request's hops across the whole fleet (the router-level
-        ``GET /debug/timeline?trace=<id>`` body)."""
+        ring; spans are lane-tagged; remote replicas' rings are fetched
+        over ``GET /debug/spans`` and rebased onto this clock, which
+        makes the result a coroutine when any replica is remote).
+        ``trace_id`` filters to one request's hops across the whole
+        fleet (the router-level ``GET /debug/timeline?trace=<id>``
+        body)."""
         from ....telemetry import timeline
-        return timeline.stitch_fleet(trace_id=trace_id)
+        remotes = self._remote_replicas()
+        if not remotes:
+            return timeline.stitch_fleet(trace_id=trace_id)
+
+        async def stitch():
+            rings = {"host": trace.export()}
+            spans = await asyncio.gather(
+                *(r.fetch_spans() for r in remotes),
+                return_exceptions=True)
+            for r, s in zip(remotes, spans):
+                if isinstance(s, list):
+                    rings[r.name] = s
+            return timeline.stitch_fleet(rings, trace_id=trace_id)
+
+        return stitch()
 
     def federated_metrics(self) -> str:
         """The router-level ``/metrics`` exposition: when replicas own
         registries (``Replica(registry=...)``), every replica's series
         is federated under a ``replica`` label next to the router's own
         (process-default) series; with shared registries the process
-        default already aggregates the fleet and renders unchanged."""
+        default already aggregates the fleet and renders unchanged.
+        Remote replicas contribute their LAST-FETCHED exposition
+        (``federated_metrics_async`` refreshes before rendering — the
+        HTTP layer prefers it)."""
         from ....telemetry import get_registry
         from ....telemetry.registry import render_federated
         own = [(r.name, r.registry) for r in self.replicas
-               if r.registry is not None]
-        if not own:
-            return get_registry().render_prometheus()
-        return render_federated([("router", get_registry())] + own)
+               if getattr(r, "registry", None) is not None]
+        if own:
+            text = render_federated([("router", get_registry())] + own)
+        else:
+            text = get_registry().render_prometheus()
+        for r in self._remote_replicas():
+            remote_text = r.metrics_text()
+            if remote_text:
+                text += _relabel_exposition(remote_text, "replica",
+                                            r.name)
+        return text
+
+    async def federated_metrics_async(self) -> str:
+        """Fetch fresh expositions from remote replicas, then render
+        the federated view."""
+        await asyncio.gather(
+            *(r.fetch_metrics() for r in self._remote_replicas()),
+            return_exceptions=True)
+        return self.federated_metrics()

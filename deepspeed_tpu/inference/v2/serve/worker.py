@@ -1,0 +1,321 @@
+"""Replica worker process: one ServingEngine behind the HTTP API.
+
+``python -m deepspeed_tpu.inference.v2.serve.worker`` hosts ONE
+in-process :class:`~.replica.Replica` (engine + serving runtime) behind
+the serve/api.py surface plus the worker-only endpoints the remote
+serving plane needs (docs/SERVING.md § Remote replicas & autoscaling):
+
+  * ``POST /generate`` / ``GET /healthz`` / ``GET /metrics`` /
+    ``GET /statusz`` / ``GET /debug/timeline`` /
+    ``POST /debug/postmortem`` — unchanged from :class:`~.api.ServingAPI`
+    (``/healthz`` carries the replica-level ``load`` /
+    ``heartbeat_age_s`` / ``block_size`` fields the router's
+    RemoteReplica maps its signals from);
+  * ``POST /drain`` — graceful drain: new submits shed immediately,
+    admitted work finishes, then the response returns (the process
+    stays up so the autoscaler can drain-then-stop);
+  * ``POST /stop`` — hard stop: in-flight requests cancel and the
+    process exits;
+  * ``POST /handoff`` — chunked streaming KV ingest
+    (serve/remote.py frame protocol): each ``C`` frame is applied to
+    the pool BETWEEN decode steps as it arrives — the transfer overlaps
+    this replica's running batch — then the terminal ``P`` frame
+    commits the restore and the decode token stream flows back on the
+    same connection. EOF before ``P`` aborts the restore and frees the
+    partially-filled blocks.
+  * ``GET /debug/spans`` — the raw span ring plus a
+    ``perf_counter``/wall-clock anchor, so a router in another process
+    can rebase and stitch this replica's lane into the fleet timeline.
+
+On start the worker prints ONE ready line — ``DS_TPU_WORKER_READY
+{"name", "host", "port", "pid", "block_size"}`` — to stdout (scan for
+the prefix: engine-build logging precedes it), which spawners (an
+autoscaler subprocess factory, the slow spawn smoke test) parse to
+address it.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+from typing import Optional, Tuple
+
+from ....telemetry import context as trace_context
+from .api import ServingAPI, _json_response, _response_head
+from .frontend import ServingConfig
+from .remote import (FRAME_BLOCKING, FRAME_CHUNK, FRAME_PARAMS,
+                     read_frame)
+
+# the tiny deterministic model the tests/gate/spawn-smoke use: params
+# init from PRNGKey(0) is bit-reproducible across processes, so a
+# remote worker built from the same spec serves bit-identical streams
+TINY_SPEC = {
+    "model": {"vocab_size": 128, "hidden_size": 64,
+              "intermediate_size": 128, "num_layers": 2, "num_heads": 4,
+              "num_kv_heads": 2, "max_seq_len": 256, "remat": False,
+              "use_flash": False},
+    "state_manager": {"max_tracked_sequences": 8, "max_seq_len": 256,
+                      "num_blocks": 65, "block_size": 16,
+                      "max_ragged_batch_size": 512},
+    "engine": {"dtype": "float32", "prefill_bucket": 16},
+    "serving": {"token_budget": 64, "chunk": 16},
+}
+
+
+def build_engine(spec: dict):
+    """Engine from a worker spec dict (the ``--spec`` JSON layout)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ....models import TransformerConfig, TransformerLM
+    from .. import InferenceEngineV2, RaggedInferenceEngineConfig
+    from ..config_v2 import DSStateManagerConfig
+    model = TransformerLM(TransformerConfig(**spec["model"]))
+    params = jax.tree.map(
+        lambda x: x.astype(jnp.float32),
+        model.init_params(jax.random.PRNGKey(spec.get("seed", 0))))
+    return InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(**spec["state_manager"]),
+            **spec.get("engine", {})), params=params)
+
+
+class WorkerAPI(ServingAPI):
+    """ServingAPI over one local Replica, plus the worker lifecycle and
+    handoff-ingest endpoints."""
+
+    def __init__(self, replica, host: str = "127.0.0.1", port: int = 0):
+        super().__init__(replica, host=host, port=port)
+        self.replica = replica
+        self.stopped = asyncio.Event()
+
+    async def _route_extra(self, method: str, target: str, query: str,
+                           headers, body, reader, writer) -> bool:
+        if method == "POST" and target == "/drain":
+            await self.replica.drain()
+            _json_response(writer, "200 OK", {"status": "drained",
+                                              "name": self.replica.name})
+            return True
+        if method == "POST" and target == "/stop":
+            _json_response(writer, "200 OK", {"status": "stopping",
+                                              "name": self.replica.name})
+            # respond first, then stop: the caller's request must not
+            # hang on the runtime it is killing
+            asyncio.ensure_future(self._stop_replica())
+            return True
+        if method == "POST" and target == "/handoff":
+            await self._handoff(reader, writer, headers)
+            return True
+        if method == "GET" and target == "/debug/spans":
+            from ....telemetry import trace
+            spans = json.loads(json.dumps(trace.export(), default=str))
+            _json_response(writer, "200 OK",
+                           {"spans": spans,
+                            "perf_now": time.perf_counter(),
+                            "wall_now": time.time()})
+            return True
+        return False
+
+    async def _stop_replica(self) -> None:
+        try:
+            await self.replica.stop()
+        finally:
+            self.stopped.set()
+
+    async def _handoff(self, reader, writer, headers) -> None:
+        """Chunked KV ingest (module docstring): apply frames as they
+        arrive, commit on the params frame, stream tokens back."""
+        upstream = trace_context.from_headers(headers or {})
+        ctx = (upstream.child() if upstream is not None
+               else trace_context.new_context())
+        handle = None
+        blocking_payload = None
+        params = None
+
+        async def fail(reason: str, detail: str,
+                       retry_after_s=None) -> None:
+            writer.write(_response_head("200 OK",
+                                        "application/x-ndjson"))
+            writer.write(json.dumps(
+                {"ok": False, "reason": reason, "detail": detail,
+                 "retry_after_s": retry_after_s}).encode() + b"\n")
+            # drain the client's in-flight frames before the connection
+            # closes: an unread receive buffer would RST the socket and
+            # can discard the verdict the client needs to re-route
+            try:
+                await asyncio.wait_for(writer.drain(), 5.0)
+                await asyncio.wait_for(reader.read(), 5.0)
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                pass
+
+        from .admission import OverloadedError
+        try:
+            with trace_context.use(ctx):
+                while True:
+                    try:
+                        kind, payload = await read_frame(reader)
+                    except (asyncio.IncompleteReadError,
+                            ConnectionResetError):
+                        # client hung up mid-transfer: abort the restore
+                        # so the partially-filled blocks free
+                        if handle is not None:
+                            await handle.abort()
+                        return
+                    if kind == FRAME_BLOCKING:
+                        blocking_payload = payload
+                    elif kind == FRAME_CHUNK:
+                        if handle is None:
+                            handle = await self.replica.serving \
+                                .begin_handoff(payload)
+                        else:
+                            await handle.feed(payload)
+                    elif kind == FRAME_PARAMS:
+                        params = json.loads(payload.decode())
+                        break
+                    else:
+                        if handle is not None:
+                            await handle.abort()
+                        await fail("protocol",
+                                   f"unknown frame {kind!r}")
+                        return
+                kw = dict(
+                    prompt=params["prompt"],
+                    generated=params["generated"],
+                    max_new_tokens=params["max_new_tokens"],
+                    eos_token_id=params.get("eos_token_id"),
+                    temperature=params.get("temperature", 0.0),
+                    top_p=params.get("top_p", 1.0),
+                    top_k=params.get("top_k", 0),
+                    rng_state=_rng_state_from_wire(
+                        params.get("rng_state")),
+                    deadline_s=params.get("deadline_s"))
+                if handle is not None:
+                    stream = await handle.commit(**kw)
+                elif blocking_payload is not None:
+                    from . import handoff as handoff_mod
+                    pack = await asyncio.to_thread(
+                        handoff_mod.deserialize, blocking_payload)
+                    stream = await self.replica.serving.resume(
+                        pack, **kw)
+                else:
+                    await fail("protocol",
+                               "no handoff payload before params")
+                    return
+        except OverloadedError as e:
+            if handle is not None:
+                await handle.abort()
+            await fail(e.reason, str(e), retry_after_s=e.retry_after_s)
+            return
+        except Exception as e:
+            if handle is not None:
+                await handle.abort()
+            await fail("error", f"{type(e).__name__}: {e}")
+            return
+        writer.write(_response_head(
+            "200 OK", "application/x-ndjson",
+            {"traceparent": ctx.to_traceparent()}))
+        writer.write(json.dumps({"ok": True}).encode() + b"\n")
+        await self._stream_tokens(reader, writer, stream, ctx)
+
+
+def _rng_state_from_wire(state):
+    """numpy bit-generator state dicts ride JSON losslessly (Python
+    ints are arbitrary precision); nested lists that were tuples on
+    export are accepted by numpy's setter as-is."""
+    return state
+
+
+class ReplicaWorker:
+    """One replica + its WorkerAPI, runnable in-process (the loopback
+    tests and the perf gate) or as the __main__ process."""
+
+    def __init__(self, engine, serving_config: Optional[ServingConfig]
+                 = None, name: str = "worker0",
+                 host: str = "127.0.0.1", port: int = 0):
+        from .replica import Replica
+        self.replica = Replica(name, engine, serving_config)
+        self.api = WorkerAPI(self.replica, host=host, port=port)
+
+    async def start(self) -> Tuple[str, int]:
+        await self.replica.start()
+        return await self.api.start()
+
+    async def stop(self) -> None:
+        try:
+            if self.replica.serving.loop_runner.running:
+                await self.replica.stop()
+        finally:
+            await self.api.stop()
+
+    async def run_until_stopped(self) -> None:
+        await self.api.stopped.wait()
+        await self.api.stop()
+
+
+def _serving_config(spec: dict) -> ServingConfig:
+    kw = dict(spec.get("serving", {}))
+    admission = kw.pop("admission", None)
+    cfg = ServingConfig(**kw)
+    if admission:
+        from .admission import AdmissionConfig
+        cfg.admission = AdmissionConfig(**admission)
+    return cfg
+
+
+READY_PREFIX = "DS_TPU_WORKER_READY "
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="deepspeed_tpu serving replica worker")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 picks a free port (printed on stdout)")
+    p.add_argument("--name", default=f"worker-{os.getpid()}")
+    p.add_argument("--spec", default=None,
+                   help="JSON file with model/state_manager/engine/"
+                        "serving sections (default: the tiny "
+                        "deterministic preset)")
+    p.add_argument("--jax-platform", default=None,
+                   help="force a jax platform (e.g. 'cpu' for the "
+                        "chip-free smoke; default: whatever jax picks)")
+    p.add_argument("--compile-cache", default=None,
+                   help="persistent XLA compilation cache dir "
+                        "(default: $DS_TPU_COMPILE_CACHE if set)")
+    args = p.parse_args(argv)
+    import jax
+    if args.jax_platform:
+        jax.config.update("jax_platforms", args.jax_platform)
+    cache = args.compile_cache or os.environ.get("DS_TPU_COMPILE_CACHE")
+    if cache:
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    if args.spec:
+        with open(args.spec) as fh:
+            spec = json.load(fh)
+    else:
+        spec = TINY_SPEC
+
+    async def run() -> None:
+        worker = ReplicaWorker(build_engine(spec),
+                               _serving_config(spec), name=args.name,
+                               host=args.host, port=args.port)
+        host, port = await worker.start()
+        print(READY_PREFIX + json.dumps(
+            {"name": args.name, "host": host, "port": port,
+             "pid": os.getpid(),
+             "block_size": spec["state_manager"]["block_size"]}),
+            flush=True)
+        await worker.run_until_stopped()
+
+    asyncio.run(run())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,6 +98,12 @@ class ZeroConfig:
     # zero_quantized_gradients (qgZ already quantizes those buckets).
     quantized_reduce: str = "off"   # off | int8 | fp8
     quant_block: int = 2048         # elements per wire-quantization block
+    # two-level (EQuARX multi-pod) shape for quantized_reduce: the
+    # number of HOSTS the dp ring spans — intra-host legs stay fp32,
+    # only inter-host legs ride the quantized wire
+    # (comm/quantized.ring_*_hier). 0/1 = flat single-level ring; must
+    # divide the dp world (validated where the mesh is known).
+    quantized_reduce_hierarchy: int = 0
     # MiCS-style shard group (reference runtime/zero/mics.py)
     mics_shard_size: int = -1
     mics_hierarchical_params_gather: bool = False
@@ -125,6 +131,17 @@ class ZeroConfig:
             raise ConfigError(
                 f"zero_optimization.quant_block must be > 0, got "
                 f"{self.quant_block}")
+        if self.quantized_reduce_hierarchy < 0:
+            raise ConfigError(
+                "zero_optimization.quantized_reduce_hierarchy must be "
+                f">= 0 (a host count, 0/1 = flat), got "
+                f"{self.quantized_reduce_hierarchy}")
+        if (self.quantized_reduce_hierarchy > 1
+                and self.quantized_reduce == "off"):
+            raise ConfigError(
+                "zero_optimization.quantized_reduce_hierarchy shapes "
+                "the quantized ring — set quantized_reduce to "
+                "'int8'|'fp8' (or drop the hierarchy knob)")
         if self.quantized_reduce != "off":
             if self.stage == 3:
                 raise ConfigError(

@@ -939,7 +939,8 @@ class DeepSpeedTpuEngine:
                 f"({self.grad_bucket_plan.num_buckets} buckets, "
                 f"{len(self.grad_bucket_plan.vjp_leaves)} vjp-reduced "
                 f"leaves, quantized={zpp_g}, "
-                f"quantized_reduce={zc.quantized_reduce})", ranks=[0])
+                f"quantized_reduce={zc.quantized_reduce}, "
+                f"hierarchy={zc.quantized_reduce_hierarchy})", ranks=[0])
 
         pipeline_mode = self.topology.axis_size("pipe") > 1
         # the 1F1B path computes unscaled grads, so fp16 loss scaling falls

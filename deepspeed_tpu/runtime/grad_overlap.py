@@ -52,7 +52,9 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..comm.quantized import (all_to_all_quant_reduce, make_zero3_gather,
-                              quant_wire_bytes, ring_all_gather_quant,
+                              quant_wire_bytes, ring_all_gather_hier,
+                              ring_all_gather_quant,
+                              ring_reduce_scatter_hier,
                               ring_reduce_scatter_quant, shard_map_unchecked)
 
 # leaf reduction categories
@@ -393,6 +395,7 @@ def apply_bucketed_reduction(grads_flat: List[Any],
                              ring: bool = True,
                              quant_reduce: Optional[str] = None,
                              quant_reduce_block: int = 2048,
+                             quant_reduce_groups: int = 0,
                              qstate: Optional[Dict[str, Dict]] = None,
                              loss_scale=None):
     """Issue one fused collective per bucket over the flat leaf list.
@@ -412,9 +415,31 @@ def apply_bucketed_reduction(grads_flat: List[Any],
     before transport; the call then returns ``(out, new_qstate)`` with
     this step's residuals. Residuals are stored UNSCALED (divided by
     ``loss_scale``) so fp16 dynamic-scale changes cannot stretch a stale
-    residual.
+    residual. ``quant_reduce_groups`` > 1 routes the ring buckets
+    through the two-level hierarchical rings instead (intra-host fp32 /
+    inter-host quantized — ``zero_optimization.
+    quantized_reduce_hierarchy``); the EF state layout is unchanged.
     """
     axis_sizes = axis_sizes or {}
+    hier = int(quant_reduce_groups or 0) > 1
+
+    def _ring_rs_quant(buf_q, ax, denom_q):
+        if hier:
+            return ring_reduce_scatter_hier(
+                buf_q, ax, denom_q, quant_reduce_groups,
+                block=quant_reduce_block, mode=quant_reduce)
+        return ring_reduce_scatter_quant(
+            buf_q, ax, denom_q, block=quant_reduce_block,
+            mode=quant_reduce)
+
+    def _ring_ag_quant(row_q, ax, denom_q):
+        if hier:
+            return ring_all_gather_hier(
+                row_q, ax, denom_q, quant_reduce_groups,
+                block=quant_reduce_block, mode=quant_reduce)
+        return ring_all_gather_quant(
+            row_q, ax, denom_q, block=quant_reduce_block,
+            mode=quant_reduce)
     # accept the config-domain literal "off" (truthy) as disabled, so the
     # return arity matches what a caller forwarding the raw knob expects
     if quant_reduce == "off":
@@ -458,13 +483,10 @@ def apply_bucketed_reduction(grads_flat: List[Any],
                 if key in qlayout:
                     res = qstate[key]
                     buf = buf + res["rs"] * ls
-                    red_sum, rs_err = ring_reduce_scatter_quant(
-                        buf, live[0], denom, block=quant_reduce_block,
-                        mode=quant_reduce)
+                    red_sum, rs_err = _ring_rs_quant(buf, live[0],
+                                                     denom)
                     red = red_sum / denom + res["ag"] * ls
-                    full, ag_err = ring_all_gather_quant(
-                        red, live[0], denom, block=quant_reduce_block,
-                        mode=quant_reduce)
+                    full, ag_err = _ring_ag_quant(red, live[0], denom)
                     new_qstate[key] = {"rs": rs_err / ls, "ag": ag_err / ls}
                 else:
                     red = _ring_reduce_rows(buf, live[0], denom) / denom
@@ -499,9 +521,7 @@ def apply_bucketed_reduction(grads_flat: List[Any],
                 live = [a for a in axes if axis_sizes.get(a, 2) > 1]
                 res = qstate[key]
                 buf = buf + res["rs"] * ls
-                row, rs_err = ring_reduce_scatter_quant(
-                    buf, live[0], world, block=quant_reduce_block,
-                    mode=quant_reduce)
+                row, rs_err = _ring_rs_quant(buf, live[0], world)
                 buf = row / world
                 new_qstate[key] = {"rs": rs_err / ls}
             elif quantized:
@@ -816,7 +836,9 @@ def make_overlapped_grad_fn(engine, zpp_w: bool, zpp_g: bool):
                 flat, plan, gd_flat, axes, cross_group_axes, world,
                 cross_world, axis_sizes=axis_sizes, quantized=zpp_g,
                 ring=not tp, quant_reduce=qr_mode,
-                quant_reduce_block=qr_block, qstate=qin, loss_scale=scale)
+                quant_reduce_block=qr_block,
+                quant_reduce_groups=qr_groups, qstate=qin,
+                loss_scale=scale)
             qout = {k: {kk: a[None] for kk, a in v.items()}
                     for k, v in qerr.items()}
         else:
@@ -872,6 +894,7 @@ def make_overlapped_grad_fn(engine, zpp_w: bool, zpp_g: bool):
     # error-feedback residuals threaded through the program
     qr_mode = getattr(zc, "quantized_reduce", "off")
     qr_block = int(getattr(zc, "quant_block", 2048))
+    qr_groups = int(getattr(zc, "quantized_reduce_hierarchy", 0) or 0)
     # inert without a ring to quantize (the engine logs and drops the
     # knob at dp=1; this guard keeps direct callers consistent)
     use_qr = qr_mode not in (None, "off") and world > 1
@@ -889,6 +912,12 @@ def make_overlapped_grad_fn(engine, zpp_w: bool, zpp_g: bool):
                 "zero_optimization.quantized_reduce needs a single live "
                 f"data-parallel mesh axis for the ring transport (got "
                 f"{live})")
+        if qr_groups > 1 and world % qr_groups != 0:
+            raise ConfigError(
+                f"zero_optimization.quantized_reduce_hierarchy="
+                f"{qr_groups} must divide the data-parallel world "
+                f"({world}): the two-level ring lays the ring out as "
+                f"hosts x devices-per-host")
         qlayout = quant_reduce_layout(plan, axes, world, axis_sizes,
                                       ring=True, a2a_quantized=zpp_g)
         qdim0 = manual if len(manual) > 1 else manual[0]

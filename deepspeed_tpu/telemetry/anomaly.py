@@ -425,9 +425,17 @@ class SLOBurnRateMonitor:
 
     def _window_burn(self, snaps: deque, now: float, window_s: float,
                      budget: float) -> float:
-        """Burn over [now - window_s, now] from the snapshot ring."""
+        """Burn over [now - window_s, now] from the snapshot ring.
+
+        The base is the newest snapshot at-or-before the window edge,
+        falling back to the OLDEST snapshot when the monitor is younger
+        than the window — never 0: a fresh monitor attached to a
+        long-lived shared registry must burn over what it OBSERVED, not
+        over the registry's whole pre-history (a histogram full of
+        earlier traffic would otherwise fire a phantom verdict on the
+        very first tick)."""
         cur_t, cur_n, cur_over = snaps[-1]
-        base_n, base_over = 0.0, 0.0
+        base_n, base_over = snaps[0][1], snaps[0][2]
         cutoff = now - window_s
         for t, n, over in reversed(snaps):
             if t <= cutoff:
@@ -486,6 +494,12 @@ class SLOBurnRateMonitor:
                                    burn_fast=round(fast, 2),
                                    burn_slow=round(slow, 2))
         return out
+
+    def burning(self) -> bool:
+        """True while ANY watched signal's fast+slow alert is latched
+        (between the ``slo_burn`` verdict and its fast-window
+        recovery) — the router autoscaler's scale-up signal."""
+        return any(self._alerting.values())
 
     def quantiles(self) -> Dict[str, Dict[str, float]]:
         """p50/p95/p99 per watched signal from the histogram buckets

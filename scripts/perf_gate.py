@@ -56,6 +56,16 @@ Gated metrics (see ``collect()``):
     routed traffic must stay recompile-free per replica after the
     double warmup, and the routing decision itself (digest chain +
     placement lookup) must stay out of the hot path.
+  * ``remote_replica_steady_recompiles`` /
+    ``autoscaler_tick_ns`` / ``handoff_decode_stall_fraction`` /
+    ``handoff_chunk_overlap_windows`` — the remote serving plane
+    (serve/remote.py + worker.py + autoscaler.py): routed traffic
+    through a loopback socket-backed replica stays recompile-free
+    after the double warmup, the autoscaler's decision tick stays off
+    the hot path, and the chunked streaming KV handoff keeps the
+    decode replica stepping its running batch between chunk applies
+    (stall fraction 0.0 = full overlap; the legacy blocking transport
+    is an atomic restore — stall fraction 1.0 by construction).
   * ``recorder_events_per_decode_step`` /
     ``recorder_ns_per_event`` — flight-recorder overhead
     (telemetry/recorder.py): how many black-box events the serving
@@ -381,7 +391,7 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
                 shared_prompts.append(
                     prefix + list(map(int, rng.integers(1, 127, 6))))
 
-        def _router_engines():
+        def _router_engines(n=2):
             return [InferenceEngineV2(
                 model, RaggedInferenceEngineConfig(
                     state_manager=DSStateManagerConfig(
@@ -390,7 +400,7 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
                         enable_prefix_caching=True),
                     dtype="float32", prefill_bucket=16,
                     decode_window=decode_window), params=params)
-                for _ in range(2)]
+                for _ in range(n)]
 
         import time as _time
 
@@ -475,6 +485,121 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
         metrics["router_steady_recompiles"] = router_steady
         metrics["routed_trace_steady_recompiles"] = traced_steady
         metrics["router_dispatch_ns_per_request"] = dispatch_ns
+
+        # -- remote serving plane (serve/remote.py + worker.py):
+        # routed traffic through a LOOPBACK socket-backed replica must
+        # stay recompile-free after the double warmup (the wire adds
+        # serialization, never programs), the autoscaler's decision
+        # tick must stay off the hot path, and a chunked streaming KV
+        # handoff must let the decode replica keep stepping its running
+        # batch (handoff_decode_stall_fraction: fraction of inter-chunk
+        # windows in which the loop could NOT step — 0.0 means full
+        # overlap; the blocking transport is one atomic restore, i.e.
+        # stall fraction 1.0 by construction)
+        def _remote_gate():
+            import asyncio
+
+            from deepspeed_tpu.inference.v2.serve import (
+                Autoscaler, AutoscalerConfig, PrefillReplica,
+                RemoteReplica, Replica, ReplicaRouter, ReplicaWorker,
+                RouterConfig, ServingConfig)
+
+            async def run():
+                out = {}
+                worker = ReplicaWorker(
+                    _router_engines(1)[0],
+                    ServingConfig(token_budget=24, chunk=16),
+                    name="gate-remote0")
+                host, port = await worker.start()
+                router = ReplicaRouter(
+                    [RemoteReplica("gate-remote0", host, port)],
+                    RouterConfig(monitor_interval_s=0.0))
+                await router.start()
+
+                async def wave():
+                    for p in shared_prompts:
+                        stream = await router.submit(p, 2)
+                        await stream.drain()
+
+                await wave()
+                await wave()     # double warm (bucket respecialization)
+                st0 = fam_total("xla_steady_state_recompiles_total")
+                watchdog.mark_steady(True)
+                try:
+                    await wave()
+                finally:
+                    watchdog.mark_steady(False)
+                out["remote_replica_steady_recompiles"] = \
+                    fam_total("xla_steady_state_recompiles_total") - st0
+
+                # autoscaler decision-loop cost on the live router
+                scaler = Autoscaler(
+                    router, lambda name: None,
+                    AutoscalerConfig(min_replicas=1, max_replicas=1))
+                n_ticks = 200
+                t0 = _time.perf_counter()
+                for _ in range(n_ticks):
+                    await scaler.tick()
+                out["autoscaler_tick_ns"] = (
+                    (_time.perf_counter() - t0) / n_ticks * 1e9)
+                await router.stop()
+                await worker.stop()
+
+                # chunked-handoff overlap on an in-process replica with
+                # a controlled victim batch
+                pw = PrefillReplica("gate-prefill", _router_engines(1)[0])
+                replica = Replica("gate-decode", _router_engines(1)[0],
+                                  ServingConfig(token_budget=24,
+                                                chunk=16))
+                await replica.start()
+                loop_runner = replica.serving.loop_runner
+                rng = __import__("numpy").random.default_rng(3)
+                # budget-capped victims (8 + 56 tokens fits the gate's
+                # max_seq_len=64): re-submitted whenever one finishes,
+                # so EVERY inter-chunk window has live batch work the
+                # loop must keep stepping — a finished victim must not
+                # read as a stall
+                async def new_victim():
+                    v = await replica.submit(
+                        list(map(int, rng.integers(1, 127, 8))), 56)
+                    return v, asyncio.ensure_future(v.drain())
+
+                victim, drainer = await new_victim()
+                prompt = list(map(int, rng.integers(1, 127, 49)))
+                tok, payloads, rng_state, _ = await pw.prefill(
+                    prompt, 4, chunk_blocks=1)
+                handle = await replica.serving.begin_handoff(payloads[0])
+                stalled = 0
+                for chunk in payloads[1:]:
+                    if drainer.done():
+                        victim, drainer = await new_victim()
+                    before = loop_runner.steps_done
+                    deadline = _time.monotonic() + 5.0
+                    # a finished victim is PROOF the loop was stepping
+                    # (it completed batch work), never a stall
+                    while (loop_runner.steps_done == before
+                           and not drainer.done()):
+                        if _time.monotonic() > deadline:
+                            stalled += 1   # the loop could NOT step
+                            break          # between chunk applies
+                        await asyncio.sleep(0.002)
+                    await handle.feed(chunk)
+                windows = max(len(payloads) - 1, 1)
+                out["handoff_decode_stall_fraction"] = stalled / windows
+                out["handoff_chunk_overlap_windows"] = windows - stalled
+                stream = await handle.commit(
+                    prompt=prompt, generated=[tok], max_new_tokens=4,
+                    rng_state=rng_state)
+                await stream.drain()
+                await victim.cancel()
+                with __import__("contextlib").suppress(Exception):
+                    await drainer
+                await replica.stop()
+                return out
+
+            return asyncio.run(run())
+
+        metrics.update(_remote_gate())
 
         # -- flight-recorder record() cost ---------------------------------
         bench_rec = FlightRecorder()
@@ -593,8 +718,15 @@ def make_baseline(metrics: Dict[str, float]) -> Dict[str, Any]:
                     "ragged_mixed_steady_recompiles",
                     "router_steady_recompiles",
                     "routed_trace_steady_recompiles",
+                    "remote_replica_steady_recompiles",
                     "kv_quant_steady_state_recompiles"):
             spec[name] = {"value": value, "direction": "max",
+                          "abs_tol": 0.0}
+        elif name == "handoff_chunk_overlap_windows":
+            # the overlap win itself: every inter-chunk window must keep
+            # letting the decode loop step — direction "min" so a
+            # blocking regression (stalled windows) fails the gate
+            spec[name] = {"value": value, "direction": "min",
                           "abs_tol": 0.0}
         elif name == "train_quant_reduce_wire_ratio":
             # the wire-compression pin: quantized ring bytes must stay
@@ -620,6 +752,12 @@ def make_baseline(metrics: Dict[str, float]) -> Dict[str, Any]:
             # regressions (e.g. hashing the whole prompt per candidate)
             spec[name] = {"value": value, "direction": "max",
                           "abs_tol": 20000.0}
+        elif name == "autoscaler_tick_ns":
+            # the autoscaler's decision loop reads counters and loads —
+            # wide absolute tolerance, but a per-tick registry render or
+            # blocking probe (orders of magnitude) fails
+            spec[name] = {"value": value, "direction": "max",
+                          "abs_tol": 200000.0}
         elif name == "ragged_mixed_programs_saved":
             # the ragged win itself: the mixed sweep must keep compiling
             # at least this many FEWER programs than the stitched

@@ -33,6 +33,7 @@ pytestmark = pytest.mark.skipif(
     reason="libtpu topology descriptions unavailable on this host")
 
 
+@pytest.mark.slow
 def test_zero3_param_gathers_async_chained():
     """Every per-layer weight gather in the unrolled ZeRO-3 step gets an
     async collective fusion chain; the exposed remainder of the hot path
@@ -63,6 +64,9 @@ def test_zero3_param_gathers_async_chained():
     assert rep.param_gather_exposed_fraction < 0.2, rep.summary()
 
 
+# slow tier: libtpu AOT compiles pay full cost every run (the
+# persistent XLA cache does not cover the host-compiler path)
+@pytest.mark.slow
 def test_flagship_7b_fits_v5e64():
     """Llama-2-7B, ZeRO-3, dp=64 on a v5e:8x8 topology: per-chip
     params+optimizer+activations clear the 16 GiB HBM budget."""
@@ -75,6 +79,7 @@ def test_flagship_7b_fits_v5e64():
     assert mem["peak_gib_per_chip"] < 16.0, mem
 
 
+@pytest.mark.slow
 def test_serving_7b_int8_fits_one_v5e():
     """Llama-2-7B v2 paged serving on ONE v5e chip: bf16 weights are
     compiler-rejected (HBM over capacity), int8 WOQ fits — and the

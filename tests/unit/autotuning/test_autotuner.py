@@ -2,6 +2,7 @@
 experiment generation + result selection; ours runs in-process)."""
 
 import numpy as np
+import pytest
 
 from deepspeed_tpu.autotuning.autotuner import Autotuner
 from tests.unit.simple_model import SimpleModel, random_batches
@@ -47,6 +48,9 @@ def test_memory_pruning():
     assert all("pruned" in (r.error or "") for r in outcome.results)
 
 
+# slow tier: true-subprocess sweep (~21s); the in-process ranking and
+# failure-isolation units above keep tier-1 coverage
+@pytest.mark.slow
 def test_experiment_autotuner_ranked_subprocess_sweep(tmp_path):
     """Launched-subprocess sweep over zero-stage x micro-batch x model
     variant, scored by measured throughput, producing a ranked results file
@@ -82,6 +86,8 @@ def test_experiment_autotuner_ranked_subprocess_sweep(tmp_path):
     assert (tmp_path / ranked[0]["name"] / "result.json").exists()
 
 
+# slow tier: true-subprocess hang/abort path (~8s)
+@pytest.mark.slow
 def test_experiment_autotuner_early_abort_on_hang(tmp_path):
     """A hung experiment is killed at the timeout and recorded as failed —
     the reference scheduler's early-abort."""

@@ -1,0 +1,209 @@
+"""Hierarchical quantized ring collectives (comm/quantized.py,
+ISSUE 12 satellite — the EQuARX multi-pod shape, arXiv:2506.17615):
+intra-host legs stay fp32, only the inter-host legs ride the int8 wire.
+
+Pinned contracts:
+  * hierarchical reduce-scatter + error rows reconstruct the exact sum
+    (the EF accounting the flat ring already pins);
+  * with ``groups == world`` (one device per host) the hierarchy IS the
+    flat quantized ring, bit-for-bit;
+  * with ``groups == 1`` (one host) nothing is quantized: exact result,
+    zero error;
+  * the hierarchical all-gather leaves every device with IDENTICAL rows
+    (the replicated-AG invariant);
+  * the inter-host wire-bytes ratio over the flat fp32 ring clears the
+    quantization win (``hier_wire_bytes``; comm_bench asserts it too);
+  * the training knob ``zero_optimization.quantized_reduce_hierarchy``
+    validates at load and trains within tolerance of the flat ring.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm.quantized import (hier_wire_bytes,
+                                          ring_all_gather_hier,
+                                          ring_all_gather_quant,
+                                          ring_reduce_scatter_hier,
+                                          ring_reduce_scatter_quant,
+                                          shard_map_unchecked)
+
+
+def _mesh():
+    return Mesh(np.asarray(jax.devices()), ("d",))
+
+
+def _rs_fn(groups, n, M):
+    def body(buf):
+        row, err = ring_reduce_scatter_hier(buf[0], "d", n, groups,
+                                            block=64)
+        return row[None], err[None]
+
+    return jax.jit(shard_map_unchecked(
+        body, _mesh(), in_specs=P("d", None, None),
+        out_specs=(P("d", None), P("d", None, None))))
+
+
+@pytest.mark.parametrize("groups", (2, 4))
+def test_hier_reduce_scatter_error_accounting(groups):
+    n = jax.device_count()
+    M = 256
+    rng = np.random.default_rng(0)
+    fuzz = rng.normal(size=(n, n, M)).astype(np.float32)
+    rows, errs = _rs_fn(groups, n, M)(jnp.asarray(fuzz))
+    want = fuzz.sum(axis=0)
+    got = np.asarray(rows)
+    # only the G-1 inter-host hops quantize; the errors close the gap
+    np.testing.assert_allclose(got, want, atol=(groups - 1) * 0.5 + 0.5)
+    np.testing.assert_allclose(got + np.asarray(errs).sum(axis=0), want,
+                               rtol=1e-5, atol=1e-4)
+
+
+def test_hier_groups_world_is_the_flat_quant_ring():
+    """One device per 'host' degenerates to the flat int8 ring —
+    bit-identical outputs, so flipping the knob on a flat topology can
+    never change numerics."""
+    n = jax.device_count()
+    M = 128
+    rng = np.random.default_rng(2)
+    fuzz = rng.normal(size=(n, n, M)).astype(np.float32)
+
+    def flat(buf):
+        row, err = ring_reduce_scatter_quant(buf[0], "d", n, block=64)
+        return row[None], err[None]
+
+    flat_fn = jax.jit(shard_map_unchecked(
+        flat, _mesh(), in_specs=P("d", None, None),
+        out_specs=(P("d", None), P("d", None, None))))
+    h_rows, h_errs = _rs_fn(n, n, M)(jnp.asarray(fuzz))
+    f_rows, f_errs = flat_fn(jnp.asarray(fuzz))
+    np.testing.assert_array_equal(np.asarray(h_rows),
+                                  np.asarray(f_rows))
+    np.testing.assert_array_equal(np.asarray(h_errs),
+                                  np.asarray(f_errs))
+
+
+def test_hier_single_group_is_exact_fp32():
+    n = jax.device_count()
+    M = 64
+    rng = np.random.default_rng(3)
+    fuzz = rng.normal(size=(n, n, M)).astype(np.float32)
+    rows, errs = _rs_fn(1, n, M)(jnp.asarray(fuzz))
+    np.testing.assert_allclose(np.asarray(rows), fuzz.sum(axis=0),
+                               rtol=1e-5, atol=1e-5)
+    assert float(np.abs(np.asarray(errs)).max()) == 0.0
+
+
+@pytest.mark.parametrize("groups", (1, 2, 4))
+def test_hier_all_gather_replicated_identical(groups):
+    n = jax.device_count()
+    M = 128
+    rng = np.random.default_rng(4)
+    rows = rng.normal(size=(n, M)).astype(np.float32)
+
+    def body(row):
+        full, err = ring_all_gather_hier(row[0], "d", n, groups,
+                                         block=64)
+        return full[None], err[None]
+
+    fn = jax.jit(shard_map_unchecked(
+        body, _mesh(), in_specs=P("d", None),
+        out_specs=(P("d", None, None), P("d", None))))
+    full, err = fn(jnp.asarray(rows))
+    full = np.asarray(full)
+    # every device reconstructs the same [n, M] — including the sources
+    for dev in range(1, n):
+        np.testing.assert_array_equal(full[dev], full[0])
+    atol = 0.0 if groups == 1 else 0.2
+    np.testing.assert_allclose(full[0], rows, atol=atol)
+    np.testing.assert_allclose(full[0] + np.zeros_like(rows)
+                               + np.asarray(err), rows, rtol=1e-5,
+                               atol=1e-4)
+    if groups == 1:
+        assert float(np.abs(np.asarray(err)).max()) == 0.0
+
+
+def test_hier_all_gather_matches_flat_at_groups_world():
+    n = jax.device_count()
+    M = 96
+    rng = np.random.default_rng(5)
+    rows = rng.normal(size=(n, M)).astype(np.float32)
+
+    def hier(row):
+        full, err = ring_all_gather_hier(row[0], "d", n, n, block=32)
+        return full[None], err[None]
+
+    def flat(row):
+        full, err = ring_all_gather_quant(row[0], "d", n, block=32)
+        return full[None], err[None]
+
+    mk = lambda body: jax.jit(shard_map_unchecked(   # noqa: E731
+        body, _mesh(), in_specs=P("d", None),
+        out_specs=(P("d", None, None), P("d", None))))
+    hf, he = mk(hier)(jnp.asarray(rows))
+    ff, fe = mk(flat)(jnp.asarray(rows))
+    np.testing.assert_array_equal(np.asarray(hf), np.asarray(ff))
+    np.testing.assert_array_equal(np.asarray(he), np.asarray(fe))
+
+
+def test_hier_validation_and_wire_bytes():
+    with pytest.raises(ValueError):
+        ring_reduce_scatter_hier(jnp.zeros((8, 4)), "d", 8, 3)
+    with pytest.raises(ValueError):
+        ring_all_gather_hier(jnp.zeros(4), "d", 8, 5)
+    wb = hier_wire_bytes(1 << 16, world=8, groups=2, block=2048)
+    # inter-host: 7 fp32 flat hops x 2 boundary messages vs 1 quantized
+    # hop per device — the whole point of the hierarchy
+    assert wb["ratio"] >= 3.5, wb
+    assert wb["inter_bytes_quant"] < wb["inter_bytes_fp32_flat"]
+    # one host: no inter-host wire at all
+    assert hier_wire_bytes(1 << 16, 8, 1)["inter_bytes_quant"] == 0
+
+
+def test_quantized_reduce_hierarchy_knob_trains(tmp_path):
+    """End-to-end: the config knob routes training through the
+    hierarchical rings (stage 1, dp8 as 2 hosts x 4) and the loss curve
+    tracks the flat int8 ring closely; bad values reject at load."""
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime.config import ConfigError
+    from tests.unit.simple_model import (SimpleModel, base_config,
+                                         random_batches)
+
+    HIDDEN = 32
+
+    def train(hierarchy):
+        cfg = base_config(micro=2, gas=1, stage=1, lr=1e-2)
+        zc = cfg["zero_optimization"]
+        zc["overlap_grad_reduce"] = "bucketed"
+        zc["reduce_bucket_size"] = 600
+        zc["allgather_bucket_size"] = 600
+        zc["quantized_reduce"] = "int8"
+        zc["quant_block"] = 64
+        if hierarchy:
+            zc["quantized_reduce_hierarchy"] = hierarchy
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=HIDDEN, nlayers=3), config=cfg,
+            seed=0)
+        gm = engine.micro_batch_size * engine.ds_config.dp_world_size
+        losses = []
+        for b in random_batches(3, gm * engine.gas, HIDDEN, seed=7):
+            gb = {k: v.reshape(engine.gas, gm, HIDDEN)
+                  for k, v in b.items()}
+            losses.append(engine.train_batch(batch=gb))
+        return losses
+
+    flat = train(0)
+    hier = train(2)
+    np.testing.assert_allclose(hier, flat, rtol=0.2, atol=0.05)
+
+    bad = base_config(micro=2, gas=1, stage=1)
+    bad["zero_optimization"]["quantized_reduce"] = "int8"
+    bad["zero_optimization"]["quantized_reduce_hierarchy"] = 3  # 8 % 3
+    with pytest.raises((ConfigError, ValueError)):
+        deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=HIDDEN, nlayers=3), config=bad,
+            seed=0)

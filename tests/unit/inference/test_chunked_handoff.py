@@ -1,0 +1,322 @@
+"""Chunked streaming KV handoff (serve/handoff.py chunk protocol +
+the serving runtime's begin/feed/commit/abort surface).
+
+Pinned contracts (ISSUE 12):
+  * chunked transfer is bit-identical to the blocking whole-sequence
+    handoff AND to colocated serving (greedy + seeded sampling);
+  * the decode replica keeps stepping its running batch while a
+    handoff is in flight (the overlap the chunk protocol exists for);
+  * a mid-transfer abort frees the partially-filled blocks and the
+    next attempt succeeds; a corrupted chunk is rejected by its
+    integrity check and cleaned up the same way;
+  * the routed disaggregated path (prefill replica -> chunked wire ->
+    decode replica, in-process AND through a socket-backed
+    RemoteReplica) stays bit-identical to colocated serving under ONE
+    trace id.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+from deepspeed_tpu.inference.v2.serve import (PrefillReplica,
+                                              RemoteReplica, Replica,
+                                              ReplicaRouter,
+                                              ReplicaWorker, RouterConfig,
+                                              ServingConfig,
+                                              ServingEngine, handoff)
+from deepspeed_tpu.telemetry import context as trace_context
+
+
+@pytest.fixture(scope="module")
+def model_and_params(tiny_model_256):
+    return tiny_model_256
+
+
+def _engine(model, params, **sm_kw):
+    sm = dict(max_tracked_sequences=8, max_seq_len=256, num_blocks=65,
+              block_size=16, max_ragged_batch_size=512)
+    sm.update(sm_kw)
+    return InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(**sm), dtype="float32",
+            prefill_bucket=16), params=params)
+
+
+def _serving_config(**kw):
+    kw.setdefault("token_budget", 64)
+    kw.setdefault("chunk", 16)
+    return ServingConfig(**kw)
+
+
+def _prompt(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return list(map(int, rng.integers(1, 127, n)))
+
+
+async def _colocated(model, params, prompt, max_new, **kw):
+    serving = ServingEngine(_engine(model, params), _serving_config())
+    await serving.start()
+    stream = await serving.submit(prompt, max_new, **kw)
+    toks = await stream.drain()
+    await serving.stop()
+    return toks
+
+
+def _disagg_stream_kw():
+    return [dict(temperature=0.0),
+            dict(temperature=0.8, top_p=0.9, seed=11)]
+
+
+# -- chunked == blocking == colocated --------------------------------------
+@pytest.mark.parametrize("kw", _disagg_stream_kw(),
+                         ids=("greedy", "sampled"))
+def test_chunked_handoff_bit_identical(model_and_params, kw):
+    model, params = model_and_params
+    prompt, max_new = _prompt(37, seed=4), 10
+
+    async def disagg(chunk_blocks):
+        pw = PrefillReplica("prefill0", _engine(model, params))
+        replica = Replica("decode0", _engine(model, params),
+                          _serving_config())
+        await replica.start()
+        try:
+            tok, payloads, rng_state, finished = await pw.prefill(
+                prompt, max_new, chunk_blocks=chunk_blocks,
+                seed=kw.get("seed"),
+                temperature=kw.get("temperature", 0.0),
+                top_p=kw.get("top_p", 1.0), top_k=kw.get("top_k", 0))
+            assert not finished
+            stream = await replica.resume_handoff(
+                payloads, chunked=chunk_blocks > 0, prompt=prompt,
+                generated=[tok], max_new_tokens=max_new,
+                temperature=kw.get("temperature", 0.0),
+                top_p=kw.get("top_p", 1.0), top_k=kw.get("top_k", 0),
+                rng_state=rng_state)
+            rest = await stream.drain()
+        finally:
+            await replica.stop()
+        return [tok] + rest
+
+    colocated = asyncio.run(_colocated(model, params, prompt, max_new,
+                                       **kw))
+    chunked = asyncio.run(disagg(chunk_blocks=1))
+    blocking = asyncio.run(disagg(chunk_blocks=0))
+    assert chunked == colocated, \
+        "chunked handoff streams must be bit-identical to colocated"
+    assert blocking == colocated
+
+
+# -- transfer overlaps the decode replica's running batch ------------------
+def test_chunked_handoff_overlaps_running_decode(model_and_params):
+    model, params = model_and_params
+    prompt = _prompt(49, seed=7)     # 4 blocks of KV -> several chunks
+
+    async def run():
+        import time as _time
+
+        pw = PrefillReplica("prefill0", _engine(model, params))
+        replica = Replica("decode0", _engine(model, params),
+                          _serving_config())
+        await replica.start()
+        loop_runner = replica.serving.loop_runner
+        try:
+            # a long-budget victim request decoding while the handoff
+            # streams in (the running batch the chunk protocol must
+            # not stall)
+            victim = await replica.submit(_prompt(8, seed=9), 200)
+            await victim.__anext__()       # victim is mid-decode
+            tok, payloads, rng_state, _ = await pw.prefill(
+                prompt, 8, chunk_blocks=1)
+            handle = await replica.serving.begin_handoff(payloads[0])
+            overlap0 = loop_runner.steps_done
+            steps_between = []
+            for chunk in payloads[1:]:
+                # the loop MUST keep stepping the victim between chunk
+                # applies — the stall the chunk protocol removes
+                before = loop_runner.steps_done
+                deadline = _time.monotonic() + 20.0
+                while loop_runner.steps_done == before:
+                    assert _time.monotonic() < deadline, \
+                        "decode loop stalled during chunked handoff"
+                    await asyncio.sleep(0.002)
+                steps_between.append(loop_runner.steps_done - before)
+                await handle.feed(chunk)
+            overlapped = loop_runner.steps_done - overlap0
+            stream = await handle.commit(
+                prompt=prompt, generated=[tok], max_new_tokens=8,
+                rng_state=rng_state)
+            rest = await stream.drain()
+            await victim.cancel()
+        finally:
+            await replica.stop()
+        return steps_between, overlapped, [tok] + rest
+
+    steps_between, overlapped, handed_off = asyncio.run(run())
+    colocated = asyncio.run(_colocated(model, params, prompt, 8))
+    assert len(steps_between) >= 2
+    assert all(g >= 1 for g in steps_between), \
+        f"decode steps must run between chunk applies, got {steps_between}"
+    assert overlapped >= len(steps_between)
+    assert handed_off == colocated, \
+        "a handoff overlapping a running batch must stay bit-identical"
+
+
+# -- mid-transfer abort + corrupted chunk ----------------------------------
+def test_chunked_handoff_abort_and_corruption_recovery(model_and_params):
+    model, params = model_and_params
+    prompt = _prompt(49, seed=3)
+
+    async def run():
+        pw = PrefillReplica("prefill0", _engine(model, params))
+        replica = Replica("decode0", _engine(model, params),
+                          _serving_config())
+        await replica.start()
+        sm = replica.engine.state_manager
+        try:
+            free0 = sm.free_blocks()
+            tok, payloads, rng_state, _ = await pw.prefill(
+                prompt, 8, chunk_blocks=1)
+            # abort mid-transfer: the partially-filled blocks free
+            handle = await replica.serving.begin_handoff(payloads[0])
+            await handle.feed(payloads[1])
+            assert sm.free_blocks() < free0
+            await handle.abort()
+            assert sm.free_blocks() == free0, \
+                "abort must free the partially-restored blocks"
+            # a corrupted chunk fails its integrity check and cleans up
+            handle = await replica.serving.begin_handoff(payloads[0])
+            # flip a byte inside the chunk's array data (mid-buffer:
+            # the KV payload dominates the npz) — either the zip
+            # member's own CRC or the chunk manifest CRC must catch it
+            bad = bytearray(payloads[1])
+            bad[len(bad) // 2] ^= 0xFF
+            with pytest.raises(Exception, match="(?i)crc|integrity"):
+                await handle.feed(bytes(bad))
+            await handle.abort()
+            assert sm.free_blocks() == free0
+            # the pool is clean: a fresh full transfer still succeeds
+            stream = await replica.resume_handoff(
+                payloads, chunked=True, prompt=prompt, generated=[tok],
+                max_new_tokens=8, rng_state=rng_state)
+            rest = await stream.drain()
+        finally:
+            await replica.stop()
+        return [tok] + rest
+
+    handed_off = asyncio.run(run())
+    colocated = asyncio.run(_colocated(model, params, prompt, 8))
+    assert handed_off == colocated
+
+
+# -- duplicate chunks are idempotent (resumability) ------------------------
+def test_chunked_handoff_duplicate_chunk_idempotent(model_and_params):
+    model, params = model_and_params
+    prompt = _prompt(33, seed=5)
+
+    async def run():
+        pw = PrefillReplica("prefill0", _engine(model, params))
+        replica = Replica("decode0", _engine(model, params),
+                          _serving_config())
+        await replica.start()
+        try:
+            tok, payloads, rng_state, _ = await pw.prefill(
+                prompt, 6, chunk_blocks=1)
+            handle = await replica.serving.begin_handoff(payloads[0])
+            for chunk in payloads[1:]:
+                await handle.feed(chunk)
+            await handle.feed(payloads[1])     # retransmit: idempotent
+            stream = await handle.commit(
+                prompt=prompt, generated=[tok], max_new_tokens=6,
+                rng_state=rng_state)
+            rest = await stream.drain()
+        finally:
+            await replica.stop()
+        return [tok] + rest
+
+    assert asyncio.run(run()) == asyncio.run(
+        _colocated(model, params, prompt, 6))
+
+
+# -- missing chunk is rejected at commit -----------------------------------
+def test_chunked_handoff_commit_rejects_missing_chunk(model_and_params):
+    model, params = model_and_params
+    prompt = _prompt(49, seed=6)
+
+    async def run():
+        pw = PrefillReplica("prefill0", _engine(model, params))
+        replica = Replica("decode0", _engine(model, params),
+                          _serving_config())
+        await replica.start()
+        sm = replica.engine.state_manager
+        free0 = sm.free_blocks()
+        try:
+            tok, payloads, rng_state, _ = await pw.prefill(
+                prompt, 8, chunk_blocks=1)
+            handle = await replica.serving.begin_handoff(payloads[0])
+            await handle.feed(payloads[1])     # skip the rest
+            with pytest.raises(Exception, match="(?i)missing|incomplete"):
+                await handle.commit(prompt=prompt, generated=[tok],
+                                    max_new_tokens=8,
+                                    rng_state=rng_state)
+            assert sm.free_blocks() == free0, \
+                "a failed commit must not leak the adopted blocks"
+        finally:
+            await replica.stop()
+
+    asyncio.run(run())
+
+
+# -- routed disaggregated chunked handoff, in-process and remote -----------
+def test_routed_disagg_chunked_parity_and_trace(model_and_params):
+    model, params = model_and_params
+    prompts = [_prompt(37, seed=4), _prompt(21, seed=8)]
+    kws = _disagg_stream_kw()
+    max_new = 10
+
+    async def colocated_all():
+        return [await _colocated(model, params, p, max_new, **kw)
+                for p, kw in zip(prompts, kws)]
+
+    async def routed(remote):
+        worker = None
+        if remote:
+            worker = ReplicaWorker(_engine(model, params),
+                                   _serving_config(), name="rdec0")
+            host, port = await worker.start()
+            replicas = [RemoteReplica("rdec0", host, port)]
+        else:
+            replicas = [Replica("dec0", _engine(model, params),
+                                _serving_config())]
+        router = ReplicaRouter(
+            replicas,
+            RouterConfig(disaggregated=True, handoff_chunk_blocks=2,
+                         monitor_interval_s=0.0),
+            prefill_replicas=[PrefillReplica(
+                "prefill0", _engine(model, params))])
+        await router.start()
+        try:
+            ctxs = [trace_context.new_context() for _ in prompts]
+            streams = []
+            for p, kw, ctx in zip(prompts, kws, ctxs):
+                with trace_context.use(ctx):
+                    streams.append(await router.submit(p, max_new, **kw))
+            outs = [await s.drain() for s in streams]
+        finally:
+            await router.stop()
+            if worker is not None:
+                await worker.stop()
+        return outs, [c.trace_id for c in ctxs]
+
+    colocated = asyncio.run(colocated_all())
+    in_proc, _ = asyncio.run(routed(remote=False))
+    remote, tids = asyncio.run(routed(remote=True))
+    assert in_proc == colocated, \
+        "routed chunked disaggregation must stay bit-identical"
+    assert remote == colocated, \
+        "socket-backed chunked disaggregation must stay bit-identical"
+    assert len(set(tids)) == len(tids)

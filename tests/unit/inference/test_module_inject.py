@@ -35,6 +35,9 @@ def _assert_logits_match(hf_model, ids_np, rtol=2e-3, atol=2e-3):
     np.testing.assert_allclose(ours, theirs, rtol=rtol, atol=atol)
 
 
+# slow tier: full HF-reference forward comparison (~17s); the
+# structural injection tests stay tier-1
+@pytest.mark.slow
 def test_llama_injection_matches_hf():
     cfg = transformers.LlamaConfig(
         vocab_size=96, hidden_size=32, intermediate_size=64,

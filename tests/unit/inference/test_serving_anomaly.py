@@ -45,15 +45,10 @@ def _fresh():
 
 
 @pytest.fixture(scope="module")
-def tiny():
-    cfg = TransformerConfig(vocab_size=128, hidden_size=64,
-                            intermediate_size=128, num_layers=2,
-                            num_heads=4, num_kv_heads=2, max_seq_len=128,
-                            remat=False, use_flash=False)
-    model = TransformerLM(cfg)
-    params = jax.tree.map(lambda x: x.astype(jnp.float32),
-                          model.init_params(jax.random.PRNGKey(0)))
-    return model, params
+def tiny(tiny_model_128):
+    # session-shared tiny model (tests/unit/conftest.py): one
+    # init_params for the whole tier instead of one per module
+    return tiny_model_128
 
 
 def _engine(model, params):

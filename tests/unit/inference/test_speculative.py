@@ -16,15 +16,10 @@ from deepspeed_tpu.models import TransformerConfig, TransformerLM
 
 
 @pytest.fixture(scope="module")
-def tiny():
-    cfg = TransformerConfig(vocab_size=128, hidden_size=64,
-                            intermediate_size=128, num_layers=2,
-                            num_heads=4, num_kv_heads=2, max_seq_len=256,
-                            remat=False, use_flash=False)
-    model = TransformerLM(cfg)
-    params = jax.tree.map(lambda x: x.astype(jnp.float32),
-                          model.init_params(jax.random.PRNGKey(0)))
-    return model, params
+def tiny(tiny_model_256):
+    # session-shared tiny model (tests/unit/conftest.py): one
+    # init_params for the whole tier instead of one per module
+    return tiny_model_256
 
 
 def _engine(model, params, **kw):
@@ -68,6 +63,9 @@ def test_speculative_matches_plain_greedy(tiny, repetitive):
         np.testing.assert_array_equal(a, b)
 
 
+# slow tier: a call-count perf property (gate-style), not parity;
+# the parity tests above stay tier-1
+@pytest.mark.slow
 def test_speculative_fewer_decode_calls_on_repetitive_text(tiny):
     """On periodic text the drafts accept, so the engine runs FEWER
     jitted steps than tokens generated."""

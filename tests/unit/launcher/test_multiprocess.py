@@ -61,6 +61,8 @@ def test_two_process_train_and_checkpoint(tmp_path):
 
 
 
+# slow tier: subprocess failure-path smoke (~8s)
+@pytest.mark.slow
 def test_child_failure_kills_group(tmp_path):
     """Rank 1 exits rc=3 right after init; rank 0 sleeps for 300s. The
     launcher must kill rank 0 and report rc=3 well before the sleep ends

@@ -78,6 +78,9 @@ def test_zero_tolerance_counters_fail_on_any_increase(perf_gate,
 
 
 # -- end-to-end: collect on this host, gate against the committed baseline --
+# slow tier: the full collect() duplicates what scripts/perf_gate.py
+# runs standalone (~67s) — the CLI/compare units below stay tier-1
+@pytest.mark.slow
 def test_gate_end_to_end_chip_free(perf_gate, baseline):
     """The real gate: run the chip-free collection (tiny serving
     workload through the v2 engine + dp8 AOT train step) and compare it

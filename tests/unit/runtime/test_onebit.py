@@ -15,6 +15,9 @@ from tests.unit.simple_model import SimpleModel, base_config, random_batches
 HIDDEN = 32
 
 
+# slow tier: a multi-step convergence sweep; the EF accounting
+# units above keep tier-1 coverage
+@pytest.mark.slow
 def test_compressed_allreduce_with_error_feedback_converges():
     """The compressed mean must approach the true mean as error feedback
     accumulates over repeated rounds on the same buffer."""
