@@ -32,6 +32,7 @@ import argparse
 import json
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -108,6 +109,147 @@ def kv_capacity_report(model_cfg, block_size: int, num_blocks: int,
         // blocks_per_seq,
         "max_seqs_fixed_bytes_quant": (pool_budget // block_bytes_q)
         // blocks_per_seq,
+    }
+
+
+def kv_spill_capacity_report(model_cfg, block_size: int, num_blocks: int,
+                             blocks_per_conv: int, spill_block_bytes: int,
+                             host_bytes: int, disk_bytes: int = 0,
+                             pool_dtype_bytes: int = 2) -> dict:
+    """Capacity math of the KV spill tier at a FIXED HBM pool budget:
+    how many conversations keep their prefix KV *available* (resident
+    in the pool, or restorable from the host/disk tier) each way. The
+    pool-only number is what admission effectively caps a conversational
+    fleet at today; the tiered number is bounded by host/disk budgets
+    instead of HBM. ``spill_block_bytes`` is the MEASURED serialized
+    size of one spilled block (int8 kv_quant pools halve it)."""
+    L, kvh, hd = (model_cfg.num_layers, model_cfg.kv_heads,
+                  model_cfg.head_dim)
+    block_bytes = 2 * L * block_size * kvh * hd * pool_dtype_bytes
+    pool_budget = num_blocks * block_bytes
+    pool_convs = (num_blocks - 1) // blocks_per_conv
+    # no measured spill bytes -> no claimed tier capacity (a silent
+    # 1-byte substitute would report a millions-of-conversations "win"
+    # exactly when spilling regressed to never happening)
+    tier_blocks = ((host_bytes + disk_bytes) // spill_block_bytes
+                   if spill_block_bytes > 0 else 0)
+    spill_convs = pool_convs + tier_blocks // blocks_per_conv
+    return {
+        "block_bytes": block_bytes,
+        "spill_block_bytes": spill_block_bytes,
+        "pool_bytes_budget": pool_budget,
+        "blocks_per_conv": blocks_per_conv,
+        "max_convs_fixed_pool": pool_convs,
+        "max_convs_with_spill": spill_convs,
+        "capacity_gain": round(spill_convs / max(pool_convs, 1), 3),
+    }
+
+
+def bench_kv_spill(model, params, *, conversations: int, prompt: int,
+                   new_tokens: int, num_blocks: Optional[int] = None,
+                   block_size: int = 16,
+                   host_bytes: int = 64 << 20) -> dict:
+    """Conversation sweep through a pressure-sized pool, spill on vs
+    off: every conversation runs turn 1, then (after the others evicted
+    its prefix) turn 2. Reports the round-2 prefix reuse each way, the
+    spill/restore flow counters, steady-state recompiles under the
+    double-warm discipline, and the capacity report at the pool's byte
+    budget."""
+    from ..inference.v2 import (InferenceEngineV2,
+                                RaggedInferenceEngineConfig)
+    from ..inference.v2.config_v2 import DSStateManagerConfig
+    from ..inference.v2.ragged.ragged_manager import prefix_digest
+    from ..telemetry import get_registry, watchdog
+
+    rng = np.random.default_rng(0)
+    hi = max(model.cfg.vocab_size - 1, 2)
+    prompts = [list(map(int, rng.integers(1, hi, prompt)))
+               for _ in range(conversations)]
+    full = (prompt // block_size) * block_size
+    blocks_per_conv = max(full // block_size, 1)
+    if num_blocks is None:
+        # pressure-sized on purpose: one conversation's worth SHORT of
+        # retaining every conversation, so the sweep actually evicts
+        num_blocks = blocks_per_conv * conversations
+
+    def sweep(spill: bool, uid_base: int, eng=None):
+        if eng is None:
+            eng = InferenceEngineV2(
+                model, RaggedInferenceEngineConfig(
+                    state_manager=DSStateManagerConfig(
+                        max_tracked_sequences=8,
+                        max_seq_len=min(1024, model.cfg.max_seq_len),
+                        num_blocks=num_blocks, block_size=block_size,
+                        enable_prefix_caching=True,
+                        enable_kv_spill=spill,
+                        kv_spill_host_bytes=host_bytes),
+                    dtype="bfloat16", prefill_bucket=block_size),
+                params=params)
+        turn1 = {}
+        for i, p in enumerate(prompts):
+            turn1[i] = eng.generate([p], max_new_tokens=new_tokens,
+                                    uids=[uid_base + i])[0]
+        reused0 = eng.state_manager._m_reused_tokens.value
+        for i in range(conversations):
+            t2 = list(map(int, turn1[i])) + [3, 5, 7]
+            eng.generate([t2], max_new_tokens=new_tokens,
+                         uids=[uid_base + 100 + i])
+        reused = eng.state_manager._m_reused_tokens.value - reused0
+        avail = sum(
+            all(d in eng.state_manager._prefix
+                or (eng.spill is not None and eng.spill.has(d))
+                for d in prefix_digest(p[:full], block_size))
+            for p in prompts)
+        return eng, reused, avail
+
+    reg = get_registry()
+    t0 = time.perf_counter()
+    eng, _, _ = sweep(True, 10_000)              # compile every bucket
+    _, _, _ = sweep(True, 20_000, eng=eng)       # absorb respecialization
+    warmup_s = time.perf_counter() - t0
+    base_steady = reg.family_total("xla_steady_state_recompiles_total")
+    watchdog.mark_steady(True)
+    try:
+        _, reused_spill, avail_spill = sweep(True, 30_000, eng=eng)
+    finally:
+        watchdog.mark_steady(False)
+    steady = reg.family_total("xla_steady_state_recompiles_total") \
+        - base_steady
+    _, reused_off, avail_off = sweep(False, 40_000)
+
+    restore_fam = reg.get("kv_restore_seconds")
+    spilled_blocks = reg.counter("kv_spill_blocks_total").value
+    spill_bytes = reg.counter("kv_spill_bytes_total").value
+    spill_block_bytes = int(spill_bytes / spilled_blocks) \
+        if spilled_blocks else 0
+    max_reuse = conversations * (((prompt + new_tokens - 1)
+                                  // block_size) * block_size)
+    return {
+        "conversations": conversations,
+        "warmup_s": round(warmup_s, 3),
+        "kv_spill_steady_state_recompiles": int(steady),
+        "spilled_blocks": int(spilled_blocks),
+        "restored_blocks": int(
+            reg.counter("kv_restore_blocks_total").value),
+        "dropped_blocks": int(
+            reg.counter("kv_spill_dropped_blocks_total").value),
+        "restore_s_mean": (round(restore_fam.sum / restore_fam.count, 6)
+                           if restore_fam and restore_fam.count else None),
+        # round-2 reuse: with spill every conversation's turn-1 KV is
+        # still available; without, evicted prefixes recompute
+        "turn2_reused_tokens_spill": int(reused_spill),
+        "turn2_reused_tokens_off": int(reused_off),
+        "turn2_reuse_fraction_spill": round(reused_spill / max_reuse, 3),
+        "turn2_reuse_fraction_off": round(reused_off / max_reuse, 3),
+        "convs_available_spill": int(avail_spill),
+        "convs_available_off": int(avail_off),
+        "kv_spill_capacity_gain": round(
+            avail_spill / max(avail_off, 1), 3),
+        **{f"capacity_{k}": v for k, v in kv_spill_capacity_report(
+            model.cfg, block_size=block_size, num_blocks=num_blocks,
+            blocks_per_conv=blocks_per_conv,
+            spill_block_bytes=spill_block_bytes,
+            host_bytes=host_bytes).items()},
     }
 
 
@@ -507,6 +649,23 @@ def main_mixed(args) -> int:
     return 0
 
 
+def main_kv_spill(args) -> int:
+    import jax
+
+    model = build_model(args.layers, args.hidden)
+    params = model.init_params(jax.random.PRNGKey(0))
+    rep = bench_kv_spill(model, params,
+                         conversations=max(args.batch, 4),
+                         prompt=min(args.prompt, 48),
+                         new_tokens=min(args.new, 8))
+    print(json.dumps({
+        "metric": "kv_spill_capacity",
+        "backend": jax.default_backend(),
+        **rep,
+    }))
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ds_tpu_serving_bench")
     p.add_argument("--batch", type=int, default=8)
@@ -524,6 +683,14 @@ def main(argv=None) -> int:
                         "pool's byte budget), quantized-kernel decode "
                         "tok/s and steady-state recompiles under the "
                         "double-warm bucket discipline")
+    p.add_argument("--kv-spill", action="store_true",
+                   help="KV spill capacity mode: a conversation sweep "
+                        "through a pressure-sized pool, spill tier on "
+                        "vs off — reports round-2 prefix reuse each "
+                        "way, spill/restore flow, steady-state "
+                        "recompiles (double-warm discipline) and max "
+                        "concurrent conversations at the fixed HBM "
+                        "pool budget")
     p.add_argument("--mixed", action="store_true",
                    help="mixed-traffic mode: concurrent prefill+decode "
                         "through the SplitFuse scheduler, ragged vs "
@@ -562,6 +729,8 @@ def main(argv=None) -> int:
         return main_mixed(args)
     if args.router:
         return main_router(args)
+    if args.kv_spill:
+        return main_kv_spill(args)
 
     import jax
 

@@ -23,6 +23,31 @@ class DSStateManagerConfig:
     # (registered at flush, matched at the next arrival, LRU-evicted
     # under pool pressure) — beyond the reference; see ragged_manager.py
     enable_prefix_caching: bool = False
+    # cold-block KV spill tier (ragged/spill.py): prefix-cache eviction
+    # demotes block CONTENT to host RAM (and optionally disk) keyed by
+    # the prefix digest; a later arrival with a spilled prefix restores
+    # it between scheduler steps instead of recomputing — idle
+    # conversations stop costing HBM. Requires enable_prefix_caching
+    # (spilled blocks are identified by their chain digests).
+    enable_kv_spill: bool = False
+    kv_spill_host_bytes: int = 64 << 20      # host-tier LRU budget
+    kv_spill_dir: Optional[str] = None       # optional disk tier
+    kv_spill_disk_bytes: int = 256 << 20     # disk-tier LRU budget
+
+    def __post_init__(self):
+        if self.enable_kv_spill and not self.enable_prefix_caching:
+            raise ValueError(
+                "enable_kv_spill requires enable_prefix_caching: spilled "
+                "blocks are keyed by the prefix chain digests the index "
+                "computes")
+        if self.enable_kv_spill and self.kv_spill_host_bytes <= 0:
+            raise ValueError(
+                f"kv_spill_host_bytes must be > 0, got "
+                f"{self.kv_spill_host_bytes}")
+        if self.enable_kv_spill and self.kv_spill_disk_bytes < 0:
+            raise ValueError(
+                f"kv_spill_disk_bytes must be >= 0, got "
+                f"{self.kv_spill_disk_bytes}")
 
 
 @dataclass

@@ -138,6 +138,14 @@ class InferenceEngineV2:
         self.kv_cache = init_paged_kv_cache(cfg, sm.num_blocks,
                                             sm.block_size, self.dtype,
                                             kv_quant=config.kv_quant)
+        # cold-block KV spill tier (ragged/spill.py): installed on the
+        # state manager so prefix eviction demotes content to host RAM
+        # (+ optional disk) and match_prefix restores it between steps
+        self.spill = None
+        if sm.enable_kv_spill:
+            from .ragged.spill import KVSpillTier
+            self.spill = KVSpillTier(self, sm)
+            self.state_manager.spill = self.spill
         # per-uid consecutive failed-verify counter for speculative
         # decoding; entries are cleared on flush() and at generate() entry
         # so a cold streak never bans a uid across independent calls

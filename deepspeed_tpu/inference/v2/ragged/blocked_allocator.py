@@ -28,6 +28,20 @@ class BlockedAllocator:
         # bumped on every allocate/share/free: lets callers memoize
         # refcount-derived aggregates (DSStateManager._evictable)
         self.version = 0
+        # per-block last-touch stamp (monotonic op counter): the cold
+        # tier (spill.py) records it on demotion so host->disk LRU order
+        # tracks true touch recency, and debuggers can ask "how cold was
+        # this block when it spilled"
+        self._touch: dict = {}
+
+    def touch(self, block: int) -> None:
+        """Refresh a block's last-touch stamp (prefix match, decode
+        append) without changing its refcount."""
+        self._touch[int(block)] = self.version
+        self.version += 1
+
+    def last_touch(self, block: int) -> int:
+        return self._touch.get(int(block), 0)
 
     @property
     def free_blocks(self) -> int:
@@ -41,6 +55,7 @@ class BlockedAllocator:
         out = [self._free.pop() for _ in range(n)]
         for b in out:
             self._refs[b] = 1
+            self._touch[b] = self.version
         self.version += 1
         return np.asarray(out, np.int32)
 
@@ -50,6 +65,7 @@ class BlockedAllocator:
         if self._refs.get(b, 0) < 1:
             raise ValueError(f"sharing unallocated block {b}")
         self._refs[b] += 1
+        self._touch[b] = self.version
         self.version += 1
 
     def refcount(self, block: int) -> int:
@@ -67,6 +83,7 @@ class BlockedAllocator:
                 raise ValueError(f"double free of block {b}")
             if refs == 1:
                 del self._refs[b]
+                self._touch.pop(b, None)
                 self._free.append(b)
             else:
                 self._refs[b] = refs - 1

@@ -65,6 +65,12 @@ class DSStateManager:
         self.allocator = BlockedAllocator(config.num_blocks)
         self.seqs: Dict[int, DSSequenceDescriptor] = {}
         self.max_blocks_per_seq = -(-config.max_seq_len // self.block_size)
+        # cold-block spill tier (spill.py KVSpillTier, installed by the
+        # engine when enable_kv_spill is on): eviction demotes a retained
+        # block's CONTENT to host RAM/disk instead of discarding it, and
+        # match_prefix re-materializes spilled digests on the next
+        # arrival — a spilled prefix is a HIT, not a miss
+        self.spill = None
         # chain-hash digest -> retained block id (insertion-ordered: LRU
         # eviction pops from the front)
         self._prefix: "OrderedDict[bytes, int]" = OrderedDict()
@@ -119,10 +125,23 @@ class DSStateManager:
         while n + bs <= usable:
             digest = _chain(digest, tokens[n:n + bs])
             blk = self._prefix.get(digest)
+            if blk is None and self.spill is not None \
+                    and self.spill.has(digest):
+                # the digest's KV was demoted under pool pressure —
+                # re-materialize it between scheduler steps (we are on
+                # the serving-loop thread, between program launches,
+                # riding the same donated-pool scatter a chunked
+                # handoff ingest uses). Blocks matched EARLIER in this
+                # walk are not share()d until the walk completes, so
+                # they still look evictable — protect them, or the
+                # restore's own eviction could free-and-reuse a block
+                # already in this chain
+                blk = self._restore_spilled(digest, protect=blocks)
             if blk is None:
                 break
             blocks.append(blk)
             self._prefix.move_to_end(digest)   # LRU touch
+            self.allocator.touch(blk)
             n += bs
         if not n:
             return [], 0
@@ -148,6 +167,28 @@ class DSStateManager:
                 self._prefix[digest] = int(seq.blocks[i])
                 self.allocator.share(seq.blocks[i])
 
+    def _restore_spilled(self, digest: bytes,
+                         protect=()) -> Optional[int]:
+        """Allocate a fresh block and scatter the spilled digest's
+        content into it; the restored block re-enters the hot index
+        holding the index's own reference, exactly like a retained
+        block. ``protect`` lists block ids the in-progress match walk
+        already collected (still refcount-1 until the walk share()s
+        them) that eviction must not touch. Returns None when the pool
+        cannot yield a block or the entry fails its integrity check
+        (the caller then treats the digest as a plain miss)."""
+        if self.allocator.free_blocks < 1:
+            self._evict_retained(1, protect=protect)
+            if self.allocator.free_blocks < 1:
+                return None
+        blk = int(self.allocator.allocate(1)[0])
+        if not self.spill.restore_block(digest, blk):
+            self.allocator.free([blk])
+            return None
+        self._prefix[digest] = blk
+        self._m_alloc.inc()
+        return blk
+
     def _evictable(self) -> int:
         """Retained blocks held ONLY by the index (reclaimable now).
         Memoized against the allocator's version stamp: decode steps that
@@ -160,17 +201,25 @@ class DSStateManager:
             self._evictable_ver = ver
         return self._evictable_val
 
-    def _evict_retained(self, need: int) -> None:
+    def _evict_retained(self, need: int, protect=()) -> None:
         """Free LRU index entries whose blocks the index alone holds
         until ``need`` blocks are free. Entries shared with live
         sequences are skipped — popping them reclaims nothing and only
-        churns hot prefixes out of the cache."""
+        churns hot prefixes out of the cache. ``protect`` blocks
+        (an in-progress match walk's collected chain) are skipped too."""
+        protected = set(map(int, protect))
         while self.allocator.free_blocks < need:
             victim = next((d for d, b in self._prefix.items()
-                           if self.allocator.refcount(b) == 1), None)
+                           if self.allocator.refcount(b) == 1
+                           and int(b) not in protected), None)
             if victim is None:
                 return
             blk = self._prefix.pop(victim)
+            if self.spill is not None:
+                # demote the content to the cold tier BEFORE the free:
+                # the next arrival with this prefix restores instead of
+                # recomputing (spill.py)
+                self.spill.spill_block(victim, blk)
             self.allocator.free([blk])
             self._m_evicted.inc()
             self._m_freed.inc()

@@ -1,0 +1,280 @@
+"""Cold-block KV spill tier: host RAM (and optional disk) behind the pool.
+
+The serving mirror of tiered optimizer offload (runtime/offload.py):
+"millions of users with mostly-idle conversations" means most KV bytes
+belong to sequences nobody is decoding RIGHT NOW — a finished turn's
+prefix blocks sit in the prefix-cache index (ragged_manager.py) waiting
+for the conversation's next message. Without this tier, pool pressure
+LRU-evicts those blocks and the KV is simply GONE: the next turn pays a
+full prefill recompute. With it, eviction demotes the block's content to
+a host-RAM tier (then an optional disk tier) keyed by the SAME chain
+digest the prefix index uses, and ``match_prefix`` treats a spilled
+digest as a hit: the block re-materializes into a freshly allocated pool
+block between scheduler steps, CRC-checked, and the request streams
+bit-identically to one whose prefix never left HBM.
+
+Mechanics reuse the chunked-handoff machinery (serve/handoff.py) block
+by block — each spilled block serializes through the same self-
+describing ``.npz`` chunk format with a crc32 over the leaf bytes, and
+restore scatters through the same donated-pool ``_scatter_blocks``
+program the handoff ingest uses. That choice is load-bearing twice
+over: the int8 ``kv_quant`` pool spills its per-(block, head) scale
+leaves alongside the int8 pages for free (half the spilled bytes, PR
+9), and restore rides the already-double-warmed donated-pool executable
+path, so a steady-state engine restores with ZERO recompiles — and the
+XLA-CPU sharded-pool-init poisoning constraint (see the PR 7 notes in
+engine_v2) is sidestepped by construction.
+
+Eviction order is last-touch LRU: the prefix index's order (refreshed on
+every match) picks the victim, and the allocator's per-block last-touch
+stamp (blocked_allocator.py) rides the spill entry as metadata so the
+tier's own host->disk demotion follows true touch recency even when
+index order and block touches drift.
+"""
+
+import os
+import time
+from collections import OrderedDict
+from typing import Dict, Optional
+
+import numpy as np
+
+from ....utils.logging import logger
+
+
+class KVSpillTier:
+    """Digest-keyed LRU of serialized KV blocks, host RAM over disk.
+
+    Owned by the engine (``engine.spill``) and consulted by the state
+    manager (``DSStateManager.spill``): ``spill_block`` runs inside
+    eviction, ``restore_block`` inside ``match_prefix`` — both on the
+    serving-loop thread, between engine program launches.
+    """
+
+    def __init__(self, engine, config):
+        self.engine = engine
+        self.host_limit = int(config.kv_spill_host_bytes)
+        self.disk_dir: Optional[str] = config.kv_spill_dir
+        self.disk_limit = int(config.kv_spill_disk_bytes)
+        if self.disk_dir:
+            os.makedirs(self.disk_dir, exist_ok=True)
+        # digest -> serialized chunk bytes, oldest first (LRU demotes /
+        # drops from the front)
+        self._host: "OrderedDict[bytes, bytes]" = OrderedDict()
+        self._disk: "OrderedDict[bytes, int]" = OrderedDict()  # -> nbytes
+        # digest -> allocator last-touch stamp at spill time: host->disk
+        # demotion picks the OLDEST-touched entry, so tier order follows
+        # true touch recency even when spill order drifts from it
+        self._stamp: Dict[bytes, int] = {}
+        self._host_bytes = 0
+        self._disk_bytes = 0
+        from ....telemetry import get_registry
+        reg = get_registry()
+        self._m_spill_bytes = reg.counter(
+            "kv_spill_bytes_total",
+            "serialized KV bytes demoted from the HBM pool to the "
+            "host/disk spill tier")
+        self._m_spill_blocks = reg.counter(
+            "kv_spill_blocks_total",
+            "KV blocks spilled out of the pool (prefix-cache eviction "
+            "under pool pressure)")
+        self._m_restore_blocks = reg.counter(
+            "kv_restore_blocks_total",
+            "spilled KV blocks re-materialized into the pool on a "
+            "prefix match")
+        self._m_restore_s = reg.histogram(
+            "kv_restore_seconds",
+            "per-block spill-tier restore time (load + crc check + "
+            "scatter into the donated pool)", unit="s",
+            buckets=(1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0))
+        self._m_resident = reg.gauge(
+            "kv_spill_resident_bytes",
+            "serialized KV bytes currently resident in the host spill "
+            "tier (disk tier excluded)")
+        self._m_dropped = reg.counter(
+            "kv_spill_dropped_blocks_total",
+            "spilled blocks dropped off the end of the tier (budget "
+            "exhausted or integrity failure) — the next request with "
+            "that prefix pays a recompute, not an error")
+
+    # -- queries ---------------------------------------------------------
+    def has(self, digest: bytes) -> bool:
+        return digest in self._host or digest in self._disk
+
+    def __len__(self) -> int:
+        return len(self._host) + len(self._disk)
+
+    def stats(self) -> Dict[str, int]:
+        return {"host_entries": len(self._host),
+                "host_bytes": self._host_bytes,
+                "disk_entries": len(self._disk),
+                "disk_bytes": self._disk_bytes}
+
+    # -- spill -----------------------------------------------------------
+    def spill_block(self, digest: bytes, block: int) -> bool:
+        """Serialize ``block``'s content (all pool leaves — int8 pages
+        AND their scale rows under kv_quant) under ``digest``. Called by
+        the state manager just before it frees the block."""
+        from ..serve import handoff
+        import jax.numpy as jnp
+
+        stamp = self.engine.state_manager.allocator.last_touch(block)
+        if self.has(digest):
+            # re-spill of an unchanged prefix block: full blocks are
+            # never rewritten, so the stored content is identical —
+            # refresh its recency only
+            self._stamp[digest] = int(stamp)
+            self._touch(digest)
+            return True
+        idx = jnp.asarray(np.asarray([block], np.int32))
+        kv = {key: np.asarray(handoff._gather_blocks(leaf, idx))
+              for key, leaf in self.engine.kv_cache.items()}
+        buf = handoff._npz_chunk(
+            {"kind": "kv_spill", "digest": digest.hex(),
+             "crc32": handoff._chunk_crc(kv), "stamp": int(stamp)}, kv)
+        self._stamp[digest] = int(stamp)
+        self._host[digest] = buf
+        self._host_bytes += len(buf)
+        self._m_spill_bytes.inc(len(buf))
+        self._m_spill_blocks.inc()
+        self._shrink_host()
+        self._m_resident.set(self._host_bytes)
+        return True
+
+    def _touch(self, digest: bytes) -> None:
+        if digest in self._host:
+            self._host.move_to_end(digest)
+        elif digest in self._disk:
+            self._disk.move_to_end(digest)
+
+    def _shrink_host(self) -> None:
+        # without a disk tier, dropping the JUST-spilled entry would make
+        # eviction lossy again — keep the newest entry even over budget;
+        # with one, everything over budget demotes
+        keep_min = 0 if self.disk_dir else 1
+        while self._host_bytes > self.host_limit \
+                and len(self._host) > keep_min:
+            # demote the OLDEST-touched entry (allocator stamp recorded
+            # at spill time), not merely the oldest-spilled one
+            victim = min(self._host,
+                         key=lambda d: self._stamp.get(d, 0))
+            buf = self._host.pop(victim)
+            self._host_bytes -= len(buf)
+            if self.disk_dir:
+                self._demote_to_disk(victim, buf)
+            else:
+                self._stamp.pop(victim, None)
+                self._m_dropped.inc()
+
+    def _disk_file(self, digest: bytes) -> str:
+        return os.path.join(self.disk_dir, f"{digest.hex()}.npz")
+
+    def _demote_to_disk(self, digest: bytes, buf: bytes) -> None:
+        try:
+            with open(self._disk_file(digest), "wb") as fh:
+                fh.write(buf)
+        except OSError as e:
+            logger.warning(f"kv spill disk tier write failed: {e}")
+            self._stamp.pop(digest, None)
+            self._m_dropped.inc()
+            return
+        self._disk[digest] = len(buf)
+        self._disk_bytes += len(buf)
+        while self._disk_bytes > self.disk_limit and len(self._disk) > 1:
+            victim = min(self._disk,
+                         key=lambda d: self._stamp.get(d, 0))
+            self._disk_bytes -= self._disk.pop(victim)
+            self._stamp.pop(victim, None)
+            self._m_dropped.inc()
+            try:
+                os.unlink(self._disk_file(victim))
+            except OSError:
+                pass
+
+    # -- restore ---------------------------------------------------------
+    def _load(self, digest: bytes) -> Optional[bytes]:
+        self._stamp.pop(digest, None)
+        buf = self._host.pop(digest, None)
+        if buf is not None:
+            self._host_bytes -= len(buf)
+            self._m_resident.set(self._host_bytes)
+            return buf
+        n = self._disk.pop(digest, None)
+        if n is None:
+            return None
+        self._disk_bytes -= n
+        path = self._disk_file(digest)
+        try:
+            with open(path, "rb") as fh:
+                buf = fh.read()
+        except OSError as e:
+            logger.warning(f"kv spill disk tier read failed: {e}")
+            self._m_dropped.inc()
+            return None
+        try:
+            os.unlink(path)
+        except OSError:
+            # a stuck unlink must not discard the successfully-read
+            # entry; the orphan is re-attempted at close()
+            pass
+        return buf
+
+    def restore_block(self, digest: bytes, block: int) -> bool:
+        """Re-materialize ``digest``'s content into pool ``block``.
+        Returns False (entry dropped, caller treats the digest as a
+        plain miss) on integrity failure — a corrupted spill entry must
+        degrade to a recompute, never to poisoned KV."""
+        from ..serve import handoff
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        buf = self._load(digest)
+        if buf is None:
+            return False
+        try:
+            chunk = handoff.parse_chunk(buf)
+            d = chunk["descriptor"]
+            if d.get("kind") != "kv_spill" or d.get("digest") != digest.hex():
+                raise ValueError("spill entry descriptor mismatch")
+            if handoff._chunk_crc(chunk["kv"]) != int(d["crc32"]):
+                raise ValueError("spill entry failed its crc32 check")
+            if set(chunk["kv"]) != set(self.engine.kv_cache):
+                raise ValueError("spill entry leaf set disagrees with "
+                                 "the pool")
+        except Exception as e:
+            logger.warning(f"kv spill restore dropped a corrupt entry: {e}")
+            self._m_dropped.inc()
+            return False
+        idx = jnp.asarray(np.asarray([block], np.int32))
+        for key in list(self.engine.kv_cache):
+            leaf = self.engine.kv_cache[key]
+            self.engine.kv_cache[key] = handoff._scatter_blocks(
+                leaf, idx, jnp.asarray(chunk["kv"][key], leaf.dtype))
+        self._m_restore_blocks.inc()
+        self._m_restore_s.observe(time.perf_counter() - t0)
+        return True
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self) -> None:
+        """Drop every entry and unlink the disk tier (drain/stop
+        semantics: a stopped replica must not leak host RAM or scratch
+        files; its spilled conversations recompute elsewhere)."""
+        self._host.clear()
+        self._host_bytes = 0
+        self._m_resident.set(0)
+        if self.disk_dir:
+            # sweep the whole scratch dir, not just tracked digests:
+            # a file whose unlink failed mid-restore is orphaned from
+            # the index but still ours to clean up
+            try:
+                for name in os.listdir(self.disk_dir):
+                    if name.endswith(".npz"):
+                        try:
+                            os.unlink(os.path.join(self.disk_dir, name))
+                        except OSError:
+                            pass
+            except OSError:
+                pass
+        self._disk.clear()
+        self._disk_bytes = 0
+        self._stamp.clear()

@@ -513,6 +513,12 @@ class ServingLoop:
         self._run_cmds()
         self._abort_remaining()
         self._diag_drain()
+        spill = getattr(self.scheduler.engine, "spill", None)
+        if spill is not None:
+            # drain/stop semantics for the cold tier: a stopped replica
+            # must not leak host RAM or disk scratch; its spilled
+            # conversations recompute wherever they land next
+            spill.close()
         if self.bridge is not None:
             try:  # drain/stop must end cleanly even if a backend throws
                 self.bridge.close()

@@ -41,7 +41,15 @@ class BF16Config:
 
 @dataclass
 class OffloadConfig:
-    """Reference: runtime/zero/offload_config.py (device: cpu|nvme)."""
+    """Reference: runtime/zero/offload_config.py (device: cpu|nvme).
+
+    ``pin_memory`` on ``offload_optimizer`` with ``device: cpu`` selects
+    the TIERED offload path (runtime/offload.py): optimizer state in
+    host memory (``pinned_host`` where the runtime supports it), update
+    streamed bucket-by-bucket at ``stage3_prefetch_bucket_size``
+    granularity with ``buffer_count`` fetches in flight. Without it,
+    ``device: cpu`` keeps the legacy host C++ optimizer
+    (runtime/zero/offload.py)."""
 
     device: str = "none"
     nvme_path: Optional[str] = None
@@ -52,6 +60,32 @@ class OffloadConfig:
     pipeline_write: bool = False
     fast_init: bool = False
     ratio: float = 1.0
+
+    def __post_init__(self):
+        if self.device not in ("none", "cpu", "nvme"):
+            # the engine used to reject unknown devices only at init —
+            # a config load is the cheapest place to fail
+            raise ConfigError(
+                f"offload device must be 'cpu' or 'nvme' (or 'none'), "
+                f"got {self.device!r}")
+        if self.device == "nvme" and not self.nvme_path:
+            raise ConfigError(
+                "offload device 'nvme' requires nvme_path")
+        # buffer-count style knobs are CONSUMED (tiered prefetch depth,
+        # AIO buffer sizing) — nonsense must fail at load, like the
+        # bucket-size checks below (a buffer_count of 0 would silently
+        # serialize every fetch; a negative size would wrap a malloc)
+        if self.buffer_count < 1:
+            raise ConfigError(
+                f"offload buffer_count must be >= 1, got "
+                f"{self.buffer_count}")
+        if self.buffer_size <= 0:
+            raise ConfigError(
+                f"offload buffer_size must be > 0, got "
+                f"{self.buffer_size}")
+        if not 0.0 < self.ratio <= 1.0:
+            raise ConfigError(
+                f"offload ratio must be in (0, 1], got {self.ratio}")
 
 
 @dataclass
@@ -153,6 +187,42 @@ class ZeroConfig:
                 raise ConfigError(
                     "quantized_reduce and zero_quantized_gradients both "
                     "quantize the gradient exchange — pick one transport")
+        offloaded = (self.offload_optimizer.device != "none"
+                     or self.offload_param.device != "none")
+        if self.quantized_reduce != "off" and offloaded:
+            # the offload paths (host C++ optimizer, tiered stream,
+            # Infinity per-layer executor) build their own gradient
+            # programs that never consult the knob — running fp32 wire
+            # while the config claims int8 would be a silent no-op
+            # (previously rejected at engine init, after the expensive
+            # state build)
+            raise ConfigError(
+                "zero_optimization.quantized_reduce requires the "
+                "standard jitted step: ZeRO-Offload / ZeRO-Infinity "
+                "keep their own gradient transports")
+        if self.offload_optimizer.pin_memory:
+            # pin_memory selects the TIERED path (runtime/offload.py)
+            if self.offload_optimizer.device == "nvme":
+                raise ConfigError(
+                    "offload_optimizer.pin_memory selects the tiered "
+                    "HOST-RAM tier and composes with device 'cpu' only; "
+                    "'nvme' runs the AIO-swapped host optimizer "
+                    "(drop pin_memory or set device: cpu)")
+            if (self.offload_optimizer.device == "cpu"
+                    and self.stage not in (1, 2)):
+                raise ConfigError(
+                    "tiered optimizer offload (offload_optimizer "
+                    "{device: cpu, pin_memory: true}) targets ZeRO "
+                    f"stages 1/2 (got stage {self.stage}); stage-3 "
+                    "state already shards via the parameter plan, "
+                    "stage 0 has no sharded optimizer tier")
+            if (self.offload_optimizer.device == "cpu"
+                    and (self.zero_quantized_gradients
+                         or self.zero_quantized_weights)):
+                raise ConfigError(
+                    "tiered optimizer offload does not compose with "
+                    "ZeRO++ quantized gradients/weights (the streamed "
+                    "update rides the plain bucketed grad program)")
         if self.zero_hpz_partition_size > 1 and self.stage != 3:
             # hpZ is a stage-3 feature (secondary partition of the COMPUTE
             # params; reference zero/config.py:256-272) — rejecting loudly
@@ -473,6 +543,19 @@ class DeepSpeedConfig:
                 f"device count {world_size} not divisible by tp*pp*sp={mp}")
         self.dp_world_size = world_size // mp
         self._resolve_batch_sizes()
+        # cross-block reject (optimizer type x zero offload): 1-bit
+        # optimizers own their communication AND their own state layout —
+        # neither host-offload backend can stream it. Fails at load
+        # instead of deep inside the engine's state init.
+        if self.cfg.zero_optimization.offload_optimizer.device != "none" \
+                and self.cfg.optimizer is not None:
+            from .fp16.onebit import is_onebit_optimizer
+            if is_onebit_optimizer(self.cfg.optimizer.type):
+                raise ConfigError(
+                    "offload_optimizer does not compose with 1-bit "
+                    "optimizers (they own their error-feedback state "
+                    "and communication); use the standard optimizer "
+                    "registry or drop the offload block")
 
     def _resolve_batch_sizes(self):
         c = self.cfg

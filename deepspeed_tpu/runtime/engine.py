@@ -217,6 +217,14 @@ class DeepSpeedTpuEngine:
         # native C++ kernel, the device only produces gradients.
         off_cfg = self.config.zero_optimization.offload_optimizer
         self.offload_device = off_cfg.device if off_cfg.device != "none" else None
+        # pin_memory routes device:cpu to the TIERED path (runtime/offload.py):
+        # optimizer state host-resident (pinned_host where supported), the
+        # update itself streamed bucket-by-bucket through the SAME jitted
+        # math as the resident step — bit-identical training, HBM holds one
+        # prefetch bucket of fp32 state at a time. pin_memory=False keeps
+        # the legacy host C++ optimizer (runtime/zero/offload.py).
+        self.offload_tiered = bool(self.offload_device == "cpu"
+                                   and off_cfg.pin_memory)
         self.host_opt = None
         # offload_param (ZeRO-Infinity parameter spill, reference
         # swap_tensor/partitioned_param_swapper.py:36): the compute-param
@@ -341,6 +349,8 @@ class DeepSpeedTpuEngine:
         if self.param_offload_nvme:
             # the per-layer executor owns its own jitted programs
             self._batch_sharding_fn = self._default_batch_sharding_fn()
+        elif self.offload_tiered:
+            self._build_tiered_offload_step()
         elif self.offload_device:
             self._build_offload_step()
         elif self.onebit_mode:
@@ -790,6 +800,10 @@ class DeepSpeedTpuEngine:
         stage_1_and_2.py cpu_offload; Infinity via nvme device)."""
         from .zero.offload import HostOffloadOptimizer, _leaf_names
 
+        if self.offload_tiered:
+            self._init_tiered_offload_state(rng)
+            return
+
         opt_cfg = self.config.optimizer
         cpu0 = jax.local_devices(backend="cpu")[0]
         with jax.default_device(cpu0):
@@ -805,6 +819,40 @@ class DeepSpeedTpuEngine:
             compute_dtype=np.dtype(self.compute_dtype))
         del master, master_np, leaves
         self._push_host_params(self.host_opt.current_bf16_leaves())
+        self.master_params = None
+        self.opt_state = None
+
+    def _init_tiered_offload_state(self, rng):
+        """Tiered offload init (runtime/offload.py): master params are
+        initialized through the SAME jitted program (same out_shardings,
+        same threefry bits) as the resident path, pulled to the host
+        tier, and the compute params cast with the resident cast — so a
+        tiered engine starts from bit-identical state to the resident
+        engine it must match step for step."""
+        from .offload import TieredOptimizerOffload
+        from .zero.offload import _leaf_names
+
+        zc = self.config.zero_optimization
+        init_master = jax.jit(self.model.init_params,
+                              out_shardings=self.zero_plan.master_sharding)
+        master_dev = init_master(rng)
+        cast = jax.jit(
+            lambda p: jax.tree.map(
+                lambda x: x.astype(self.compute_dtype), p),
+            out_shardings=self.zero_plan.param_sharding)
+        self.params = cast(master_dev)
+        leaves_dev, self._param_treedef = jax.tree_util.tree_flatten(
+            master_dev)
+        master_np = [np.asarray(l, np.float32) for l in leaves_dev]
+        del master_dev, leaves_dev
+        self.host_opt = TieredOptimizerOffload(
+            self.optimizer, self._lr_fn, master_np,
+            _leaf_names(jax.tree_util.tree_unflatten(self._param_treedef,
+                                                     master_np)),
+            bucket_elems=zc.stage3_prefetch_bucket_size,
+            buffer_count=zc.offload_optimizer.buffer_count,
+            compute_dtype=np.dtype(self.compute_dtype),
+            fetch_sharding=self.topology.replicated())
         self.master_params = None
         self.opt_state = None
 
@@ -1283,6 +1331,106 @@ class DeepSpeedTpuEngine:
                                   in_shardings=(param_sh, repl, None))
         self._batch_sharding_fn = self._default_batch_sharding_fn()
 
+    def _build_tiered_offload_step(self):
+        """Grad-only device program for TIERED offload: bit-for-bit the
+        resident ``_build_train_step`` gradient half — same bucketed
+        ppermute-ring program on pure-dp meshes (grad_overlap.py), same
+        unscale/clip/check epilogue, grads LEFT IN fp32 ON DEVICE — the
+        streamed bucket update (runtime/offload.py) then applies the
+        resident optimizer math per prefetch bucket. Sharing the exact
+        gradient program is what makes offloaded-vs-resident training
+        bit-identical (pinned by test_tiered_offload.py)."""
+        plan = self.zero_plan
+        gas = self.gas
+        clip = self.config.gradient_clipping
+        fp16 = self.fp16_enabled
+        scale_cfg = self.scale_cfg
+        grad_sh = plan.grad_sharding
+        param_sh = self.param_storage_sharding
+        lr_fn = self._lr_fn
+        dcfg = self.config.diagnostics
+        grad_attribution = (bool(self.config.telemetry.enabled)
+                            and dcfg.enabled and dcfg.grad_attribution)
+
+        from .grad_overlap import make_overlapped_grad_fn, \
+            resolve_overlap_mode
+        self.grad_overlap_mode = resolve_overlap_mode(self, False)
+        use_manual = self.grad_overlap_mode == "bucketed"
+        manual_grad_fn = None
+        if use_manual:
+            manual_grad_fn, self.grad_bucket_plan, _ = \
+                make_overlapped_grad_fn(self, False, False)
+            log_dist(
+                f"tiered offload: bucketed grad ring "
+                f"({self.grad_bucket_plan.num_buckets} reduce buckets) + "
+                f"streamed optimizer update", ranks=[0])
+
+        def constrain(tree, sh):
+            return jax.tree.map(
+                lambda x, s: jax.lax.with_sharding_constraint(x, s),
+                tree, sh)
+
+        def grad_step(params, scale_state, step, rng, batch):
+            lr = lr_fn(step)
+            scale = (scale_state["loss_scale"] if fp16
+                     else jnp.asarray(1.0, jnp.float32))
+            if use_manual:
+                rng, sub = jax.random.split(rng)
+                grads, loss = manual_grad_fn(params, sub, batch, scale)
+                grads = constrain(grads, grad_sh)
+                inv = 1.0 / (gas * scale)
+            else:
+                def micro_fn(carry, micro):
+                    grads_acc, rng = carry
+                    rng, sub = jax.random.split(rng)
+                    (scaled, (loss, _aux)), grads = jax.value_and_grad(
+                        self._loss_fn, has_aux=True)(params, micro, sub,
+                                                     scale, step)
+                    grads = jax.tree.map(
+                        lambda a, g: a + g.astype(jnp.float32),
+                        grads_acc, grads)
+                    grads = constrain(grads, grad_sh)
+                    return (grads, rng), loss
+
+                grads0 = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                grads0 = constrain(grads0, grad_sh)
+                (grads, rng), losses = jax.lax.scan(micro_fn,
+                                                    (grads0, rng), batch)
+                loss = jnp.mean(losses)
+                inv = 1.0 / (gas * scale)
+            if grad_attribution:
+                grads, finite, gnorm, leaf_sq = unscale_clip_check(
+                    grads, inv, clip, fp16, with_leaf_sqnorms=True)
+            else:
+                grads, finite, gnorm = unscale_clip_check(
+                    grads, inv, clip, fp16)
+            new_scale_state = (update_scale(scale_state, finite, scale_cfg)
+                               if fp16 else scale_state)
+            metrics = {"loss": loss, "grad_norm": gnorm, "lr": lr,
+                       "skipped": (~finite).astype(jnp.int32)}
+            if fp16:
+                metrics["loss_scale"] = scale
+            if grad_attribution:
+                metrics["grad_leaf_sqnorms"] = leaf_sq
+            return grads, new_scale_state, rng, metrics
+
+        repl = self.topology.replicated()
+        scale_sh = (jax.tree.map(lambda _: repl, self.scale_state)
+                    if self.scale_state is not None else None)
+        # explicit out_shardings is safe here (unlike _build_offload_step's
+        # param_offload guard): tiered offload is config-rejected outside
+        # ZeRO 1/2 while offload_param requires stage 3, so params can
+        # never carry host-memory-kind shardings on this path
+        assert not self.param_offload
+        self._grad_step = jax.jit(
+            grad_step,
+            in_shardings=(param_sh, scale_sh, repl, repl, None),
+            out_shardings=(grad_sh, scale_sh, repl, None),
+            donate_argnums=(1,))
+        self._build_eval_step()
+        self._batch_sharding_fn = self._default_batch_sharding_fn()
+
     def _relocate_params_to_storage(self):
         """Move freshly-updated (device-resident) compute params back to
         their storage placement (pinned_host layer stack). Outside-jit on
@@ -1328,7 +1476,30 @@ class DeepSpeedTpuEngine:
         metrics["lr"] = lr
         return metrics
 
+    def _train_batch_tiered(self, dev_batch):
+        """Tiered-offload batch: prefetch the first optimizer-state
+        buckets so their H2D rides under the gradient program's
+        backward+ring window, then stream the update bucket-by-bucket
+        (runtime/offload.py). Grads never leave the device; host only
+        sees the scalar metrics."""
+        self.host_opt.prefetch()
+        grads, self.scale_state, self._model_rng, metrics = self._grad_step(
+            self.params, self.scale_state, self._step_arr, self._model_rng,
+            dev_batch)
+        if not int(metrics["skipped"]):
+            step_no = int(self._step_arr) + 1
+            new_leaves = self.host_opt.stream_update(
+                jax.tree.leaves(grads), self._step_arr)
+            params = jax.tree_util.tree_unflatten(self._param_treedef,
+                                                  new_leaves)
+            self.params = jax.tree.map(jax.device_put, params,
+                                       self.param_storage_sharding)
+            self._step_arr = jnp.asarray(step_no, jnp.int32)
+        return metrics
+
     def _train_batch_offloaded(self, dev_batch):
+        if self.offload_tiered:
+            return self._train_batch_tiered(dev_batch)
         grads, self.scale_state, self._model_rng, metrics = self._grad_step(
             self.params, self.scale_state, self._step_arr, self._model_rng,
             dev_batch)

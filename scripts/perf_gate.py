@@ -66,6 +66,22 @@ Gated metrics (see ``collect()``):
     decode replica stepping its running batch between chunk applies
     (stall fraction 0.0 = full overlap; the legacy blocking transport
     is an atomic restore — stall fraction 1.0 by construction).
+  * ``kv_spill_steady_state_recompiles`` / ``kv_spill_capacity_gain``
+    / ``kv_spill_turn2_reuse_fraction`` — the KV spill tier
+    (ragged/spill.py): a conversation sweep through a pressure-sized
+    pool must re-admit spilled prefixes as FULL hits (turn-2 reuse
+    1.0), keep strictly more conversations available at the fixed pool
+    budget than the pool alone retains (gain pinned from below), and
+    restore through the double-warmed donated-pool scatter with zero
+    steady-state recompiles.
+  * ``offload_prefetch_hit_fraction`` /
+    ``offload_prefetch_exposed_fraction`` /
+    ``tiered_offload_update_programs`` — tiered optimizer offload
+    (runtime/offload.py) on the dp8 CPU-mesh proxy: every optimizer-
+    state fetch issued ahead of its consumer, the blocked-on-transfer
+    share of streaming time pinned low (wide wall-clock tolerance),
+    and the streamed update holding one compiled executable per
+    bucket signature.
   * ``recorder_events_per_decode_step`` /
     ``recorder_ns_per_event`` — flight-recorder overhead
     (telemetry/recorder.py): how many black-box events the serving
@@ -367,6 +383,55 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
                 qprog.get("flops", 0.0) / qprog["token_bucket"])
             metrics["kv_quant_ragged_peak_bytes"] = float(
                 qprog["peak_bytes"])
+
+        # -- tiered memory: KV spill tier + host-offloaded optimizer -------
+        # serving half (ragged/spill.py): the conversation sweep through
+        # a pressure-sized pool — spilled prefixes must re-admit as hits
+        # (turn-2 reuse 1.0), strictly more conversations must stay
+        # available than the pool alone retains (capacity gain
+        # min-pinned), and the restore path must ride the double-warmed
+        # donated-pool scatter with ZERO steady-state recompiles
+        from deepspeed_tpu.benchmarks.serving_bench import bench_kv_spill
+        spill_rep = bench_kv_spill(model, params, conversations=4,
+                                   prompt=48, new_tokens=6)
+        metrics["kv_spill_steady_state_recompiles"] = float(
+            spill_rep["kv_spill_steady_state_recompiles"])
+        metrics["kv_spill_capacity_gain"] = float(
+            spill_rep["kv_spill_capacity_gain"])
+        metrics["kv_spill_turn2_reuse_fraction"] = float(
+            spill_rep["turn2_reuse_fraction_spill"])
+
+        # training half (runtime/offload.py) on the dp8 CPU mesh proxy:
+        # a real tiered train run — every state fetch must have been
+        # issued AHEAD of its consumer (hit fraction min-pinned ~1.0),
+        # the blocked-on-transfer share of streaming time stays low
+        # (exposed fraction, wide wall-clock tolerance), and the
+        # streamed update stays within its compiled-program budget (one
+        # executable per bucket signature)
+        import deepspeed_tpu as _ds
+        toff, _, _, _ = _ds.initialize(
+            model=TransformerLM(cfg),
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "bf16": {"enabled": True},
+                    "optimizer": {"type": "adamw",
+                                  "params": {"lr": 1e-3}},
+                    "zero_optimization": {
+                        "stage": 2,
+                        "offload_optimizer": {"device": "cpu",
+                                              "pin_memory": True},
+                        "stage3_prefetch_bucket_size": 1 << 14},
+                    "steps_per_print": 10 ** 9})
+        ids = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (1, 8, 32), dtype=np.int64)
+        for _ in range(3):
+            toff.train_batch(batch={"input_ids": ids})
+        metrics["offload_prefetch_hit_fraction"] = reg.gauge(
+            "offload_prefetch_hit_fraction").value
+        metrics["offload_prefetch_exposed_fraction"] = reg.gauge(
+            "offload_prefetch_exposed_fraction").value
+        metrics["tiered_offload_update_programs"] = float(
+            len(toff.host_opt._update_fns))
+        toff.destroy()
 
         # -- routing tier: affinity win + per-replica steady state ---------
         # (serve/router.py): a shared-prefix workload through 2 routed
@@ -719,9 +784,31 @@ def make_baseline(metrics: Dict[str, float]) -> Dict[str, Any]:
                     "router_steady_recompiles",
                     "routed_trace_steady_recompiles",
                     "remote_replica_steady_recompiles",
-                    "kv_quant_steady_state_recompiles"):
+                    "kv_quant_steady_state_recompiles",
+                    "kv_spill_steady_state_recompiles",
+                    "tiered_offload_update_programs"):
             spec[name] = {"value": value, "direction": "max",
                           "abs_tol": 0.0}
+        elif name in ("kv_spill_capacity_gain",
+                      "kv_spill_turn2_reuse_fraction"):
+            # the spill win itself: at the fixed pool budget, spill must
+            # keep more conversations available than the pool retains,
+            # and a spilled prefix must keep re-admitting as a full hit
+            # (deterministic sweep counts) — direction "min" so erosion
+            # fails the gate
+            spec[name] = {"value": value, "direction": "min",
+                          "abs_tol": 0.0}
+        elif name == "offload_prefetch_hit_fraction":
+            # every bucket fetch must ride ahead of its consumer; a
+            # depth regression (fetch-on-demand) fails
+            spec[name] = {"value": value, "direction": "min",
+                          "abs_tol": 0.05}
+        elif name == "offload_prefetch_exposed_fraction":
+            # wall-clock-ish (blocked-on-transfer share of streaming
+            # time): wide absolute tolerance, but a serialization
+            # regression (transfers no longer hidden) fails
+            spec[name] = {"value": value, "direction": "max",
+                          "abs_tol": 0.25}
         elif name == "handoff_chunk_overlap_windows":
             # the overlap win itself: every inter-chunk window must keep
             # letting the decode loop step — direction "min" so a

@@ -28,7 +28,9 @@ INERT_BY_DESIGN = {
     # ZeRO-3 prefetch machinery is replaced by XLA's scheduler (SURVEY §7)
     "stage3_max_live_parameters": "XLA latency-hiding scheduler owns liveness",
     "stage3_max_reuse_distance": "XLA latency-hiding scheduler owns reuse",
-    "stage3_prefetch_bucket_size": "XLA latency-hiding scheduler owns prefetch",
+    # stage3_prefetch_bucket_size is CONSUMED since the tiered-offload PR
+    # (runtime/offload.py streams the optimizer update at that
+    # granularity), so it left this list
     "stage3_gather_16bit_weights_on_model_save":
         "save_16bit_model always gathers (sharded arrays fetch on read)",
     "sub_group_size": "optimizer runs fused on the shard; no sub-groups",
@@ -63,7 +65,8 @@ INERT_BY_DESIGN = {
                        "curriculum_learning block",
     "data_types": "precision comes from the fp16/bf16 blocks",
     # aio/checkpoint knobs owned by the C++ layer's own defaults
-    "buffer_count": "AIO thread pool sizes its own staging buffers",
+    # (buffer_count is CONSUMED since the tiered-offload PR: it is the
+    # streamed update's prefetch depth)
     "buffer_size": "AIO thread pool sizes its own staging buffers",
     "pipeline_read": "AIO reads are already overlapped by the thread pool",
     "pipeline_write": "AIO writes are already overlapped by the thread pool",
