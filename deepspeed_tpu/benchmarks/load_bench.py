@@ -34,6 +34,14 @@ percentiles, goodput share) plus router-level shed/re-route counts.
 ``--placement`` picks the routing policy (affinity | hash |
 round_robin) so the affinity win is measurable against the
 random-placement baseline.
+
+``--chaos SEED`` (with ``--router N``) swaps the in-process replicas
+for LOOPBACK socket workers and wraps every replica's transport in a
+seeded fault plane (serve/faults.py: dial latency + mid-stream
+resets). The report adds the chaos accounting: ``invariant_ok`` (every
+submitted request completed or failed typed — the robustness
+invariant), faults injected by kind, mid-stream reconnects, retries,
+and suspect/death verdicts.
 """
 
 import argparse
@@ -303,6 +311,99 @@ def run_router_open_loop(engines, arrivals, prompts, new_tokens, budget,
     return asyncio.run(drive())
 
 
+def run_chaos_open_loop(engines, arrivals, prompts, new_tokens, budget,
+                        chunk, max_pending, max_queued_tokens=None,
+                        deadline_s=None, placement="affinity",
+                        chaos_seed=0, reset_p=0.15, latency_p=0.2,
+                        latency_s=0.03):
+    """Open-loop Poisson trace through a LOOPBACK remote fleet under a
+    seeded probabilistic fault schedule (``--chaos``): every replica is
+    a socket-backed worker whose transport is wrapped by a
+    serve/faults.py plane injecting dial latency and mid-stream
+    connection resets. The report carries the chaos accounting and the
+    robustness invariant — every submitted request either completed or
+    failed with a typed reason (``invariant_ok``), with the reconnect
+    and retry counts that absorbed the schedule."""
+    import asyncio
+
+    async def drive():
+        from ..inference.v2.serve import (AdmissionConfig, FaultPlane,
+                                          FaultSpec, RemoteReplica,
+                                          ReplicaRouter, ReplicaWorker,
+                                          RouterConfig, ServingConfig)
+        from ..telemetry import get_registry
+        fam = get_registry().family_total
+        base = {name: fam(name) for name in
+                ("remote_stream_reconnects_total",
+                 "remote_stream_reconnect_failures_total",
+                 "remote_call_retries_total", "router_suspects_total",
+                 "router_dead_replicas_total")}
+        workers, planes, replicas = [], [], []
+        for i, eng in enumerate(engines):
+            w = ReplicaWorker(
+                eng, ServingConfig(
+                    token_budget=budget, chunk=chunk,
+                    admission=AdmissionConfig(
+                        max_pending=max_pending,
+                        max_queued_tokens=max_queued_tokens)),
+                name=f"chaos{i}")
+            host, port = await w.start()
+            plane = FaultPlane([
+                FaultSpec(kind="latency", op="connect",
+                          target="/generate", delay_s=latency_s,
+                          probability=latency_p, times=None),
+                FaultSpec(kind="reset", op="read", target="/generate",
+                          skip=2, probability=reset_p, times=None),
+            ], seed=chaos_seed + i)
+            workers.append(w)
+            planes.append(plane)
+            replicas.append(RemoteReplica(
+                f"chaos{i}", host, port, faults=plane,
+                probe_interval_s=0.05, reconnect_backoff_s=0.01))
+        router = ReplicaRouter(replicas,
+                               RouterConfig(placement=placement))
+        await router.start()
+        t0 = time.perf_counter()
+        stats, ttfts, totals, tpots, good = await _drive_open_loop(
+            router.submit, t0, arrivals, prompts, new_tokens,
+            deadline_s)
+        await router.stop(drain=True)
+        for w in workers:
+            await w.stop()
+        makespan = time.perf_counter() - t0
+        report = _open_loop_report(stats, ttfts, totals, tpots, good,
+                                   makespan)
+        accounted = (report["completed"] + report["rejected"]
+                     + report["expired"] + report["errors"])
+        injected = {}
+        for plane in planes:
+            for kind, n in plane.injected.items():
+                injected[kind] = injected.get(kind, 0) + n
+        return {
+            "replicas": len(engines),
+            "chaos_seed": chaos_seed,
+            **report,
+            # the robustness invariant: nothing hung, nothing vanished
+            "submitted": len(prompts),
+            "invariant_ok": accounted == len(prompts),
+            "faults_injected": injected,
+            "stream_reconnects":
+                fam("remote_stream_reconnects_total")
+                - base["remote_stream_reconnects_total"],
+            "reconnect_failures":
+                fam("remote_stream_reconnect_failures_total")
+                - base["remote_stream_reconnect_failures_total"],
+            "call_retries": fam("remote_call_retries_total")
+            - base["remote_call_retries_total"],
+            "replicas_suspected": fam("router_suspects_total")
+            - base["router_suspects_total"],
+            "replicas_died": fam("router_dead_replicas_total")
+            - base["router_dead_replicas_total"],
+        }
+
+    return asyncio.run(drive())
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ds_tpu_load_bench")
     p.add_argument("--requests", type=int, default=48)
@@ -331,6 +432,19 @@ def main(argv=None) -> int:
                         "to MAX replicas under shed pressure and "
                         "draining back on idle; the report carries "
                         "the scale events")
+    p.add_argument("--chaos", type=int, default=None, metavar="SEED",
+                   help="router mode: drive the trace through LOOPBACK "
+                        "socket replicas under a seeded fault schedule "
+                        "(dial latency + mid-stream resets; "
+                        "serve/faults.py). The report carries the "
+                        "robustness invariant (invariant_ok), fault/"
+                        "reconnect/retry counts and per-outcome "
+                        "accounting")
+    p.add_argument("--chaos-reset-p", type=float, default=0.15,
+                   help="chaos mode: per-read probability of an "
+                        "injected mid-stream connection reset")
+    p.add_argument("--chaos-latency-s", type=float, default=0.03,
+                   help="chaos mode: injected dial latency seconds")
     p.add_argument("--max-pending", type=int, default=16,
                    help="open mode: admission queue bound")
     p.add_argument("--max-queued-tokens", type=int, default=0,
@@ -364,6 +478,29 @@ def main(argv=None) -> int:
                               "num_blocks": 4096,
                               "enable_prefix_caching": prefix_caching},
         }, params=params)
+
+    if args.router > 0 and args.chaos is not None:
+        engines = [fresh_engine() for _ in range(args.router)]
+        # warm each engine's jit buckets with a closed-loop pass so the
+        # chaos trace measures fault handling, not compiles
+        for eng in engines:
+            run_trace(eng, arrivals, prompts, args.new, args.budget,
+                      args.chunk, uid_base=10 ** 6)
+        report = run_chaos_open_loop(
+            engines, arrivals, prompts, args.new, args.budget,
+            args.chunk, max_pending=args.max_pending,
+            max_queued_tokens=args.max_queued_tokens or None,
+            deadline_s=args.deadline or None, placement=args.placement,
+            chaos_seed=args.chaos, reset_p=args.chaos_reset_p,
+            latency_s=args.chaos_latency_s)
+        print(json.dumps({
+            "metric": "serving_router_chaos_open_loop",
+            "backend": jax.default_backend(),
+            "requests": args.requests, "rate_rps": args.rate,
+            "budget": args.budget, "chunk": args.chunk,
+            "new_tokens": args.new, **report,
+        }))
+        return 0
 
     if args.router > 0:
         # one engine per replica with prefix caching on (so affinity has

@@ -27,7 +27,14 @@ DeepSpeed-MII persistent deployments over the FastGen engine):
   worker.py    — the replica worker process behind RemoteReplica
                  (python -m deepspeed_tpu.inference.v2.serve.worker)
   autoscaler.py— spawn/drain replicas off the router's load, shed,
-                 SLO-burn and heartbeat signals
+                 SLO-burn and heartbeat signals; spawn failures are
+                 counted and quarantined, never propagated
+  resilience.py— RetryPolicy (backoff + jitter under one shared
+                 deadline budget) and the per-replica CircuitBreaker
+                 (suspected vs dead) behind the remote plane
+  faults.py    — deterministic, scriptable fault injection over the
+                 remote transport (the chaos harness behind the chaos
+                 tests and load_bench --chaos)
 
 See docs/SERVING.md ("Async serving runtime", "Routing tier" and
 "Remote replicas & autoscaling") for the architecture and protocols.
@@ -36,6 +43,9 @@ See docs/SERVING.md ("Async serving runtime", "Routing tier" and
 from . import handoff  # noqa: F401
 from .admission import (AdmissionConfig, AdmissionController,  # noqa: F401
                         OverloadedError)
+from .faults import FaultPlane, FaultSpec  # noqa: F401
+from .resilience import (BreakerConfig, CircuitBreaker,  # noqa: F401
+                         RetryConfig, RetryPolicy)
 from .frontend import (DeadlineExceeded, RequestFailed,  # noqa: F401
                        ServingConfig, ServingEngine, TokenStream)
 from .loop import ServingLoop  # noqa: F401
@@ -44,7 +54,8 @@ from .replica import PrefillReplica, Replica, build_replicas  # noqa: F401
 from .router import (ReplicaRouter, RoutedStream,  # noqa: F401
                      RouterConfig)
 from .remote import RemoteReplica, RemoteStream  # noqa: F401
-from .worker import ReplicaWorker, WorkerAPI  # noqa: F401
+from .worker import (ReplicaWorker, WorkerAPI,  # noqa: F401
+                     WorkerSpawnError, spawn_worker)
 from .autoscaler import Autoscaler, AutoscalerConfig  # noqa: F401
 
 __all__ = [
@@ -54,5 +65,8 @@ __all__ = [
     "PrefillReplica", "Replica", "build_replicas",
     "ReplicaRouter", "RoutedStream", "RouterConfig",
     "RemoteReplica", "RemoteStream", "ReplicaWorker", "WorkerAPI",
+    "WorkerSpawnError", "spawn_worker",
     "Autoscaler", "AutoscalerConfig", "handoff",
+    "FaultPlane", "FaultSpec",
+    "RetryConfig", "RetryPolicy", "BreakerConfig", "CircuitBreaker",
 ]

@@ -69,6 +69,11 @@ from .frontend import DeadlineExceeded, RequestFailed
 _MAX_HEADER_BYTES = 64 * 1024
 _MAX_BODY_BYTES = 8 * 1024 * 1024
 
+# response header carrying the runtime uid at stream start — the one
+# name the resume protocol hangs on (serve/remote.py reads it, the
+# worker's /resume and /handoff responses echo it)
+UID_HEADER = "x-ds-tpu-uid"
+
 
 async def _read_request(reader: asyncio.StreamReader):
     request_line = await reader.readline()
@@ -373,9 +378,13 @@ class ServingAPI:
             _json_response(writer, "400 Bad Request", {"error": str(e)})
             return
 
+        # the runtime uid rides a response header so a client knows what
+        # to resume (worker GET /resume) BEFORE the tail line arrives
+        extra = {"traceparent": ctx.to_traceparent()}
+        if getattr(stream, "uid", None) is not None:
+            extra[UID_HEADER] = str(stream.uid)
         writer.write(_response_head(
-            "200 OK", "application/x-ndjson",
-            {"traceparent": ctx.to_traceparent()}))
+            "200 OK", "application/x-ndjson", extra))
         await self._stream_tokens(reader, writer, stream, ctx)
 
     async def _stream_tokens(self, reader, writer, stream, ctx) -> None:

@@ -51,6 +51,12 @@ class AutoscalerConfig:
     # background run() cadence
     interval_s: float = 0.5
     replace_dead: bool = True
+    # spawn-failure quarantine: after a factory/spawn failure the
+    # autoscaler backs off exponentially (base * 2^(failures-1), capped)
+    # before trying to spawn again — even for dead-capacity
+    # replacement, so a broken factory cannot hot-loop
+    spawn_backoff_s: float = 1.0
+    spawn_backoff_max_s: float = 30.0
 
 
 class Autoscaler:
@@ -81,6 +87,9 @@ class Autoscaler:
         self._last_events = self._event_count()
         self._last_action_t: Optional[float] = None
         self._spawning = False
+        self._spawn_failures = 0
+        self._spawn_quarantine_until: Optional[float] = None
+        self._last_spawn_error: Optional[str] = None
         self._task: Optional[asyncio.Task] = None
         from ....telemetry import get_registry
         reg = get_registry()
@@ -99,6 +108,11 @@ class Autoscaler:
             "autoscaler decision-loop cost per tick (excl. spawn/drain "
             "awaits)", unit="s",
             buckets=(1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 0.1))
+        self._m_spawn_fail = reg.counter(
+            "router_autoscale_spawn_failures_total",
+            "factory/spawn failures caught by the autoscaler (the "
+            "failure is recorded in last_decision and the spawner "
+            "quarantined; it never escapes tick())")
 
     # -- signals --------------------------------------------------------
     def _event_count(self) -> float:
@@ -167,11 +181,20 @@ class Autoscaler:
         now = self.clock()
         cooled = (self._last_action_t is None
                   or now - self._last_action_t >= cfg.cooldown_s)
+        # spawn quarantine: a failed factory backs the SPAWNER off (not
+        # just the cooldown), and dead-capacity replacement respects it
+        # too — plus the breaker state: suspected replicas still count
+        # as up capacity, so suspicion never triggers a replacement
+        spawn_ok = (self._spawn_quarantine_until is None
+                    or now >= self._spawn_quarantine_until)
+        decision["spawn_quarantine_s"] = (
+            round(max(self._spawn_quarantine_until - now, 0.0), 3)
+            if self._spawn_quarantine_until is not None else 0.0)
         if (cfg.replace_dead and len(up) < cfg.min_replicas
-                and not self._spawning):
+                and spawn_ok and not self._spawning):
             decision["action"] = await self._scale_up("replace_dead")
         elif (self._pressure_ticks >= cfg.scale_up_after_ticks
-                and len(up) < cfg.max_replicas and cooled
+                and len(up) < cfg.max_replicas and cooled and spawn_ok
                 and not self._spawning):
             decision["action"] = await self._scale_up("pressure")
             self._pressure_ticks = 0
@@ -179,6 +202,10 @@ class Autoscaler:
                 and len(up) > cfg.min_replicas and cooled):
             decision["action"] = await self._scale_down(up, loads)
             self._idle_ticks = 0
+        if decision["action"].startswith("up_failed:"):
+            decision["spawn_error"] = self._last_spawn_error
+            decision["spawn_quarantine_s"] = round(
+                max(self._spawn_quarantine_until - self.clock(), 0.0), 3)
         self.last_decision = decision
         return decision
 
@@ -189,8 +216,30 @@ class Autoscaler:
         try:
             replica = await self.factory(name)
             await self.router.add_replica(replica)
+        except Exception as e:
+            # a spawn failure must never escape tick(): count it,
+            # record it, quarantine the spawner with exponential
+            # backoff, and STILL advance the cooldown clock so the
+            # decision cadence stays honest
+            self._spawn_failures += 1
+            self._m_spawn_fail.inc()
+            backoff = min(
+                self.config.spawn_backoff_s
+                * 2 ** (self._spawn_failures - 1),
+                self.config.spawn_backoff_max_s)
+            self._spawn_quarantine_until = self.clock() + backoff
+            self._last_action_t = self.clock()
+            self._last_spawn_error = f"{type(e).__name__}: {e}"
+            trace.record("router_autoscale", t0,
+                         time.perf_counter() - t0, lane=_ROUTER_LANE,
+                         action="up_failed", replica=name,
+                         reason=reason, error=self._last_spawn_error,
+                         backoff_s=round(backoff, 3))
+            return f"up_failed:{name}"
         finally:
             self._spawning = False
+        self._spawn_failures = 0
+        self._spawn_quarantine_until = None
         self._last_action_t = self.clock()
         self._m_up.labels(reason=reason).inc()
         trace.record("router_autoscale", t0, time.perf_counter() - t0,

@@ -28,6 +28,30 @@ deepspeed_tpu.inference.v2.serve.worker``) over its HTTP API:
     (remote span clocks are rebased onto this process's
     ``perf_counter`` via the worker's wall-clock anchor).
 
+Resilience (ISSUE 14; docs/SERVING.md § Chaos-hardened serving):
+
+  * every IDEMPOTENT call above (probes, metrics/span fetches, drain,
+    the handoff frame send — its chunk protocol is
+    idempotent-retransmit) runs under a :class:`~.resilience
+    .RetryPolicy`: exponential backoff + jitter inside ONE deadline
+    budget shared across attempts;
+  * :meth:`refresh` CLASSIFIES probe failures (``probe_status``:
+    ``ok`` / ``timeout`` / ``reset`` / ``refused`` / ``error``) instead
+    of collapsing them to not-alive, and bumps ``probe_seq`` per real
+    probe — the router's circuit breaker consumes exactly one verdict
+    per probe and distinguishes *suspected* (route around) from *dead*
+    (connection refused = process exit, or breaker exhausted);
+  * :class:`RemoteStream` RECONNECTS on mid-stream connection loss:
+    the worker keeps a bounded per-uid token log behind ``GET
+    /resume?uid=&offset=`` (serve/worker.py), so the stream re-attaches
+    at its consumed offset — resumed streams are bit-identical to
+    uninterrupted ones — while a COMPLETE-but-malformed NDJSON frame
+    is data corruption and fails the stream with a typed
+    :class:`~.frontend.RequestFailed` instead of reconnecting (or
+    leaking a raw ``JSONDecodeError``);
+  * a :class:`~.faults.FaultPlane` (``faults=``) wraps every
+    connection this replica opens — the deterministic chaos harness.
+
 Everything is stdlib asyncio — no HTTP client dependency — and every
 connection is ``Connection: close``, matching serve/api.py's protocol.
 """
@@ -39,7 +63,9 @@ from typing import List, Optional
 
 from ....telemetry import context as trace_context
 from .admission import OverloadedError
+from .api import UID_HEADER
 from .frontend import DeadlineExceeded, RequestFailed
+from .resilience import RetryConfig, RetryPolicy
 
 # ---------------------------------------------------------------------------
 # /handoff frame protocol: after the request headers, the client streams
@@ -54,6 +80,10 @@ FRAME_BLOCKING = b"B"
 FRAME_PARAMS = b"P"
 _MAX_FRAME_BYTES = 256 * 1024 * 1024
 
+# mid-stream / mid-call transport failures (typed server verdicts are
+# deliberately NOT here)
+_CONN_ERRORS = (OSError, ConnectionError, asyncio.IncompleteReadError,
+                asyncio.TimeoutError, TimeoutError)
 
 def write_frame(writer: asyncio.StreamWriter, kind: bytes,
                 payload: bytes) -> None:
@@ -76,41 +106,75 @@ async def read_frame(reader: asyncio.StreamReader):
 # ---------------------------------------------------------------------------
 async def _open_request(host: str, port: int, method: str, target: str,
                         headers: Optional[dict] = None, body: bytes = b"",
-                        timeout: float = 5.0):
+                        timeout: float = 5.0, faults=None):
     """Send one request and parse the response head; returns
     ``(status_code, resp_headers, reader, writer)`` with the body left
-    on ``reader`` (the streaming endpoints keep reading it)."""
-    reader, writer = await asyncio.wait_for(
-        asyncio.open_connection(host, port), timeout)
-    lines = [f"{method} {target} HTTP/1.1", f"Host: {host}:{port}",
-             "Connection: close", f"Content-Length: {len(body)}"]
-    for k, v in (headers or {}).items():
-        lines.append(f"{k}: {v}")
-    writer.write(("\r\n".join(lines) + "\r\n\r\n").encode() + body)
-    await writer.drain()
-    status_line = await asyncio.wait_for(reader.readline(), timeout)
-    if not status_line:
-        raise ConnectionError(f"empty response from {host}:{port}")
-    parts = status_line.decode("latin-1").split(None, 2)
-    code = int(parts[1])
-    resp_headers = {}
-    while True:
-        line = await reader.readline()
-        if line in (b"\r\n", b"\n", b""):
-            break
-        name, _, value = line.decode("latin-1").partition(":")
-        resp_headers[name.strip().lower()] = value.strip()
+    on ``reader`` (the streaming endpoints keep reading it).
+    ``faults`` (serve/faults.py) wraps the dial and both streams."""
+    async def dial():
+        if faults is not None:
+            # inside the caller's timeout, so injected latency really
+            # expires the probe budget instead of stretching it
+            await faults.connect(target)
+        return await asyncio.open_connection(host, port)
+
+    # ONE absolute deadline for the whole head exchange: per-read
+    # budgets would let a worker dripping header lines overrun the
+    # caller's (and the retry policy's) deadline many-fold
+    deadline = time.monotonic() + timeout
+
+    def remaining() -> float:
+        return max(deadline - time.monotonic(), 0.001)
+
+    reader, writer = await asyncio.wait_for(dial(), remaining())
+    if faults is not None:
+        reader, writer = faults.wrap(reader, writer, target)
+    try:
+        lines = [f"{method} {target} HTTP/1.1", f"Host: {host}:{port}",
+                 "Connection: close", f"Content-Length: {len(body)}"]
+        for k, v in (headers or {}).items():
+            lines.append(f"{k}: {v}")
+        writer.write(("\r\n".join(lines) + "\r\n\r\n").encode() + body)
+        await writer.drain()
+        status_line = await asyncio.wait_for(reader.readline(),
+                                             remaining())
+        if not status_line:
+            raise ConnectionError(f"empty response from {host}:{port}")
+        parts = status_line.decode("latin-1").split(None, 2)
+        code = int(parts[1])
+        resp_headers = {}
+        while True:
+            line = await asyncio.wait_for(reader.readline(),
+                                          remaining())
+            if line in (b"\r\n", b"\n", b""):
+                break
+            name, _, value = line.decode("latin-1").partition(":")
+            resp_headers[name.strip().lower()] = value.strip()
+    except BaseException:
+        # the dial succeeded: the socket must not leak on a head-read
+        # failure (the retry policy would multiply the leak), and
+        # closing it hands the worker its hangup signal — an already-
+        # admitted request gets cancelled (after its resume linger)
+        # instead of silently double-running forever
+        try:
+            writer.close()
+        except Exception:
+            pass
+        raise
+    if faults is not None:
+        reader.arm()     # read-op fault counting starts at the body
     return code, resp_headers, reader, writer
 
 
 async def _request_json(host: str, port: int, method: str, target: str,
-                        body: Optional[dict] = None, timeout: float = 5.0):
+                        body: Optional[dict] = None, timeout: float = 5.0,
+                        faults=None):
     """One-shot JSON request/response; returns ``(code, obj)``."""
     payload = json.dumps(body).encode() if body is not None else b""
     code, _, reader, writer = await _open_request(
         host, port, method, target,
         headers={"Content-Type": "application/json"} if body else None,
-        body=payload, timeout=timeout)
+        body=payload, timeout=timeout, faults=faults)
     try:
         data = await asyncio.wait_for(reader.read(), timeout)
     finally:
@@ -137,14 +201,31 @@ class RemoteStream:
     """Async token stream over one remote NDJSON response — the
     TokenStream surface (iterate / ``cancel()`` / ``drain()`` /
     ``.tokens`` / ``.status`` / ``.reason`` / ``.uid``). ``uid`` is the
-    REMOTE runtime's uid, filled in by the tail summary line."""
+    REMOTE runtime's uid (from the response's ``x-ds-tpu-uid`` header,
+    confirmed by the tail summary line).
+
+    On mid-stream CONNECTION LOSS (reset, EOF, truncated frame) the
+    stream reconnects through its replica's ``GET /resume?uid=&offset=``
+    — the worker replays its bounded token log from the consumed offset
+    and keeps streaming, so resumed streams are bit-identical to
+    uninterrupted ones under the same trace id. A complete-but-malformed
+    NDJSON line is DATA CORRUPTION, not a hangup: the stream fails with
+    a typed :class:`RequestFailed` immediately."""
 
     def __init__(self, reader: asyncio.StreamReader,
-                 writer: asyncio.StreamWriter):
+                 writer: asyncio.StreamWriter, *, replica=None,
+                 uid: Optional[int] = None,
+                 trace_headers: Optional[dict] = None):
         self._reader = reader
         self._writer = writer
         self._ended = False
-        self.uid: Optional[int] = None
+        self._replica = replica
+        self._trace_headers = dict(trace_headers or {})
+        self._reconnects_left = (replica.reconnect_max
+                                 if replica is not None else 0)
+        self._last_reconnect_error: Optional[str] = None
+        self.reconnects = 0
+        self.uid: Optional[int] = uid
         self.status = "active"
         self.reason: Optional[str] = None
         self.trace_id: Optional[str] = None
@@ -159,23 +240,40 @@ class RemoteStream:
         while True:
             try:
                 line = await self._reader.readline()
-            except (ConnectionResetError, BrokenPipeError, OSError) as e:
-                self._finish("error", f"connection lost: {e}")
-                raise RequestFailed(f"remote stream: {self.reason}")
+            except _CONN_ERRORS as e:
+                if await self._reconnect(f"connection lost: {e}"):
+                    continue
+                raise self._fail(f"connection lost: {e}")
             if not line:
-                self._finish(self.status if self._ended else "error",
-                             "connection closed mid-stream")
-                raise RequestFailed(f"remote stream: {self.reason}")
+                if await self._reconnect("connection closed mid-stream"):
+                    continue
+                raise self._fail("connection closed mid-stream")
+            if not line.endswith(b"\n"):
+                # a frame cut mid-byte-stream IS a connection loss (the
+                # peer can only stop mid-line by dying), so the offset
+                # protocol can replace it losslessly
+                if await self._reconnect("truncated frame"):
+                    continue
+                raise self._fail(f"truncated frame {line[:80]!r}")
             line = line.strip()
             if not line:
                 continue
-            obj = json.loads(line)
+            try:
+                obj = json.loads(line)
+            except ValueError as e:
+                # complete but unparseable (JSONDecodeError, or raw
+                # garbage bytes -> UnicodeDecodeError): corruption,
+                # never retried
+                raise self._fail(
+                    f"malformed frame {line[:120]!r} "
+                    f"({type(e).__name__}: {e})")
             if "token" in obj:
                 tok = int(obj["token"])
                 self.tokens.append(tok)
                 return tok
             # tail summary line
-            self.uid = obj.get("uid")
+            if obj.get("uid") is not None:
+                self.uid = obj.get("uid")
             self.trace_id = obj.get("trace_id")
             self._finish(obj.get("status", "completed"),
                          obj.get("detail"))
@@ -186,6 +284,64 @@ class RemoteStream:
                 raise RequestFailed(f"remote request: {self.reason}")
             raise StopAsyncIteration
 
+    def _fail(self, detail: str) -> RequestFailed:
+        if self._last_reconnect_error is not None:
+            detail = f"{detail}; reconnect failed " \
+                     f"({self._last_reconnect_error})"
+        self._finish("error", detail)
+        return RequestFailed(f"remote stream: {detail}")
+
+    async def _reconnect(self, why: str) -> bool:
+        """Re-attach at the consumed offset through ``/resume``;
+        returns True when the stream may keep reading. Bounded by the
+        replica's ``reconnect_max`` across the stream's whole life so a
+        flapping wire always terminates in a typed failure."""
+        r = self._replica
+        if self._ended or r is None or self.uid is None:
+            return False
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+        backoff = r.reconnect_backoff_s
+        while self._reconnects_left > 0:
+            self._reconnects_left -= 1
+            try:
+                code, _, reader, writer = await r._open(
+                    "GET", f"/resume?uid={self.uid}"
+                           f"&offset={len(self.tokens)}",
+                    headers=self._trace_headers,
+                    timeout=r.probe_timeout_s)
+            except _CONN_ERRORS as e:
+                self._last_reconnect_error = f"{type(e).__name__}: {e}"
+                if self._reconnects_left > 0:   # no dead sleep after
+                    await asyncio.sleep(backoff)   # the final attempt
+                    backoff = min(backoff * 2, 1.0)
+                continue
+            if code != 200:
+                # typed refusal (uid unknown / offset trimmed): the
+                # request is unrecoverable here — no more attempts
+                body = b""
+                try:
+                    body = await asyncio.wait_for(reader.read(),
+                                                  r.probe_timeout_s)
+                except Exception:
+                    pass
+                writer.close()
+                self._last_reconnect_error = \
+                    f"resume refused {code}: {body[:160].decode('latin-1')}"
+                r._m_reconnect_failures.inc()
+                return False
+            self._reader, self._writer = reader, writer
+            self.reconnects += 1
+            r._m_reconnects.inc()
+            return True
+        self._last_reconnect_error = (self._last_reconnect_error
+                                      or f"{why}: reconnect budget "
+                                         f"exhausted")
+        r._m_reconnect_failures.inc()
+        return False
+
     def _finish(self, status: str, reason: Optional[str]) -> None:
         self._ended = True
         self.status, self.reason = status, reason
@@ -195,10 +351,16 @@ class RemoteStream:
             pass
 
     async def cancel(self) -> None:
-        """Close the client write side — the worker reads the hangup
-        (serve/api.py's EOF protocol) and cancels the request, freeing
-        its KV blocks on the remote pool."""
+        """Explicitly cancel: one cancel byte then close. The worker
+        distinguishes this from a bare connection loss (which it holds
+        resumable for its linger window) and frees the KV blocks
+        immediately (serve/worker.py)."""
         if not self._ended:
+            try:
+                self._writer.write(b"X")
+                await self._writer.drain()
+            except Exception:
+                pass
             self._finish("cancelled", None)
 
     async def aclose(self) -> None:
@@ -217,15 +379,23 @@ class RemoteReplica:
     ``state`` stays router-owned exactly like the in-process
     :class:`~.replica.Replica`. Health/load/heartbeat signals come from
     cached ``GET /healthz`` snapshots refreshed by :meth:`refresh`
-    (the router polls it from ``check_replicas``); a refresh that
-    cannot reach the worker marks the replica not-alive, which the
-    router's dead-replica detector treats like a dead loop thread."""
+    (the router polls it from ``check_replicas``); each real probe
+    bumps ``probe_seq`` and classifies its outcome into
+    ``probe_status`` — the router's circuit breaker turns those
+    verdicts into *suspected* vs *dead*, replacing the old one-probe
+    death call. ``faults`` installs a per-replica chaos plane; ``retry``
+    tunes the idempotent-call retry policy; ``reconnect_max`` /
+    ``reconnect_backoff_s`` bound :class:`RemoteStream`'s mid-stream
+    reconnects."""
 
     registry = None          # metrics federate via /metrics text instead
 
     def __init__(self, name: str, host: str, port: int, *,
                  probe_timeout_s: float = 5.0,
-                 probe_interval_s: float = 0.25, clock=time.monotonic):
+                 probe_interval_s: float = 0.25, clock=time.monotonic,
+                 retry: Optional[RetryConfig] = None, faults=None,
+                 reconnect_max: int = 4,
+                 reconnect_backoff_s: float = 0.05):
         self.name = name
         self.host = host
         self.port = int(port)
@@ -234,12 +404,48 @@ class RemoteReplica:
         self.probe_timeout_s = probe_timeout_s
         self.probe_interval_s = probe_interval_s
         self.clock = clock
+        self.faults = faults
+        self.retry = RetryPolicy(retry or RetryConfig())
+        self.reconnect_max = int(reconnect_max)
+        self.reconnect_backoff_s = reconnect_backoff_s
         self._health: dict = {"name": name, "state": "unknown"}
         self._reachable = False
         self._last_probe = -1.0
         self._last_metrics: Optional[str] = None
         self.block_size: Optional[int] = None
         self.max_seq_len: Optional[int] = None
+        # probe classification consumed by the router's breaker: one
+        # verdict per probe_seq increment
+        self.probe_status = "unknown"
+        self.probe_seq = 0
+        from ....telemetry import get_registry
+        reg = get_registry()
+        self._m_reconnects = reg.counter(
+            "remote_stream_reconnects_total",
+            "mid-stream reconnects that re-attached a remote token "
+            "stream at its consumed offset")
+        self._m_reconnect_failures = reg.counter(
+            "remote_stream_reconnect_failures_total",
+            "mid-stream reconnect attempts that gave up (budget "
+            "exhausted or resume refused) — the stream failed typed")
+
+    # -- transport ------------------------------------------------------
+    async def _open(self, method: str, target: str, *,
+                    headers: Optional[dict] = None, body: bytes = b"",
+                    timeout: Optional[float] = None):
+        return await _open_request(
+            self.host, self.port, method, target, headers=headers,
+            body=body,
+            timeout=self.probe_timeout_s if timeout is None else timeout,
+            faults=self.faults)
+
+    async def _json(self, method: str, target: str,
+                    body: Optional[dict] = None,
+                    timeout: Optional[float] = None):
+        return await _request_json(
+            self.host, self.port, method, target, body=body,
+            timeout=self.probe_timeout_s if timeout is None else timeout,
+            faults=self.faults)
 
     # -- lifecycle ------------------------------------------------------
     async def start(self) -> "RemoteReplica":
@@ -253,10 +459,11 @@ class RemoteReplica:
 
     async def drain(self) -> None:
         """Graceful: the worker rejects new submits immediately and
-        finishes everything admitted before returning."""
-        code, _ = await _request_json(
-            self.host, self.port, "POST", "/drain",
-            timeout=max(self.probe_timeout_s, 60.0))
+        finishes everything admitted before returning. Idempotent, so
+        a transient transport failure retries under the policy."""
+        code, _ = await self.retry.call(
+            lambda t: self._json("POST", "/drain", timeout=t),
+            call="drain", deadline_s=max(self.probe_timeout_s, 60.0))
         if code != 200:
             raise RuntimeError(
                 f"remote replica {self.name}: drain returned {code}")
@@ -266,9 +473,9 @@ class RemoteReplica:
         process exits. Unreachable workers are treated as already
         stopped (the autoscaler kills what it cannot drain)."""
         try:
-            await _request_json(self.host, self.port, "POST", "/stop",
-                                timeout=self.probe_timeout_s)
-        except (OSError, ConnectionError, asyncio.TimeoutError):
+            await self._json("POST", "/stop",
+                             timeout=self.probe_timeout_s)
+        except _CONN_ERRORS:
             pass
 
     async def kill(self) -> None:
@@ -283,26 +490,45 @@ class RemoteReplica:
     async def refresh(self, force: bool = False) -> None:
         """Re-poll ``GET /healthz`` (rate-limited to
         ``probe_interval_s`` unless forced) — the ONE source for this
-        replica's health/load/heartbeat signals between polls."""
+        replica's health/load/heartbeat signals between polls. The
+        outcome is CLASSIFIED into ``probe_status`` (a refused dial
+        means the process is gone; a timeout or reset means the wire or
+        worker is slow — suspected, not dead) and ``probe_seq`` bumps
+        once per real probe so the router's breaker consumes each
+        verdict exactly once."""
         now = self.clock()
         if not force and self._last_probe >= 0 \
                 and now - self._last_probe < self.probe_interval_s:
             return
         self._last_probe = now
         try:
-            code, obj = await _request_json(
-                self.host, self.port, "GET", "/healthz",
-                timeout=self.probe_timeout_s)
-            self._reachable = code == 200 and isinstance(obj, dict)
-            if self._reachable:
+            code, obj = await self.retry.call(
+                lambda t: self._json("GET", "/healthz", timeout=t),
+                call="healthz", deadline_s=self.probe_timeout_s)
+            ok = code == 200 and isinstance(obj, dict)
+            self._reachable = ok
+            self.probe_status = "ok" if ok else "error"
+            if ok:
                 self._health = obj
                 if obj.get("block_size") is not None:
                     self.block_size = int(obj["block_size"])
                 if obj.get("max_seq_len") is not None:
                     self.max_seq_len = int(obj["max_seq_len"])
-        except (OSError, ConnectionError, asyncio.TimeoutError,
-                ValueError):
+        except ConnectionRefusedError:
             self._reachable = False
+            self.probe_status = "refused"
+        except (asyncio.TimeoutError, TimeoutError):
+            self._reachable = False
+            self.probe_status = "timeout"
+        except (ConnectionResetError, BrokenPipeError):
+            self._reachable = False
+            self.probe_status = "reset"
+        except (OSError, ConnectionError, ValueError,
+                asyncio.IncompleteReadError):
+            self._reachable = False
+            self.probe_status = "error"
+        finally:
+            self.probe_seq += 1
 
     def alive(self) -> bool:
         return self._reachable and bool(self._health.get("loop_alive",
@@ -318,7 +544,8 @@ class RemoteReplica:
     def health(self) -> dict:
         return {**self._health, "name": self.name, "state": self.state,
                 "remote": f"{self.host}:{self.port}",
-                "reachable": self._reachable}
+                "reachable": self._reachable,
+                "probe_status": self.probe_status}
 
     # -- submission -----------------------------------------------------
     async def submit(self, prompt, max_new_tokens: int,
@@ -327,11 +554,11 @@ class RemoteReplica:
                 "max_new_tokens": int(max_new_tokens)}
         body.update({k: v for k, v in kw.items() if v is not None})
         payload = json.dumps(body).encode()
-        code, headers, reader, writer = await _open_request(
-            self.host, self.port, "POST", "/generate",
-            headers={"Content-Type": "application/json",
-                     **_trace_headers()},
-            body=payload, timeout=self.probe_timeout_s)
+        trace_hdrs = _trace_headers()
+        code, headers, reader, writer = await self._open(
+            "POST", "/generate",
+            headers={"Content-Type": "application/json", **trace_hdrs},
+            body=payload)
         if code == 429:
             data = await reader.read()
             writer.close()
@@ -349,7 +576,11 @@ class RemoteReplica:
             raise RequestFailed(
                 f"remote replica {self.name}: /generate returned "
                 f"{code}: {data[:200]!r}")
-        return RemoteStream(reader, writer)
+        uid = headers.get(UID_HEADER)
+        return RemoteStream(
+            reader, writer, replica=self,
+            uid=int(uid) if uid is not None else None,
+            trace_headers=trace_hdrs)
 
     # -- handoff (disaggregated decode side) ----------------------------
     async def resume_handoff(self, payloads: List[bytes], *, chunked:
@@ -361,17 +592,43 @@ class RemoteReplica:
         decode token stream. Chunked payloads go as one frame each —
         the worker applies frame i between its decode steps while
         frame i+1 is still in flight, so the transfer overlaps the
-        remote replica's running batch."""
+        remote replica's running batch.
+
+        The whole transfer is IDEMPOTENT (the worker aborts a partial
+        restore on disconnect and each chunk is retransmit-safe), so a
+        transport failure mid-send retries the complete call under the
+        policy; a typed worker verdict (draining / protocol error)
+        never retries."""
+        return await self.retry.call(
+            lambda t: self._resume_handoff_once(
+                payloads, chunked=chunked, prompt=prompt,
+                generated=generated, max_new_tokens=max_new_tokens,
+                eos_token_id=eos_token_id, temperature=temperature,
+                top_p=top_p, top_k=top_k, rng_state=rng_state,
+                deadline_s=deadline_s),
+            call="handoff", deadline_s=max(self.probe_timeout_s, 30.0))
+
+    async def _resume_handoff_once(self, payloads, *, chunked, prompt,
+                                   generated, max_new_tokens,
+                                   eos_token_id, temperature, top_p,
+                                   top_k, rng_state, deadline_s):
+        trace_hdrs = _trace_headers()
         # the worker answers only after the terminal params frame, so
         # the request head and every frame go out BEFORE any response
         # read (an _open_request-style head-first read would deadlock)
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(self.host, self.port),
-            self.probe_timeout_s)
+        async def dial():
+            if self.faults is not None:
+                await self.faults.connect("/handoff")
+            return await asyncio.open_connection(self.host, self.port)
+
+        reader, writer = await asyncio.wait_for(dial(),
+                                                self.probe_timeout_s)
+        if self.faults is not None:
+            reader, writer = self.faults.wrap(reader, writer, "/handoff")
         lines = ["POST /handoff HTTP/1.1",
                  f"Host: {self.host}:{self.port}",
                  "Connection: close", "Content-Length: 0"]
-        for k, v in _trace_headers().items():
+        for k, v in trace_hdrs.items():
             lines.append(f"{k}: {v}")
         writer.write(("\r\n".join(lines) + "\r\n\r\n").encode())
         transfer_err: Optional[Exception] = None
@@ -402,22 +659,48 @@ class RemoteReplica:
             # verdict is readable is this a transfer failure
             transfer_err = e
         # now the response: status line + headers, then the verdict
-        # NDJSON line, then the token stream
+        # NDJSON line, then the token stream — the whole head exchange
+        # shares ONE absolute deadline (a worker stalling or dripping
+        # lines expires the budget instead of hanging the dispatch),
+        # and the socket never leaks on a failed read
+        resp_deadline = time.monotonic() + max(self.probe_timeout_s,
+                                               30.0)
+
+        def resp_remaining() -> float:
+            return max(resp_deadline - time.monotonic(), 0.001)
+
         try:
-            status_line = await reader.readline()
+            status_line = await asyncio.wait_for(reader.readline(),
+                                                 resp_remaining())
         except (ConnectionResetError, BrokenPipeError, OSError):
             status_line = b""
+        except BaseException:
+            writer.close()
+            raise
         if not status_line:
             writer.close()
+            # transport failure with no verdict: retryable (the worker
+            # aborted the partial restore on our disconnect), so raise
+            # it as the ConnectionError the retry policy understands
             detail = (f"transfer failed: {transfer_err}" if transfer_err
                       else "closed without a response")
-            raise RequestFailed(
+            raise ConnectionError(
                 f"remote replica {self.name}: handoff {detail}")
-        code = int(status_line.decode("latin-1").split(None, 2)[1])
-        while True:
-            hline = await reader.readline()
-            if hline in (b"\r\n", b"\n", b""):
-                break
+        try:
+            code = int(status_line.decode("latin-1").split(None, 2)[1])
+            resp_headers = {}
+            while True:
+                hline = await asyncio.wait_for(reader.readline(),
+                                               resp_remaining())
+                if hline in (b"\r\n", b"\n", b""):
+                    break
+                hname, _, hvalue = hline.decode("latin-1").partition(":")
+                resp_headers[hname.strip().lower()] = hvalue.strip()
+        except BaseException:
+            writer.close()
+            raise
+        if hasattr(reader, "arm"):
+            reader.arm()
         if code != 200:
             data = await reader.read()
             writer.close()
@@ -432,7 +715,12 @@ class RemoteReplica:
                     retry_after_s=obj.get("retry_after_s"))
             raise RequestFailed(
                 f"remote replica {self.name}: /handoff returned {code}")
-        line = await reader.readline()
+        try:
+            line = await asyncio.wait_for(reader.readline(),
+                                          resp_remaining())
+        except BaseException:
+            writer.close()
+            raise
         try:
             verdict = json.loads(line.decode() or "{}")
         except json.JSONDecodeError:
@@ -448,7 +736,11 @@ class RemoteReplica:
             raise RequestFailed(
                 f"remote handoff rejected: "
                 f"{verdict.get('detail', repr(line[:200]))}")
-        return RemoteStream(reader, writer)
+        uid = resp_headers.get(UID_HEADER)
+        return RemoteStream(
+            reader, writer, replica=self,
+            uid=int(uid) if uid is not None else None,
+            trace_headers=trace_hdrs)
 
     # -- fleet observability --------------------------------------------
     def metrics_text(self) -> Optional[str]:
@@ -458,14 +750,18 @@ class RemoteReplica:
 
     async def fetch_metrics(self) -> Optional[str]:
         try:
-            code, _, reader, writer = await _open_request(
-                self.host, self.port, "GET", "/metrics",
-                timeout=self.probe_timeout_s)
-            data = await reader.read()
-            writer.close()
+            async def fetch(t):
+                code, _, reader, writer = await self._open(
+                    "GET", "/metrics", timeout=t)
+                data = await asyncio.wait_for(reader.read(), t)
+                writer.close()
+                return code, data
+
+            code, data = await self.retry.call(
+                fetch, call="metrics", deadline_s=self.probe_timeout_s)
             if code == 200:
                 self._last_metrics = data.decode()
-        except (OSError, ConnectionError, asyncio.TimeoutError):
+        except _CONN_ERRORS:
             pass
         return self._last_metrics
 
@@ -474,10 +770,10 @@ class RemoteReplica:
         ``perf_counter`` clock through the worker's wall-clock anchor —
         what :meth:`~.router.ReplicaRouter.fleet_timeline` stitches."""
         try:
-            code, obj = await _request_json(
-                self.host, self.port, "GET", "/debug/spans",
-                timeout=self.probe_timeout_s)
-        except (OSError, ConnectionError, asyncio.TimeoutError):
+            code, obj = await self.retry.call(
+                lambda t: self._json("GET", "/debug/spans", timeout=t),
+                call="spans", deadline_s=self.probe_timeout_s)
+        except _CONN_ERRORS:
             return []
         if code != 200 or not isinstance(obj, dict):
             return []

@@ -23,11 +23,15 @@ serving study treats as baseline — PAPERS.md arXiv:2605.25645):
   * **Lifecycle** — ``drain_replica()`` finishes a replica's in-flight
     streams while new traffic diverts to survivors;
     ``check_replicas()`` (run at submit time and by the background
-    monitor) declares a replica DEAD when its stall-watchdog heartbeat
-    expires or its loop thread dies, reclaims its queued
-    (not-yet-prefilled) requests and re-enqueues them on survivors —
-    a request that already streamed tokens fails explicitly instead
-    (its KV lives only on the dead replica).
+    monitor) classifies every replica through a per-replica circuit
+    breaker (serve/resilience.py): probe timeouts/resets make it
+    SUSPECTED — out of rotation, mid-stream requests keep streaming —
+    while a refused dial (process exit), an exhausted breaker, a dead
+    loop thread or an expired stall-watchdog heartbeat make it DEAD:
+    its queued (not-yet-prefilled) requests re-enqueue on survivors
+    and a request that already streamed tokens fails explicitly (its
+    KV lives only on the dead replica). One slow ``/healthz`` probe is
+    never a death verdict.
   * **Disaggregation** (``RouterConfig.disaggregated``) — dedicated
     prefill replicas run whole-prompt prefill and hand the paged KV
     blocks off to a decode replica (serve/handoff.py); token streams
@@ -58,6 +62,12 @@ from ..ragged.ragged_manager import prefix_digest
 from .admission import OverloadedError
 from .frontend import DeadlineExceeded, RequestFailed
 from .replica import PrefillReplica, Replica
+from .resilience import BreakerConfig, CircuitBreaker
+
+# transport-level dispatch failures the router re-routes (typed server
+# verdicts — OverloadedError, RequestFailed — are handled separately)
+_DISPATCH_CONN_ERRORS = (OSError, ConnectionError, asyncio.TimeoutError,
+                         asyncio.IncompleteReadError, TimeoutError)
 
 _ROUTER_LANE = "router"
 
@@ -115,6 +125,12 @@ class RouterConfig:
     handoff_chunk_blocks: int = 4
     # consistent-hash ring points per replica
     ring_points: int = 32
+    # per-replica circuit breaker (serve/resilience.py): probe failures
+    # OPEN it (the replica is SUSPECTED — routed around, mid-stream
+    # requests keep streaming), half-open probes retest it, exhaustion
+    # (max_open_cycles failed retests) or a refused dial (process exit)
+    # is the DEAD verdict that triggers failover + re-enqueue
+    breaker: BreakerConfig = field(default_factory=BreakerConfig)
     # fleet-level diagnostics (telemetry/anomaly.py): the router runs an
     # SLO burn monitor over the AGGREGATED replica histograms
     # (fleet_slo_burn_rate gauges / fleet_slo_burn verdicts) and — when
@@ -284,6 +300,12 @@ class ReplicaRouter:
                                config.ring_points)
         self._affinity: "OrderedDict[bytes, str]" = OrderedDict()
         self._backoff_until: Dict[str, float] = {}
+        # resilience state (remote replicas): per-replica breaker, the
+        # suspected set (out of rotation, streams kept), and the last
+        # probe_seq consumed so each probe feeds the breaker ONCE
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._suspected: Dict[str, str] = {}     # name -> reason
+        self._probe_seen: Dict[str, int] = {}
         self._rr = itertools.count()          # round-robin cursors
         self._rr_prefill = itertools.count()
         self._uids = itertools.count(1)
@@ -377,6 +399,25 @@ class ReplicaRouter:
             "router_fleet_postmortems_total",
             "fleet post-mortem bundles written in answer to a replica "
             "anomaly verdict")
+        # resilience signals: suspected (out of rotation, streams kept)
+        # is DISTINCT from dead (failover) — the breaker's whole point
+        self._m_suspected = reg.gauge(
+            "router_replica_suspected",
+            "1 while the replica is suspected (probe timeouts / open "
+            "breaker): routed around but NOT failed over",
+            labelnames=("replica",))
+        self._m_suspects = reg.counter(
+            "router_suspects_total",
+            "replicas taken out of rotation as suspected (probe "
+            "timeout / reset / breaker open)")
+        self._m_breaker_state = reg.gauge(
+            "router_breaker_state",
+            "per-replica circuit-breaker state (0 closed, 0.5 "
+            "half-open, 1 open)", labelnames=("replica",))
+        self._m_breaker_opens = reg.counter(
+            "router_breaker_open_total",
+            "circuit-breaker open transitions (a replica entered "
+            "suspicion)")
         self._m_replicas.set(len(self.replicas))
         for r in self.replicas:
             self._m_state.labels(replica=r.name).set(1)
@@ -470,6 +511,11 @@ class ReplicaRouter:
             del self._affinity[digest]
         self._backoff_until.pop(name, None)
         self._hb_series.pop(name, None)
+        self._breakers.pop(name, None)
+        self._probe_seen.pop(name, None)
+        if name in self._suspected:
+            del self._suspected[name]
+            self._m_suspected.labels(replica=name).set(0)
         self._m_replicas.set(len(self.replicas))
         trace.record("router_membership", time.perf_counter(), 0.0,
                      lane=_ROUTER_LANE, action="remove", replica=name)
@@ -568,6 +614,7 @@ class ReplicaRouter:
         now = self.clock()
         return [r for r in self.replicas
                 if r.state == "up"
+                and r.name not in self._suspected
                 and self._backoff_until.get(r.name, 0.0) <= now]
 
     def _record_affinity(self, digests: List[bytes], name: str) -> None:
@@ -679,6 +726,7 @@ class ReplicaRouter:
         t0 = time.perf_counter()
         name, digests = self._pick_for(rec)
         last_err: Optional[OverloadedError] = None
+        conn_err: Optional[Exception] = None
         for replica in self._candidates(name):
             try:
                 # bind the request's trace context around the replica
@@ -702,6 +750,19 @@ class ReplicaRouter:
                              backoff_s=round(backoff, 3),
                              **rec.trace_attr())
                 continue
+            except _DISPATCH_CONN_ERRORS as e:
+                # transport failure before any token: the prompt is
+                # idempotent at zero tokens, so route around — feed the
+                # breaker, suspect the replica, try the next candidate
+                conn_err = e
+                self._note_dispatch_failure(replica)
+                self._m_reroutes.labels(reason="connect_error").inc()
+                trace.record("router_reroute", time.perf_counter(), 0.0,
+                             lane=_ROUTER_LANE, uid=rec.uid,
+                             replica=replica.name,
+                             reason="connect_error",
+                             **rec.trace_attr())
+                continue
             self._attach(rec, replica.name, inner, digests)
             trace.record("router_dispatch", t0,
                          time.perf_counter() - t0, lane=_ROUTER_LANE,
@@ -712,7 +773,14 @@ class ReplicaRouter:
         trace.record("router_shed", t0, time.perf_counter() - t0,
                      lane=_ROUTER_LANE, uid=rec.uid,
                      reason=last_err.reason if last_err else
-                     "no_replicas", **rec.trace_attr())
+                     ("connect_error" if conn_err else "no_replicas"),
+                     **rec.trace_attr())
+        if last_err is None and conn_err is not None:
+            # every candidate failed at the transport level: a typed
+            # dispatch failure, not an overload signal
+            raise RequestFailed(
+                f"dispatch failed: no replica reachable "
+                f"({type(conn_err).__name__}: {conn_err})")
         raise OverloadedError(
             last_err.reason if last_err else "no_replicas",
             f"all routable replicas overloaded: "
@@ -787,6 +855,19 @@ class ReplicaRouter:
                     e.retry_after_s if e.retry_after_s is not None
                     else self.config.default_backoff_s)
                 self._m_reroutes.labels(reason=e.reason).inc()
+                continue
+            except _DISPATCH_CONN_ERRORS as e:
+                # the chunked protocol is idempotent-retransmit (and
+                # the worker aborts partial restores on disconnect), so
+                # after the replica's own retries failed the handoff is
+                # safe to offer to the next candidate
+                self._note_dispatch_failure(replica)
+                self._m_reroutes.labels(reason="connect_error").inc()
+                trace.record("router_reroute", time.perf_counter(), 0.0,
+                             lane=_ROUTER_LANE, uid=rec.uid,
+                             replica=replica.name,
+                             reason="connect_error",
+                             **rec.trace_attr())
                 continue
             rec.handed_off = True
             self._m_handoffs.inc()
@@ -879,28 +960,132 @@ class ReplicaRouter:
         series.set(age if age is not None else 0.0)
         return age
 
-    def _is_dead(self, replica: Replica) -> bool:
-        if not replica.started or replica.state != "up":
-            return False
-        if not replica.alive():
-            return True
-        age = self.replica_heartbeat_age(replica)
-        return (age is not None
-                and age > self.config.heartbeat_timeout_s)
+    def _breaker(self, name: str) -> CircuitBreaker:
+        br = self._breakers.get(name)
+        if br is None:
+            br = CircuitBreaker(self.config.breaker, clock=self.clock)
+            self._breakers[name] = br
+        return br
+
+    @staticmethod
+    def _is_remote(replica) -> bool:
+        # the probe-classification surface is the remote marker
+        return hasattr(replica, "probe_seq")
+
+    def _suspect(self, name: str, reason: str) -> None:
+        if name not in self._suspected:
+            self._suspected[name] = reason
+            self._m_suspected.labels(replica=name).set(1)
+            self._m_suspects.inc()
+            trace.record("router_suspect", time.perf_counter(), 0.0,
+                         lane=_ROUTER_LANE, replica=name, action="suspect",
+                         reason=reason)
+        else:
+            self._suspected[name] = reason
+
+    def _unsuspect(self, name: str) -> None:
+        if name in self._suspected:
+            del self._suspected[name]
+            self._m_suspected.labels(replica=name).set(0)
+            trace.record("router_suspect", time.perf_counter(), 0.0,
+                         lane=_ROUTER_LANE, replica=name, action="clear")
+
+    def _note_dispatch_failure(self, replica) -> None:
+        """A submit/handoff attempt failed at the transport level:
+        feed the breaker (one verdict) and suspect the replica so the
+        very next candidate scan routes around it."""
+        if not self._is_remote(replica):
+            return
+        br = self._breaker(replica.name)
+        was = br.state
+        br.record_failure()
+        if br.state == "open" and was != "open":
+            self._m_breaker_opens.inc()
+        self._sync_breaker_gauge(replica.name)
+        self._suspect(replica.name, "connect_error")
+
+    def _sync_breaker_gauge(self, name: str) -> None:
+        state = self._breaker(name).state
+        self._m_breaker_state.labels(replica=name).set(
+            {"closed": 0.0, "half_open": 0.5, "open": 1.0}[state])
+
+    def _verdict(self, replica) -> tuple:
+        """Classify one up replica: ``('ok'|'suspected'|'dead',
+        reason)``. In-process replicas keep the direct local signals
+        (loop exit / heartbeat expiry are reliable, not a network
+        blip); remote replicas go through the probe classification +
+        circuit breaker so one slow probe suspends routing instead of
+        amplifying into a failover."""
+        if not self._is_remote(replica):
+            if not replica.alive():
+                return "dead", "loop_exit"
+            age = self.replica_heartbeat_age(replica)
+            if age is not None and age > self.config.heartbeat_timeout_s:
+                return "dead", "heartbeat_expired"
+            return "ok", None
+        br = self._breaker(replica.name)
+        seq = replica.probe_seq
+        fresh = seq != self._probe_seen.get(replica.name)
+        self._probe_seen[replica.name] = seq
+        status = replica.probe_status
+        if not fresh and br.state != "closed":
+            # no new probe, breaker not closed (opened by dispatch
+            # failures or held open between half-open windows): a STALE
+            # 'ok' must not re-admit the replica — only a fresh
+            # successful probe closes the breaker
+            return "suspected", f"breaker_{br.state}"
+        if status == "ok":
+            if fresh:
+                br.record_success()
+                self._sync_breaker_gauge(replica.name)
+            if not replica.alive():
+                # the worker answered but reports its loop dead
+                return "dead", "worker_loop_exit"
+            age = self.replica_heartbeat_age(replica)
+            if age is not None and age > self.config.heartbeat_timeout_s:
+                return "dead", "heartbeat_expired"
+            return "ok", None
+        if status == "refused":
+            # connection refused = nothing listening = process exit
+            return "dead", "connection_refused"
+        if fresh:
+            was = br.state
+            br.record_failure()
+            if br.state == "open" and was != "open":
+                self._m_breaker_opens.inc()
+            self._sync_breaker_gauge(replica.name)
+        if br.exhausted:
+            return "dead", f"breaker_exhausted({status})"
+        return "suspected", status
 
     async def check_replicas(self) -> List[str]:
-        """Declare replicas dead (heartbeat expiry / loop exit) and
-        fail over: queued requests with no tokens yet re-dispatch onto
-        survivors; requests that already streamed tokens end with an
-        explicit error (their KV exists only on the dead replica).
+        """Probe the fleet and classify each up replica: OK (in
+        rotation), SUSPECTED (probe timeouts / open breaker — routed
+        around, mid-stream requests KEEP streaming) or DEAD (process
+        exit / exhausted breaker / local loop death), then fail the
+        dead ones over: queued requests with no tokens yet re-dispatch
+        onto survivors; requests that already streamed tokens end with
+        an explicit error (their KV exists only on the dead replica).
         Returns the names declared dead this call."""
-        # remote replicas: re-poll /healthz (rate-limited client-side)
-        # so alive()/heartbeat_age() read fresh state
+        # remote replicas: re-poll /healthz (rate-limited client-side);
+        # an OPEN breaker holds its probes back until its half-open
+        # window, so a struggling worker is not hammered
+        up = [r for r in self.replicas if r.started and r.state == "up"]
         await asyncio.gather(
-            *(r.refresh() for r in self.replicas
-              if r.started and r.state == "up"),
+            *(r.refresh() for r in up
+              if not self._is_remote(r)
+              or self._breaker(r.name).allow_probe()),
             return_exceptions=True)
-        died = [r for r in self.replicas if self._is_dead(r)]
+        died = []
+        for r in up:
+            verdict, why = self._verdict(r)
+            if verdict == "dead":
+                died.append(r)
+                self._unsuspect(r.name)
+            elif verdict == "suspected":
+                self._suspect(r.name, why)
+            else:
+                self._unsuspect(r.name)
         for replica in died:
             t0 = time.perf_counter()
             requeued = failed = 0
@@ -927,7 +1112,7 @@ class ReplicaRouter:
                     requeued += 1
                     try:
                         await self._dispatch(rec)
-                    except OverloadedError as e:
+                    except (OverloadedError, RequestFailed) as e:
                         self._finish(rec, "error",
                                      f"re-enqueue after replica death "
                                      f"shed: {e}")
@@ -975,6 +1160,9 @@ class ReplicaRouter:
                 "backoff_remaining_s": max(
                     0.0, round(self._backoff_until.get(r.name, 0.0)
                                - self.clock(), 3)),
+                "suspected": self._suspected.get(r.name),
+                "breaker": (self._breaker(r.name).snapshot()
+                            if self._is_remote(r) else None),
             }
         for p in self.prefill_replicas:
             out[p.name] = p.health()
@@ -987,6 +1175,7 @@ class ReplicaRouter:
             "affinity_entries": len(self._affinity),
             "inflight_routed": len(self._requests),
             "replica_states": {r.name: r.state for r in self.replicas},
+            "suspected": dict(self._suspected),
             "last_fleet_bundle": self._last_fleet_bundle,
         }
 

@@ -26,12 +26,26 @@ serving plane needs (docs/SERVING.md § Remote replicas & autoscaling):
   * ``GET /debug/spans`` — the raw span ring plus a
     ``perf_counter``/wall-clock anchor, so a router in another process
     can rebase and stitch this replica's lane into the fleet timeline.
+  * ``GET /resume?uid=N&offset=K`` — MID-STREAM RECONNECT (ISSUE 14):
+    every streamed request keeps a bounded per-uid token log; a client
+    whose connection dropped re-attaches here and the worker replays
+    the log from ``offset`` (dedup by position — the stream stays
+    bit-identical) then keeps streaming live. A bare connection loss
+    does NOT cancel the request: the worker holds it resumable for
+    ``resume_linger_s`` (the KV is still intact — dropping it would
+    amplify a network blip into request loss); only an EXPLICIT client
+    cancel (one cancel byte before close, serve/remote.py) or linger
+    expiry frees the KV. A request cancelled by linger expiry answers
+    later resumes with a typed error, never a silently-truncated
+    "completed" stream.
 
 On start the worker prints ONE ready line — ``DS_TPU_WORKER_READY
 {"name", "host", "port", "pid", "block_size"}`` — to stdout (scan for
 the prefix: engine-build logging precedes it), which spawners (an
 autoscaler subprocess factory, the slow spawn smoke test) parse to
-address it.
+address it; :func:`spawn_worker` wraps the whole handshake — spawn,
+wait for the ready line under an explicit timeout, and surface the
+captured stderr when the worker dies before it.
 """
 
 import argparse
@@ -40,10 +54,11 @@ import json
 import os
 import sys
 import time
-from typing import Optional, Tuple
+from collections import OrderedDict
+from typing import List, Optional, Tuple
 
 from ....telemetry import context as trace_context
-from .api import ServingAPI, _json_response, _response_head
+from .api import UID_HEADER, ServingAPI, _json_response, _response_head
 from .frontend import ServingConfig
 from .remote import (FRAME_BLOCKING, FRAME_CHUNK, FRAME_PARAMS,
                      read_frame)
@@ -82,17 +97,60 @@ def build_engine(spec: dict):
             **spec.get("engine", {})), params=params)
 
 
-class WorkerAPI(ServingAPI):
-    """ServingAPI over one local Replica, plus the worker lifecycle and
-    handoff-ingest endpoints."""
+class _StreamRecord:
+    """One resumable request: the live TokenStream, its bounded token
+    log (``base`` = offset of ``tokens[0]`` once the front is trimmed),
+    and the attachment/linger state. All state lives on the worker's
+    one event loop — no locking."""
 
-    def __init__(self, replica, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, uid: int, stream, ctx, log_limit: int):
+        self.uid = uid
+        self.stream = stream
+        self.ctx = ctx
+        self.log_limit = log_limit
+        self.tokens: List[int] = []
+        self.base = 0
+        self.status: Optional[str] = None
+        self.detail: Optional[str] = None
+        self.done = False
+        self.event = asyncio.Event()
+        self.attached = 0
+        self.linger = None           # pending call_later handle
+        self.linger_expired = False
+        self.client_cancelled = False
+        self.task: Optional[asyncio.Task] = None
+
+    @property
+    def end(self) -> int:
+        return self.base + len(self.tokens)
+
+
+class WorkerAPI(ServingAPI):
+    """ServingAPI over one local Replica, plus the worker lifecycle,
+    handoff-ingest and mid-stream-resume endpoints (module
+    docstring)."""
+
+    def __init__(self, replica, host: str = "127.0.0.1", port: int = 0,
+                 *, resume_linger_s: float = 2.0,
+                 token_log_limit: int = 4096, resume_records: int = 256):
         super().__init__(replica, host=host, port=port)
         self.replica = replica
         self.stopped = asyncio.Event()
+        self.resume_linger_s = resume_linger_s
+        self.token_log_limit = token_log_limit
+        self.resume_records = resume_records
+        self._records: "OrderedDict[int, _StreamRecord]" = OrderedDict()
+        from ....telemetry import get_registry
+        self._m_resume = get_registry().counter(
+            "worker_resume_requests_total",
+            "GET /resume reconnect attempts answered by this worker",
+            labelnames=("outcome",))
 
     async def _route_extra(self, method: str, target: str, query: str,
                            headers, body, reader, writer) -> bool:
+        if method == "GET" and target == "/resume":
+            await self._resume_route(query, reader, writer)
+            return True
         if method == "POST" and target == "/drain":
             await self.replica.drain()
             _json_response(writer, "200 OK", {"status": "drained",
@@ -123,6 +181,189 @@ class WorkerAPI(ServingAPI):
             await self.replica.stop()
         finally:
             self.stopped.set()
+
+    # -- resumable streaming (mid-stream reconnect) ---------------------
+    async def _stream_tokens(self, reader, writer, stream, ctx) -> None:
+        """Worker override of the streaming pump: tokens flow through a
+        bounded per-uid log so a dropped connection can re-attach at
+        its offset (``GET /resume``) instead of killing the request."""
+        rec = self._track(stream, ctx)
+        await self._serve_record(reader, writer, rec, offset=0)
+
+    def _track(self, stream, ctx) -> _StreamRecord:
+        rec = _StreamRecord(stream.uid, stream, ctx,
+                            self.token_log_limit)
+        self._records[stream.uid] = rec
+        rec.task = asyncio.ensure_future(self._pump_record(rec))
+        # bounded registry: evict finished, detached records oldest
+        # first (live or attached ones are never evicted)
+        while len(self._records) > self.resume_records:
+            for uid, r in list(self._records.items()):
+                if r.done and r.attached == 0:
+                    del self._records[uid]
+                    break
+            else:
+                break
+        return rec
+
+    async def _pump_record(self, rec: _StreamRecord) -> None:
+        from .frontend import DeadlineExceeded, RequestFailed
+        try:
+            async for tok in rec.stream:
+                rec.tokens.append(int(tok))
+                if len(rec.tokens) > rec.log_limit:
+                    drop = len(rec.tokens) - rec.log_limit
+                    del rec.tokens[:drop]
+                    rec.base += drop
+                rec.event.set()
+            status = rec.stream.status
+            detail = getattr(rec.stream, "reason", None)
+        except DeadlineExceeded:
+            status, detail = "expired", "deadline exceeded"
+        except RequestFailed as e:
+            status, detail = "error", str(e)
+        except Exception as e:       # never strand a waiting client
+            status, detail = "error", f"{type(e).__name__}: {e}"
+        if status == "cancelled" and not rec.client_cancelled:
+            # the CLIENT did not ask for this: linger expiry or a
+            # server-side hard stop truncated the request — surface it
+            # TYPED, never as a silently-truncated end-of-stream
+            status = "error"
+            detail = (f"resume window expired ({self.resume_linger_s}s "
+                      f"with no client attached); request cancelled"
+                      if rec.linger_expired else
+                      "request cancelled by the server (hard stop)")
+        rec.status, rec.detail = status, detail
+        rec.done = True
+        rec.event.set()
+
+    async def _serve_record(self, reader, writer, rec: _StreamRecord,
+                            offset: int) -> None:
+        """Pump one connection from the record: replay the log from
+        ``offset``, then follow live until the request ends (tail
+        summary) or the client detaches (hangup -> linger window)."""
+        rec.attached += 1
+        if rec.linger is not None:
+            rec.linger.cancel()
+            rec.linger = None
+        hangup = asyncio.ensure_future(reader.read(1))
+        pos = offset
+        detached = False
+        try:
+            while True:
+                if pos < rec.base:
+                    # the bounded log trimmed past this connection's
+                    # position (a slow client fell behind generation):
+                    # fail TYPED — serving rec.tokens[negative] would
+                    # be silent stream corruption
+                    writer.write(json.dumps(
+                        {"done": True, "status": "error",
+                         "uid": rec.uid,
+                         "detail": f"client fell behind the bounded "
+                                   f"token log (position {pos} < "
+                                   f"retained base {rec.base})"}
+                        ).encode() + b"\n")
+                    await writer.drain()
+                    return
+                while pos < rec.end:
+                    writer.write(json.dumps(
+                        {"token": rec.tokens[pos - rec.base]}).encode()
+                        + b"\n")
+                    pos += 1
+                await writer.drain()
+                if rec.done:
+                    break
+                if hangup.done():
+                    break
+                rec.event.clear()
+                if pos < rec.end or rec.done:
+                    continue     # raced a new token past the clear
+                waiter = asyncio.ensure_future(rec.event.wait())
+                done, _ = await asyncio.wait(
+                    {waiter, hangup},
+                    return_when=asyncio.FIRST_COMPLETED)
+                if hangup in done and waiter not in done:
+                    waiter.cancel()
+                    break
+            if hangup.done() and not rec.done:
+                data = (hangup.result()
+                        if not hangup.cancelled() else b"")
+                if data:
+                    # explicit client cancel (serve/remote.py writes a
+                    # cancel byte): free the KV NOW, no linger
+                    rec.client_cancelled = True
+                    await rec.stream.cancel()
+                else:
+                    detached = True   # bare loss: hold resumable
+                return
+            tail = {"done": True, "status": rec.status, "uid": rec.uid,
+                    "n": rec.end, "tokens": list(rec.tokens),
+                    "trace_id": (rec.ctx.trace_id
+                                 if rec.ctx is not None else None)}
+            if rec.base:
+                tail["token_base"] = rec.base
+            if rec.detail:
+                tail["detail"] = rec.detail
+            writer.write(json.dumps(tail).encode() + b"\n")
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError, OSError):
+            detached = True
+        finally:
+            hangup.cancel()
+            rec.attached -= 1
+            if detached and not rec.done and rec.attached == 0:
+                self._arm_linger(rec)
+
+    def _arm_linger(self, rec: _StreamRecord) -> None:
+        loop = asyncio.get_event_loop()
+        rec.linger = loop.call_later(
+            self.resume_linger_s,
+            lambda: asyncio.ensure_future(self._linger_expire(rec)))
+
+    async def _linger_expire(self, rec: _StreamRecord) -> None:
+        rec.linger = None
+        if rec.done or rec.attached > 0:
+            return
+        rec.linger_expired = True
+        await rec.stream.cancel()
+
+    async def _resume_route(self, query: str, reader, writer) -> None:
+        from urllib.parse import parse_qs
+        q = parse_qs(query)
+        try:
+            uid = int(q["uid"][0])
+            offset = int(q.get("offset", ["0"])[0])
+        except (KeyError, ValueError, IndexError):
+            self._m_resume.labels(outcome="bad_request").inc()
+            _json_response(writer, "400 Bad Request",
+                           {"error": "bad_request",
+                            "detail": "resume needs integer uid= and "
+                                      "offset= parameters"})
+            return
+        rec = self._records.get(uid)
+        if rec is None:
+            self._m_resume.labels(outcome="unknown_uid").inc()
+            _json_response(writer, "410 Gone",
+                           {"error": "unknown_uid",
+                            "detail": f"no resumable stream for uid "
+                                      f"{uid} (finished long ago, "
+                                      f"evicted, or never existed)"})
+            return
+        if offset < rec.base or offset > rec.end:
+            self._m_resume.labels(outcome="bad_offset").inc()
+            _json_response(writer, "416 Range Not Satisfiable",
+                           {"error": "bad_offset",
+                            "detail": f"offset {offset} outside the "
+                                      f"retained log "
+                                      f"[{rec.base}, {rec.end}]"})
+            return
+        self._m_resume.labels(outcome="ok").inc()
+        extra = {UID_HEADER: str(uid)}
+        if rec.ctx is not None:
+            extra["traceparent"] = rec.ctx.to_traceparent()
+        writer.write(_response_head("200 OK", "application/x-ndjson",
+                                    extra))
+        await self._serve_record(reader, writer, rec, offset)
 
     async def _handoff(self, reader, writer, headers) -> None:
         """Chunked KV ingest (module docstring): apply frames as they
@@ -213,9 +454,11 @@ class WorkerAPI(ServingAPI):
                 await handle.abort()
             await fail("error", f"{type(e).__name__}: {e}")
             return
+        head = {"traceparent": ctx.to_traceparent()}
+        if getattr(stream, "uid", None) is not None:
+            head[UID_HEADER] = str(stream.uid)
         writer.write(_response_head(
-            "200 OK", "application/x-ndjson",
-            {"traceparent": ctx.to_traceparent()}))
+            "200 OK", "application/x-ndjson", head))
         writer.write(json.dumps({"ok": True}).encode() + b"\n")
         await self._stream_tokens(reader, writer, stream, ctx)
 
@@ -233,10 +476,11 @@ class ReplicaWorker:
 
     def __init__(self, engine, serving_config: Optional[ServingConfig]
                  = None, name: str = "worker0",
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0, **api_kw):
         from .replica import Replica
         self.replica = Replica(name, engine, serving_config)
-        self.api = WorkerAPI(self.replica, host=host, port=port)
+        self.api = WorkerAPI(self.replica, host=host, port=port,
+                             **api_kw)
 
     async def start(self) -> Tuple[str, int]:
         await self.replica.start()
@@ -267,6 +511,82 @@ def _serving_config(spec: dict) -> ServingConfig:
 READY_PREFIX = "DS_TPU_WORKER_READY "
 
 
+class WorkerSpawnError(RuntimeError):
+    """A spawned worker process never completed the ready handshake —
+    it died first (the message carries its exit code and stderr tail)
+    or the timeout expired."""
+
+
+def spawn_worker(extra_args: Optional[List[str]] = None, *,
+                 timeout_s: float = 60.0, env: Optional[dict] = None,
+                 cmd: Optional[List[str]] = None):
+    """Spawn a worker subprocess and wait for its ``DS_TPU_WORKER_READY``
+    line under an explicit deadline.
+
+    Returns ``(proc, info)`` — the live ``subprocess.Popen`` (stdout
+    still open for the caller) and the parsed ready-line dict. Raises
+    :class:`WorkerSpawnError` when the process exits before the
+    handshake (the captured stderr tail rides the message, so "no chip
+    / bad spec / import error" is diagnosable from the exception) or
+    when the deadline passes (the stuck process is killed first).
+
+    ``cmd`` overrides the full command line (tests); the default is
+    ``python -m deepspeed_tpu.inference.v2.serve.worker`` plus
+    ``extra_args``."""
+    import collections
+    import subprocess
+    import threading
+
+    if cmd is None:
+        cmd = [sys.executable, "-m",
+               "deepspeed_tpu.inference.v2.serve.worker"]
+        cmd += list(extra_args or [])
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, env=env, text=True)
+    box = {}
+    # stderr must be DRAINED for the worker's whole life (jax/absl
+    # engine-build logging goes there; an unread PIPE would block the
+    # worker once the buffer fills — before OR after the handshake).
+    # A bounded tail is kept for spawn-failure diagnostics.
+    stderr_tail: "collections.deque" = collections.deque(maxlen=400)
+
+    def drain_stderr():
+        for line in proc.stderr:
+            stderr_tail.append(line)
+
+    drainer = threading.Thread(target=drain_stderr, daemon=True)
+    drainer.start()
+    proc.stderr_tail = stderr_tail   # callers can inspect it later
+
+    def scan():
+        for line in proc.stdout:      # logging precedes the ready line
+            if line.startswith(READY_PREFIX):
+                box["info"] = json.loads(line[len(READY_PREFIX):])
+                return
+
+    t = threading.Thread(target=scan, daemon=True)
+    t.start()
+    t.join(timeout_s)
+
+    def tail() -> str:
+        drainer.join(2.0)     # let the drainer flush the final lines
+        return "".join(stderr_tail)[-2000:]
+
+    if "info" in box:
+        return proc, box["info"]
+    if proc.poll() is None:          # still running, never handshook
+        proc.kill()
+        proc.wait(timeout=10)
+        raise WorkerSpawnError(
+            f"worker spawn timed out after {timeout_s}s without a "
+            f"{READY_PREFIX.strip()} line (killed); stderr tail:\n"
+            f"{tail()}")
+    proc.wait(timeout=10)
+    raise WorkerSpawnError(
+        f"worker exited with code {proc.returncode} before the "
+        f"{READY_PREFIX.strip()} handshake; stderr tail:\n{tail()}")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="deepspeed_tpu serving replica worker")
@@ -284,6 +604,14 @@ def main(argv=None) -> int:
     p.add_argument("--compile-cache", default=None,
                    help="persistent XLA compilation cache dir "
                         "(default: $DS_TPU_COMPILE_CACHE if set)")
+    p.add_argument("--resume-linger-s", type=float, default=2.0,
+                   help="seconds a request stays resumable (KV held) "
+                        "after a bare client connection loss before it "
+                        "is cancelled")
+    p.add_argument("--token-log-limit", type=int, default=4096,
+                   help="per-request resume token-log bound (oldest "
+                        "tokens trim first; a resume below the trim "
+                        "point is refused typed)")
     args = p.parse_args(argv)
     import jax
     if args.jax_platform:
@@ -304,7 +632,9 @@ def main(argv=None) -> int:
     async def run() -> None:
         worker = ReplicaWorker(build_engine(spec),
                                _serving_config(spec), name=args.name,
-                               host=args.host, port=args.port)
+                               host=args.host, port=args.port,
+                               resume_linger_s=args.resume_linger_s,
+                               token_log_limit=args.token_log_limit)
         host, port = await worker.start()
         print(READY_PREFIX + json.dumps(
             {"name": args.name, "host": host, "port": port,

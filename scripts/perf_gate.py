@@ -91,6 +91,17 @@ Gated metrics (see ``collect()``):
     absolute tolerance — it guards against order-of-magnitude
     regressions like snapshotting state per event, not scheduler
     jitter).
+  * ``reconnect_steady_recompiles`` /
+    ``breaker_false_positive_failovers`` / ``retry_amplification`` —
+    the chaos-hardened serving plane (serve/faults.py +
+    serve/resilience.py, ISSUE 14): a steady wave where every request
+    loses its connection mid-stream and re-attaches through the
+    worker's ``/resume`` must stay at ZERO recompiles (reconnect is
+    host-side replay, never a program), a timeout-only fault schedule
+    must cause ZERO failovers (the breaker suspects slow replicas, it
+    never false-positively kills them), and the retry layer under a
+    one-reset-per-probe schedule must hold ~2 attempts/probe (a retry
+    storm fails the gate).
   * ``trace_ns_per_span`` / ``routed_trace_steady_recompiles`` —
     distributed-tracing overhead (telemetry/context.py,
     telemetry/trace.py): the per-span record cost with a trace-id attr
@@ -666,6 +677,96 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
 
         metrics.update(_remote_gate())
 
+        # -- chaos-hardened serving plane (ISSUE 14): mid-stream
+        # reconnects must be host-side only (zero steady-state
+        # recompiles: the /resume replay never touches a compiled
+        # program), a TIMEOUT-ONLY fault schedule must cause zero
+        # failovers (the breaker suspects, never false-positively
+        # kills), and the retry layer's amplification must stay bounded
+        # by its schedule (one injected reset per probe => ~2
+        # attempts/probe, never max_attempts blowup)
+        def _chaos_gate():
+            import asyncio
+
+            from deepspeed_tpu.inference.v2.serve import (
+                FaultPlane, FaultSpec, RemoteReplica, ReplicaRouter,
+                ReplicaWorker, RouterConfig, ServingConfig)
+
+            async def run():
+                out = {}
+                plane = FaultPlane()
+                worker = ReplicaWorker(
+                    _router_engines(1)[0],
+                    ServingConfig(token_budget=24, chunk=16),
+                    name="gate-chaos0")
+                host, port = await worker.start()
+                replica = RemoteReplica("gate-chaos0", host, port,
+                                        faults=plane,
+                                        probe_interval_s=0.0,
+                                        reconnect_backoff_s=0.01)
+                router = ReplicaRouter(
+                    [replica], RouterConfig(monitor_interval_s=0.0))
+                await router.start()
+
+                async def wave():
+                    for p in shared_prompts:
+                        stream = await router.submit(p, 2)
+                        await stream.drain()
+
+                await wave()
+                await wave()     # double warm (bucket respecialization)
+                # reconnect wave: every request loses its connection
+                # after one token and re-attaches through /resume
+                plane.script(FaultSpec(kind="reset", op="read",
+                                       target="/generate", skip=1,
+                                       every=2, times=None))
+                st0 = fam_total("xla_steady_state_recompiles_total")
+                watchdog.mark_steady(True)
+                try:
+                    await wave()
+                finally:
+                    watchdog.mark_steady(False)
+                out["reconnect_steady_recompiles"] = \
+                    fam_total("xla_steady_state_recompiles_total") - st0
+                plane.clear()
+
+                # timeout-only faults: probes stall past the budget —
+                # the replica is SUSPECTED (routed around), and the
+                # dead-replica counter must not move
+                dead0 = fam_total("router_dead_replicas_total")
+                replica.probe_timeout_s = 0.1
+                plane.script(FaultSpec(kind="latency", op="connect",
+                                       target="/healthz", delay_s=0.3,
+                                       times=None))
+                for _ in range(4):
+                    await router.check_replicas()
+                    await asyncio.sleep(0.02)
+                out["breaker_false_positive_failovers"] = \
+                    fam_total("router_dead_replicas_total") - dead0
+                plane.clear()
+                replica.probe_timeout_s = 5.0
+
+                # retry amplification: one injected reset per probe
+                # (every other dial) forces exactly one retry each
+                att0 = fam_total("remote_call_attempts_total")
+                plane.script(FaultSpec(kind="reset", op="connect",
+                                       target="/healthz", skip=0,
+                                       every=2, times=None))
+                n_probes = 8
+                for _ in range(n_probes):
+                    await replica.refresh(force=True)
+                out["retry_amplification"] = (
+                    fam_total("remote_call_attempts_total") - att0
+                ) / n_probes
+                plane.clear()
+                await router.stop()
+                await worker.stop()
+                return out
+
+            return asyncio.run(run())
+
+        metrics.update(_chaos_gate())
+
         # -- flight-recorder record() cost ---------------------------------
         bench_rec = FlightRecorder()
         prev_bench = set_recorder(bench_rec)
@@ -786,9 +887,18 @@ def make_baseline(metrics: Dict[str, float]) -> Dict[str, Any]:
                     "remote_replica_steady_recompiles",
                     "kv_quant_steady_state_recompiles",
                     "kv_spill_steady_state_recompiles",
-                    "tiered_offload_update_programs"):
+                    "tiered_offload_update_programs",
+                    "reconnect_steady_recompiles",
+                    "breaker_false_positive_failovers"):
             spec[name] = {"value": value, "direction": "max",
                           "abs_tol": 0.0}
+        elif name == "retry_amplification":
+            # the retry-amplification bound: the scripted
+            # one-reset-per-probe schedule must cost ~2 attempts/probe
+            # — a retry storm (attempts racing to max_attempts per
+            # probe, or backoff not engaging) fails the gate
+            spec[name] = {"value": value, "direction": "max",
+                          "abs_tol": 0.25}
         elif name in ("kv_spill_capacity_gain",
                       "kv_spill_turn2_reuse_fraction"):
             # the spill win itself: at the fixed pool budget, spill must

@@ -75,6 +75,31 @@ def test_router_flag_wires_up_replicas():
 
 
 @pytest.mark.slow
+def test_chaos_open_loop_bench_holds_the_invariant(capsys):
+    """Slow smoke: `--router 2 --chaos SEED` drives the Poisson trace
+    through loopback socket replicas under the seeded fault schedule
+    and the report upholds the robustness invariant — every request
+    accounted (completed / rejected / expired / typed error), with the
+    chaos bookkeeping present."""
+    import json as _json
+
+    from deepspeed_tpu.benchmarks.load_bench import main
+
+    rc = main(["--router", "2", "--chaos", "7", "--requests", "10",
+               "--rate", "50.0", "--budget", "64", "--chunk", "16",
+               "--new", "8", "--layers", "2", "--hidden", "64",
+               "--max-pending", "8"])
+    assert rc == 0
+    report = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["metric"] == "serving_router_chaos_open_loop"
+    assert report["submitted"] == 10
+    assert report["invariant_ok"] is True
+    assert report["completed"] > 0
+    assert isinstance(report["faults_injected"], dict)
+    assert report["stream_reconnects"] >= 0
+
+
+@pytest.mark.slow
 def test_router_open_loop_bench_reports_per_replica_breakdown(capsys):
     """Slow smoke: `--router 2` drives Poisson arrivals through the
     routed frontend and reports per-replica TTFT/goodput plus

@@ -168,6 +168,93 @@ def test_autoscaler_replaces_dead_capacity(model_and_params):
     asyncio.run(run())
 
 
+def test_autoscaler_spawn_failure_contained_and_quarantined(
+        model_and_params):
+    """ISSUE 14 satellite: a factory exception never escapes tick() —
+    it is counted, recorded in last_decision, advances the cooldown
+    clock, and quarantines the spawner with exponential backoff (also
+    respected by dead-capacity replacement)."""
+    model, params = model_and_params
+
+    class _Clock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    async def run():
+        from deepspeed_tpu.inference.v2.serve import AutoscalerConfig
+        clock = _Clock()
+        router = ReplicaRouter(
+            [Replica("base0", _engine(model, params), _tight_config())],
+            RouterConfig(monitor_interval_s=0.0, default_backoff_s=0.0))
+        await router.start()
+        calls = []
+
+        async def bad_factory(name):
+            calls.append(name)
+            raise RuntimeError("factory exploded: no capacity")
+
+        scaler = Autoscaler(
+            router, bad_factory,
+            AutoscalerConfig(min_replicas=1, max_replicas=3,
+                             scale_up_after_ticks=1, cooldown_s=0.0,
+                             spawn_backoff_s=5.0,
+                             spawn_backoff_max_s=30.0), clock=clock)
+        reg = get_registry()
+        fail0 = reg.family_total(
+            "router_autoscale_spawn_failures_total")
+
+        async def burst(base):
+            for i in range(8):
+                try:
+                    await router.submit(_prompt(12, seed=base + i), 8)
+                except OverloadedError:
+                    pass
+
+        try:
+            await burst(0)
+            d = await scaler.tick()          # the failure is CONTAINED
+            assert d["action"].startswith("up_failed:")
+            assert "factory exploded" in d["spawn_error"]
+            assert reg.family_total(
+                "router_autoscale_spawn_failures_total") - fail0 == 1
+            assert len(calls) == 1 and len(router.replicas) == 1
+            # quarantined: renewed pressure does not re-spawn yet
+            await burst(100)
+            d = await scaler.tick()
+            assert d["action"] == "none" and len(calls) == 1
+            assert d["spawn_quarantine_s"] > 0
+            # after the backoff window the spawner retries (and the
+            # quarantine doubles on the repeat failure)
+            clock.t += 5.1
+            await burst(200)
+            d = await scaler.tick()
+            assert d["action"].startswith("up_failed:")
+            assert len(calls) == 2
+            assert d["spawn_quarantine_s"] == pytest.approx(10.0,
+                                                            abs=0.5)
+            # dead-capacity replacement respects the quarantine too: a
+            # dead fleet with a broken factory must not hot-loop
+            router.replicas[0].serving.loop_runner.request_stop()
+            for _ in range(100):
+                await asyncio.sleep(0.01)
+                if not router.replicas[0].alive():
+                    break
+            d = await scaler.tick()
+            assert d["action"] == "none" and len(calls) == 2
+            clock.t += 10.1
+            d = await scaler.tick()
+            assert d["action"].startswith("up_failed:")
+            assert len(calls) == 3
+        finally:
+            await scaler.stop()
+            await router.stop()
+
+    asyncio.run(run())
+
+
 def test_autoscaler_cooldown_and_config_validation(model_and_params):
     model, params = model_and_params
     with pytest.raises(ValueError):

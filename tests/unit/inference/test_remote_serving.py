@@ -19,11 +19,8 @@ Pinned contracts (ISSUE 12 acceptance):
 """
 
 import asyncio
-import json
 import os
 import signal
-import subprocess
-import sys
 
 import numpy as np
 import pytest
@@ -214,25 +211,18 @@ def test_federated_metrics_include_remote(model_and_params):
 # -- true subprocess spawn / drain / kill (slow tier) ----------------------
 @pytest.mark.slow
 def test_worker_subprocess_spawn_drain_kill(tmp_path):
-    from deepspeed_tpu.inference.v2.serve.worker import READY_PREFIX
+    from deepspeed_tpu.inference.v2.serve import spawn_worker
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # ISOLATED compile cache: a worker SIGKILLed on a failure path must
     # never be able to poison the shared suite cache
     env["DS_TPU_COMPILE_CACHE"] = str(tmp_path / "xla-cache")
-    proc = subprocess.Popen(
-        [sys.executable, "-m",
-         "deepspeed_tpu.inference.v2.serve.worker", "--name", "sub0",
-         "--jax-platform", "cpu"],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
-        text=True)
+    # the spawn helper owns the handshake: ready-line wait under an
+    # explicit timeout, stderr surfaced if the worker dies first
+    proc, info = spawn_worker(
+        ["--name", "sub0", "--jax-platform", "cpu"],
+        timeout_s=120.0, env=env)
     try:
-        info = None
-        for line in proc.stdout:      # logging precedes the ready line
-            if line.startswith(READY_PREFIX):
-                info = json.loads(line[len(READY_PREFIX):])
-                break
-        assert info is not None, "worker exited without a ready line"
         assert info["name"] == "sub0" and info["block_size"] == 16
 
         async def run():

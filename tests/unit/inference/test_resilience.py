@@ -1,0 +1,287 @@
+"""Resilience primitives and the remote stream protocol, chip-free.
+
+Covers serve/resilience.py (RetryPolicy deadline-budget semantics, the
+CircuitBreaker state machine), serve/faults.py scheduling, the worker
+spawn handshake helper, and — against a tiny scripted HTTP server, no
+engine at all — RemoteStream's typed malformed-frame failure and its
+mid-stream reconnect through ``GET /resume``."""
+
+import asyncio
+import json
+import sys
+
+import pytest
+
+from deepspeed_tpu.inference.v2.serve import (BreakerConfig,
+                                              CircuitBreaker,
+                                              FaultPlane, FaultSpec,
+                                              RemoteReplica,
+                                              RequestFailed, RetryConfig,
+                                              RetryPolicy,
+                                              WorkerSpawnError,
+                                              spawn_worker)
+
+
+# -- RetryPolicy -----------------------------------------------------------
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_retry_policy_retries_then_succeeds_within_budget():
+    clock = _Clock()
+    slept = []
+
+    async def sleep(s):
+        slept.append(s)
+        clock.t += s
+
+    policy = RetryPolicy(RetryConfig(max_attempts=3, base_backoff_s=0.1,
+                                     jitter=0.0, deadline_s=10.0),
+                         clock=clock, sleep=sleep)
+    calls = []
+
+    async def flaky(remaining):
+        calls.append(remaining)
+        if len(calls) < 3:
+            raise ConnectionResetError("blip")
+        return "ok"
+
+    assert asyncio.run(policy.call(flaky, call="t1")) == "ok"
+    assert len(calls) == 3
+    # exponential backoff, no jitter: 0.1 then 0.2
+    assert slept == [0.1, 0.2]
+    # the remaining budget shrinks as the shared deadline is consumed
+    assert calls[0] == pytest.approx(10.0) and calls[2] < calls[0]
+
+
+def test_retry_policy_budget_shared_across_attempts():
+    clock = _Clock()
+
+    async def sleep(s):
+        clock.t += s
+
+    policy = RetryPolicy(RetryConfig(max_attempts=5, base_backoff_s=0.2,
+                                     jitter=0.0, deadline_s=0.5),
+                         clock=clock, sleep=sleep)
+    attempts = []
+
+    async def timeout_like(remaining):
+        attempts.append(remaining)
+        clock.t += remaining          # the attempt consumed its budget
+        raise asyncio.TimeoutError()
+
+    with pytest.raises(asyncio.TimeoutError):
+        asyncio.run(policy.call(timeout_like, call="t2"))
+    # one attempt ate the whole budget: no blind re-timeout stacking
+    assert len(attempts) == 1
+
+
+def test_retry_policy_never_retries_typed_errors():
+    policy = RetryPolicy(RetryConfig(max_attempts=3))
+    calls = []
+
+    async def typed(remaining):
+        calls.append(1)
+        raise RequestFailed("typed verdict")
+
+    with pytest.raises(RequestFailed):
+        asyncio.run(policy.call(typed))
+    assert len(calls) == 1
+
+
+# -- CircuitBreaker --------------------------------------------------------
+def test_breaker_opens_half_opens_and_recovers():
+    clock = _Clock()
+    br = CircuitBreaker(BreakerConfig(failure_threshold=2, open_s=1.0,
+                                      max_open_cycles=3), clock=clock)
+    assert br.state == "closed" and br.allow_probe()
+    br.record_failure()
+    assert br.state == "closed"          # one failure: not open yet
+    br.record_failure()
+    assert br.state == "open" and not br.allow_probe()
+    clock.t += 1.1
+    assert br.allow_probe()              # half-open trial window
+    assert br.state == "half_open"
+    br.record_success()
+    assert br.state == "closed" and not br.exhausted
+
+
+def test_breaker_exhausts_after_failed_half_open_probes():
+    clock = _Clock()
+    br = CircuitBreaker(BreakerConfig(failure_threshold=1, open_s=0.5,
+                                      max_open_cycles=2), clock=clock)
+    br.record_failure()                  # open, cycle 1
+    assert br.state == "open" and not br.exhausted
+    clock.t += 0.6
+    assert br.allow_probe()
+    br.record_failure()                  # half-open probe failed: cycle 2
+    assert br.exhausted
+    # a success anywhere fully resets the ledger
+    clock.t += 0.6
+    assert br.allow_probe()
+    br.record_success()
+    assert not br.exhausted and br.state == "closed"
+
+
+# -- FaultPlane scheduling -------------------------------------------------
+def test_fault_spec_skip_every_times_schedule():
+    plane = FaultPlane([FaultSpec(kind="reset", op="read",
+                                  target="/generate", skip=2, every=3,
+                                  times=2)])
+    fired = [plane._fire("read", "/generate") is not None
+             for _ in range(12)]
+    # ops 0,1 skipped; fires at 2 and 5; times=2 exhausts it
+    assert fired == [False, False, True, False, False, True] + [False] * 6
+    assert plane.injected == {"reset": 2}
+    # target filter: other endpoints never match
+    assert plane._fire("read", "/healthz") is None
+
+
+def test_fault_plane_seeded_probability_is_deterministic():
+    def run(seed):
+        plane = FaultPlane([FaultSpec(kind="reset", op="connect",
+                                      probability=0.5, times=None)],
+                           seed=seed)
+        return [plane._fire("connect", "/x") is not None
+                for _ in range(32)]
+
+    a, b = run(7), run(7)
+    assert a == b and any(a) and not all(a)
+    assert run(8) != a
+
+
+# -- spawn_worker handshake ------------------------------------------------
+def test_spawn_worker_surfaces_stderr_on_early_death():
+    with pytest.raises(WorkerSpawnError) as ei:
+        spawn_worker(cmd=[sys.executable, "-c",
+                          "import sys; sys.stderr.write('boom: no chip"
+                          " here\\n'); sys.exit(3)"],
+                     timeout_s=30.0)
+    msg = str(ei.value)
+    assert "code 3" in msg and "boom: no chip here" in msg
+
+
+def test_spawn_worker_times_out_and_kills():
+    with pytest.raises(WorkerSpawnError) as ei:
+        spawn_worker(cmd=[sys.executable, "-c",
+                          "import time; time.sleep(60)"],
+                     timeout_s=0.5)
+    assert "timed out" in str(ei.value)
+
+
+# -- RemoteStream protocol against a scripted fake worker ------------------
+class _FakeWorker:
+    """Minimal scripted HTTP server speaking the worker NDJSON protocol
+    — enough to drive RemoteStream without any engine."""
+
+    def __init__(self):
+        self.resume_calls = []
+        self.server = None
+        self.port = None
+
+    async def start(self):
+        self.server = await asyncio.start_server(
+            self._handle, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+
+    async def stop(self):
+        self.server.close()
+        await self.server.wait_closed()
+
+    @staticmethod
+    def _head(extra=""):
+        return ("HTTP/1.1 200 OK\r\nContent-Type: application/x-ndjson"
+                "\r\nConnection: close\r\n" + extra + "\r\n").encode()
+
+    async def _handle(self, reader, writer):
+        req = (await reader.readline()).decode()
+        target = req.split()[1]
+        while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+            pass
+        try:
+            if target.startswith("/generate-drop"):
+                # uid header, two tokens, then the connection dies
+                writer.write(self._head("x-ds-tpu-uid: 7\r\n"))
+                writer.write(b'{"token": 1}\n{"token": 2}\n')
+                await writer.drain()
+                writer.close()
+                return
+            if target.startswith("/resume"):
+                q = dict(p.split("=") for p in
+                         target.partition("?")[2].split("&"))
+                self.resume_calls.append((int(q["uid"]),
+                                          int(q["offset"])))
+                writer.write(self._head("x-ds-tpu-uid: 7\r\n"))
+                for t in range(int(q["offset"]) + 1, 6):
+                    writer.write(json.dumps({"token": t}).encode()
+                                 + b"\n")
+                writer.write(json.dumps(
+                    {"done": True, "status": "completed", "uid": 7,
+                     "n": 5, "trace_id": "feed"}).encode() + b"\n")
+                await writer.drain()
+                writer.close()
+                return
+            if target.startswith("/generate-garbled"):
+                writer.write(self._head("x-ds-tpu-uid: 9\r\n"))
+                writer.write(b'{"token": 1}\n{"token": 2\n')
+                await writer.drain()
+                writer.close()
+                return
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+
+
+async def _submit(replica, target):
+    code, headers, reader, wtr = await replica._open("POST", target)
+    from deepspeed_tpu.inference.v2.serve.remote import (RemoteStream,
+                                                         UID_HEADER)
+    uid = headers.get(UID_HEADER)
+    return RemoteStream(reader, wtr, replica=replica,
+                        uid=int(uid) if uid else None)
+
+
+def test_remote_stream_reconnects_at_offset():
+    async def run():
+        fake = _FakeWorker()
+        await fake.start()
+        replica = RemoteReplica("fw", "127.0.0.1", fake.port,
+                                probe_timeout_s=2.0,
+                                reconnect_backoff_s=0.01)
+        stream = await _submit(replica, "/generate-drop")
+        toks = await asyncio.wait_for(stream.drain(), 20)
+        await fake.stop()
+        return toks, stream, fake.resume_calls
+
+    toks, stream, calls = asyncio.run(run())
+    # the resumed stream is the uninterrupted sequence: replay from the
+    # consumed offset, no gap, no duplicate
+    assert toks == [1, 2, 3, 4, 5]
+    assert stream.status == "completed" and stream.reconnects == 1
+    assert calls == [(7, 2)]
+    assert stream.trace_id == "feed"
+
+
+def test_remote_stream_malformed_frame_fails_typed():
+    async def run():
+        fake = _FakeWorker()
+        await fake.start()
+        replica = RemoteReplica("fw", "127.0.0.1", fake.port,
+                                probe_timeout_s=2.0)
+        stream = await _submit(replica, "/generate-garbled")
+        try:
+            with pytest.raises(RequestFailed) as ei:
+                await asyncio.wait_for(stream.drain(), 20)
+        finally:
+            await fake.stop()
+        return stream, str(ei.value)
+
+    stream, msg = asyncio.run(run())
+    # a COMPLETE but unparseable frame is corruption: typed failure,
+    # no reconnect attempt, never a leaked JSONDecodeError
+    assert "malformed frame" in msg
+    assert stream.status == "error" and stream.reconnects == 0
+    assert stream.tokens == [1]
