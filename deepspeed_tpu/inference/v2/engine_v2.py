@@ -159,6 +159,11 @@ class InferenceEngineV2:
         # (decode_step/decode_window/ragged_step) carry the trace ids of
         # every request they served; cleared on flush()
         self._uid_traces: Dict[int, str] = {}
+        # live-weight version (serve/weights.py hot-swap): 0 = the boot
+        # checkpoint; bumped by swap_engine_params. Advertised through
+        # /healthz so the router's blue/green rollout can converge a
+        # fleet onto one version
+        self.weight_version = 0
         self._init_telemetry()
         # Pallas kernels only at tp=1: a bare pallas_call is not
         # GSPMD-partitionable, so sharded-param (tp>1) serving keeps the
@@ -379,6 +384,19 @@ class InferenceEngineV2:
             "HBM the int8 KV pool frees vs the same pool at the serving "
             "dtype (0 when kv_quant is off) — the capacity headroom that "
             "admits ~2x concurrent sequences", unit="bytes")
+        self._m_weight_swaps = reg.counter(
+            "inference_weight_swaps_total",
+            "live param hot-swaps applied to this engine (donated "
+            "buffer replacement; zero recompiles by construction)")
+        self._m_weight_swap_time = reg.histogram(
+            "inference_weight_swap_seconds",
+            "param hot-swap apply time (device_put of every leaf onto "
+            "its existing sharding)", unit="s",
+            buckets=(1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0))
+        self._m_weight_version = reg.gauge(
+            "serving_weight_version",
+            "live weight version this engine serves (0 = the boot "
+            "checkpoint; bumped by each hot-swap)")
 
     def _update_pool_telemetry(self):
         sm = self.state_manager
@@ -996,6 +1014,31 @@ class InferenceEngineV2:
                                       + sm.config.max_tracked_sequences]
                 results.update(self._decode_batch(chunk_u, chunk_t))
         return np.stack([results[uid] for uid, _ in entries])
+
+    # -- weight hot-swap (serve/weights.py) -----------------------------
+    def note_weight_swap(self, seconds: float) -> None:
+        """Book-keeping after ``swap_engine_params`` replaced
+        ``self.params``: telemetry, flight event, and the params-buffer
+        HBM accounting (the swapped tree may differ in dtype bytes only
+        if the publisher changed — record the live truth)."""
+        self._m_weight_swaps.inc()
+        self._m_weight_swap_time.observe(seconds)
+        self._m_weight_version.set(self.weight_version)
+        flight.record("weight_swap", version=int(self.weight_version),
+                      dur_s=round(float(seconds), 5))
+        try:
+            ds_memory.record_buffer("params",
+                                    ds_memory.tree_bytes(self.params))
+        except Exception:   # accounting must never block serving
+            pass
+
+    def swap_params(self, flat_leaves, version: int) -> None:
+        """Install published weight leaves (``{path: fp32 ndarray}``) by
+        donated buffer replacement — see serve/weights.py
+        ``swap_engine_params`` (this is the method form the serving
+        runtime and the hybrid engine call)."""
+        from .serve import weights as serve_weights
+        serve_weights.swap_engine_params(self, flat_leaves, version)
 
     # -- distributed tracing (telemetry/context.py) ---------------------
     def bind_trace(self, uid: int, trace_id: str) -> None:

@@ -41,6 +41,7 @@ See docs/SERVING.md ("Async serving runtime", "Routing tier" and
 """
 
 from . import handoff  # noqa: F401
+from . import weights  # noqa: F401
 from .admission import (AdmissionConfig, AdmissionController,  # noqa: F401
                         OverloadedError)
 from .faults import FaultPlane, FaultSpec  # noqa: F401
@@ -66,7 +67,7 @@ __all__ = [
     "ReplicaRouter", "RoutedStream", "RouterConfig",
     "RemoteReplica", "RemoteStream", "ReplicaWorker", "WorkerAPI",
     "WorkerSpawnError", "spawn_worker",
-    "Autoscaler", "AutoscalerConfig", "handoff",
+    "Autoscaler", "AutoscalerConfig", "handoff", "weights",
     "FaultPlane", "FaultSpec",
     "RetryConfig", "RetryPolicy", "BreakerConfig", "CircuitBreaker",
 ]

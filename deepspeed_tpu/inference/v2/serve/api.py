@@ -74,6 +74,14 @@ _MAX_BODY_BYTES = 8 * 1024 * 1024
 # worker's /resume and /handoff responses echo it)
 UID_HEADER = "x-ds-tpu-uid"
 
+# shared-secret auth header (worker API): when the server is built with
+# an auth token (worker --auth-token / $DS_TPU_WORKER_AUTH), EVERY
+# request must carry it — a mismatch is a typed 401, never a silent
+# accept. RemoteReplica sends it on every hop, /weights and /resume
+# included.
+AUTH_HEADER = "x-ds-tpu-auth"
+AUTH_ENV = "DS_TPU_WORKER_AUTH"
+
 
 async def _read_request(reader: asyncio.StreamReader):
     request_line = await reader.readline()
@@ -123,14 +131,22 @@ class ServingAPI:
     (anything with the ``submit``/``health`` surface)."""
 
     def __init__(self, serving, host: str = "127.0.0.1",
-                 port: int = 0, registry=None):
+                 port: int = 0, registry=None,
+                 auth_token: Optional[str] = None):
         self.serving = serving
         self.host = host
         self.port = port
+        # shared-secret auth (AUTH_HEADER): None = open (the in-process
+        # default); a token makes every route require the header
+        self.auth_token = auth_token
         if registry is None:
             from ....telemetry import get_registry
             registry = get_registry()
         self.registry = registry
+        self._m_auth_failures = registry.counter(
+            "serving_auth_failures_total",
+            "requests rejected 401 for a missing or wrong "
+            "x-ds-tpu-auth shared secret")
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> Tuple[str, int]:
@@ -158,7 +174,28 @@ class ServingAPI:
                                {"error": "malformed request"})
                 return
             target, _, query = target.partition("?")
-            if method == "GET" and target == "/healthz":
+            if self.auth_token is not None and \
+                    headers.get(AUTH_HEADER) != self.auth_token:
+                self._m_auth_failures.inc()
+                _json_response(
+                    writer, "401 Unauthorized",
+                    {"error": "unauthorized",
+                     "detail": f"missing or wrong {AUTH_HEADER} header "
+                               f"(this worker requires the shared "
+                               f"secret)"})
+                if method == "POST" and not body:
+                    # frame-streaming routes (/weights, /handoff) send
+                    # their payload AFTER the head: drain it so the
+                    # close cannot RST away the typed 401 and turn a
+                    # non-retryable auth failure into a retried
+                    # transport error
+                    try:
+                        await asyncio.wait_for(writer.drain(), 5.0)
+                        await asyncio.wait_for(reader.read(), 5.0)
+                    except (OSError, asyncio.TimeoutError,
+                            ConnectionError):
+                        pass
+            elif method == "GET" and target == "/healthz":
                 _json_response(writer, "200 OK", self.serving.health())
             elif method == "GET" and target == "/metrics":
                 # routed frontend mode: federate per-replica registries

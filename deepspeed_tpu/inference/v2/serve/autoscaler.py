@@ -209,12 +209,31 @@ class Autoscaler:
         self.last_decision = decision
         return decision
 
+    def _spawn_call(self, name: str):
+        """Call the factory, passing the fleet's current target weight
+        version when the factory accepts it — a scale-up after a push
+        must join at the LIVE version, not the boot checkpoint (the
+        router's ``sync_weights_on_add`` then verifies/pushes either
+        way)."""
+        import inspect
+        target = getattr(self.router, "target_weight_version", None)
+        try:
+            sig = inspect.signature(self.factory)
+            accepts = ("weight_version" in sig.parameters
+                       or any(p.kind is inspect.Parameter.VAR_KEYWORD
+                              for p in sig.parameters.values()))
+        except (TypeError, ValueError):
+            accepts = False
+        if accepts:
+            return self.factory(name, weight_version=target)
+        return self.factory(name)
+
     async def _scale_up(self, reason: str) -> str:
         name = f"{self.name_prefix}{next(self._ids)}"
         t0 = time.perf_counter()
         self._spawning = True
         try:
-            replica = await self.factory(name)
+            replica = await self._spawn_call(name)
             await self.router.add_replica(replica)
         except Exception as e:
             # a spawn failure must never escape tick(): count it,

@@ -373,6 +373,43 @@ class ServingEngine:
             lambda: self._loop_runner.begin_restore(uid, header))
         return ChunkedHandoff(self, uid, header)
 
+    # -- live weight update (serve/weights.py; blue/green hot-swap) -----
+    async def begin_weight_update(self, header_chunk: bytes
+                                  ) -> "WeightUpdate":
+        """Open a chunked weight update: chunks stage HOST-SIDE (CRC-
+        checked, off the loop thread — the running batch keeps
+        stepping), then ``commit`` applies ONE atomic param swap
+        between scheduler steps. A stream therefore never sees tokens
+        from two weight versions unless it spans the commit — which the
+        router's blue/green rollout prevents by draining a replica's
+        routed streams before pushing (serve/router.py)."""
+        from . import weights as serve_weights
+        if self._stopped or self.admission.closed:
+            from .admission import OverloadedError
+            raise OverloadedError(
+                "draining", "serving runtime is draining; not accepting "
+                "weight updates",
+                retry_after_s=self.config.admission.retry_after_s)
+        header = await asyncio.to_thread(
+            serve_weights.parse_weights_header, header_chunk)
+        return WeightUpdate(self, serve_weights.WeightStager(header))
+
+    async def apply_weights(self, payloads: Sequence[bytes]) -> int:
+        """Stage + commit a complete weight payload; returns the
+        installed version."""
+        update = await self.begin_weight_update(payloads[0])
+        try:
+            for chunk in payloads[1:]:
+                await update.feed(chunk)
+            return await update.commit()
+        except BaseException:
+            await update.abort()
+            raise
+
+    @property
+    def weight_version(self) -> int:
+        return int(getattr(self.scheduler.engine, "weight_version", 0))
+
     # -- introspection --------------------------------------------------
     def heartbeat_age(self) -> Optional[float]:
         """Seconds since the serving loop's last stall-watchdog
@@ -401,6 +438,9 @@ class ServingEngine:
                 self.scheduler.engine.state_manager.block_size),
             "max_seq_len": int(
                 self.scheduler.engine.state_manager.config.max_seq_len),
+            # blue/green rollout signal (serve/weights.py): the router
+            # converges the fleet onto one target version off this field
+            "weight_version": self.weight_version,
         }
 
 
@@ -497,5 +537,78 @@ class ChunkedHandoff:
                 self._serving._loop_runner.post(
                     lambda: self._serving._loop_runner._abort_restore(
                         self.uid))
+            except Exception:
+                pass
+
+
+class WeightUpdate:
+    """Client handle for one staged weight update into a
+    :class:`ServingEngine` (``begin_weight_update``): ``feed`` each
+    payload chunk (host-side staging + CRC — the loop keeps stepping
+    its batch), then ``commit`` applies the atomic swap between
+    scheduler steps; ``abort`` drops the staged leaves without touching
+    the live params."""
+
+    def __init__(self, serving: ServingEngine, stager):
+        self._serving = serving
+        self._stager = stager
+        self._open = True
+        self._t0 = time.perf_counter()
+        loop = serving._loop_runner
+        loop.weight_staging += 1
+        from ....telemetry import get_registry
+        self._m_seconds = get_registry().histogram(
+            "serving_weight_update_seconds",
+            "weight update begin -> committed swap (staging overlaps "
+            "the running batch; only the final swap touches the loop)",
+            unit="s", buckets=(1e-3, 1e-2, 0.1, 1.0, 10.0, 60.0))
+
+    @property
+    def version(self) -> int:
+        return int(self._stager.version)
+
+    async def feed(self, chunk: bytes) -> None:
+        if not self._open:
+            raise RuntimeError("weight update already closed")
+        try:
+            await asyncio.to_thread(self._stager.feed, chunk)
+        except BaseException:
+            await self.abort()
+            raise
+
+    async def commit(self) -> int:
+        """Verify every chunk arrived and swap the live params between
+        scheduler steps. Returns the installed version."""
+        from . import weights as serve_weights
+        if not self._open:
+            raise RuntimeError("weight update already closed")
+        stager = self._stager
+        stager.commit_check()
+        loop = self._serving._loop_runner
+
+        def swap() -> int:
+            serve_weights.swap_engine_params(
+                loop.scheduler.engine, stager.leaves, stager.version)
+            return stager.version
+        try:
+            version = await loop.run_on_loop(swap)
+        finally:
+            self._close()
+        self._m_seconds.observe(time.perf_counter() - self._t0)
+        return version
+
+    async def abort(self) -> None:
+        self._close()
+
+    def _close(self) -> None:
+        if self._open:
+            self._open = False
+            self._stager.leaves = {}
+            self._serving._loop_runner.weight_staging -= 1
+
+    def __del__(self):
+        if self._open:
+            try:
+                self._close()
             except Exception:
                 pass

@@ -79,6 +79,11 @@ class ServingLoop:
         # scheduler steps completed since start — the overlap evidence
         # the chunked-handoff tests and perf gate read
         self.steps_done = 0
+        # weight updates currently STAGING host-side (frontend.py
+        # WeightUpdate): staging never blocks the loop — steps taken
+        # while >= 1 update stages are the publish/decode overlap the
+        # perf gate's weight_publish_decode_stall_fraction pins at 0
+        self.weight_staging = 0
         from ....telemetry import get_registry
         reg = get_registry()
         self._m_expired = reg.counter(
@@ -107,6 +112,11 @@ class ServingLoop:
             "scheduler steps completed while >=1 chunked handoff was "
             "in flight (the transfer/compute overlap the protocol "
             "buys)")
+        self._m_weight_overlap_steps = reg.counter(
+            "weight_update_overlap_steps_total",
+            "scheduler steps completed while >=1 weight update was "
+            "staging (publication overlaps decode; only the final "
+            "atomic swap lands between steps)")
 
     # -- cross-thread surface (any thread) ------------------------------
     def post(self, fn: Callable[[], None]) -> None:
@@ -478,6 +488,8 @@ class ServingLoop:
                     # a chunked handoff is streaming in AND the batch
                     # kept stepping — the overlap the protocol buys
                     self._m_overlap_steps.inc()
+                if self.weight_staging:
+                    self._m_weight_overlap_steps.inc()
                 self._cancel_dead()
                 self._flush_finished()
                 continue

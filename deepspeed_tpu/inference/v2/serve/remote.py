@@ -58,12 +58,13 @@ connection is ``Connection: close``, matching serve/api.py's protocol.
 
 import asyncio
 import json
+import os
 import time
 from typing import List, Optional
 
 from ....telemetry import context as trace_context
 from .admission import OverloadedError
-from .api import UID_HEADER
+from .api import AUTH_ENV, AUTH_HEADER, UID_HEADER
 from .frontend import DeadlineExceeded, RequestFailed
 from .resilience import RetryConfig, RetryPolicy
 
@@ -168,12 +169,14 @@ async def _open_request(host: str, port: int, method: str, target: str,
 
 async def _request_json(host: str, port: int, method: str, target: str,
                         body: Optional[dict] = None, timeout: float = 5.0,
-                        faults=None):
+                        faults=None, headers: Optional[dict] = None):
     """One-shot JSON request/response; returns ``(code, obj)``."""
     payload = json.dumps(body).encode() if body is not None else b""
+    req_headers = dict(headers or {})
+    if body is not None:
+        req_headers.setdefault("Content-Type", "application/json")
     code, _, reader, writer = await _open_request(
-        host, port, method, target,
-        headers={"Content-Type": "application/json"} if body else None,
+        host, port, method, target, headers=req_headers or None,
         body=payload, timeout=timeout, faults=faults)
     try:
         data = await asyncio.wait_for(reader.read(), timeout)
@@ -395,12 +398,19 @@ class RemoteReplica:
                  probe_interval_s: float = 0.25, clock=time.monotonic,
                  retry: Optional[RetryConfig] = None, faults=None,
                  reconnect_max: int = 4,
-                 reconnect_backoff_s: float = 0.05):
+                 reconnect_backoff_s: float = 0.05,
+                 auth_token: Optional[str] = None):
         self.name = name
         self.host = host
         self.port = int(port)
         self.state = "up"
         self.started = False
+        # shared-secret worker auth (serve/api.py AUTH_HEADER): sent on
+        # EVERY hop — probes, /generate, /handoff, /weights, /resume.
+        # Defaults to $DS_TPU_WORKER_AUTH so a fleet shares one secret
+        # via the environment.
+        self.auth_token = (auth_token if auth_token is not None
+                           else os.environ.get(AUTH_ENV))
         self.probe_timeout_s = probe_timeout_s
         self.probe_interval_s = probe_interval_s
         self.clock = clock
@@ -430,11 +440,16 @@ class RemoteReplica:
             "exhausted or resume refused) — the stream failed typed")
 
     # -- transport ------------------------------------------------------
+    def _auth_headers(self) -> dict:
+        return ({AUTH_HEADER: self.auth_token}
+                if self.auth_token is not None else {})
+
     async def _open(self, method: str, target: str, *,
                     headers: Optional[dict] = None, body: bytes = b"",
                     timeout: Optional[float] = None):
         return await _open_request(
-            self.host, self.port, method, target, headers=headers,
+            self.host, self.port, method, target,
+            headers={**self._auth_headers(), **(headers or {})},
             body=body,
             timeout=self.probe_timeout_s if timeout is None else timeout,
             faults=self.faults)
@@ -445,7 +460,7 @@ class RemoteReplica:
         return await _request_json(
             self.host, self.port, method, target, body=body,
             timeout=self.probe_timeout_s if timeout is None else timeout,
-            faults=self.faults)
+            faults=self.faults, headers=self._auth_headers() or None)
 
     # -- lifecycle ------------------------------------------------------
     async def start(self) -> "RemoteReplica":
@@ -547,6 +562,114 @@ class RemoteReplica:
                 "reachable": self._reachable,
                 "probe_status": self.probe_status}
 
+    @property
+    def weight_version(self):
+        """Last-advertised live weight version (``/healthz``; refreshed
+        by probes and updated in place by a successful push). ``None``
+        until the first probe answers."""
+        v = self._health.get("weight_version")
+        return int(v) if v is not None else None
+
+    # -- live weight push (blue/green rollout; serve/weights.py) --------
+    async def push_weights(self, payloads: List[bytes]) -> int:
+        """Stream a weight payload to the worker (``POST /weights``) and
+        return the installed version. The transfer is IDEMPOTENT (the
+        worker stages per connection and aborts on disconnect — the
+        live params are only touched by the final commit), so transport
+        failures retry under the policy; typed worker verdicts
+        (draining / corrupt payload) never retry."""
+        return await self.retry.call(
+            lambda t: self._push_weights_once(payloads, t),
+            call="weights", deadline_s=max(self.probe_timeout_s, 60.0))
+
+    async def _push_weights_once(self, payloads: List[bytes],
+                                 timeout: float) -> int:
+        async def dial():
+            if self.faults is not None:
+                await self.faults.connect("/weights")
+            return await asyncio.open_connection(self.host, self.port)
+
+        reader, writer = await asyncio.wait_for(dial(),
+                                                self.probe_timeout_s)
+        if self.faults is not None:
+            reader, writer = self.faults.wrap(reader, writer, "/weights")
+        lines = ["POST /weights HTTP/1.1",
+                 f"Host: {self.host}:{self.port}",
+                 "Connection: close", "Content-Length: 0"]
+        for k, v in {**self._auth_headers(),
+                     **_trace_headers()}.items():
+            lines.append(f"{k}: {v}")
+        writer.write(("\r\n".join(lines) + "\r\n\r\n").encode())
+        # ONE absolute deadline covers the whole transfer AND the
+        # response: a wedged worker (full TCP send buffer, drain never
+        # returning) must expire the retry budget as a typed timeout,
+        # not hang push_weights forever with the replica out of
+        # rotation
+        deadline = time.monotonic() + max(timeout, 5.0)
+
+        def remaining() -> float:
+            return max(deadline - time.monotonic(), 0.001)
+
+        transfer_err: Optional[Exception] = None
+        try:
+            for p in payloads:
+                write_frame(writer, FRAME_CHUNK, p)
+                await asyncio.wait_for(writer.drain(), remaining())
+            write_frame(writer, FRAME_PARAMS, b"{}")
+            await asyncio.wait_for(writer.drain(), remaining())
+        except (ConnectionResetError, BrokenPipeError, OSError) as e:
+            # the worker may have written a typed verdict (draining /
+            # 401) and closed while frames were in flight — fall
+            # through and try to read it before calling this a
+            # transport failure
+            transfer_err = e
+        except asyncio.TimeoutError:
+            writer.close()
+            raise ConnectionError(
+                f"remote replica {self.name}: weight push transfer "
+                f"timed out after {max(timeout, 5.0):.1f}s")
+        try:
+            status_line = await asyncio.wait_for(reader.readline(),
+                                                 remaining())
+            while True:
+                hline = await asyncio.wait_for(reader.readline(),
+                                               remaining())
+                if hline in (b"\r\n", b"\n", b""):
+                    break
+            if hasattr(reader, "arm"):
+                reader.arm()
+            body = await asyncio.wait_for(reader.read(), remaining())
+        except (ConnectionResetError, BrokenPipeError, OSError,
+                asyncio.IncompleteReadError):
+            status_line, body = b"", b""
+        except BaseException:
+            writer.close()
+            raise
+        writer.close()
+        if not status_line:
+            detail = (f"transfer failed: {transfer_err}" if transfer_err
+                      else "closed without a response")
+            raise ConnectionError(
+                f"remote replica {self.name}: weight push {detail}")
+        code = int(status_line.decode("latin-1").split(None, 2)[1])
+        try:
+            verdict = json.loads(body.decode() or "{}")
+        except json.JSONDecodeError:
+            verdict = {}
+        if code == 429 or verdict.get("reason") == "draining":
+            raise OverloadedError(
+                verdict.get("reason", "overloaded"),
+                verdict.get("detail", "remote weight push shed"),
+                retry_after_s=verdict.get("retry_after_s"))
+        if code != 200 or not verdict.get("ok"):
+            detail = verdict.get("detail") or repr(body[:200])
+            raise RequestFailed(
+                f"remote replica {self.name}: weight push rejected "
+                f"({code}): {detail}")
+        version = int(verdict["version"])
+        self._health["weight_version"] = version
+        return version
+
     # -- submission -----------------------------------------------------
     async def submit(self, prompt, max_new_tokens: int,
                      **kw) -> RemoteStream:
@@ -628,7 +751,7 @@ class RemoteReplica:
         lines = ["POST /handoff HTTP/1.1",
                  f"Host: {self.host}:{self.port}",
                  "Connection: close", "Content-Length: 0"]
-        for k, v in trace_hdrs.items():
+        for k, v in {**self._auth_headers(), **trace_hdrs}.items():
             lines.append(f"{k}: {v}")
         writer.write(("\r\n".join(lines) + "\r\n\r\n").encode())
         transfer_err: Optional[Exception] = None

@@ -152,6 +152,17 @@ class Replica:
         the router reads ``self.registry`` directly)."""
         return None
 
+    # -- live weights (serve/weights.py; blue/green rollout) ------------
+    @property
+    def weight_version(self) -> int:
+        return self.serving.weight_version
+
+    async def apply_weights(self, payloads: Sequence[bytes]) -> int:
+        """Stage + commit a weight payload on this replica (the router's
+        in-process push transport; remote replicas stream the same
+        payload over ``POST /weights``)."""
+        return await self.serving.apply_weights(payloads)
+
     # -- traffic --------------------------------------------------------
     async def submit(self, prompt: Sequence[int], max_new_tokens: int,
                      **kw):
@@ -285,7 +296,23 @@ class PrefillReplica:
         sm = self.engine.state_manager
         return {"name": self.name, "state": self.state, "role": "prefill",
                 "free_blocks": sm.free_blocks(),
-                "tracked_sequences": sm.tracked_sequences()}
+                "tracked_sequences": sm.tracked_sequences(),
+                "weight_version": self.weight_version}
+
+    @property
+    def weight_version(self) -> int:
+        return int(getattr(self.engine, "weight_version", 0))
+
+    async def apply_weights(self, payloads: Sequence[bytes]) -> int:
+        """Swap this prefill worker's params (no serving loop — the
+        engine lock serializes against in-flight prefills, so a prompt
+        is never half-prefilled across versions)."""
+        from . import weights as serve_weights
+
+        def swap() -> int:
+            with self._lock:
+                return serve_weights.apply_payload(self.engine, payloads)
+        return await asyncio.to_thread(swap)
 
 
 def build_replicas(engines: Sequence, config: Optional[ServingConfig]

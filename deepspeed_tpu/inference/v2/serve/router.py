@@ -125,6 +125,15 @@ class RouterConfig:
     handoff_chunk_blocks: int = 4
     # consistent-hash ring points per replica
     ring_points: int = 32
+    # blue/green weight push (push_weights): how long a stale replica
+    # may take to finish its in-flight routed streams before the push
+    # fails typed (streams complete on their ORIGINAL version — the
+    # swap waits for them, never flips a stream mid-decode)
+    weight_push_drain_timeout_s: float = 30.0
+    # a replica added after a push (autoscaler scale-up) receives the
+    # cached target payload before taking traffic, so scale-ups join
+    # the fleet at the LIVE version instead of their boot checkpoint
+    sync_weights_on_add: bool = True
     # per-replica circuit breaker (serve/resilience.py): probe failures
     # OPEN it (the replica is SUSPECTED — routed around, mid-stream
     # requests keep streaming), half-open probes retest it, exhaustion
@@ -308,6 +317,13 @@ class ReplicaRouter:
         self._probe_seen: Dict[str, int] = {}
         self._rr = itertools.count()          # round-robin cursors
         self._rr_prefill = itertools.count()
+        # blue/green weight state (push_weights): the fleet's target
+        # version, the cached payload newcomers sync from, and the set
+        # of replicas currently draining for their swap (out of
+        # rotation, streams finishing on their original version)
+        self.target_weight_version: Optional[int] = None
+        self._weight_payloads: Optional[List[bytes]] = None
+        self._updating: set = set()
         self._uids = itertools.count(1)
         self._requests: Dict[int, _RoutedRequest] = {}
         self._monitor: Optional[asyncio.Task] = None
@@ -418,6 +434,34 @@ class ReplicaRouter:
             "router_breaker_open_total",
             "circuit-breaker open transitions (a replica entered "
             "suspicion)")
+        # blue/green weight push (serve/weights.py)
+        self._m_weight_pushes = reg.counter(
+            "router_weight_pushes_total",
+            "per-replica weight pushes completed by the blue/green "
+            "rollout", labelnames=("replica",))
+        self._m_weight_push_bytes = reg.counter(
+            "router_weight_push_bytes_total",
+            "serialized weight-payload bytes pushed to replicas",
+            unit="bytes")
+        self._m_weight_push_time = reg.histogram(
+            "router_weight_push_seconds",
+            "whole-fleet push_weights wall time (drain stale streams + "
+            "transfer + swap, per rollout)", unit="s",
+            buckets=(1e-2, 0.1, 1.0, 10.0, 60.0, 600.0))
+        self._m_weight_push_failures = reg.counter(
+            "router_weight_push_failures_total",
+            "per-replica weight pushes that failed (replica still "
+            "stale; the rollout raises typed when it stays up)")
+        self._m_target_version = reg.gauge(
+            "router_target_weight_version",
+            "the fleet's target weight version (0 until the first "
+            "push)")
+        self._m_replica_version = reg.gauge(
+            "router_replica_weight_version",
+            "per-replica live weight version as last advertised "
+            "(healthz/heartbeat) or installed by a push",
+            labelnames=("replica",))
+        self._wv_series: Dict[str, object] = {}
         self._m_replicas.set(len(self.replicas))
         for r in self.replicas:
             self._m_state.labels(replica=r.name).set(1)
@@ -479,6 +523,27 @@ class ReplicaRouter:
         if start and not replica.started:
             await replica.start()
         self._check_block_size(replica)
+        # scale-ups join at the LIVE version: push the cached target
+        # payload BEFORE the replica enters the ring, so it never
+        # serves a request from its boot checkpoint after a push
+        if (self.config.sync_weights_on_add
+                and self._weight_payloads is not None
+                and self.target_weight_version is not None
+                and self._replica_weight_version(replica)
+                != self.target_weight_version):
+            try:
+                await self._push_to_replica(
+                    replica, self._weight_payloads,
+                    sum(len(p) for p in self._weight_payloads))
+            except BaseException:
+                # the replica was already STARTED above: stop it before
+                # propagating, or a failed sync leaks a live worker the
+                # autoscaler only counts as a spawn failure
+                try:
+                    await replica.stop()
+                except Exception:
+                    pass
+                raise
         self.replicas.append(replica)
         self._by_name[replica.name] = replica
         self._rebuild_ring()
@@ -511,6 +576,8 @@ class ReplicaRouter:
             del self._affinity[digest]
         self._backoff_until.pop(name, None)
         self._hb_series.pop(name, None)
+        self._wv_series.pop(name, None)
+        self._updating.discard(name)
         self._breakers.pop(name, None)
         self._probe_seen.pop(name, None)
         if name in self._suspected:
@@ -610,12 +677,40 @@ class ReplicaRouter:
                 self._m_fleet_bundles.inc()
 
     # -- placement ------------------------------------------------------
+    @staticmethod
+    def _replica_weight_version(replica) -> Optional[int]:
+        v = getattr(replica, "weight_version", None)
+        return int(v) if v is not None else None
+
+    def _note_weight_version(self, replica) -> None:
+        v = self._replica_weight_version(replica)
+        if v is None:
+            return
+        series = self._wv_series.get(replica.name)
+        if series is None:
+            series = self._m_replica_version.labels(
+                replica=replica.name)
+            self._wv_series[replica.name] = series
+        series.set(v)
+
     def _routable(self) -> List[Replica]:
         now = self.clock()
-        return [r for r in self.replicas
+        base = [r for r in self.replicas
                 if r.state == "up"
                 and r.name not in self._suspected
+                and r.name not in self._updating
                 and self._backoff_until.get(r.name, 0.0) <= now]
+        # blue/green invariant: once ANY routable replica serves the
+        # target version, new dispatches land only on target-version
+        # replicas — stale ones keep their in-flight streams (their
+        # pumps are untouched) and drain toward their own swap
+        if self.target_weight_version is not None:
+            at_target = [r for r in base
+                         if self._replica_weight_version(r)
+                         == self.target_weight_version]
+            if at_target:
+                return at_target
+        return base
 
     def _record_affinity(self, digests: List[bytes], name: str) -> None:
         for d in digests:
@@ -930,6 +1025,116 @@ class ReplicaRouter:
                 pass
         self._finish(rec, "cancelled", None)
 
+    # -- blue/green weight push (serve/weights.py) ----------------------
+    async def push_weights(self, payloads: Sequence[bytes],
+                           version: Optional[int] = None) -> int:
+        """Converge the fleet onto a new weight version, blue/green:
+
+        1. the payload version becomes the fleet TARGET (``_routable``
+           then prefers target-version replicas for every new
+           dispatch);
+        2. each stale up replica in turn is taken out of rotation, its
+           in-flight routed streams finish ON THE OLD VERSION (the
+           quiesce wait — a stream never spans a swap), the payload is
+           pushed (``POST /weights`` for remote replicas, the staged
+           in-process update otherwise) and the replica returns to
+           rotation at the target version.
+
+        Zero requests are dropped: new traffic always has the other
+        replicas (rolling, one at a time), in-flight streams complete
+        where they started, and a replica that cannot be pushed (still
+        up, still stale) fails the rollout TYPED. The payload is cached
+        so later ``add_replica`` scale-ups join at the live version.
+        Returns the target version."""
+        from . import weights as serve_weights
+        if self.config.disaggregated:
+            raise NotImplementedError(
+                "blue/green weight push over disaggregated fleets is "
+                "not supported yet: prefill and decode replicas would "
+                "need a coupled swap to keep handed-off streams on one "
+                "version")
+        if self._stopped:
+            raise RuntimeError("router is stopped")
+        if version is None:
+            version = serve_weights.payload_version(payloads)
+        version = int(version)
+        t0 = time.perf_counter()
+        payloads = list(payloads)
+        self.target_weight_version = version
+        self._weight_payloads = payloads
+        self._m_target_version.set(version)
+        nbytes = serve_weights.payload_bytes(payloads)
+        failures: List[str] = []
+        for replica in list(self.replicas):
+            if replica.state != "up":
+                continue
+            if self._replica_weight_version(replica) == version:
+                continue
+            try:
+                await self._push_to_replica(replica, payloads, nbytes)
+            except Exception as e:
+                self._m_weight_push_failures.inc()
+                failures.append(
+                    f"{replica.name}: {type(e).__name__}: {e}")
+        self._m_weight_push_time.observe(time.perf_counter() - t0)
+        trace.record("router_weight_push", t0,
+                     time.perf_counter() - t0, lane=_ROUTER_LANE,
+                     version=version, payload_bytes=nbytes,
+                     failures=len(failures))
+        # a failed push only fails the rollout while the replica is
+        # still UP and stale — a replica that died mid-push was already
+        # failed over by check_replicas and no longer serves anything
+        still_stale = [
+            r.name for r in self.replicas
+            if r.state == "up"
+            and self._replica_weight_version(r) != version]
+        if still_stale:
+            detail = "; ".join(failures) if failures \
+                else "no error recorded"
+            raise RequestFailed(
+                f"weight push to version {version} did not converge: "
+                f"replicas {still_stale} still stale ({detail})")
+        return version
+
+    async def _push_to_replica(self, replica, payloads: List[bytes],
+                               nbytes: int) -> None:
+        name = replica.name
+        self._updating.add(name)
+        t0 = time.perf_counter()
+        try:
+            await self._quiesce_replica(replica)
+            if hasattr(replica, "push_weights"):
+                v = await replica.push_weights(payloads)
+            else:
+                v = await replica.apply_weights(payloads)
+        finally:
+            self._updating.discard(name)
+        self._m_weight_pushes.labels(replica=name).inc()
+        self._m_weight_push_bytes.inc(nbytes)
+        self._note_weight_version(replica)
+        trace.record("router_weight_push_replica", t0,
+                     time.perf_counter() - t0, lane=_ROUTER_LANE,
+                     replica=name, version=int(v))
+
+    async def _quiesce_replica(self, replica) -> None:
+        """Wait for the replica's routed in-flight streams to finish
+        (they complete on the version they started on; new dispatches
+        already divert — the replica is in ``_updating``)."""
+        deadline = (time.monotonic()
+                    + self.config.weight_push_drain_timeout_s)
+        while True:
+            live = [rec for rec in self._requests.values()
+                    if rec.replica == replica.name]
+            if not live:
+                return
+            if time.monotonic() > deadline:
+                raise RequestFailed(
+                    f"replica {replica.name} did not finish its "
+                    f"{len(live)} in-flight streams within "
+                    f"{self.config.weight_push_drain_timeout_s}s; "
+                    f"weight push aborted for it")
+            await asyncio.sleep(0.005)
+
     # -- lifecycle: drain & failover ------------------------------------
     async def drain_replica(self, name: str) -> None:
         """Take ``name`` out of rotation and finish its in-flight
@@ -1078,6 +1283,7 @@ class ReplicaRouter:
             return_exceptions=True)
         died = []
         for r in up:
+            self._note_weight_version(r)
             verdict, why = self._verdict(r)
             if verdict == "dead":
                 died.append(r)
@@ -1177,6 +1383,13 @@ class ReplicaRouter:
             "replica_states": {r.name: r.state for r in self.replicas},
             "suspected": dict(self._suspected),
             "last_fleet_bundle": self._last_fleet_bundle,
+            # blue/green rollout state: the fleet has converged when
+            # every up replica's version equals the target
+            "target_weight_version": self.target_weight_version,
+            "weight_updating": sorted(self._updating),
+            "replica_weight_versions": {
+                r.name: self._replica_weight_version(r)
+                for r in self.replicas},
         }
 
     # -- fleet observability surfaces -----------------------------------

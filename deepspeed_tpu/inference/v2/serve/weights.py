@@ -1,0 +1,285 @@
+"""Versioned weight payloads and zero-recompile param hot-swap.
+
+The train->serve seam of the Hybrid Engine (docs/SERVING.md § Blue/green
+weight push; docs/TRAINING.md § Hybrid engine): a training engine
+publishes its live params as a **versioned, chunked, CRC-checked**
+payload — the same frame discipline as the KV handoff (serve/handoff.py)
+— and a serving engine ingests it by **donated buffer replacement**:
+every new leaf is ``device_put`` onto the OLD leaf's sharding with the
+OLD leaf's dtype, so the swapped tree presents the exact executable
+signature (shape x dtype x sharding) every compiled serving program was
+keyed on. Steady-state recompiles across a swap are zero *by
+construction* — and pinned by the recompile watchdog in the perf gate
+(``hot_swap_steady_recompiles``) and the parity tests.
+
+Payload layout (``chunk_weight_leaves``): one HEADER chunk carrying the
+version, the leaf manifest (names / shapes / dtypes) and per-chunk
+CRC32s, then N leaf-group chunks — leaves are packed into size-capped
+buckets (``bucket_bytes``) so the publisher gathers and serializes one
+bucket at a time instead of materializing the whole model twice. Each
+chunk is an independent ``.npz`` buffer (handoff's ``_npz_chunk``), so
+retransmit is idempotent and a corrupt chunk fails TYPED at its CRC
+without touching the serving params.
+
+Leaves travel as fp32 numpy (the lossless host form of bf16/fp16 train
+params — checkpoint/state_checkpoint's ``_fetch`` convention); the
+ingest side casts to the serving dtype with the same ``jnp.asarray``
+cast a fresh engine applies at init, which is what makes post-swap
+streams bit-identical to a fresh engine built from the published
+payload (the hot-swap parity pin).
+"""
+
+import time
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+from .handoff import _chunk_crc, _npz_chunk, parse_chunk
+
+_HEADER_KIND = "weights_header"
+_CHUNK_KIND = "weights"
+
+# default leaf-group bucket: bounds how much of the model the publisher
+# holds gathered at once (and the per-frame wire unit of a remote push)
+DEFAULT_BUCKET_BYTES = 16 << 20
+
+
+def _metrics():
+    from ....telemetry import get_registry
+    reg = get_registry()
+    return (
+        reg.counter("serving_weight_update_chunks_total",
+                    "weight-payload chunks staged by serving runtimes"),
+        reg.counter("serving_weight_update_bytes_total",
+                    "serialized weight-payload bytes staged",
+                    unit="bytes"),
+    )
+
+
+def flatten_params(tree) -> Tuple[List[Tuple[str, object]], object]:
+    """Flatten a params pytree to ``([(path, leaf)], treedef)`` with the
+    checkpoint layer's stable path naming — the one key space the
+    publisher, the payload and every ingesting engine share."""
+    from ....checkpoint.state_checkpoint import _leaf_paths
+    return _leaf_paths(tree)
+
+
+def fetch_leaf(leaf) -> np.ndarray:
+    """Gather one (possibly sharded) leaf to host fp32 numpy — the
+    checkpoint layer's lossless wire form (bf16/fp16 upcast)."""
+    from ....checkpoint.state_checkpoint import _fetch
+    return _fetch(leaf)
+
+
+def plan_buckets(items: Sequence[Tuple[str, object]],
+                 bucket_bytes: int = DEFAULT_BUCKET_BYTES
+                 ) -> List[List[str]]:
+    """Group leaf names into size-capped publication buckets (fp32 host
+    bytes), preserving tree order — the gather/serialize granularity."""
+    bucket_bytes = max(int(bucket_bytes), 1)
+    buckets: List[List[str]] = []
+    cur: List[str] = []
+    cur_bytes = 0
+    for name, leaf in items:
+        nbytes = int(np.prod(getattr(leaf, "shape", ()) or (1,))) * 4
+        if cur and cur_bytes + nbytes > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(name)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def chunk_weight_leaves(groups: Iterable[Dict[str, np.ndarray]],
+                        version: int) -> List[bytes]:
+    """Serialize host leaf groups into the wire payload
+    ``[header, chunk...]``. ``groups`` yields ``{name: fp32 ndarray}``
+    dicts (one per publication bucket)."""
+    chunks: List[bytes] = []
+    crcs: List[int] = []
+    chunk_leaves: List[List[str]] = []
+    leaf_meta: Dict[str, dict] = {}
+    param_count = 0
+    for seq, group in enumerate(groups):
+        group = {k: np.ascontiguousarray(np.asarray(v, np.float32))
+                 for k, v in group.items()}
+        crc = _chunk_crc(group)
+        crcs.append(crc)
+        chunk_leaves.append(sorted(group))
+        for name, arr in group.items():
+            leaf_meta[name] = {"shape": list(arr.shape)}
+            param_count += int(arr.size)
+        chunks.append(_npz_chunk(
+            {"kind": _CHUNK_KIND, "seq": seq, "crc32": crc,
+             "version": int(version)}, group))
+    header = _npz_chunk(
+        {"kind": _HEADER_KIND, "version": int(version),
+         "n_chunks": len(chunks), "chunk_crcs": crcs,
+         "chunk_leaves": chunk_leaves, "leaf_meta": leaf_meta,
+         "param_count": param_count}, {})
+    return [header] + chunks
+
+
+def parse_weights_header(buf: bytes) -> Dict:
+    d = parse_chunk(buf)["descriptor"]
+    if d.get("kind") != _HEADER_KIND:
+        raise ValueError(
+            f"weight payload must start with the header chunk "
+            f"(got kind={d.get('kind')!r})")
+    return d
+
+
+def payload_version(payloads: Sequence[bytes]) -> int:
+    return int(parse_weights_header(payloads[0])["version"])
+
+
+def payload_bytes(payloads: Sequence[bytes]) -> int:
+    return sum(len(p) for p in payloads)
+
+
+class WeightStager:
+    """Host-side state machine for one incoming weight payload: feed
+    each chunk (CRC-checked, idempotent on retransmit), then
+    ``commit_check`` + ``flat()`` hand the complete ``{name: ndarray}``
+    map to the swap. Staging never touches the engine — the atomic
+    swap is the only loop-thread moment."""
+
+    def __init__(self, header: Dict):
+        self.header = header
+        self.version = int(header["version"])
+        self.leaves: Dict[str, np.ndarray] = {}
+        self.received: set = set()
+        self._m_chunks, self._m_bytes = _metrics()
+
+    def feed(self, chunk_buf: bytes) -> None:
+        try:
+            chunk = parse_chunk(chunk_buf)
+        except Exception as e:
+            # a corrupt buffer can die inside np.load (BadZipFile &c.)
+            # before the CRC ever runs — surface it as the same typed
+            # integrity failure so ingest verdicts stay uniform
+            raise ValueError(
+                f"weights chunk failed to parse (corrupted in "
+                f"transfer): {type(e).__name__}: {e}") from e
+        d = chunk["descriptor"]
+        if d.get("kind") != _CHUNK_KIND:
+            raise ValueError(
+                f"expected a weights chunk, got {d.get('kind')!r}")
+        seq = int(d["seq"])
+        if not 0 <= seq < int(self.header["n_chunks"]):
+            raise ValueError(
+                f"weights chunk seq {seq} outside the header's "
+                f"{self.header['n_chunks']} chunks")
+        crc = _chunk_crc(chunk["kv"])
+        if crc != int(d["crc32"]) \
+                or crc != int(self.header["chunk_crcs"][seq]):
+            raise ValueError(
+                f"weights chunk {seq} failed its crc32 integrity check "
+                f"(corrupted in transfer)")
+        if sorted(chunk["kv"]) != list(self.header["chunk_leaves"][seq]):
+            raise ValueError(
+                f"weights chunk {seq} leaf set disagrees with the "
+                f"header manifest")
+        self.leaves.update(chunk["kv"])
+        self.received.add(seq)
+        self._m_chunks.inc()
+        self._m_bytes.inc(len(chunk_buf))
+
+    def missing(self) -> List[int]:
+        return [s for s in range(int(self.header["n_chunks"]))
+                if s not in self.received]
+
+    def commit_check(self) -> None:
+        gaps = self.missing()
+        if gaps:
+            raise ValueError(
+                f"weight payload incomplete: missing chunks {gaps} of "
+                f"{self.header['n_chunks']}")
+
+
+def stage_payload(payloads: Sequence[bytes]) -> WeightStager:
+    """Parse + CRC-check a complete payload into a ready stager."""
+    stager = WeightStager(parse_weights_header(payloads[0]))
+    for chunk in payloads[1:]:
+        stager.feed(chunk)
+    stager.commit_check()
+    return stager
+
+
+def flat_to_tree(template_tree, flat: Dict[str, np.ndarray]):
+    """Rebuild a host params pytree shaped like ``template_tree`` from a
+    flat ``{path: ndarray}`` map (fresh-engine construction from a
+    published payload — the hot-swap parity reference)."""
+    import jax
+    items, treedef = flatten_params(template_tree)
+    leaves = []
+    for name, leaf in items:
+        if name not in flat:
+            raise ValueError(f"weight payload missing leaf {name!r}")
+        leaves.append(np.asarray(flat[name], np.float32))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def swap_engine_params(engine, flat: Dict[str, np.ndarray],
+                       version: int) -> None:
+    """Replace ``engine.params`` (an :class:`InferenceEngineV2`) with
+    the published leaves by donated buffer replacement: each new leaf is
+    cast to the OLD leaf's dtype and ``device_put`` onto the OLD leaf's
+    sharding, so every compiled program's executable signature is
+    unchanged — no retrace, no respecialization. Validation happens
+    BEFORE any leaf is replaced: a bad payload leaves the engine
+    serving its current version."""
+    import jax
+    import jax.numpy as jnp
+
+    if getattr(engine, "_qmeta", None) is not None:
+        raise NotImplementedError(
+            "weight hot-swap over quant_bits (WOQ) params is not "
+            "supported: the quantized leaf layout does not match the "
+            "published dense tree")
+    items, treedef = flatten_params(engine.params)
+    names = [name for name, _ in items]
+    missing = [n for n in names if n not in flat]
+    if missing:
+        raise ValueError(
+            f"weight payload missing {len(missing)} leaves "
+            f"(first: {missing[:3]}); publisher and serving engine "
+            f"must share one model structure")
+    extra = sorted(set(flat) - set(names))
+    if extra:
+        raise ValueError(
+            f"weight payload has {len(extra)} unknown leaves "
+            f"(first: {extra[:3]})")
+    for name, old in items:
+        if tuple(np.shape(flat[name])) != tuple(old.shape):
+            raise ValueError(
+                f"weight leaf {name!r} shape "
+                f"{tuple(np.shape(flat[name]))} != engine shape "
+                f"{tuple(old.shape)}")
+    t0 = time.perf_counter()
+    new_leaves = []
+    for name, old in items:
+        arr = jnp.asarray(np.asarray(flat[name]), old.dtype)
+        # replicate the OLD leaf's placement exactly: the pjit
+        # executable cache keys on committed-ness as well as sharding —
+        # committing a leaf the engine held uncommitted (a plain jit
+        # output on one device) would silently respecialize every
+        # program on its next call
+        if getattr(old, "committed", True):
+            arr = jax.device_put(arr, old.sharding)
+        new_leaves.append(arr)
+    engine.params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    engine.weight_version = int(version)
+    engine.note_weight_swap(time.perf_counter() - t0)
+
+
+def apply_payload(engine, payloads: Sequence[bytes]) -> int:
+    """Stage + swap a complete payload into ``engine`` synchronously
+    (the colocated hybrid path; serving runtimes go through
+    :meth:`~.frontend.ServingEngine.begin_weight_update` so the swap
+    lands between scheduler steps). Returns the installed version."""
+    stager = stage_payload(payloads)
+    swap_engine_params(engine, stager.leaves, stager.version)
+    return stager.version

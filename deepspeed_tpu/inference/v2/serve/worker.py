@@ -132,8 +132,10 @@ class WorkerAPI(ServingAPI):
 
     def __init__(self, replica, host: str = "127.0.0.1", port: int = 0,
                  *, resume_linger_s: float = 2.0,
-                 token_log_limit: int = 4096, resume_records: int = 256):
-        super().__init__(replica, host=host, port=port)
+                 token_log_limit: int = 4096, resume_records: int = 256,
+                 auth_token: Optional[str] = None):
+        super().__init__(replica, host=host, port=port,
+                         auth_token=auth_token)
         self.replica = replica
         self.stopped = asyncio.Event()
         self.resume_linger_s = resume_linger_s
@@ -165,6 +167,9 @@ class WorkerAPI(ServingAPI):
             return True
         if method == "POST" and target == "/handoff":
             await self._handoff(reader, writer, headers)
+            return True
+        if method == "POST" and target == "/weights":
+            await self._weights(reader, writer)
             return True
         if method == "GET" and target == "/debug/spans":
             from ....telemetry import trace
@@ -364,6 +369,75 @@ class WorkerAPI(ServingAPI):
         writer.write(_response_head("200 OK", "application/x-ndjson",
                                     extra))
         await self._serve_record(reader, writer, rec, offset)
+
+    async def _weights(self, reader, writer) -> None:
+        """Chunked weight ingest (blue/green hot-swap, serve/weights.py):
+        ``C`` frames carry the payload (header first), the terminal
+        ``P`` frame commits — chunks stage host-side while the running
+        batch keeps stepping, then ONE atomic swap lands between decode
+        steps. EOF before ``P`` aborts the staged update (the live
+        params are untouched, so retransmit is idempotent)."""
+        from .admission import OverloadedError
+
+        async def fail(status: str, obj: dict) -> None:
+            _json_response(writer, status, obj)
+            # drain in-flight client frames before the close so the
+            # verdict is not lost to a socket RST (same discipline as
+            # the handoff ingest)
+            try:
+                await asyncio.wait_for(writer.drain(), 5.0)
+                await asyncio.wait_for(reader.read(), 5.0)
+            except (OSError, asyncio.TimeoutError, ConnectionError):
+                pass
+
+        update = None
+        try:
+            while True:
+                try:
+                    kind, payload = await read_frame(reader)
+                except (asyncio.IncompleteReadError,
+                        ConnectionResetError):
+                    if update is not None:
+                        await update.abort()
+                    return
+                if kind == FRAME_CHUNK:
+                    if update is None:
+                        update = await self.replica.serving \
+                            .begin_weight_update(payload)
+                    else:
+                        await update.feed(payload)
+                elif kind == FRAME_PARAMS:
+                    break
+                else:
+                    if update is not None:
+                        await update.abort()
+                    await fail("400 Bad Request",
+                               {"ok": False, "reason": "protocol",
+                                "detail": f"unknown frame {kind!r}"})
+                    return
+            if update is None:
+                await fail("400 Bad Request",
+                           {"ok": False, "reason": "protocol",
+                            "detail": "no weight chunks before the "
+                                      "commit frame"})
+                return
+            version = await update.commit()
+        except OverloadedError as e:
+            await fail("429 Too Many Requests",
+                       {"ok": False, "reason": e.reason,
+                        "detail": str(e),
+                        "retry_after_s": e.retry_after_s})
+            return
+        except Exception as e:
+            if update is not None:
+                await update.abort()
+            await fail("400 Bad Request",
+                       {"ok": False, "reason": "error",
+                        "detail": f"{type(e).__name__}: {e}"})
+            return
+        _json_response(writer, "200 OK",
+                       {"ok": True, "version": version,
+                        "name": self.replica.name})
 
     async def _handoff(self, reader, writer, headers) -> None:
         """Chunked KV ingest (module docstring): apply frames as they
@@ -612,6 +686,11 @@ def main(argv=None) -> int:
                    help="per-request resume token-log bound (oldest "
                         "tokens trim first; a resume below the trim "
                         "point is refused typed)")
+    p.add_argument("--auth-token", default=None,
+                   help="shared-secret worker auth: every request must "
+                        "carry it in the x-ds-tpu-auth header (401 "
+                        "otherwise); default: $DS_TPU_WORKER_AUTH if "
+                        "set, else open")
     args = p.parse_args(argv)
     import jax
     if args.jax_platform:
@@ -629,12 +708,16 @@ def main(argv=None) -> int:
     else:
         spec = TINY_SPEC
 
+    from .api import AUTH_ENV
+    auth_token = args.auth_token or os.environ.get(AUTH_ENV) or None
+
     async def run() -> None:
         worker = ReplicaWorker(build_engine(spec),
                                _serving_config(spec), name=args.name,
                                host=args.host, port=args.port,
                                resume_linger_s=args.resume_linger_s,
-                               token_log_limit=args.token_log_limit)
+                               token_log_limit=args.token_log_limit,
+                               auth_token=auth_token)
         host, port = await worker.start()
         print(READY_PREFIX + json.dumps(
             {"name": args.name, "host": host, "port": port,

@@ -419,6 +419,18 @@ class HybridEngineConfig:
     release_inference_cache: bool = False
     pin_parameters: bool = True
     tp_gather_partition_size: int = 8
+    # train->serve seam (docs/TRAINING.md § Hybrid engine): publication
+    # bucket size (host bytes gathered per payload chunk — the ZeRO
+    # gather granularity and the remote push's per-frame wire unit)
+    publish_bucket_bytes: int = 16 << 20
+    # bounded rollout->training queue (oldest rollouts drop when full,
+    # counted — an RLHF actor loop must never grow host memory
+    # unboundedly behind a slow learner)
+    rollout_queue_size: int = 64
+    # overrides for the colocated serving engine the hybrid engine
+    # builds (keys: "state_manager", "engine", "serving" — the worker
+    # --spec layout); empty = geometry derived from the model config
+    serving: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass

@@ -2136,6 +2136,39 @@ class DeepSpeedTpuEngine:
         leaves, _ = _leaf_paths(self.params)
         return {key: np.asarray(_fetch(leaf)) for key, leaf in leaves}
 
+    def consolidated_param_buckets(self, bucket_bytes: int = 16 << 20):
+        """Yield the live compute params as ``{path: fp32 ndarray}``
+        groups, gathered bucket-by-bucket (size-capped on host fp32
+        bytes) — the :class:`~.hybrid_engine.WeightPublisher` feed.
+
+        ZeRO-sharded leaves materialize on host through the same fetch
+        the consolidated checkpoint uses (XLA inserts the gathers; a
+        bucket at a time bounds host memory to ``bucket_bytes`` +
+        payload). Fetching is READ-ONLY: params keep their storage
+        shardings and placement, so the compiled train step's
+        executable is untouched — publication can never respecialize
+        training (pinned by tests/unit/runtime/test_hybrid_engine.py).
+        """
+        from ..checkpoint.state_checkpoint import _fetch, _leaf_paths
+        if self.param_offload_nvme:
+            raise NotImplementedError(
+                "weight publication over the NVMe parameter tier is "
+                "not supported; use save_16bit_model")
+        if self.params is None:
+            raise RuntimeError("engine holds no live compute params")
+        bucket_bytes = max(int(bucket_bytes), 1)
+        group: Dict[str, np.ndarray] = {}
+        group_bytes = 0
+        for key, leaf in _leaf_paths(self.params)[0]:
+            nbytes = int(np.prod(leaf.shape or (1,))) * 4
+            if group and group_bytes + nbytes > bucket_bytes:
+                yield group
+                group, group_bytes = {}, 0
+            group[key] = np.asarray(_fetch(leaf), np.float32)
+            group_bytes += nbytes
+        if group:
+            yield group
+
     def save_16bit_model(self, save_dir, save_filename="pytorch_model.npz"):
         """Consolidated inference-ready weights (reference engine.py:3464
         save_16bit_model)."""

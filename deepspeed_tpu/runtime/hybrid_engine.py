@@ -1,44 +1,94 @@
-"""Hybrid engine: one set of weights for RLHF training AND fast generation.
+"""Hybrid Engine: train↔serve colocation with zero-recompile hot-swap.
 
 TPU-native analogue of the reference's DeepSpeedHybridEngine
-(runtime/hybrid_engine.py:32; generate :174, _zero3_forward :363, LoRA
-fuse/unfuse :118-160). The reference swaps module containers and gathers
-ZeRO-3 params into inference kernels before each generate; in JAX the same
-arrays back both paths for free — ``generate`` jits the KV-cache decode loop
-directly over the TRAINING params with their live shardings (XLA inserts the
-ZeRO-3 gathers where needed), and the actor's train_batch/step is inherited
-unchanged. LoRA adapters fuse into the base weights for generation and
-unfuse afterwards (pure tree transforms, no copies kept).
+(runtime/hybrid_engine.py:32 — inference v1 + RLHF): one process owns
+BOTH halves of an RLHF actor — the ZeRO-sharded bucketed train step
+(runtime/engine.py) and the paged serving engine (inference/v2) — and
+the seam between them is explicit:
+
+  * :class:`WeightPublisher` snapshots the training engine's live
+    params (ZeRO-gathered bucket-by-bucket through
+    ``engine.consolidated_param_buckets`` — the same fetch machinery
+    the consolidated checkpoint uses, read-only, so the train step's
+    executable is untouched) into a **versioned, chunked, CRC-checked
+    payload** (serve/weights.py — the KV handoff's frame discipline).
+  * The colocated serving engine ingests each publication by **donated
+    buffer replacement** between scheduler steps: every new leaf lands
+    on the old leaf's sharding/dtype, so the recompile watchdog stays
+    at zero steady-state recompiles across a swap *by construction* —
+    and post-publish streams are bit-identical to a fresh engine built
+    from the published payload (pinned by the hot-swap parity tests).
+  * :meth:`DeepSpeedHybridEngine.rollout` runs generation through the
+    serving engine's ``put()`` + the existing host sampling path
+    (``sampling.host_sample`` — the SplitFuse scheduler's exact draw
+    discipline, so rollout streams are bit-identical to served
+    streams) and feeds ``(prompt, tokens, per-token logprobs)`` into a
+    **bounded** :class:`RolloutQueue` — the actor loop is
+    train_batch → publish → rollout, one process, no recompiles.
+  * The same payload pushes to a remote fleet:
+    ``router.push_weights(engine.publish())`` runs the blue/green
+    rollout (serve/router.py) — replicas advertise ``weight_version``
+    in ``/healthz``, stale replicas drain as updated ones go live.
+
+LoRA (reference _fuse_lora/_unfuse_lora :118-160): any subtree shaped
+``{"w": [in, out], "lora_a": [in, r], "lora_b": [r, out]}`` fuses to
+``w' = w + scale * (a @ b)`` for generation. ``fuse_lora`` carries the
+pre-fuse base alongside the fused weight so ``unfuse_lora`` restores it
+BIT-EXACTLY (recomputing ``w' - scale*(a@b)`` in floating point does
+not round-trip); publication fuses adapters on the gathered host
+leaves, so the published payload is inference-ready dense weights and
+the live training params are never touched.
 """
 
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
-from ..inference.engine import generate_tokens
 from ..utils.logging import log_dist
-from ..utils.timer import SynchronizedWallClockTimer
 from .engine import DeepSpeedTpuEngine
 
+# ---------------------------------------------------------------------------
+# LoRA fuse/unfuse (reference hybrid_engine.py _fuse_lora/_unfuse_lora)
+# ---------------------------------------------------------------------------
+# pre-fuse base stashed inside a fused group: what makes unfuse a
+# bit-exact restore instead of a lossy float subtraction
+_PRE_FUSE_KEY = "lora_w_prefuse"
 
-# ---------------------------------------------------------------------------
-# LoRA fuse/unfuse (reference hybrid_engine.py _fuse_lora/_unfuse_lora):
-# any subtree shaped {"w": [in, out], "lora_a": [in, r], "lora_b": [r, out]}
-# fuses to w' = w + scale * (a @ b).
-# ---------------------------------------------------------------------------
+
 def _is_lora_group(node) -> bool:
     return (isinstance(node, dict) and "w" in node and "lora_a" in node
             and "lora_b" in node)
 
 
+def _fused_w(w, a, b, scale: float) -> np.ndarray:
+    """THE fused-weight definition (host fp32 math): every fuse path —
+    the tree transform and the publisher's flat-leaf fusion — goes
+    through this one function, so fused-vs-unfused generate parity is
+    bit-exact by construction."""
+    w32 = np.asarray(w, np.float32)
+    delta = float(scale) * (np.asarray(a, np.float32)
+                            @ np.asarray(b, np.float32))
+    return (w32 + delta).astype(np.asarray(w).dtype, copy=False)
+
+
 def fuse_lora(params, scale: float = 1.0):
+    """Fuse every LoRA group's adapters into its base weight (pure tree
+    transform). The fused group keeps the pre-fuse base under a private
+    key so :func:`unfuse_lora` restores it bit-exactly; fusing an
+    already-fused group is a no-op."""
+    import jax.numpy as jnp
+
     def walk(node):
         if _is_lora_group(node):
+            if _PRE_FUSE_KEY in node:
+                return dict(node)
             new = dict(node)
-            new["w"] = node["w"] + scale * (
-                node["lora_a"] @ node["lora_b"]).astype(node["w"].dtype)
+            new[_PRE_FUSE_KEY] = node["w"]
+            new["w"] = jnp.asarray(
+                _fused_w(node["w"], node["lora_a"], node["lora_b"],
+                         scale),
+                jnp.asarray(node["w"]).dtype)
             return new
         if isinstance(node, dict):
             return {k: walk(v) for k, v in node.items()}
@@ -48,28 +98,337 @@ def fuse_lora(params, scale: float = 1.0):
 
 
 def unfuse_lora(params, scale: float = 1.0):
-    return fuse_lora(params, -scale)
+    """Restore every fused group's base weight. Fused groups carry
+    their pre-fuse base (bit-exact restore); a group fused by older
+    code without the stash falls back to the reference's float
+    subtraction."""
+    import jax.numpy as jnp
+
+    def walk(node):
+        if _is_lora_group(node):
+            new = dict(node)
+            if _PRE_FUSE_KEY in new:
+                new["w"] = new.pop(_PRE_FUSE_KEY)
+            else:
+                new["w"] = jnp.asarray(
+                    _fused_w(node["w"], node["lora_a"], node["lora_b"],
+                             -scale),
+                    jnp.asarray(node["w"]).dtype)
+            return new
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(params)
 
 
+def _lora_groups_flat(names: Iterable[str]) -> Dict[str, Dict[str, str]]:
+    """Detect LoRA groups in FLAT leaf-path space: ``{prefix: {"w":
+    path, "a": path, "b": path}}`` for every complete
+    ``prefix/{w,lora_a,lora_b}`` triple."""
+    groups: Dict[str, Dict[str, str]] = {}
+    for n in names:
+        head, _, tail = n.rpartition("/")
+        key = {"w": "w", "lora_a": "a", "lora_b": "b"}.get(tail)
+        if key is not None:
+            groups.setdefault(head, {})[key] = n
+    return {p: g for p, g in groups.items()
+            if set(g) == {"w", "a", "b"}}
+
+
+def fuse_flat_leaves(flat: Dict[str, np.ndarray], scale: float = 1.0,
+                     adapters: Optional[Dict[str, Tuple[np.ndarray,
+                                                        np.ndarray]]]
+                     = None) -> Dict[str, np.ndarray]:
+    """Host-side fusion over published flat leaves: every in-tree LoRA
+    group's ``w`` is replaced by its fused form (adapter leaves stay —
+    the serving tree structurally matches the training tree), and every
+    EXTERNAL adapter (``{leaf_path: (a, b)}`` — hybrid-level adapters
+    that are not part of the param tree) fuses into its named leaf."""
+    out = dict(flat)
+    for prefix, g in _lora_groups_flat(flat).items():
+        out[g["w"]] = _fused_w(flat[g["w"]], flat[g["a"]],
+                               flat[g["b"]], scale)
+    for name, (a, b) in (adapters or {}).items():
+        if name not in out:
+            raise ValueError(
+                f"external LoRA adapter targets unknown leaf {name!r}")
+        out[name] = _fused_w(out[name], a, b, scale)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Weight publication (training side of serve/weights.py)
+# ---------------------------------------------------------------------------
+class WeightPublisher:
+    """Versioned snapshots of a training engine's live params.
+
+    ``source`` is a :class:`~.engine.DeepSpeedTpuEngine` (gathered
+    bucket-by-bucket through ``consolidated_param_buckets``) or any
+    params pytree (tests, externally-held weights). Each
+    :meth:`snapshot` bumps the version and returns the chunked payload
+    (``[header, chunk...]`` — serve/weights.py) that
+    ``ServingEngine.apply_weights`` / ``router.push_weights`` ingest.
+    """
+
+    def __init__(self, source, bucket_bytes: int = 16 << 20,
+                 lora_scale: float = 1.0):
+        self.source = source
+        self.bucket_bytes = max(int(bucket_bytes), 1)
+        self.lora_scale = float(lora_scale)
+        self.version = 0
+        from ..telemetry import get_registry
+        reg = get_registry()
+        self._m_publishes = reg.counter(
+            "training_weight_publishes_total",
+            "weight snapshots published by the training engine")
+        self._m_publish_time = reg.histogram(
+            "training_weight_publish_seconds",
+            "gather + serialize time of one weight publication",
+            unit="s", buckets=(1e-2, 0.1, 1.0, 10.0, 60.0, 600.0))
+        self._m_publish_bytes = reg.counter(
+            "training_weight_publish_bytes_total",
+            "serialized weight-payload bytes published", unit="bytes")
+        self._m_version = reg.gauge(
+            "training_weight_version",
+            "version of the newest published weight snapshot")
+
+    def _iter_buckets(self) -> Iterable[Dict[str, np.ndarray]]:
+        src = self.source
+        if hasattr(src, "consolidated_param_buckets"):
+            yield from src.consolidated_param_buckets(self.bucket_bytes)
+            return
+        from ..inference.v2.serve import weights as serve_weights
+        items, _ = serve_weights.flatten_params(src)
+        for names in serve_weights.plan_buckets(items,
+                                                self.bucket_bytes):
+            leaves = dict(items)
+            yield {n: serve_weights.fetch_leaf(leaves[n]) for n in names}
+
+    def snapshot(self, fuse_lora: bool = False,
+                 lora_scale: Optional[float] = None,
+                 adapters: Optional[Dict[str, Tuple[np.ndarray,
+                                                    np.ndarray]]] = None
+                 ) -> List[bytes]:
+        """Gather + serialize one publication; returns the payload.
+
+        ``fuse_lora=True`` (or external ``adapters``) fuses adapters
+        into their base weights on the gathered HOST leaves — the live
+        training params are never modified, so there is nothing to
+        unfuse and the training executable cannot respecialize."""
+        from ..inference.v2.serve import weights as serve_weights
+        from ..telemetry import recorder as flight
+        t0 = time.perf_counter()
+        self.version += 1
+        scale = self.lora_scale if lora_scale is None else float(
+            lora_scale)
+        if fuse_lora or adapters:
+            # fusion needs whole groups (and external adapters their
+            # target leaf), so the fused publication stages the full
+            # flat map before chunking
+            flat: Dict[str, np.ndarray] = {}
+            for group in self._iter_buckets():
+                flat.update(group)
+            fused = fuse_flat_leaves(flat, scale, adapters)
+            items = list(fused.items())
+            buckets = serve_weights.plan_buckets(items,
+                                                 self.bucket_bytes)
+            groups = ({n: fused[n] for n in names} for names in buckets)
+            payloads = serve_weights.chunk_weight_leaves(
+                groups, self.version)
+        else:
+            payloads = serve_weights.chunk_weight_leaves(
+                self._iter_buckets(), self.version)
+        dt = time.perf_counter() - t0
+        nbytes = serve_weights.payload_bytes(payloads)
+        self._m_publishes.inc()
+        self._m_publish_time.observe(dt)
+        self._m_publish_bytes.inc(nbytes)
+        self._m_version.set(self.version)
+        flight.record("weight_publish", version=self.version,
+                      bytes=nbytes, chunks=len(payloads) - 1,
+                      fused=bool(fuse_lora or adapters),
+                      dur_s=round(dt, 4))
+        return payloads
+
+
+# ---------------------------------------------------------------------------
+# Rollouts (serving -> training direction of the seam)
+# ---------------------------------------------------------------------------
+class RolloutSample:
+    """One generated rollout: the RLHF actor-loop unit."""
+
+    __slots__ = ("prompt", "tokens", "logprobs", "weight_version",
+                 "seed")
+
+    def __init__(self, prompt: List[int], tokens: List[int],
+                 logprobs: List[float], weight_version: int,
+                 seed: Optional[int]):
+        self.prompt = prompt
+        self.tokens = tokens
+        self.logprobs = logprobs
+        self.weight_version = weight_version
+        self.seed = seed
+
+
+class RolloutQueue:
+    """Bounded rollout->training queue: oldest samples drop (counted)
+    when the learner falls behind — host memory never grows unboundedly
+    behind a slow train step."""
+
+    def __init__(self, maxlen: int = 64):
+        import collections
+        import threading
+        self.maxlen = max(int(maxlen), 1)
+        self._q: "collections.deque" = collections.deque()
+        self._lock = threading.Lock()
+        from ..telemetry import get_registry
+        reg = get_registry()
+        self._m_depth = reg.gauge(
+            "hybrid_rollout_queue_depth",
+            "rollouts waiting in the bounded training queue")
+        self._m_dropped = reg.counter(
+            "hybrid_rollout_queue_dropped_total",
+            "rollouts dropped oldest-first because the bounded queue "
+            "was full (the learner fell behind the actor)")
+
+    def push(self, sample: RolloutSample) -> None:
+        with self._lock:
+            self._q.append(sample)
+            while len(self._q) > self.maxlen:
+                self._q.popleft()
+                self._m_dropped.inc()
+            self._m_depth.set(len(self._q))
+
+    def pop(self, n: int = 1) -> List[RolloutSample]:
+        """Up to ``n`` oldest samples (the next training micro-batch)."""
+        out: List[RolloutSample] = []
+        with self._lock:
+            while self._q and len(out) < n:
+                out.append(self._q.popleft())
+            self._m_depth.set(len(self._q))
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+
+def _host_logprob(logits: np.ndarray, token: int) -> float:
+    """log softmax(logits)[token], numerically stable host math — the
+    per-token policy logprob the rollout records."""
+    x = np.asarray(logits, np.float32)
+    m = float(x.max())
+    return float(x[token] - (m + np.log(np.exp(x - m).sum())))
+
+
+# ---------------------------------------------------------------------------
+# The hybrid engine
+# ---------------------------------------------------------------------------
 class DeepSpeedHybridEngine(DeepSpeedTpuEngine):
-    """Training engine + inference-speed generate on shared weights."""
+    """Training engine + colocated paged serving engine on published
+    weights (module docstring). Built by ``deepspeed_tpu.initialize``
+    when the config has ``hybrid_engine.enabled``."""
 
-    def __init__(self, *args, lora_scale: float = 1.0, **kwargs):
+    def __init__(self, *args, lora_scale: float = 1.0,
+                 serving_model=None, **kwargs):
         super().__init__(*args, **kwargs)
-        assert hasattr(self.model, "forward_cached") and \
-            hasattr(self.model, "init_kv_cache"), \
-            "hybrid engine needs a model with a KV-cache decode path " \
-            "(forward_cached/init_kv_cache)"
-        self.lora_scale = lora_scale
-        self._gen_jit_cache: Dict[Any, Any] = {}
-        self._gen_timer = SynchronizedWallClockTimer()
-        self.latency_stats = {"generate_calls": 0, "generate_seconds": 0.0,
+        hy = self.config.hybrid_engine
+        self.lora_scale = float(lora_scale)
+        # external adapters ({flat leaf path: (lora_a, lora_b)} host
+        # arrays): fused into the named leaves at publish time
+        self.lora_adapters: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        self.publisher = WeightPublisher(
+            self, bucket_bytes=hy.publish_bucket_bytes,
+            lora_scale=lora_scale)
+        self.rollout_queue = RolloutQueue(hy.rollout_queue_size)
+        self._serving_model = serving_model
+        self._serving = None
+        self._published_at: Optional[Tuple[int, int]] = None
+        self._rollout_uid = 1 << 20   # clear of serving-runtime uids
+        self.latency_stats = {"generate_calls": 0,
+                              "generate_seconds": 0.0,
                               "generated_tokens": 0}
-        log_dist("hybrid engine ready (shared train/generate weights)",
-                 ranks=[0])
+        from ..telemetry import get_registry
+        reg = get_registry()
+        self._m_rollouts = reg.counter(
+            "hybrid_rollouts_total",
+            "rollouts generated by the hybrid engine's serving half")
+        self._m_rollout_tokens = reg.counter(
+            "hybrid_rollout_tokens_total",
+            "tokens generated across hybrid rollouts")
+        log_dist("hybrid engine ready (train step + paged serving on "
+                 "published weights)", ranks=[0])
 
-    def _has_lora(self) -> bool:
-        found = []
+    # -- the colocated serving engine -----------------------------------
+    @property
+    def weight_version(self) -> int:
+        return self.publisher.version
+
+    @property
+    def serving_engine(self):
+        """The colocated :class:`InferenceEngineV2` (built on first
+        use, always serving the newest publication)."""
+        self._ensure_current()
+        return self._serving
+
+    def _serving_spec(self) -> Dict[str, Dict[str, Any]]:
+        cfg = getattr(self.model, "cfg", None)
+        assert cfg is not None, \
+            "hybrid engine needs an inference/v2-capable model (a " \
+            "TransformerLM-style .cfg); pass serving_model= for " \
+            "custom models"
+        overrides = dict(self.config.hybrid_engine.serving or {})
+        sm = {"max_tracked_sequences": 8,
+              "max_seq_len": int(cfg.max_seq_len), "block_size": 16}
+        sm["num_blocks"] = (sm["max_tracked_sequences"]
+                            * -(-sm["max_seq_len"] // sm["block_size"])
+                            + 1)
+        sm.update(overrides.get("state_manager", {}))
+        eng = {"dtype": self.ds_config.precision_dtype,
+               "prefill_bucket": 16}
+        eng.update(overrides.get("engine", {}))
+        return {"state_manager": sm, "engine": eng}
+
+    def _build_serving(self, payloads: List[bytes]):
+        import jax
+
+        from ..inference.v2 import (InferenceEngineV2,
+                                    RaggedInferenceEngineConfig)
+        from ..inference.v2.config_v2 import DSStateManagerConfig
+        from ..inference.v2.serve import weights as serve_weights
+        spec = self._serving_spec()
+        model = self._serving_model
+        if model is None:
+            # a FRESH model instance: the serving engine binds its own
+            # (tp=1, ep=1) topology — sharing the training model object
+            # would clobber the train mesh topology it carries
+            model = type(self.model)(self.model.cfg)
+        stager = serve_weights.stage_payload(payloads)
+        shapes = jax.eval_shape(model.init_params,
+                                jax.random.PRNGKey(0))
+        host_tree = serve_weights.flat_to_tree(shapes, stager.leaves)
+        engine = InferenceEngineV2(
+            model, RaggedInferenceEngineConfig(
+                state_manager=DSStateManagerConfig(
+                    **spec["state_manager"]),
+                **spec["engine"]),
+            params=host_tree)
+        engine.weight_version = stager.version
+        return engine
+
+    def _ensure_current(self) -> None:
+        """Publish-on-demand: the serving engine always generates with
+        the CURRENT training weights (the reference generate()
+        contract) — stale publications re-publish, missing serving
+        engines build from the newest payload."""
+        stamp = (self.global_steps, self.micro_steps)
+        if self._published_at != stamp or self._serving is None:
+            self.publish()
+
+    # -- publication -----------------------------------------------------
+    def has_lora(self) -> bool:
+        found: List[bool] = []
 
         def walk(node):
             if _is_lora_group(node):
@@ -78,47 +437,137 @@ class DeepSpeedHybridEngine(DeepSpeedTpuEngine):
                 for v in node.values():
                     walk(v)
 
-        walk(self.params)
-        return bool(found)
+        walk(self.params if isinstance(self.params, dict) else {})
+        return bool(found) or bool(self.lora_adapters)
 
+    def publish(self, fuse_lora: Optional[bool] = None) -> List[bytes]:
+        """Snapshot the live training params into a versioned payload,
+        install it on the colocated serving engine (atomic swap — zero
+        recompiles), and return it for fleet distribution
+        (``router.push_weights``). ``fuse_lora`` defaults to auto:
+        fused whenever the params carry LoRA groups or external
+        adapters are attached."""
+        from ..inference.v2.serve import weights as serve_weights
+        if fuse_lora is None:
+            fuse_lora = self.has_lora()
+        payloads = self.publisher.snapshot(
+            fuse_lora=fuse_lora,
+            adapters=(self.lora_adapters or None) if fuse_lora
+            else None)
+        self._published_at = (self.global_steps, self.micro_steps)
+        # the payload is NOT retained here (a fp32 serialized copy of
+        # the whole model would double host footprint): the serving
+        # engine holds the installed weights, the caller holds the
+        # returned payload for fleet distribution, and the router
+        # caches its own copy for scale-up sync
+        if self._serving is None:
+            self._serving = self._build_serving(payloads)
+        else:
+            serve_weights.apply_payload(self._serving, payloads)
+        return payloads
+
+    # -- generation (reference hybrid_engine.generate :174) -------------
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 0.0,
                  eos_token_id: Optional[int] = None, seed: int = 0,
                  **_kw) -> np.ndarray:
-        """Reference hybrid_engine.generate (:174): runs generation with the
-        CURRENT training weights (post-update actor), returning
-        [B, prompt+new] ids."""
+        """Generate with the CURRENT training weights through the paged
+        serving engine (engine_v2 — fused decode windows, paged KV,
+        device-side sampling), returning ``[B, prompt+new]`` ids.
+        Re-publishes automatically after training steps; repeated calls
+        at one version never recompile (the swap preserves every
+        executable signature)."""
+        self._ensure_current()
         ids = np.asarray(input_ids)
         if ids.ndim == 1:
             ids = ids[None]
-        eos = -1 if eos_token_id is None else int(eos_token_id)
-        key = (ids.shape, int(max_new_tokens), float(temperature),
-               int(top_k), float(top_p), eos, self._has_lora())
-        if key not in self._gen_jit_cache:
-            fuse = self._has_lora()
-            scale = self.lora_scale
-            model, dtype = self.model, self.compute_dtype
-
-            def gen(params, ids, rng):
-                if fuse:  # fuse adapters for the decode loop only
-                    params = fuse_lora(params, scale)
-                return generate_tokens(
-                    model, params, ids, rng, dtype,
-                    max_new_tokens=int(max_new_tokens),
-                    temperature=float(temperature), top_k=int(top_k),
-                    top_p=float(top_p), eos=eos)
-
-            self._gen_jit_cache[key] = jax.jit(gen)
-        self._gen_timer("generate").start()
-        toks = self._gen_jit_cache[key](
-            self.params, jnp.asarray(ids), jax.random.PRNGKey(seed))
-        toks = np.asarray(jax.block_until_ready(toks))
-        self._gen_timer("generate").stop()
+        t0 = time.perf_counter()
+        outs = self._serving.generate(
+            [list(map(int, row)) for row in ids],
+            max_new_tokens=int(max_new_tokens),
+            eos_token_id=eos_token_id,
+            temperature=float(temperature),
+            top_p=float(top_p) if top_p > 0 else 1.0,
+            top_k=int(top_k), seed=int(seed))
+        dt = time.perf_counter() - t0
+        width = ids.shape[1] + int(max_new_tokens)
+        pad = eos_token_id if eos_token_id is not None else 0
+        full = np.full((len(outs), width), pad, ids.dtype)
+        generated = 0
+        for i, row in enumerate(outs):
+            row = np.asarray(row)[:width]
+            full[i, :len(row)] = row
+            generated += len(row) - ids.shape[1]
         self.latency_stats["generate_calls"] += 1
-        self.latency_stats["generate_seconds"] += \
-            self._gen_timer("generate").elapsed(reset=True)
-        self.latency_stats["generated_tokens"] += int(toks.size)
-        return np.concatenate([ids, toks], axis=1)
+        self.latency_stats["generate_seconds"] += dt
+        self.latency_stats["generated_tokens"] += int(generated)
+        return full
+
+    # -- rollouts (serving -> training) ----------------------------------
+    def rollout(self, prompts: Sequence[Sequence[int]],
+                max_new_tokens: int = 32, temperature: float = 0.0,
+                top_p: float = 1.0, top_k: int = 0,
+                seed: Optional[int] = 0,
+                eos_token_id: Optional[int] = None,
+                enqueue: bool = True) -> List[RolloutSample]:
+        """Generate rollouts and feed the bounded training queue.
+
+        Tokens come from the serving engine's ``put()`` logits sampled
+        with ``sampling.host_sample`` under a per-prompt
+        ``np.random.default_rng`` — EXACTLY the SplitFuse scheduler's
+        draw discipline, so a rollout's stream is bit-identical to the
+        same request served through the async runtime (parity-pinned).
+        Per-token logprobs are the policy log-softmax of each sampled
+        token, computed from the same logits that sampled it."""
+        from ..inference.v2.sampling import host_sample
+        self._ensure_current()
+        eng = self._serving
+        samples: List[RolloutSample] = []
+        for row_i, prompt in enumerate(prompts):
+            prompt = list(map(int, prompt))
+            row_seed = None if seed is None else int(seed) + row_i
+            rng = np.random.default_rng(row_seed)
+            uid = self._rollout_uid
+            self._rollout_uid += 1
+            toks: List[int] = []
+            lps: List[float] = []
+            logits = np.asarray(
+                eng.put([uid], [np.asarray(prompt, np.int64)])[0],
+                np.float32)
+            try:
+                for i in range(int(max_new_tokens)):
+                    tok = int(host_sample(logits, rng, temperature,
+                                          top_p, top_k))
+                    toks.append(tok)
+                    lps.append(_host_logprob(logits, tok))
+                    if eos_token_id is not None and tok == eos_token_id:
+                        break
+                    if i + 1 < int(max_new_tokens):
+                        logits = np.asarray(
+                            eng.put([uid], [[tok]])[0], np.float32)
+            finally:
+                eng.flush(uid)
+            sample = RolloutSample(prompt, toks, lps,
+                                   self.weight_version, row_seed)
+            samples.append(sample)
+            if enqueue:
+                self.rollout_queue.push(sample)
+            self._m_rollouts.inc()
+            self._m_rollout_tokens.inc(len(toks))
+        return samples
+
+    # -- misc ------------------------------------------------------------
+    def attach_lora_adapter(self, leaf_path: str, lora_a, lora_b) -> None:
+        """Register an external adapter for ``leaf_path`` (a flat param
+        path — see serve/weights.py ``flatten_params``): publication
+        fuses it into that leaf (``publish(fuse_lora=True)`` or auto)."""
+        self.lora_adapters[str(leaf_path)] = (
+            np.asarray(lora_a, np.float32),
+            np.asarray(lora_b, np.float32))
+        # adapters change the published weights: the next generate()
+        # must republish even though no train step ran
+        self._published_at = None
 
     def eval(self):
         return self

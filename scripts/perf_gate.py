@@ -767,6 +767,110 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
 
         metrics.update(_chaos_gate())
 
+        # -- hybrid engine: zero-recompile weight hot-swap (ISSUE 15) ------
+        # a published payload swapped into a double-warmed serving
+        # replica must not retrace ANY program (same shapes/dtypes/
+        # shardings by construction — hot_swap_steady_recompiles), and
+        # staging a chunked publication must overlap the running batch
+        # exactly like handoff chunks (weight_publish_decode_stall_
+        # fraction: inter-feed windows in which the loop could not
+        # step; only the final atomic swap lands between steps)
+        def _hybrid_gate():
+            import asyncio
+
+            from deepspeed_tpu.inference.v2.serve import (Replica,
+                                                          ServingConfig)
+            from deepspeed_tpu.runtime.hybrid_engine import \
+                WeightPublisher
+
+            params_v1 = jax.tree.map(
+                lambda x: x.astype(jnp.float32),
+                model.init_params(jax.random.PRNGKey(9)))
+
+            async def run():
+                out = {}
+                replica = Replica("gate-hybrid0",
+                                  _router_engines(1)[0],
+                                  ServingConfig(token_budget=24,
+                                                chunk=16))
+                await replica.start()
+
+                async def wave():
+                    for p in shared_prompts:
+                        stream = await replica.submit(p, 2)
+                        await stream.drain()
+
+                await wave()
+                await wave()     # double warm (bucket respecialization)
+                payloads = WeightPublisher(params_v1).snapshot()
+                st0 = fam_total("xla_steady_state_recompiles_total")
+                watchdog.mark_steady(True)
+                try:
+                    await replica.apply_weights(payloads)
+                    await wave()
+                finally:
+                    watchdog.mark_steady(False)
+                out["hot_swap_steady_recompiles"] = \
+                    fam_total("xla_steady_state_recompiles_total") - st0
+
+                # publication/decode overlap with a live victim batch
+                # (same probe shape as the chunked-handoff stall gate)
+                many = WeightPublisher(
+                    params_v1, bucket_bytes=1 << 14).snapshot()
+                loop_runner = replica.serving.loop_runner
+                rng = __import__("numpy").random.default_rng(5)
+
+                async def new_victim():
+                    v = await replica.submit(
+                        list(map(int, rng.integers(1, 127, 8))), 56)
+                    return v, asyncio.ensure_future(v.drain())
+
+                victim, drainer = await new_victim()
+                update = await replica.serving.begin_weight_update(
+                    many[0])
+                stalled = 0
+                for chunk in many[1:]:
+                    if drainer.done():
+                        victim, drainer = await new_victim()
+                    before = loop_runner.steps_done
+                    deadline = _time.monotonic() + 5.0
+                    while (loop_runner.steps_done == before
+                           and not drainer.done()):
+                        if _time.monotonic() > deadline:
+                            stalled += 1
+                            break
+                        await asyncio.sleep(0.002)
+                    await update.feed(chunk)
+                windows = max(len(many) - 1, 1)
+                out["weight_publish_decode_stall_fraction"] = \
+                    stalled / windows
+                await update.commit()
+                await victim.cancel()
+                with __import__("contextlib").suppress(Exception):
+                    await drainer
+                await replica.stop()
+                return out
+
+            return asyncio.run(run())
+
+        metrics.update(_hybrid_gate())
+
+        # -- rollout-queue push/pop cost (the hybrid actor loop's
+        # bounded serving->training queue; abs-tol pinned like
+        # recorder_ns_per_event)
+        from deepspeed_tpu.runtime.hybrid_engine import (RolloutQueue,
+                                                         RolloutSample)
+        rq = RolloutQueue(maxlen=256)
+        n = 20000
+        t0 = _time.perf_counter()
+        for i in range(n):
+            rq.push(RolloutSample([1, 2, 3], [4, 5], [-0.1, -0.2],
+                                  1, i))
+            if i % 4 == 3:
+                rq.pop(4)
+        metrics["rollout_queue_ns_per_item"] = (
+            (_time.perf_counter() - t0) / n * 1e9)
+
         # -- flight-recorder record() cost ---------------------------------
         bench_rec = FlightRecorder()
         prev_bench = set_recorder(bench_rec)

@@ -1,0 +1,417 @@
+"""Hybrid-engine serving seam: weight hot-swap + blue/green rollout
+(serve/weights.py, router.push_weights, worker POST /weights).
+
+Pinned contracts (ISSUE 15 acceptance):
+  * HOT-SWAP PARITY — after a payload swaps into a warmed serving
+    runtime, routed streams (greedy AND seeded sampling) are
+    bit-identical to a fresh engine built from the published payload,
+    with ZERO steady-state recompiles across the swap (same shapes /
+    dtypes / shardings => no retrace by construction).
+  * BLUE/GREEN E2E — the router converges a 2-replica fleet onto the
+    target ``weight_version`` with zero dropped requests: in-flight
+    streams complete bit-identically on their ORIGINAL version, new
+    dispatches land only on the target version once one replica has
+    it.
+  * CHAOS — a push under injected latency/resets (the PR 14 fault
+    plane) still converges, every request completing bit-identical on
+    SOME version or failing typed — never a mid-stream version flip.
+  * AUTH — a worker built with a shared secret 401s anything missing
+    the ``x-ds-tpu-auth`` header; RemoteReplica sends it on every hop.
+  * SCALE-UP SYNC — a replica added after a push receives the cached
+    payload before taking traffic (live version, not boot checkpoint).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+from deepspeed_tpu.inference.v2.serve import (Autoscaler,
+                                              AutoscalerConfig,
+                                              FaultPlane, FaultSpec,
+                                              RemoteReplica, Replica,
+                                              ReplicaRouter,
+                                              ReplicaWorker,
+                                              RouterConfig,
+                                              ServingConfig,
+                                              ServingEngine, weights)
+from deepspeed_tpu.runtime.hybrid_engine import WeightPublisher
+from deepspeed_tpu.telemetry import get_registry, watchdog
+
+
+@pytest.fixture(scope="module")
+def model_and_params(tiny_model_256):
+    return tiny_model_256
+
+
+@pytest.fixture(scope="module")
+def alt_params(model_and_params):
+    """A second weight set (different init seed): the 'new version'."""
+    import jax.numpy as jnp
+    model, _ = model_and_params
+    return jax.tree.map(lambda x: x.astype(jnp.float32),
+                        model.init_params(jax.random.PRNGKey(7)))
+
+
+@pytest.fixture(scope="module")
+def alt_payloads(alt_params):
+    return WeightPublisher(alt_params).snapshot()
+
+
+def _engine(model, params):
+    return InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_tracked_sequences=8, max_seq_len=256, num_blocks=65,
+                block_size=16, max_ragged_batch_size=512),
+            dtype="float32", prefill_bucket=16), params=params)
+
+
+def _cfg(**kw):
+    kw.setdefault("token_budget", 64)
+    kw.setdefault("chunk", 16)
+    return ServingConfig(**kw)
+
+
+def _prompts(ns, seed=0):
+    rng = np.random.default_rng(seed)
+    return [list(map(int, rng.integers(1, 127, n))) for n in ns]
+
+
+_REQ_KW = [dict(temperature=0.0), dict(temperature=0.8, top_p=0.9,
+                                       seed=11)]
+
+
+async def _reference_streams(model, params_or_payloads, prompts, kws,
+                             max_new=8):
+    """Streams from a FRESH engine (params tree, or a payload — the
+    'engine built from the published checkpoint' reference)."""
+    if isinstance(params_or_payloads, list):
+        stager = weights.stage_payload(params_or_payloads)
+        shapes = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
+        params = weights.flat_to_tree(shapes, stager.leaves)
+    else:
+        params = params_or_payloads
+    serving = ServingEngine(_engine(model, params), _cfg())
+    await serving.start()
+    try:
+        outs = []
+        for p, kw in zip(prompts, kws):
+            s = await serving.submit(p, max_new, **kw)
+            outs.append(await s.drain())
+        return outs
+    finally:
+        await serving.stop()
+
+
+def _fam_total(name):
+    reg = get_registry()
+    fam = reg.get(name)
+    return sum(s.value for _, s in fam.series()) if fam else 0.0
+
+
+# ---------------------------------------------------------------------------
+# hot-swap parity + zero recompiles
+# ---------------------------------------------------------------------------
+def test_hot_swap_parity_zero_recompiles(model_and_params, alt_params,
+                                         alt_payloads):
+    model, params = model_and_params
+    prompts = _prompts((20, 9))
+
+    async def run():
+        refs = await _reference_streams(model, alt_payloads, prompts,
+                                        _REQ_KW)
+        serving = ServingEngine(_engine(model, params), _cfg())
+        await serving.start()
+        try:
+            # double warm (bucket respecialization discipline)
+            for _ in range(2):
+                for p, kw in zip(prompts, _REQ_KW):
+                    s = await serving.submit(p, 8, **kw)
+                    await s.drain()
+            st0 = _fam_total("xla_steady_state_recompiles_total")
+            watchdog.mark_steady(True)
+            try:
+                version = await serving.apply_weights(alt_payloads)
+                outs = []
+                # sequential submits: bucket composition stays exactly
+                # what the warm waves compiled (concurrent arrivals
+                # compose timing-dependent ragged batches)
+                for p, kw in zip(prompts, _REQ_KW):
+                    s = await serving.submit(p, 8, **kw)
+                    outs.append(await s.drain())
+            finally:
+                watchdog.mark_steady(False)
+            steady = _fam_total(
+                "xla_steady_state_recompiles_total") - st0
+            return version, outs, steady
+        finally:
+            await serving.stop()
+
+    version, outs, steady = asyncio.run(run())
+    assert version == 1
+    assert steady == 0, "hot swap must not retrace any program"
+    ref_version_streams = asyncio.run(_reference_streams(
+        model, alt_payloads, prompts, _REQ_KW))
+    assert outs == ref_version_streams, \
+        "post-swap streams must be bit-identical to a fresh engine " \
+        "built from the published payload"
+
+
+def test_corrupt_payload_typed_and_params_untouched(model_and_params,
+                                                    alt_payloads):
+    model, params = model_and_params
+    prompts = _prompts((12,))
+
+    async def run():
+        serving = ServingEngine(_engine(model, params), _cfg())
+        await serving.start()
+        try:
+            s = await serving.submit(prompts[0], 6)
+            before = await s.drain()
+            bad = list(alt_payloads)
+            blob = bytearray(bad[1])
+            blob[len(blob) // 2] ^= 0xFF
+            bad[1] = bytes(blob)
+            with pytest.raises(ValueError, match="crc32|integrity|"
+                                                 "load|failed"):
+                await serving.apply_weights(bad)
+            assert serving.weight_version == 0
+            s = await serving.submit(prompts[0], 6)
+            after = await s.drain()
+            return before, after
+        finally:
+            await serving.stop()
+
+    before, after = asyncio.run(run())
+    assert before == after, "a rejected payload must leave the live " \
+                            "params serving unchanged"
+
+
+# ---------------------------------------------------------------------------
+# blue/green fleet rollout
+# ---------------------------------------------------------------------------
+def test_blue_green_convergence_zero_drops(model_and_params, alt_params,
+                                           alt_payloads):
+    model, params = model_and_params
+    prompts = _prompts((18, 7, 25, 11), seed=3)
+    kws = [dict(temperature=0.0), dict(temperature=0.8, top_p=0.9,
+                                       seed=5)] * 2
+
+    async def run():
+        replicas = [Replica(f"bg{i}", _engine(model, params), _cfg())
+                    for i in range(2)]
+        router = ReplicaRouter(replicas,
+                               RouterConfig(monitor_interval_s=0.0))
+        await router.start()
+        try:
+            # in-flight streams on v0, still decoding when the push
+            # starts — they must finish on v0
+            inflight = [await router.submit(p, 16, **kw)
+                        for p, kw in zip(prompts, kws)]
+            push = asyncio.ensure_future(
+                router.push_weights(alt_payloads))
+            inflight_outs = [await s.drain() for s in inflight]
+            version = await push
+            statusz = router.router_statusz()
+            # post-push traffic lands on the target version everywhere
+            post = [await router.submit(p, 8, **kw)
+                    for p, kw in zip(prompts[:2], kws[:2])]
+            post_outs = [await s.drain() for s in post]
+            statuses = [s.status for s in inflight + post]
+            return (version, inflight_outs, post_outs, statuses,
+                    statusz, [r.weight_version for r in replicas])
+        finally:
+            await router.stop()
+
+    (version, inflight_outs, post_outs, statuses, statusz,
+     versions) = asyncio.run(run())
+    assert version == 1 and versions == [1, 1]
+    assert statusz["target_weight_version"] == 1
+    assert statusz["replica_weight_versions"] == {"bg0": 1, "bg1": 1}
+    assert statuses == ["completed"] * 6, "zero dropped requests"
+    refs_v0 = asyncio.run(_reference_streams(
+        model, params, prompts, kws, max_new=16))
+    assert inflight_outs == refs_v0, \
+        "in-flight streams must complete on their ORIGINAL version"
+    refs_v1 = asyncio.run(_reference_streams(
+        model, alt_payloads, prompts[:2], kws[:2]))
+    assert post_outs == refs_v1, \
+        "new dispatches must land on the target version"
+
+
+def test_blue_green_under_chaos(model_and_params, alt_params,
+                                alt_payloads):
+    """A push while the fault plane injects resets + latency must still
+    converge, with every request bit-identical on some version or
+    failing typed — never a mid-stream version flip."""
+    model, params = model_and_params
+    prompts = _prompts((14, 8, 21), seed=9)
+    kws = [dict(temperature=0.0), dict(temperature=0.7, top_p=0.9,
+                                       seed=3), dict(temperature=0.0)]
+
+    async def run():
+        planes = [FaultPlane(), FaultPlane()]
+        # every other /weights dial resets (the retry layer must
+        # retransmit the idempotent transfer), plus dial latency
+        for plane in planes:
+            plane.script(FaultSpec(kind="reset", op="connect",
+                                   target="/weights", skip=0, every=2,
+                                   times=2))
+            plane.script(FaultSpec(kind="latency", op="connect",
+                                   target="/weights", delay_s=0.02,
+                                   times=4))
+        workers = []
+        reps = []
+        for i, plane in enumerate(planes):
+            w = ReplicaWorker(_engine(model, params), _cfg(),
+                              name=f"cw{i}")
+            host, port = await w.start()
+            workers.append(w)
+            reps.append(RemoteReplica(f"cw{i}", host, port,
+                                      faults=plane,
+                                      probe_interval_s=0.0,
+                                      reconnect_backoff_s=0.01))
+        router = ReplicaRouter(reps,
+                               RouterConfig(monitor_interval_s=0.0))
+        await router.start()
+        try:
+            inflight = [await router.submit(p, 12, **kw)
+                        for p, kw in zip(prompts, kws)]
+            push = asyncio.ensure_future(
+                router.push_weights(alt_payloads))
+            outs = []
+            for s in inflight:
+                try:
+                    outs.append((await s.drain(), s.status, None))
+                except Exception as e:
+                    outs.append((s.tokens, s.status,
+                                 f"{type(e).__name__}"))
+            version = await push
+            post = await router.submit(prompts[0], 6, **kws[0])
+            post_out = await post.drain()
+            injected = [dict(p.injected) for p in planes]
+            return version, outs, post_out, injected, \
+                [r.weight_version for r in reps]
+        finally:
+            await router.stop()
+            for w in workers:
+                await w.stop()
+
+    version, outs, post_out, injected, versions = asyncio.run(run())
+    assert version == 1 and versions == [1, 1]
+    assert any(d.get("reset", 0) > 0 for d in injected), \
+        "the chaos schedule must actually have fired"
+    refs_v0 = asyncio.run(_reference_streams(
+        model, params, prompts, kws, max_new=12))
+    refs_v1 = asyncio.run(_reference_streams(
+        model, alt_payloads, prompts, kws, max_new=12))
+    for i, (tokens, status, err) in enumerate(outs):
+        if status == "completed":
+            assert tokens in (refs_v0[i], refs_v1[i]), \
+                f"request {i} mixed weight versions mid-stream"
+        else:
+            assert err is not None, \
+                f"request {i} ended {status} without a typed error"
+    post_ref = asyncio.run(_reference_streams(
+        model, alt_payloads, prompts[:1], kws[:1], max_new=6))
+    assert post_out == post_ref[0]
+
+
+# ---------------------------------------------------------------------------
+# worker auth (satellite)
+# ---------------------------------------------------------------------------
+def test_worker_shared_secret_auth(model_and_params, alt_payloads):
+    model, params = model_and_params
+
+    async def run():
+        worker = ReplicaWorker(_engine(model, params), _cfg(),
+                               name="auth0", auth_token="sekrit")
+        host, port = await worker.start()
+        try:
+            # no header -> typed 401
+            reader, writer = await asyncio.open_connection(host, port)
+            writer.write(b"GET /healthz HTTP/1.1\r\n"
+                         b"Host: x\r\nConnection: close\r\n"
+                         b"Content-Length: 0\r\n\r\n")
+            await writer.drain()
+            status = await reader.readline()
+            body = await reader.read()
+            writer.close()
+            assert b"401" in status
+            assert b"unauthorized" in body
+            # wrong token -> unreachable (start fails typed)
+            bad = RemoteReplica("auth0", host, port,
+                                auth_token="wrong",
+                                probe_interval_s=0.0)
+            with pytest.raises(ConnectionError):
+                await bad.start()
+            # right token -> every hop works, /weights included
+            good = RemoteReplica("auth0", host, port,
+                                 auth_token="sekrit",
+                                 probe_interval_s=0.0)
+            await good.start()
+            stream = await good.submit([3, 5, 7], 4)
+            toks = await stream.drain()
+            version = await good.push_weights(alt_payloads)
+            await good.refresh(force=True)
+            assert _fam_total("serving_auth_failures_total") >= 2
+            return toks, version, good.weight_version
+        finally:
+            await worker.stop()
+
+    toks, version, advertised = asyncio.run(run())
+    assert len(toks) == 4
+    assert version == 1 and advertised == 1
+
+
+# ---------------------------------------------------------------------------
+# scale-ups join at the live version (satellite)
+# ---------------------------------------------------------------------------
+def test_scale_up_joins_at_live_version(model_and_params, alt_params,
+                                        alt_payloads):
+    model, params = model_and_params
+    prompts = _prompts((10,), seed=1)
+    seen_versions = []
+
+    async def run():
+        replicas = [Replica("su0", _engine(model, params), _cfg())]
+        router = ReplicaRouter(replicas,
+                               RouterConfig(monitor_interval_s=0.0))
+        await router.start()
+        try:
+            await router.push_weights(alt_payloads)
+
+            async def factory(name, weight_version=None):
+                seen_versions.append(weight_version)
+                return Replica(name, _engine(model, params), _cfg())
+
+            scaler = Autoscaler(router, factory,
+                                AutoscalerConfig(min_replicas=1,
+                                                 max_replicas=2))
+            replica = await scaler._spawn_call("su1")
+            await router.add_replica(replica)
+            assert replica.weight_version == 1, \
+                "a scale-up must be synced to the live version " \
+                "before taking traffic"
+            # force traffic onto the newcomer: drain the original
+            await router.drain_replica("su0")
+            stream = await router.submit(prompts[0], 6)
+            out = await stream.drain()
+            return out, stream.replica
+        finally:
+            await router.stop()
+
+    out, replica_name = asyncio.run(run())
+    assert seen_versions == [1], \
+        "the factory must receive the fleet's target weight version"
+    assert replica_name == "su1"
+    ref = asyncio.run(_reference_streams(
+        model, alt_payloads, prompts, [dict(temperature=0.0)],
+        max_new=6))
+    assert out == ref[0]

@@ -113,3 +113,212 @@ def test_hybrid_engine_moe_expert_parallel():
     assert np.isfinite(loss)
     out = engine.generate(np.array([[3, 5, 7]]), max_new_tokens=4)
     assert out.shape == (1, 7)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15: the real train<->serve seam (publish / hot-swap / rollouts)
+# ---------------------------------------------------------------------------
+import asyncio  # noqa: E402
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,  # noqa: E402
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.config_v2 import \
+    DSStateManagerConfig  # noqa: E402
+from deepspeed_tpu.inference.v2.serve import weights  # noqa: E402
+from deepspeed_tpu.runtime.hybrid_engine import (RolloutQueue,  # noqa: E402
+                                                 RolloutSample,
+                                                 WeightPublisher,
+                                                 _fused_w)
+from deepspeed_tpu.telemetry import get_registry, watchdog  # noqa: E402
+
+
+def _fam_total(name):
+    fam = get_registry().get(name)
+    return sum(s.value for _, s in fam.series()) if fam else 0.0
+
+
+def _fresh_from_payload(payloads, model_cfg=None):
+    """A fresh engine_v2 built from a published payload — the hot-swap
+    parity reference."""
+    model = TransformerLM(model_cfg or _cfg())
+    stager = weights.stage_payload(payloads)
+    shapes = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
+    params = weights.flat_to_tree(shapes, stager.leaves)
+    eng = InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_tracked_sequences=8, max_seq_len=64, num_blocks=33,
+                block_size=16),
+            dtype="bfloat16", prefill_bucket=16), params=params)
+    eng.weight_version = stager.version
+    return eng
+
+
+def test_publish_zero_recompiles_and_train_executable_unchanged():
+    """The acceptance pin: across train -> publish -> generate, the
+    serving engine never retraces (steady recompiles 0) and the train
+    step's executable is untouched by the gather/snapshot path."""
+    engine = _engine()
+    prompt = np.array([[2, 4, 6, 8]])
+    engine.train_batch(batch=_batch(engine))
+    train_cache0 = engine._train_step._cache_size()
+    # warm the serving engine twice at one version (the documented
+    # bucket double-warm discipline)
+    engine.generate(prompt, max_new_tokens=4)
+    engine.generate(prompt, max_new_tokens=4)
+    st0 = _fam_total("xla_steady_state_recompiles_total")
+    watchdog.mark_steady(True)
+    try:
+        engine.train_batch(batch=_batch(engine, seed=3))
+        v_before = engine.weight_version
+        out = engine.generate(prompt, max_new_tokens=4)  # auto-publish
+    finally:
+        watchdog.mark_steady(False)
+    assert engine.weight_version == v_before + 1
+    assert out.shape == (1, 8)
+    assert _fam_total("xla_steady_state_recompiles_total") - st0 == 0, \
+        "publish + hot-swap must not retrace any serving program"
+    assert engine._train_step._cache_size() == train_cache0, \
+        "the snapshot gather must not respecialize the train step"
+
+
+def test_generate_matches_fresh_engine_from_payload():
+    engine = _engine()
+    engine.train_batch(batch=_batch(engine))
+    payloads = engine.publish()
+    prompt = np.array([[3, 5, 7, 9]])
+    out = engine.generate(prompt, max_new_tokens=5)
+    ref_eng = _fresh_from_payload(payloads)
+    ref = ref_eng.generate([[3, 5, 7, 9]], max_new_tokens=5)
+    np.testing.assert_array_equal(out[0], np.asarray(ref[0]))
+
+
+def test_rollout_stream_parity_and_logprobs():
+    """Rollout tokens must be bit-identical to the same request served
+    through the async serving runtime (same host_sample draw
+    discipline), greedy AND seeded sampling; logprobs are finite
+    per-token policy log-softmax values."""
+    from deepspeed_tpu.inference.v2.serve import (ServingConfig,
+                                                  ServingEngine)
+    engine = _engine()
+    payloads = engine.publish()
+    prompt = [3, 5, 7, 9, 11]
+    kws = [dict(temperature=0.0), dict(temperature=0.8, top_p=0.9)]
+    samples = [engine.rollout([prompt], max_new_tokens=6, seed=12,
+                              enqueue=False, **kw)[0] for kw in kws]
+
+    async def served(kw):
+        serving = ServingEngine(_fresh_from_payload(payloads),
+                                ServingConfig(token_budget=32, chunk=16))
+        await serving.start()
+        try:
+            s = await serving.submit(prompt, 6, seed=12, **kw)
+            return await s.drain()
+        finally:
+            await serving.stop()
+
+    for sample, kw in zip(samples, kws):
+        assert sample.tokens == asyncio.run(served(kw)), \
+            f"rollout diverged from the served stream for {kw}"
+        assert len(sample.logprobs) == len(sample.tokens)
+        assert all(np.isfinite(lp) and lp <= 0.0
+                   for lp in sample.logprobs)
+        assert sample.weight_version == engine.weight_version
+
+
+def test_rollout_queue_bounded_drops_oldest():
+    q = RolloutQueue(maxlen=2)
+    for i in range(3):
+        q.push(RolloutSample([i], [i], [0.0], 1, i))
+    assert len(q) == 2
+    popped = q.pop(4)
+    assert [s.prompt for s in popped] == [[1], [2]], \
+        "oldest rollout must have been dropped"
+    assert len(q) == 0
+
+
+def test_actor_loop_train_publish_rollout():
+    """The RLHF actor loop in one process: train -> publish -> rollout,
+    repeatedly, with rollouts landing in the bounded queue at the
+    published version."""
+    engine = _engine()
+    for step in range(2):
+        engine.train_batch(batch=_batch(engine, seed=step))
+        engine.publish()
+        engine.rollout([[2, 4, 6]], max_new_tokens=3,
+                       temperature=0.7, top_p=0.9, seed=step)
+    assert len(engine.rollout_queue) == 2
+    a, b = engine.rollout_queue.pop(2)
+    assert (a.weight_version, b.weight_version) == (1, 2)
+    # training continues after rollouts (train->generate->train)
+    assert np.isfinite(engine.train_batch(batch=_batch(engine, seed=9)))
+
+
+def test_lora_fuse_unfuse_bit_roundtrip():
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.standard_normal((16, 8)) * 1e3, jnp.float32)
+    params = {"blk": {"proj": {
+        "w": w,
+        "lora_a": jnp.asarray(rng.standard_normal((16, 2)), jnp.float32),
+        "lora_b": jnp.asarray(rng.standard_normal((2, 8)), jnp.float32),
+    }}}
+    fused = fuse_lora(params, scale=0.3)
+    g = fused["blk"]["proj"]
+    expected = _fused_w(w, params["blk"]["proj"]["lora_a"],
+                        params["blk"]["proj"]["lora_b"], 0.3)
+    assert np.asarray(g["w"]).tobytes() == expected.tobytes()
+    restored = unfuse_lora(fused, scale=0.3)
+    rg = restored["blk"]["proj"]
+    # BIT-exact restore (a float subtraction would not round-trip the
+    # large-magnitude weights above), and no stash left behind
+    assert np.asarray(rg["w"]).tobytes() == np.asarray(w).tobytes()
+    assert set(rg) == {"w", "lora_a", "lora_b"}
+
+
+def test_publisher_prefuses_lora_groups():
+    rng = np.random.default_rng(2)
+    tree = {"blk": {"proj": {
+        "w": np.asarray(rng.standard_normal((8, 8)), np.float32),
+        "lora_a": np.asarray(rng.standard_normal((8, 2)), np.float32),
+        "lora_b": np.asarray(rng.standard_normal((2, 8)), np.float32),
+    }}, "head": np.asarray(rng.standard_normal((8, 4)), np.float32)}
+    pub = WeightPublisher(tree, lora_scale=2.0)
+    flat = weights.stage_payload(pub.snapshot(fuse_lora=True)).leaves
+    expected = _fused_w(tree["blk"]["proj"]["w"],
+                        tree["blk"]["proj"]["lora_a"],
+                        tree["blk"]["proj"]["lora_b"], 2.0)
+    assert flat["blk/proj/w"].tobytes() == expected.tobytes()
+    np.testing.assert_array_equal(flat["head"], tree["head"])
+    # unfused publication leaves the base weight untouched
+    flat_raw = weights.stage_payload(pub.snapshot()).leaves
+    assert flat_raw["blk/proj/w"].tobytes() == \
+        tree["blk"]["proj"]["w"].tobytes()
+
+
+def test_fused_vs_unfused_generate_parity():
+    """External adapters fuse at publish time: fused generation is
+    bit-identical to a fresh engine built from the fused payload, and
+    detaching the adapters restores the base streams exactly (the
+    training params were never touched)."""
+    engine = _engine()
+    prompt = np.array([[2, 4, 6]])
+    base_out = engine.generate(prompt, max_new_tokens=4)
+    # adapt the output head — a leaf that demonstrably shifts logits
+    items, _ = weights.flatten_params(engine.params)
+    name, leaf = next((n, l) for n, l in items if n == "lm_head")
+    rng = np.random.default_rng(3)
+    a = rng.standard_normal((leaf.shape[0], 2)).astype(np.float32)
+    b = rng.standard_normal((2, leaf.shape[1])).astype(np.float32)
+    engine.attach_lora_adapter(name, a, b)
+    fused_payloads = engine.publish()        # auto-fused (adapters)
+    fused_out = engine.generate(prompt, max_new_tokens=4)
+    ref = _fresh_from_payload(fused_payloads)
+    ref_out = ref.generate([[2, 4, 6]], max_new_tokens=4)
+    np.testing.assert_array_equal(fused_out[0], np.asarray(ref_out[0]))
+    assert not np.array_equal(fused_out, base_out), \
+        "a non-trivial adapter must change generation"
+    # detach -> unfused publication -> base streams restored bit-exact
+    engine.lora_adapters.clear()
+    engine.publish(fuse_lora=False)
+    unfused_out = engine.generate(prompt, max_new_tokens=4)
+    np.testing.assert_array_equal(unfused_out, base_out)
