@@ -1,6 +1,33 @@
-"""Autotuning (reference deepspeed/autotuning/): in-process estimator
-(Autotuner) and launched-subprocess experiment sweep (ExperimentAutotuner +
-ResourceManager)."""
+"""Profile-guided autotuning (ROADMAP item 5).
 
-from .autotuner import Autotuner, ExperimentAutotuner  # noqa: F401
-from .scheduler import ExperimentSpec, ResourceManager  # noqa: F401
+Three layers over the declarative tunable registry
+(runtime/tunables.py):
+
+  * :mod:`capture` — workload capture & replay: serialize a
+    flight-recorder ring or synthesize a load_bench-style trace into a
+    versioned artifact; expand it into a deterministic replay schedule,
+  * :mod:`offline` — :class:`OfflineTuner`: chip-free coordinate
+    descent over the registry's search ladders, scored on the runtime's
+    own AOT planners (bucket plans, ring wire bytes, prefetch plans)
+    plus a queueing model over the replayed workload,
+  * :mod:`online` — :class:`OnlineAdapter`: SLO-burn-driven nudging of
+    the ``online=True`` knobs (decode window, admission token budget)
+    between scheduler steps, hysteresis-armed, warmed-shapes-only at
+    steady state (zero steady-state recompiles).
+
+Entry points: ``scripts/autotune.py`` (capture / offline / online-demo
+CLI) and ``deepspeed_tpu.launcher --autotuning`` (tunes, then exports
+the tuned config to every rank via ``DS_TPU_AUTOTUNED_CONFIG``).
+"""
+
+from .capture import (  # noqa: F401
+    ARTIFACT_VERSION,
+    capture_from_recorder,
+    load,
+    replay_schedule,
+    save,
+    simulate_queue,
+    synthesize,
+)
+from .offline import OfflineTuner, serving_overrides  # noqa: F401
+from .online import OnlineAdapter, OnlineAdapterConfig  # noqa: F401

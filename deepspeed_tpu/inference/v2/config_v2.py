@@ -40,14 +40,14 @@ class DSStateManagerConfig:
                 "enable_kv_spill requires enable_prefix_caching: spilled "
                 "blocks are keyed by the prefix chain digests the index "
                 "computes")
-        if self.enable_kv_spill and self.kv_spill_host_bytes <= 0:
-            raise ValueError(
-                f"kv_spill_host_bytes must be > 0, got "
-                f"{self.kv_spill_host_bytes}")
-        if self.enable_kv_spill and self.kv_spill_disk_bytes < 0:
-            raise ValueError(
-                f"kv_spill_disk_bytes must be >= 0, got "
-                f"{self.kv_spill_disk_bytes}")
+        if self.enable_kv_spill:
+            # spill budgets are registered tunables: bad values fail
+            # naming the registry entry and its documented range
+            from ...runtime import tunables
+            for key in ("kv_spill_host_bytes", "kv_spill_disk_bytes"):
+                name = f"state_manager.{key}"
+                tunables.check(name, getattr(self, key), label=key)
+                tunables.observe(name, getattr(self, key), "config")
 
 
 @dataclass
@@ -97,6 +97,16 @@ class RaggedInferenceEngineConfig:
     #            (the rollback knob; parity-tested against "on")
     ragged_attention: str = "auto"
     seed: int = 0
+
+    def __post_init__(self):
+        # serving geometry knobs are registered tunables
+        # (runtime/tunables.py): validate against the documented range
+        # and publish the effective value + provenance for /statusz
+        from ...runtime import tunables
+        for key, name in (("decode_window", "serving.decode_window"),
+                          ("prefill_bucket", "serving.prefill_bucket")):
+            tunables.check(name, getattr(self, key), label=key)
+            tunables.observe(name, getattr(self, key), "config")
 
     @classmethod
     def from_dict(cls, d: dict) -> "RaggedInferenceEngineConfig":

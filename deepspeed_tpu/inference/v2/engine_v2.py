@@ -226,20 +226,40 @@ class InferenceEngineV2:
         # (batch bucket, table-width bucket).
         self.decode_window = max(int(config.decode_window), 1)
         self._m_window_size.set(self.decode_window)
-        self._fused_greedy_jit = watchdog.watch("decode_window_greedy", jax.jit(
-            lambda p, t, pos, bt, c, sl, eos: paged_decode_window(
-                cfg, p, t, pos, bt, c, sl, eos, sm.block_size,
-                self.decode_window, use_kernel=use_kernel,
-                topo=topo),
-            donate_argnums=(4,)))
-        self._fused_sample_jit = watchdog.watch("decode_window_sample", jax.jit(
-            lambda p, t, pos, bt, c, sl, eos, rng, seeds, g0, temp, topp, \
-            topk: paged_decode_window(
-                cfg, p, t, pos, bt, c, sl, eos, sm.block_size,
-                self.decode_window, rng=rng, row_seeds=seeds, gen_idx0=g0,
-                temp=temp, topp=topp, topk=topk,
-                use_kernel=use_kernel, topo=topo),
-            donate_argnums=(4,)))
+
+        # K is baked into each compiled window program, so runtime
+        # adaptation (autotuning/online.py set_decode_window) swaps
+        # whole jit OBJECTS from this per-K cache — reusing one jit
+        # across K values would silently serve the old-K program (the
+        # closure int is not part of jax's cache key). All K values
+        # share the watchdog program names, so compile accounting stays
+        # one row per path regardless of the ladder.
+        self._fused_jit_cache: Dict[int, tuple] = {}
+
+        def _build_fused_pair(K: int):
+            greedy = watchdog.watch("decode_window_greedy", jax.jit(
+                lambda p, t, pos, bt, c, sl, eos, _K=K: paged_decode_window(
+                    cfg, p, t, pos, bt, c, sl, eos, sm.block_size,
+                    _K, use_kernel=use_kernel,
+                    topo=topo),
+                donate_argnums=(4,)))
+            sample = watchdog.watch("decode_window_sample", jax.jit(
+                lambda p, t, pos, bt, c, sl, eos, rng, seeds, g0, temp, \
+                topp, topk, _K=K: paged_decode_window(
+                    cfg, p, t, pos, bt, c, sl, eos, sm.block_size,
+                    _K, rng=rng, row_seeds=seeds, gen_idx0=g0,
+                    temp=temp, topp=topp, topk=topk,
+                    use_kernel=use_kernel, topo=topo),
+                donate_argnums=(4,)))
+            return greedy, sample
+
+        self._build_fused_pair = _build_fused_pair
+        # windows whose programs have actually run (and therefore
+        # compiled for the current buckets): the online adapter's
+        # steady-state move set
+        self._warmed_windows: set = set()
+        self._fused_greedy_jit, self._fused_sample_jit = \
+            self._fused_pair(self.decode_window)
         self._prefill_jit = watchdog.watch("prefill", jax.jit(
             lambda p, ids, n, c, b, o: paged_prefill(
                 cfg, p, ids, n, c, b, o,
@@ -423,6 +443,43 @@ class InferenceEngineV2:
         # (tp/ep, alibi), and quantized KV runs the kernel's quant
         # variant — there is no unsupported case
         return mode != "off"
+
+    # ------------------------------------------------------------------
+    # Fused decode window K: per-K jit cache + live adaptation
+    # ------------------------------------------------------------------
+    def _fused_pair(self, window: int):
+        if window not in self._fused_jit_cache:
+            self._fused_jit_cache[window] = self._build_fused_pair(window)
+        return self._fused_jit_cache[window]
+
+    def warmed_decode_windows(self):
+        """Window sizes whose decode program has dispatched at least
+        once (so its compiled program is cached for the buckets traffic
+        actually uses) — the only K values the online adapter may move
+        to at steady state."""
+        return sorted(self._warmed_windows)
+
+    def set_decode_window(self, window: int, *,
+                          source: str = "online") -> int:
+        """Switch the fused decode window K at runtime
+        (autotuning/online.py actuates here; must be called from the
+        thread that owns the engine). Swaps the per-K jit pair, so an
+        already-warmed K never recompiles; a brand-new K compiles on
+        its next dispatch like any cold program."""
+        from ...runtime import tunables
+        window = tunables.check("serving.decode_window", window,
+                                label="decode_window")
+        if window == self.decode_window:
+            return window
+        self._fused_greedy_jit, self._fused_sample_jit = \
+            self._fused_pair(window)
+        self.decode_window = window
+        self.config.decode_window = window
+        self._m_window_size.set(window)
+        tunables.observe("serving.decode_window", window, source)
+        flight.record("tunable_set", name="serving.decode_window",
+                      value=window, source=source)
+        return window
 
     def set_ragged_mode(self, mode: str) -> None:
         """Flip the ragged/stitched dispatch at runtime
@@ -742,6 +799,7 @@ class InferenceEngineV2:
             self._m_decode_tput.set(len(uids) / dt)
         flight.record("decode_step", batch=len(uids),
                       dur_s=round(dt, 5))
+        self._warmed_windows.add(1)   # per-token path == window 1
         log_tokens = sm.config.enable_prefix_caching
         out = {}
         for i, uid in enumerate(uids):
@@ -842,6 +900,7 @@ class InferenceEngineV2:
             self._m_decode_tput.set(total / dt)
         flight.record("decode_window", batch=len(uids), tokens=total,
                       window=self.decode_window, dur_s=round(dt, 5))
+        self._warmed_windows.add(self.decode_window)
         self._update_pool_telemetry()
         return emitted
 

@@ -57,6 +57,18 @@ class AdmissionConfig:
     # wait before retrying THIS runtime
     retry_after_s: float = 0.5
 
+    def __post_init__(self):
+        # the shed threshold is a registered tunable: a bad value fails
+        # naming the registry entry + documented range, and /statusz
+        # shows the effective value with its provenance
+        if self.max_queued_tokens is not None:
+            from ....runtime import tunables
+            tunables.check("serving.max_queued_tokens",
+                           self.max_queued_tokens,
+                           label="max_queued_tokens")
+            tunables.observe("serving.max_queued_tokens",
+                             self.max_queued_tokens, "config")
+
 
 def request_cost(entry) -> int:
     """Future-work cost of a request in tokens (admission currency)."""
@@ -230,6 +242,26 @@ class AdmissionController:
             self._tokens = 0
             self._update_gauges()
             return out
+
+    def set_max_queued_tokens(self, budget: Optional[int], *,
+                              source: str = "online") -> Optional[int]:
+        """Retarget the queued-token shed threshold at runtime (the
+        online adapter's actuation path — autotuning/online.py).
+        ``try_admit`` reads the config under the lock on every call, so
+        the new budget applies to the next admission decision. ``None``
+        disables token-budget shedding (the config default)."""
+        from ....runtime import tunables
+        if budget is not None:
+            budget = tunables.check("serving.max_queued_tokens", budget,
+                                    label="max_queued_tokens")
+        with self._lock:
+            old = self.config.max_queued_tokens
+            self.config.max_queued_tokens = budget
+        if budget != old:
+            tunables.observe("serving.max_queued_tokens", budget, source)
+            flight.record("tunable_set", name="serving.max_queued_tokens",
+                          value=budget, source=source)
+        return budget
 
     # ------------------------------------------------------------------
     def close(self) -> None:

@@ -306,6 +306,7 @@ class ServingAPI:
         from ....telemetry import anomaly as ds_anomaly
         from ....telemetry import memory as ds_memory
         from ....telemetry import watchdog
+        from ....runtime import tunables
         from ....telemetry.recorder import get_recorder
         out = {
             "health": self.serving.health(),
@@ -316,6 +317,9 @@ class ServingAPI:
             "metric_families": len(self.registry.families()),
             "recorder": get_recorder().stats(),
             "anomalies": {"recent": ds_anomaly.recent(16)},
+            # every registered perf knob: effective value + provenance
+            # (default|config|tuned|online) — runtime/tunables.py
+            "tunables": tunables.statusz_section(),
         }
         if hasattr(self.serving, "replica_statusz"):
             # routed frontend mode: the "serving engine" is a

@@ -24,6 +24,7 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
+from ....autotuning.online import OnlineAdapter, OnlineAdapterConfig
 from ....telemetry import context as trace_context
 from ....telemetry.anomaly import (DiagnosticsConfig, KVLeakDetector,
                                    SLOBurnRateMonitor, StallWatchdog)
@@ -60,6 +61,10 @@ class ServingConfig:
     # anomaly.py; docs/TELEMETRY.md § Anomaly detectors)
     diagnostics: DiagnosticsConfig = field(
         default_factory=DiagnosticsConfig)
+    # SLO-driven online adaptation of the registry's online=True knobs
+    # (decode window, admission token budget) between scheduler steps —
+    # autotuning/online.py; None disables it
+    autotune: Optional["OnlineAdapterConfig"] = None
 
 
 class ServingDiagnostics:
@@ -218,11 +223,21 @@ class ServingEngine:
             chunk=self.config.chunk, clock=clock)
         self.admission = AdmissionController(self.config.admission)
         self.diagnostics = ServingDiagnostics(self.config.diagnostics)
+        # SLO-driven online adapter (autotuning/online.py): ticked by the
+        # loop thread between scheduler steps — the only thread allowed
+        # to swap the engine's fused decode program
+        self.adapter: Optional[OnlineAdapter] = None
+        if (self.config.autotune is not None
+                and self.config.autotune.enabled):
+            self.adapter = OnlineAdapter(
+                engine, admission=self.admission,
+                slo=self.diagnostics.slo, config=self.config.autotune)
         self._loop_runner = ServingLoop(
             self.scheduler, self.admission,
             max_inflight=self.config.max_inflight,
             idle_wait_s=self.config.idle_wait_s, clock=clock,
-            bridge=bridge, diagnostics=self.diagnostics, lane=lane)
+            bridge=bridge, diagnostics=self.diagnostics, lane=lane,
+            adapter=self.adapter)
         self._uids = itertools.count(1)
         self._stopped = False
 

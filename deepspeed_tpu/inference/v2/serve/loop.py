@@ -35,7 +35,7 @@ class ServingLoop:
                  max_inflight: Optional[int] = None,
                  idle_wait_s: float = 0.002, clock=time.perf_counter,
                  bridge=None, diagnostics=None,
-                 lane: Optional[str] = None):
+                 lane: Optional[str] = None, adapter=None):
         self.scheduler = scheduler
         self.admission = admission
         # fleet lane name (telemetry/trace.py set_lane): the loop thread
@@ -52,6 +52,11 @@ class ServingLoop:
         # drains — the loop thread is the only place that sees all three
         # moments
         self.diagnostics = diagnostics
+        # optional SLO-driven online adapter (autotuning/online.py):
+        # ticked right after the SLO monitor so it reads a fresh burn
+        # verdict, on this thread (the only one allowed to swap the
+        # engine's fused decode program)
+        self.adapter = adapter
         self._last_slo_tick = 0.0
         sm = scheduler.engine.state_manager.config
         # cap on requests inside the scheduler at once; the admission
@@ -434,14 +439,18 @@ class ServingLoop:
 
     def _diag_tick(self) -> None:
         diag = self.diagnostics
-        if diag is None or diag.slo is None:
-            return
-        now = time.monotonic()
-        if now - self._last_slo_tick >= 1.0:
-            self._last_slo_tick = now
+        if diag is not None and diag.slo is not None:
+            now = time.monotonic()
+            if now - self._last_slo_tick >= 1.0:
+                self._last_slo_tick = now
+                try:
+                    diag.slo.tick()
+                except Exception:   # monitoring must never stall serving
+                    pass
+        if self.adapter is not None:
             try:
-                diag.slo.tick()
-            except Exception:   # monitoring must never stall serving
+                self.adapter.tick()
+            except Exception:       # adaptation must never stall serving
                 pass
 
     def _diag_drain(self) -> None:

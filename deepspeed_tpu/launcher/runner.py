@@ -188,54 +188,63 @@ def parse_args(argv=None):
     p.add_argument("--master_port", type=int, default=DEFAULT_COORD_PORT)
     p.add_argument("--launcher", default="pdsh", choices=sorted(RUNNERS))
     p.add_argument("--force_multi", action="store_true")
-    # reference bin/deepspeed --autotuning {tune,run} (launcher/runner.py:360
-    # run_autotuning): sweep configs via launched experiments before/instead
-    # of the real run
+    # reference bin/deepspeed --autotuning {tune,run}: tune knobs
+    # before/instead of the real run. Here tuning is chip-free offline
+    # replay (autotuning/offline.py) — no launched experiment subprocesses
     p.add_argument("--autotuning", choices=("tune", "run"), default=None)
     p.add_argument("--autotuning_config", default=None,
                    help="JSON file with the base engine config for autotuning")
     p.add_argument("--autotuning_exp_dir", default="autotuning_exps")
-    p.add_argument("--autotuning_platform", default=None,
-                   help="pin experiment subprocesses to a jax platform "
-                        "(e.g. cpu); default = the real device")
-    p.add_argument("--autotuning_timeout", type=float, default=600.0,
-                   help="per-experiment wall-clock timeout (hang reaper)")
+    p.add_argument("--autotuning_workload", default=None,
+                   help="workload artifact (scripts/autotune.py capture) "
+                        "to replay; default = a synthesized load_bench mix")
     p.add_argument("user_script")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
 def run_autotuning(args):
-    """reference launcher/runner.py:360: sweep experiment configs on the
-    user script and write ranked results + the winning config. Returns 0/1
-    in mode 'tune'; in mode 'run' returns the winning-config path so main()
-    proceeds to launch the real run with it."""
+    """reference launcher/runner.py:360 semantics, offline machinery:
+    replay a workload artifact through the chip-free tuner
+    (autotuning/offline.py) and write the ranked report + the winning
+    config. Returns 0/1 in mode 'tune'; in mode 'run' returns the
+    winning-config path so main() proceeds to launch the real run with
+    it."""
     import json
 
-    from ..autotuning import ExperimentAutotuner
+    from .. import autotuning
 
     base = {"optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
             "steps_per_print": 10 ** 9}
     if args.autotuning_config:
         with open(args.autotuning_config) as fh:
             base = json.load(fh)
-    tuner = ExperimentAutotuner(args.user_script, base,
-                                exp_dir=args.autotuning_exp_dir,
-                                platform=args.autotuning_platform,
-                                timeout_s=args.autotuning_timeout)
-    ranked = tuner.tune()
-    best = next((r for r in ranked if r.get("ok")), None)
-    if best is None:
-        logger.error("autotuning: every experiment failed")
+    if args.autotuning_workload:
+        artifact = autotuning.load(args.autotuning_workload)
+    else:
+        artifact = autotuning.synthesize()
+    tuner = autotuning.OfflineTuner(artifact, base_config=base)
+    result = tuner.tune()
+    if result["improved_signals"] < 1:
+        logger.error("autotuning: no registered cost signal improved over "
+                     "defaults on this workload")
         return 1
+    os.makedirs(args.autotuning_exp_dir, exist_ok=True)
+    with open(os.path.join(args.autotuning_exp_dir,
+                           "autotune_results.json"), "w") as fh:
+        json.dump({"report": result["report"],
+                   "improved_signals": result["improved_signals"],
+                   "trials": result["trials"]}, fh, indent=2)
     # absolute: the path is exported into remote node commands, whose shells
     # start in $HOME, not this launcher's cwd
     best_path = os.path.abspath(
         os.path.join(args.autotuning_exp_dir, "best_config.json"))
     with open(best_path, "w") as fh:
-        json.dump(best.get("config", {}), fh, indent=2)
-    logger.info(f"autotuning best: {best['name']} "
-                f"({best['samples_per_sec']:.1f} samples/s) — results in "
+        json.dump(result["config"], fh, indent=2)
+    top = result["report"][0] if result["report"] else {}
+    logger.info(f"autotuning: {result['improved_signals']} cost signal(s) "
+                f"improved over {result['trials']} trials (best: "
+                f"{top.get('knob')} -> {top.get('tuned')}) — report in "
                 f"{args.autotuning_exp_dir}/autotune_results.json, winning "
                 f"config in {best_path}")
     if args.autotuning == "run":

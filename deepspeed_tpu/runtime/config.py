@@ -145,14 +145,18 @@ class ZeroConfig:
     def __post_init__(self):
         if self.stage not in (0, 1, 2, 3):
             raise ConfigError(f"zero_optimization.stage must be 0-3, got {self.stage}")
-        # bucket knobs are CONSUMED (grad_overlap.py / stage-3 plan), so a
-        # nonsensical value must fail at config load, not mid-bucketing
+        # bucket knobs are CONSUMED (grad_overlap.py / stage-3 plan) and
+        # REGISTERED tunables (runtime/tunables.py): a nonsensical value
+        # fails at config load naming the registry entry and its
+        # documented range, and the effective value lands in /statusz
+        # with its provenance
+        from . import tunables
         for key in ("reduce_bucket_size", "allgather_bucket_size",
                     "stage3_prefetch_bucket_size"):
-            if getattr(self, key) <= 0:
-                raise ConfigError(
-                    f"zero_optimization.{key} must be > 0, got "
-                    f"{getattr(self, key)}")
+            tunables.check(f"zero_optimization.{key}",
+                           getattr(self, key), exc=ConfigError)
+            tunables.observe(f"zero_optimization.{key}",
+                             getattr(self, key), "config")
         if self.overlap_grad_reduce not in ("auto", "bucketed", "off"):
             raise ConfigError(
                 "zero_optimization.overlap_grad_reduce must be one of "
@@ -161,10 +165,10 @@ class ZeroConfig:
             raise ConfigError(
                 "zero_optimization.quantized_reduce must be one of "
                 f"'off'|'int8'|'fp8', got {self.quantized_reduce!r}")
-        if self.quant_block <= 0:
-            raise ConfigError(
-                f"zero_optimization.quant_block must be > 0, got "
-                f"{self.quant_block}")
+        tunables.check("zero_optimization.quant_block", self.quant_block,
+                       exc=ConfigError)
+        tunables.observe("zero_optimization.quant_block",
+                         self.quant_block, "config")
         if self.quantized_reduce_hierarchy < 0:
             raise ConfigError(
                 "zero_optimization.quantized_reduce_hierarchy must be "
@@ -544,6 +548,15 @@ class DeepSpeedConfig:
             raise ConfigError(f"config must be a path or dict, got {type(config)}")
         self.raw = raw
         self.cfg = hydrate(DeepSpeedTpuConfig, _coerce_optional_blocks(raw))
+        # tuned-config provenance: scripts/autotune.py stamps the knobs
+        # it moved under autotuning.tuned; /statusz then reports them
+        # as provenance "tuned" rather than "config"
+        from . import tunables
+        tuned = (self.cfg.autotuning or {}).get("tuned", {})
+        if isinstance(tuned, dict):
+            for name, value in tuned.items():
+                if name in tunables.REGISTRY:
+                    tunables.observe(name, value, "tuned")
         if world_size is None:
             import jax
 

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Profile-guided autotuner CLI (ROADMAP item 5; docs/TUNING.md).
+
+Three subcommands, all chip-free:
+
+  capture   synthesize a load_bench-style workload artifact (or
+            re-serialize one for inspection): request arrivals, the
+            prompt/new-token length mix, tenants. Deterministic in
+            --seed; the artifact is the replayable unit of tuning.
+
+  offline   replay an artifact through the chip-free cost models
+            (autotuning/offline.py: the runtime's own bucket/wire/
+            prefetch planners + a queueing model) and coordinate-descent
+            the registered knob ladders. Emits the tuned runtime config
+            (verified to load through DeepSpeedConfig) and a report
+            ranked by cost-signal delta.
+
+  online    scripted chip-free demo of the SLO-driven online adapter:
+            a synthetic burn timeline drives decode_window down within
+            registry bounds and back up on recovery, printing every
+            adaptation. Shows the decision loop without an engine.
+
+Examples::
+
+    python scripts/autotune.py capture --out /tmp/workload.json
+    python scripts/autotune.py offline --workload /tmp/workload.json \
+        --out /tmp/tuned.json --report /tmp/report.json
+    python scripts/autotune.py online --ticks 30 --burn 5:12
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def cmd_capture(args) -> int:
+    from deepspeed_tpu import autotuning
+
+    if args.workload:
+        art = autotuning.load(args.workload)
+    else:
+        art = autotuning.synthesize(
+            requests=args.requests, rate=args.rate, seed=args.seed)
+    autotuning.save(art, args.out)
+    n = len(art["requests"])
+    span = art["requests"][-1]["t"] if n else 0.0
+    print(f"captured {n} requests over {span:.2f}s "
+          f"(source: {art['meta'].get('source')}) -> {args.out}")
+    return 0
+
+
+def cmd_offline(args) -> int:
+    from deepspeed_tpu import autotuning
+
+    if args.workload:
+        art = autotuning.load(args.workload)
+    else:
+        art = autotuning.synthesize(seed=args.seed)
+        print("no --workload given; tuning against a synthesized "
+              f"load_bench mix (seed {args.seed})")
+    base = {}
+    if args.base_config:
+        with open(args.base_config) as fh:
+            base = json.load(fh)
+    tuner = autotuning.OfflineTuner(art, base_config=base,
+                                    passes=args.passes)
+    result = tuner.tune()
+
+    # the tuned config must round-trip through real config loading —
+    # a tuned config the runtime rejects is worse than no tuning. The
+    # batch-size key is the one field config loading requires and
+    # tuning has no opinion on; fill it only for the check.
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    probe = dict(result["config"])
+    if not any(k in probe for k in ("train_batch_size",
+                                    "train_micro_batch_size_per_gpu")):
+        probe["train_micro_batch_size_per_gpu"] = 1
+    DeepSpeedConfig(probe)
+
+    with open(args.out, "w") as fh:
+        json.dump(result["config"], fh, indent=2, sort_keys=True)
+    if args.report:
+        with open(args.report, "w") as fh:
+            json.dump(result["report"], fh, indent=2)
+
+    print(f"{result['trials']} trials, {result['improved_signals']} "
+          f"cost signal(s) improved over registry defaults")
+    for row in result["report"]:
+        marker = "+" if row["delta"] > 0 else " "
+        print(f"  {marker} {row['knob']}: {row['default']} -> "
+              f"{row['tuned']}  (cost {row['baseline_cost']:.4f} -> "
+              f"{row['tuned_cost']:.4f}, signal {row['cost_signal']})")
+    print(f"tuned config (loads via DeepSpeedConfig) -> {args.out}")
+    return 0 if result["improved_signals"] >= 1 else 1
+
+
+class _ScriptedSLO:
+    """burning() follows a scripted tick window [start, stop)."""
+
+    def __init__(self, start: int, stop: int):
+        self.start, self.stop = start, stop
+        self.tick = 0
+
+    def advance(self):
+        self.tick += 1
+
+    def burning(self) -> bool:
+        return self.start <= self.tick < self.stop
+
+
+class _DemoEngine:
+    """Chip-free stand-in exposing the adapter's engine surface."""
+
+    def __init__(self, window: int):
+        self.decode_window = window
+        self._warmed = {1, 2, 4, window}
+
+    def warmed_decode_windows(self):
+        return sorted(self._warmed)
+
+    def set_decode_window(self, window, *, source="online"):
+        from deepspeed_tpu.runtime import tunables
+        window = tunables.check("serving.decode_window", window,
+                                label="decode_window")
+        self.decode_window = window
+        self._warmed.add(window)
+        tunables.observe("serving.decode_window", window, source)
+        return window
+
+
+def cmd_online(args) -> int:
+    from deepspeed_tpu.autotuning import OnlineAdapter, OnlineAdapterConfig
+
+    start, _, stop = args.burn.partition(":")
+    slo = _ScriptedSLO(int(start), int(stop))
+    engine = _DemoEngine(args.window)
+    adapter = OnlineAdapter(
+        engine, slo=slo,
+        config=OnlineAdapterConfig(interval_s=0.0, hold_ticks=1,
+                                   restore_ticks=2),
+        clock=lambda: float(slo.tick))
+    print(f"tick  burning  decode_window  armed")
+    for _ in range(args.ticks):
+        moved = adapter.tick()
+        flag = "*" if moved else " "
+        print(f"{slo.tick:4d}  {str(slo.burning()):7s}  "
+              f"{engine.decode_window:13d}  {str(adapter.armed):5s} {flag}")
+        slo.advance()
+    print(f"{adapter.adaptations} adaptations; window restored: "
+          f"{engine.decode_window == args.window}; re-armed: "
+          f"{adapter.armed}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    cap = sub.add_parser("capture", help="synthesize a workload artifact")
+    cap.add_argument("--out", required=True)
+    cap.add_argument("--workload", default=None,
+                     help="re-serialize an existing artifact instead")
+    cap.add_argument("--requests", type=int, default=64)
+    cap.add_argument("--rate", type=float, default=32.0)
+    cap.add_argument("--seed", type=int, default=0)
+
+    off = sub.add_parser("offline", help="replay + coordinate descent")
+    off.add_argument("--workload", default=None,
+                     help="workload artifact (default: synthesize)")
+    off.add_argument("--base-config", default=None,
+                     help="base runtime config JSON to merge into")
+    off.add_argument("--out", required=True,
+                     help="tuned runtime config JSON")
+    off.add_argument("--report", default=None,
+                     help="ranked per-knob report JSON")
+    off.add_argument("--passes", type=int, default=2)
+    off.add_argument("--seed", type=int, default=0)
+
+    onl = sub.add_parser("online", help="scripted adapter demo")
+    onl.add_argument("--ticks", type=int, default=30)
+    onl.add_argument("--burn", default="5:12",
+                     help="burning tick window start:stop")
+    onl.add_argument("--window", type=int, default=8,
+                     help="baseline decode window")
+
+    args = ap.parse_args(argv)
+    return {"capture": cmd_capture, "offline": cmd_offline,
+            "online": cmd_online}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Cross-reference the tunable registry against docs/TUNING.md.
+
+The registry (runtime/tunables.py) is the single source of truth for
+what may be tuned; the catalog table in docs/TUNING.md § Tunable
+registry is where humans read it. Like the telemetry catalog
+(check_telemetry_docs.py), that table only stays useful while it is
+complete and not stale, so this script extracts:
+
+  * every entry registered in ``deepspeed_tpu.runtime.tunables.REGISTRY``
+    (the module is import-light by design — stdlib only — so this
+    works without jax or a configured backend), and
+  * every tunable documented as a catalog table row in docs/TUNING.md
+    (``| `dotted.name` | ...``),
+
+and fails on either direction of drift: registered-but-undocumented
+(write the row) or documented-but-unregistered (stale row).
+tests/unit/runtime/test_tunables_docs.py runs this as a tier-1 test;
+it is also runnable standalone::
+
+    python scripts/check_tunables_docs.py
+"""
+
+import pathlib
+import re
+import sys
+from typing import Set, Tuple
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# catalog rows use the dotted registry name: | `serving.decode_window` |
+_DOC_ROW_RE = re.compile(
+    r"^\|\s*`([a-zA-Z_][a-zA-Z0-9_.]*\.[a-zA-Z0-9_.]+)`\s*\|", re.M)
+
+
+def registered_tunables(root: pathlib.Path = REPO) -> Set[str]:
+    sys.path.insert(0, str(root))
+    try:
+        from deepspeed_tpu.runtime.tunables import REGISTRY
+    finally:
+        sys.path.pop(0)
+    return set(REGISTRY.names())
+
+
+def documented_tunables(root: pathlib.Path = REPO) -> Set[str]:
+    doc = root / "docs" / "TUNING.md"
+    return set(_DOC_ROW_RE.findall(doc.read_text()))
+
+
+def check(root: pathlib.Path = REPO) -> Tuple[Set[str], Set[str]]:
+    """Returns (undocumented, stale) — both empty when the catalog is
+    honest."""
+    code = registered_tunables(root)
+    docs = documented_tunables(root)
+    return code - docs, docs - code
+
+
+def main() -> int:
+    undocumented, stale = check()
+    rc = 0
+    for name in sorted(undocumented):
+        print(f"check_tunables_docs: UNDOCUMENTED tunable {name!r} — "
+              f"add a catalog row to docs/TUNING.md § Tunable registry",
+              file=sys.stderr)
+        rc = 1
+    for name in sorted(stale):
+        print(f"check_tunables_docs: STALE catalog row {name!r} — no "
+              f"such entry in runtime/tunables.py", file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        n = len(registered_tunables())
+        print(f"check_tunables_docs: OK ({n} tunables, catalog in sync)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,6 +3,7 @@ run_sanity_check.py scaled down): a small causal LM must actually LEARN a
 synthetic language — not just tick the loss down — within a step budget."""
 
 import numpy as np
+import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.models import TransformerConfig, TransformerLM
@@ -49,6 +50,7 @@ def test_small_lm_learns_synthetic_language():
     assert last < 1.0, (first, last)
 
 
+@pytest.mark.slow  # tier-1 siblings: test_moe_model_trains + test_pp_x_ep_matches_ep_only cover the ep gating/dispatch path
 def test_moe_lm_learns_with_expert_parallel():
     """Expert-parallel MoE LM (ep=2 x dp=4) learns the synthetic rule —
     convergence through the gating/dispatch path, not just loss ticking

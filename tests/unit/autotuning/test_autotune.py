@@ -1,0 +1,165 @@
+"""Workload capture & replay + the chip-free offline tuner
+(autotuning/capture.py, autotuning/offline.py): artifact determinism
+(ISSUE 16 acceptance — same artifact => identical replay schedule),
+recorder capture, the queueing model, and the coordinate-descent search
+emitting a loadable tuned config that improves >= 1 registered cost
+signal over registry defaults."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu import autotuning
+from deepspeed_tpu.autotuning import OfflineTuner, serving_overrides
+from deepspeed_tpu.runtime import tunables
+
+
+@pytest.fixture
+def artifact():
+    return autotuning.synthesize(requests=32, rate=64.0, seed=7)
+
+
+class TestCapture:
+    def test_synthesize_deterministic_in_seed(self):
+        a = autotuning.synthesize(requests=16, seed=3)
+        b = autotuning.synthesize(requests=16, seed=3)
+        assert a == b
+        c = autotuning.synthesize(requests=16, seed=4)
+        assert a != c
+
+    def test_save_load_roundtrip(self, artifact, tmp_path):
+        p = str(tmp_path / "wl.json")
+        autotuning.save(artifact, p)
+        assert autotuning.load(p) == artifact
+
+    def test_load_rejects_bad_version_and_empty(self, tmp_path):
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps({"version": 99, "requests": [{}]}))
+        with pytest.raises(ValueError, match="version"):
+            autotuning.load(str(p))
+        p.write_text(json.dumps(
+            {"version": autotuning.ARTIFACT_VERSION, "requests": []}))
+        with pytest.raises(ValueError, match="no requests"):
+            autotuning.load(str(p))
+
+    def test_capture_from_recorder(self):
+        from deepspeed_tpu.telemetry import FlightRecorder
+        rec = FlightRecorder()
+        rec.record("request_submit", uid=1, prompt_tokens=10,
+                   max_new_tokens=4)
+        rec.record("request_submit", uid=2, prompt_tokens=200,
+                   max_new_tokens=16, tenant="team-b")
+        art = autotuning.capture_from_recorder(rec)
+        assert art["meta"]["source"] == "flight_recorder"
+        assert len(art["requests"]) == 2
+        # arrivals normalized to the first submit
+        assert art["requests"][0]["t"] == 0.0
+        assert art["requests"][1]["prompt_len"] == 200
+        assert art["requests"][1]["tenant"] == "team-b"
+
+    def test_capture_empty_ring_raises(self):
+        from deepspeed_tpu.telemetry import FlightRecorder
+        with pytest.raises(ValueError, match="no request_submit"):
+            autotuning.capture_from_recorder(FlightRecorder())
+
+
+class TestReplayDeterminism:
+    def test_same_artifact_identical_schedule(self, artifact):
+        """The ISSUE acceptance pin: same artifact in, byte-identical
+        replay schedule out — including the synthetic prompt ids."""
+        s1 = autotuning.replay_schedule(artifact)
+        s2 = autotuning.replay_schedule(artifact)
+        assert s1 == s2
+        assert json.dumps(s1, sort_keys=True) == \
+            json.dumps(s2, sort_keys=True)
+
+    def test_schedule_survives_serialization(self, artifact, tmp_path):
+        p = str(tmp_path / "wl.json")
+        autotuning.save(artifact, p)
+        assert autotuning.replay_schedule(autotuning.load(p)) == \
+            autotuning.replay_schedule(artifact)
+
+    def test_schedule_is_arrival_ordered_and_concrete(self, artifact):
+        sched = autotuning.replay_schedule(artifact)
+        assert [r["t"] for r in sched] == \
+            sorted(r["t"] for r in sched)
+        for r in sched:
+            assert len(r["prompt"]) == r["prompt_len"]
+            assert all(isinstance(t, int) for t in r["prompt"])
+
+
+class TestQueueModel:
+    def test_smaller_budget_waits_longer(self, artifact):
+        sched = autotuning.replay_schedule(artifact)
+        tight = autotuning.simulate_queue(sched, 32)
+        roomy = autotuning.simulate_queue(sched, 4096)
+        assert tight["mean_wait_s"] >= roomy["mean_wait_s"]
+        assert roomy["pad_fraction"] >= tight["pad_fraction"]
+
+    def test_admission_budget_sheds(self, artifact):
+        sched = autotuning.replay_schedule(artifact)
+        open_door = autotuning.simulate_queue(sched, 64)
+        shut = autotuning.simulate_queue(sched, 64, max_queued_tokens=64)
+        assert open_door["shed_fraction"] == 0.0
+        assert shut["shed_fraction"] > 0.0
+        assert shut["served"] < len(sched)
+
+
+class TestOfflineTuner:
+    def test_tune_improves_a_registered_cost_signal(self, artifact):
+        result = OfflineTuner(artifact).tune()
+        assert result["improved_signals"] >= 1
+        assert result["trials"] > 0
+        signals = {t.cost_signal for t in tunables.REGISTRY.entries()}
+        for row in result["report"]:
+            assert row["cost_signal"] in signals
+        # the report is ranked by delta, best first
+        deltas = [r["delta"] for r in result["report"]]
+        assert deltas == sorted(deltas, reverse=True)
+
+    def test_tuned_values_in_registry_range(self, artifact):
+        result = OfflineTuner(artifact).tune()
+        for name, value in result["tuned"].items():
+            assert tunables.REGISTRY.get(name).in_range(value), name
+
+    def test_tune_deterministic(self, artifact):
+        r1 = OfflineTuner(artifact).tune()
+        r2 = OfflineTuner(artifact).tune()
+        assert r1["tuned"] == r2["tuned"]
+        assert r1["report"] == r2["report"]
+
+    def test_config_loads_and_stamps_provenance(self, artifact):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        result = OfflineTuner(
+            artifact,
+            base_config={"train_micro_batch_size_per_gpu": 1}).tune()
+        cfg = result["config"]
+        assert cfg["autotuning"]["tuned"] == result["tuned"]
+        tunables.REGISTRY.reset_observations()
+        try:
+            ds = DeepSpeedConfig(cfg)
+            for name, value in result["tuned"].items():
+                if name.startswith("zero_optimization."):
+                    key = name.split(".", 1)[1]
+                    assert getattr(ds.cfg.zero_optimization, key) == value
+                eff, src = tunables.REGISTRY.effective(name)
+                assert (eff, src) == (value, "tuned"), name
+        finally:
+            tunables.REGISTRY.reset_observations()
+
+    def test_serving_overrides_extraction(self, artifact):
+        result = OfflineTuner(artifact).tune()
+        overrides = serving_overrides(result["config"])
+        for key, value in overrides.items():
+            assert result["tuned"][f"serving.{key}"] == value
+        assert serving_overrides({}) == {}
+
+    def test_unknown_knob_rejected(self, artifact):
+        with pytest.raises(ValueError, match="no offline cost model"):
+            OfflineTuner(artifact, knobs=["autoscaler.load_high"])
+
+    def test_single_knob_search(self, artifact):
+        result = OfflineTuner(
+            artifact, knobs=["serving.token_budget"]).tune()
+        assert set(result["tuned"]) <= {"serving.token_budget"}
+        assert result["report"][0]["knob"] == "serving.token_budget"

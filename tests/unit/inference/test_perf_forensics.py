@@ -85,6 +85,7 @@ def test_watchdog_matches_bucket_cache_behavior(tiny, _fresh):
     assert all(e["signature"] for e in watchdog.events())
 
 
+@pytest.mark.slow  # gate twin: steady_state_recompiles=0 pinned in perf_baseline.json every gate run
 def test_zero_steady_state_recompiles_on_fused_path(tiny, _fresh):
     """The acceptance bar: after warmup passes over the workload's
     buckets, steady-state serving compiles NOTHING — repeat traffic and

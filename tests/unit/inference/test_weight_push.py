@@ -245,6 +245,7 @@ def test_blue_green_convergence_zero_drops(model_and_params, alt_params,
         "new dispatches must land on the target version"
 
 
+@pytest.mark.slow  # tier-1 siblings: test_blue_green_convergence_zero_drops + test_chaos_serving invariant sweep
 def test_blue_green_under_chaos(model_and_params, alt_params,
                                 alt_payloads):
     """A push while the fault plane injects resets + latency must still

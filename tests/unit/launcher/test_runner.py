@@ -79,31 +79,40 @@ def test_parse_args_remainder():
 
 
 def test_runner_autotuning_mode(monkeypatch, tmp_path, capsys):
-    """`ds_tpu --autotuning run script` drives the experiment autotuner
-    (reference launcher/runner.py:360 run_autotuning)."""
+    """`ds_tpu --autotuning run script` drives the offline replay tuner
+    (reference launcher/runner.py:360 run_autotuning semantics)."""
     import deepspeed_tpu.autotuning as at
     from deepspeed_tpu.launcher import runner
 
     calls = {}
 
     class StubTuner:
-        def __init__(self, script, base, exp_dir, **kw):
-            calls["script"] = script
-            calls["exp_dir"] = exp_dir
+        def __init__(self, artifact, base_config=None, **kw):
+            calls["requests"] = len(artifact["requests"])
+            calls["base"] = base_config
 
         def tune(self):
-            return [{"ok": True, "name": "z1_mb4",
-                     "samples_per_sec": 123.0, "config": {"zero": 1}}]
+            return {"tuned": {"zero_optimization.reduce_bucket_size": 1},
+                    "report": [{"knob": "zero_optimization"
+                                        ".reduce_bucket_size",
+                                "tuned": 1, "delta": 0.5}],
+                    "improved_signals": 1, "trials": 7,
+                    "config": {"zero": 1}}
 
-    monkeypatch.setattr(at, "ExperimentAutotuner", StubTuner)
+    monkeypatch.setattr(at, "OfflineTuner", StubTuner)
     rc = runner.main(["--autotuning", "tune",
                       "--autotuning_exp_dir", str(tmp_path),
                       "train.py"])
     assert rc == 0
-    assert calls == {"script": "train.py", "exp_dir": str(tmp_path)}
-    # the winning config was persisted for the user
+    # a synthesized workload was replayed against the default base config
+    assert calls["requests"] > 0
+    assert "optimizer" in calls["base"]
+    # the winning config and the ranked report were persisted for the user
     import json
     assert json.load(open(tmp_path / "best_config.json")) == {"zero": 1}
+    results = json.load(open(tmp_path / "autotune_results.json"))
+    assert results["improved_signals"] == 1
+    assert results["report"][0]["knob"].endswith("reduce_bucket_size")
 
     # mode 'run': after tuning, the real launch happens with the winning
     # config exported (reference bin/deepspeed --autotuning run semantics)
@@ -132,10 +141,10 @@ def test_autotuned_config_rides_node_command(monkeypatch, tmp_path):
             pass
 
         def tune(self):
-            return [{"ok": True, "name": "best", "samples_per_sec": 1.0,
-                     "config": {"zero": 2}}]
+            return {"tuned": {}, "report": [], "improved_signals": 1,
+                    "trials": 1, "config": {"zero": 2}}
 
-    monkeypatch.setattr(at, "ExperimentAutotuner", StubTuner)
+    monkeypatch.setattr(at, "OfflineTuner", StubTuner)
     launched = {}
     monkeypatch.setattr(runner.subprocess, "call",
                         lambda cmd: launched.update(cmd=cmd) or 0)

@@ -439,6 +439,7 @@ def test_pp_x_ep_matches_ep_only():
     assert not eg.sharding.is_fully_replicated
 
 
+@pytest.mark.slow  # tier-1 sibling: test_pp_x_ep_matches_ep_only (same pp x ep composition, aux off)
 def test_pp_x_ep_trains_with_aux_loss():
     """With the load-balancing aux on (per-device statistics), pp x ep
     still tracks the ep-only trajectory and decreases."""

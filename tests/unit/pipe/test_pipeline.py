@@ -64,6 +64,7 @@ def test_pipeline_rejects_zero3():
         run(pp=2, micro=1, gas=2, zero=3)
 
 
+@pytest.mark.slow  # tier-1 sibling: test_pipeline_matches_dp (same pp-vs-dp parity, dense path)
 def test_pipeline_learned_positions_match_dp():
     """GPT-2-style (layernorm + learned positions + gelu) under pp=2 must
     match pure DP — guards the pos_embed path in the pipelined stages."""
@@ -118,6 +119,7 @@ def run_moe(pp, micro, gas, experts, steps=3, coef=0.05, **cfg_kw):
     return losses, engine
 
 
+@pytest.mark.slow  # tier-1 siblings: test_pipeline_matches_dp (parity) + test_pipeline_moe_trains (pp x moe)
 def test_pipeline_moe_single_expert_matches_dp():
     """pp x MoE exact parity check: with E=1 the routing is deterministic in
     ANY token grouping and the aux loss is exactly 1.0 everywhere, so pp=2

@@ -24,9 +24,15 @@ def _topologies_available():
         return False
 
 
-pytestmark = pytest.mark.skipif(
-    not _topologies_available(),
-    reason="libtpu topology descriptions unavailable on this host")
+pytestmark = [
+    pytest.mark.skipif(
+        not _topologies_available(),
+        reason="libtpu topology descriptions unavailable on this host"),
+    # perf-gate twins: train_grad_exposed_collective_fraction /
+    # train_quant_reduce_wire_ratio pin the same AOT overlap structure
+    # every gate run; tier-1 sibling: test_overlap.py sharded-grad report
+    pytest.mark.slow,
+]
 
 
 @pytest.fixture(scope="module")

@@ -98,6 +98,7 @@ def test_lora_fuse_unfuse_roundtrip():
                                   np.asarray(params["other"]))
 
 
+@pytest.mark.slow  # tier-1 sibling: test_generate_uses_current_training_weights (same train->publish->generate loop, dense)
 def test_hybrid_engine_moe_expert_parallel():
     """RLHF hybrid engine over a live expert-parallel MoE actor: train a
     step, then generate with the SAME sharded weights (reference hybrid

@@ -77,6 +77,7 @@ def test_infinity_loss_parity_and_files(tmp_path):
     np.testing.assert_allclose(losses["inf"], losses["std"], atol=2e-3)
 
 
+@pytest.mark.slow  # tier-1 sibling: test_infinity_loss_parity_and_files (same streamed update; nvme tier = dir-backed host path)
 def test_infinity_full_nvme_optimizer_states(tmp_path):
     """offload_optimizer nvme + offload_param nvme = full ZeRO-Infinity:
     per-layer optim files on disk, still parity with the standard path."""
@@ -105,6 +106,7 @@ def test_infinity_gradient_accumulation(tmp_path):
         np.testing.assert_allclose(li, ls, atol=2e-3)
 
 
+@pytest.mark.slow  # tier-1 sibling: test_infinity_loss_parity_and_files (same streamed-layer path, dp-only)
 def test_infinity_tensor_parallel(tmp_path):
     """dp x tp: each streamed layer is device_put with its TP sharding."""
     cfg = _cfg(num_layers=2)

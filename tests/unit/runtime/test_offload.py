@@ -92,6 +92,7 @@ def test_fp16_offload_skips_on_overflow():
     assert engine.loss_scale < 2.0 ** 32
 
 
+@pytest.mark.slow  # tier-1 siblings: test_cpu_offload_trains_and_matches_device_path + pipe/test_pipeline_trains
 def test_offload_x_pipeline():
     """ZeRO-Offload composes with pipeline parallelism: the 1F1B pipeline
     produces gradients, the host C++ optimizer applies them (lifts the

@@ -3,6 +3,7 @@ overlap report that replaces the reference's two-stream eyeballing
 (stage3.py:1151)."""
 
 import numpy as np
+import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -86,6 +87,7 @@ def test_zero3_overlap_comm_unrolls_layer_scan():
     np.testing.assert_allclose(losses[True], losses[False], rtol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 sibling: test_overlap_report_on_sharded_grad; gate twin: train_grad_exposed_collective_fraction
 def test_chip_evidence_overlap_section(tmp_path):
     """The chip-evidence collector's overlap section runs end-to-end
     (engine.lower_train_step -> HLO analysis) and writes its JSON."""

@@ -1,0 +1,186 @@
+"""The declarative tunable registry (runtime/tunables.py): validation
+routed through registry entries, error messages that name the entry and
+its documented range, provenance tracking, and the /statusz section."""
+
+import pytest
+
+from deepspeed_tpu.runtime import tunables
+from deepspeed_tpu.runtime.tunables import (PROVENANCES, REGISTRY,
+                                            Tunable, TunableRegistry)
+
+
+@pytest.fixture
+def reg():
+    r = TunableRegistry()
+    r.register(Tunable(name="a.knob", default=8, lo=1, hi=64,
+                       cost_signal="sig_a", doc="", online=True,
+                       search=(2, 4, 8, 16)))
+    r.register(Tunable(name="b.cap", default=None, lo=1, hi=1 << 20,
+                       cost_signal="sig_b", doc="",
+                       search=(256, 1024)))
+    return r
+
+
+class TestRegistrySemantics:
+    def test_check_coerces_and_passes_in_range(self, reg):
+        assert reg.check("a.knob", 16.0) == 16
+        assert isinstance(reg.check("a.knob", 16.0), int)
+
+    def test_check_error_names_entry_and_range(self, reg):
+        with pytest.raises(ValueError) as ei:
+            reg.check("a.knob", 0)
+        msg = str(ei.value)
+        assert "a.knob" in msg
+        assert "[1, 64]" in msg
+        assert "docs/TUNING.md" in msg
+
+    def test_check_custom_exc_and_label(self, reg):
+        class Boom(Exception):
+            pass
+
+        with pytest.raises(Boom, match="my_field"):
+            reg.check("a.knob", 999, exc=Boom, label="my_field")
+
+    def test_check_rejects_nan_and_garbage(self, reg):
+        with pytest.raises(ValueError):
+            reg.check("a.knob", float("nan"))
+        with pytest.raises(ValueError):
+            reg.check("a.knob", "not-a-number")
+
+    def test_unknown_name_lists_registered(self, reg):
+        with pytest.raises(KeyError, match="a.knob"):
+            reg.check("no.such", 1)
+
+    def test_clamp_snaps_into_range(self, reg):
+        assert reg.clamp("a.knob", 0) == 1
+        assert reg.clamp("a.knob", 1000) == 64
+        assert reg.clamp("a.knob", 32) == 32
+
+    def test_ladder_includes_default_sorted(self, reg):
+        assert reg.ladder("a.knob") == [2, 4, 8, 16]
+        # None default is skipped, not crashed on
+        assert reg.ladder("b.cap") == [256, 1024]
+
+    def test_conflicting_redefinition_rejected(self, reg):
+        with pytest.raises(ValueError, match="already registered"):
+            reg.register(Tunable(name="a.knob", default=9,
+                                 cost_signal="sig_a", doc=""))
+        # identical re-registration is idempotent
+        reg.register(Tunable(name="a.knob", default=8, lo=1, hi=64,
+                             cost_signal="sig_a", doc="", online=True,
+                             search=(2, 4, 8, 16)))
+
+
+class TestProvenance:
+    def test_default_until_observed(self, reg):
+        assert reg.effective("a.knob") == (8, "default")
+
+    def test_config_observation(self, reg):
+        reg.observe("a.knob", 32, "config")
+        assert reg.effective("a.knob") == (32, "config")
+
+    def test_config_equal_to_default_demotes(self, reg):
+        reg.observe("a.knob", 8, "config")
+        assert reg.effective("a.knob") == (8, "default")
+
+    def test_last_writer_wins(self, reg):
+        reg.observe("a.knob", 32, "config")
+        reg.observe("a.knob", 4, "online")
+        assert reg.effective("a.knob") == (4, "online")
+
+    def test_bad_provenance_rejected(self, reg):
+        with pytest.raises(ValueError, match="provenance"):
+            reg.observe("a.knob", 8, "magic")
+
+    def test_statusz_section_shape(self, reg):
+        reg.observe("a.knob", 16, "tuned")
+        sec = reg.statusz_section()
+        assert sec["a.knob"] == {
+            "value": 16, "provenance": "tuned", "default": 8,
+            "range": "[1, 64]", "cost_signal": "sig_a", "online": True}
+        assert sec["b.cap"]["provenance"] == "default"
+
+
+class TestGlobalRegistry:
+    def test_expected_entries_registered(self):
+        for name in ("zero_optimization.reduce_bucket_size",
+                     "zero_optimization.quant_block",
+                     "serving.decode_window", "serving.token_budget",
+                     "serving.max_queued_tokens",
+                     "serving.handoff_chunk_blocks",
+                     "state_manager.kv_spill_host_bytes",
+                     "autoscaler.load_high"):
+            assert name in REGISTRY, name
+
+    def test_online_entries_are_exactly_the_adapter_knobs(self):
+        online = {t.name for t in REGISTRY.entries() if t.online}
+        assert online == {"serving.decode_window",
+                          "serving.max_queued_tokens"}
+
+    def test_every_entry_default_in_own_range(self):
+        for t in REGISTRY.entries():
+            if t.default is not None:
+                assert t.in_range(t.default), t.name
+            for v in t.search:
+                assert t.in_range(v), (t.name, v)
+
+    def test_provenances_constant(self):
+        assert PROVENANCES == ("default", "config", "tuned", "online")
+
+
+class TestConfigIntegration:
+    def test_zero_config_error_names_registry_entry(self):
+        from deepspeed_tpu.runtime.config import ConfigError, ZeroConfig
+        with pytest.raises(ConfigError) as ei:
+            ZeroConfig(reduce_bucket_size=0)
+        msg = str(ei.value)
+        assert "zero_optimization.reduce_bucket_size" in msg
+        assert "docs/TUNING.md" in msg
+
+    def test_quant_block_error_names_registry_entry(self):
+        from deepspeed_tpu.runtime.config import ConfigError, ZeroConfig
+        with pytest.raises(ConfigError, match="quant_block"):
+            ZeroConfig(quantized_reduce="int8", quant_block=-5)
+
+    def test_state_manager_spill_error_names_entry(self):
+        from deepspeed_tpu.inference.v2.config_v2 import \
+            DSStateManagerConfig
+        with pytest.raises(ValueError) as ei:
+            DSStateManagerConfig(enable_kv_spill=True,
+                                 enable_prefix_caching=True,
+                                 kv_spill_host_bytes=0)
+        msg = str(ei.value)
+        assert "kv_spill_host_bytes" in msg
+        assert "state_manager.kv_spill_host_bytes" in msg
+
+    def test_engine_config_decode_window_routed(self):
+        from deepspeed_tpu.inference.v2.config_v2 import \
+            RaggedInferenceEngineConfig
+        with pytest.raises(ValueError) as ei:
+            RaggedInferenceEngineConfig(decode_window=0)
+        assert "serving.decode_window" in str(ei.value)
+
+    def test_admission_budget_routed(self):
+        from deepspeed_tpu.inference.v2.serve.admission import \
+            AdmissionConfig
+        with pytest.raises(ValueError) as ei:
+            AdmissionConfig(max_queued_tokens=0)
+        assert "serving.max_queued_tokens" in str(ei.value)
+        AdmissionConfig(max_queued_tokens=None)   # None stays legal
+
+    def test_tuned_config_records_provenance(self):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        REGISTRY.reset_observations()
+        try:
+            DeepSpeedConfig({
+                "train_micro_batch_size_per_gpu": 1,
+                "zero_optimization": {"reduce_bucket_size": 1 << 24},
+                "autotuning": {"tuned": {
+                    "zero_optimization.reduce_bucket_size": 1 << 24}},
+            })
+            value, source = REGISTRY.effective(
+                "zero_optimization.reduce_bucket_size")
+            assert value == 1 << 24
+            assert source == "tuned"
+        finally:
+            REGISTRY.reset_observations()

@@ -1,0 +1,58 @@
+"""Tier-1 enforcement of the docs/TUNING.md § Tunable registry catalog
+(scripts/check_tunables_docs.py): every entry registered in
+runtime/tunables.py has a catalog row, and every row names a real
+entry."""
+
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parents[3]
+sys.path.insert(0, str(REPO / "scripts"))
+
+import check_tunables_docs  # noqa: E402
+
+
+def test_extractors_see_the_known_tunables():
+    """Sanity-pin the extractors (an empty set passing the cross-check
+    would mean the regex rotted, not that docs are perfect)."""
+    code = check_tunables_docs.registered_tunables(REPO)
+    assert len(code) >= 10
+    for expected in ("serving.decode_window",
+                     "zero_optimization.reduce_bucket_size",
+                     "serving.max_queued_tokens",
+                     "state_manager.kv_spill_host_bytes",
+                     "autoscaler.cooldown_s"):
+        assert expected in code, expected
+    docs = check_tunables_docs.documented_tunables(REPO)
+    assert len(docs) >= 10
+    assert "serving.decode_window" in docs
+    # dotless rows elsewhere in TUNING.md (remat policies etc.) must
+    # NOT parse as tunables
+    assert "nothing_saveable" not in docs
+
+
+def test_catalog_is_in_sync():
+    undocumented, stale = check_tunables_docs.check(REPO)
+    assert not undocumented, (
+        f"tunables registered in runtime/tunables.py but missing from "
+        f"docs/TUNING.md § Tunable registry: {sorted(undocumented)} — "
+        f"add catalog rows")
+    assert not stale, (
+        f"docs/TUNING.md catalog rows with no registry entry behind "
+        f"them: {sorted(stale)} — delete or fix the rename")
+
+
+def test_cli_reports_drift(tmp_path, monkeypatch):
+    """check() fails loudly on a stale doc row against a doctored doc
+    tree (the registry side comes from the real package)."""
+    root = tmp_path / "repo"
+    (root / "docs").mkdir(parents=True)
+    real_doc = (REPO / "docs" / "TUNING.md").read_text()
+    (root / "docs" / "TUNING.md").write_text(
+        real_doc + "\n| `stale.block.gone_knob` | 1 | [1, 2] | no | "
+                   "`x` | stale |\n")
+    # registered_tunables(root) falls back to the already-imported real
+    # package — exactly what we want: real registry vs doctored docs
+    undocumented, stale = check_tunables_docs.check(root)
+    assert "stale.block.gone_knob" in stale
+    assert not undocumented
