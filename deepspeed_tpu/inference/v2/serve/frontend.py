@@ -601,10 +601,22 @@ class WeightUpdate:
         stager.commit_check()
         loop = self._serving._loop_runner
 
+        engine = loop.scheduler.engine
+        # host-side half off the loop thread: for DELTA payloads this
+        # validates the base version and reconstructs base +
+        # dequant(delta) (typed failure on stale base, live params
+        # untouched); full payloads pass through
+        try:
+            flat = await asyncio.to_thread(
+                serve_weights.prepare_stager, engine, stager)
+        except BaseException:
+            await self.abort()
+            raise
+
         def swap() -> int:
-            serve_weights.swap_engine_params(
-                loop.scheduler.engine, stager.leaves, stager.version)
-            return stager.version
+            serve_weights.swap_engine_params(engine, flat,
+                                             stager.version)
+            return int(stager.version)
         try:
             version = await loop.run_on_loop(swap)
         finally:

@@ -456,6 +456,15 @@ class ReplicaRouter:
             "router_target_weight_version",
             "the fleet's target weight version (0 until the first "
             "push)")
+        # delta negotiation (serve/weights.py § delta payloads)
+        self._m_delta_pushes = reg.counter(
+            "router_weight_delta_pushes_total",
+            "per-replica pushes that shipped the quantized DELTA "
+            "payload (replica advertised the delta's base version)")
+        self._m_delta_fallbacks = reg.counter(
+            "router_weight_delta_fallbacks_total",
+            "delta pushes that failed typed (stale base, no retained "
+            "base, corrupt chunk) and fell back to the full payload")
         self._m_replica_version = reg.gauge(
             "router_replica_weight_version",
             "per-replica live weight version as last advertised "
@@ -1027,7 +1036,9 @@ class ReplicaRouter:
 
     # -- blue/green weight push (serve/weights.py) ----------------------
     async def push_weights(self, payloads: Sequence[bytes],
-                           version: Optional[int] = None) -> int:
+                           version: Optional[int] = None,
+                           delta: Optional[Sequence[bytes]] = None
+                           ) -> int:
         """Converge the fleet onto a new weight version, blue/green:
 
         1. the payload version becomes the fleet TARGET (``_routable``
@@ -1045,8 +1056,21 @@ class ReplicaRouter:
         where they started, and a replica that cannot be pushed (still
         up, still stale) fails the rollout TYPED. The payload is cached
         so later ``add_replica`` scale-ups join at the live version.
-        Returns the target version."""
+        Returns the target version.
+
+        ``delta`` (or a :class:`~....runtime.hybrid_engine.
+        WeightPublication` passed as ``payloads``) enables per-replica
+        DELTA NEGOTIATION: a replica whose advertised
+        ``weight_version`` equals the delta's ``base_version`` gets the
+        quantized delta payload (~4x fewer wire bytes); anyone else —
+        and any delta that fails typed (stale base, corrupt chunk) —
+        gets the full payload. Only the FULL payload is cached for
+        scale-up sync (newcomers hold no base)."""
         from . import weights as serve_weights
+        if hasattr(payloads, "full"):   # a WeightPublication
+            if delta is None:
+                delta = payloads.delta
+            payloads = payloads.full
         if self.config.disaggregated:
             raise NotImplementedError(
                 "blue/green weight push over disaggregated fleets is "
@@ -1060,6 +1084,17 @@ class ReplicaRouter:
         version = int(version)
         t0 = time.perf_counter()
         payloads = list(payloads)
+        delta_base: Optional[int] = None
+        delta_nbytes = 0
+        if delta is not None:
+            delta = list(delta)
+            if serve_weights.payload_version(delta) != version:
+                raise ValueError(
+                    f"delta payload version "
+                    f"{serve_weights.payload_version(delta)} != full "
+                    f"payload version {version}")
+            delta_base = serve_weights.delta_base_version(delta)
+            delta_nbytes = serve_weights.payload_bytes(delta)
         self.target_weight_version = version
         self._weight_payloads = payloads
         self._m_target_version.set(version)
@@ -1070,6 +1105,24 @@ class ReplicaRouter:
                 continue
             if self._replica_weight_version(replica) == version:
                 continue
+            if (delta is not None
+                    and self._replica_weight_version(replica)
+                    == delta_base):
+                try:
+                    await self._push_to_replica(replica, delta,
+                                                delta_nbytes)
+                    self._m_delta_pushes.inc()
+                    continue
+                except Exception as e:
+                    # typed delta rejection (stale base, corrupt
+                    # chunk, pre-delta worker): fall back to the full
+                    # payload for this replica
+                    self._m_delta_fallbacks.inc()
+                    trace.record(
+                        "router_weight_delta_fallback", t0,
+                        time.perf_counter() - t0, lane=_ROUTER_LANE,
+                        replica=replica.name,
+                        error=f"{type(e).__name__}: {e}")
             try:
                 await self._push_to_replica(replica, payloads, nbytes)
             except Exception as e:

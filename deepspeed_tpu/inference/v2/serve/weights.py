@@ -27,6 +27,27 @@ ingest side casts to the serving dtype with the same ``jnp.asarray``
 cast a fresh engine applies at init, which is what makes post-swap
 streams bit-identical to a fresh engine built from the published
 payload (the hot-swap parity pin).
+
+DELTA payloads (docs/SERVING.md § Delta weight push): at RLHF
+publish-every-N cadence push bytes are the scaling limit, so
+``chunk_weight_deltas`` ships ``current - base`` block-quantized to
+int8 with fp32 per-block scales (the PR 9 quantized-wire helpers,
+comm/quantized.py) instead of full fp32 leaves — ~4x fewer bytes.
+The header grows ``payload_kind="delta"``, ``base_version`` and a
+per-chunk manifest; each chunk carries the concatenated int8 values +
+scales for its leaf bucket (EQuARX, arXiv:2506.17615 — the publisher
+carries error-feedback residuals across pushes, see
+hybrid_engine.WeightPublisher). Ingest (``commit_stager``) rebuilds
+``base + dequant(delta)`` HOST-SIDE against the fp32 base retained
+from the last applied payload, then runs the same donated-buffer swap
+— still zero steady-state recompiles. A stale base, version mismatch
+or CRC failure raises typed BEFORE any live param is touched (the
+router falls back to a full push). Reconstruction is deterministic
+numpy fp32, so every replica following the delta chain holds
+bit-identical weights — the publisher's error-feedback reference
+tracks them exactly. ``quant="off"`` ships changed leaves at full
+fp32 (bitwise-unchanged leaves are skipped), making reconstruction
+EXACTLY equal to a full push.
 """
 
 import time
@@ -120,6 +141,200 @@ def chunk_weight_leaves(groups: Iterable[Dict[str, np.ndarray]],
          "chunk_leaves": chunk_leaves, "leaf_meta": leaf_meta,
          "param_count": param_count}, {})
     return [header] + chunks
+
+
+# ---------------------------------------------------------------------------
+# Delta payloads (quantized weight-delta publication)
+# ---------------------------------------------------------------------------
+# delta quant modes: "int8" (block-quantized values + fp32 block scales,
+# the comm/quantized wire form) or "off" (changed leaves at full fp32 —
+# reconstruction is bitwise-exact)
+DELTA_QUANT_MODES = ("int8", "off")
+DEFAULT_DELTA_BLOCK = 2048
+
+
+def _delta_keys(seq: int) -> Tuple[str, str]:
+    """The two kv entries of one int8 delta chunk: concatenated
+    quantized values and concatenated fp32 block scales. Seq-suffixed
+    so a stager's flat leaf map never collides across chunks."""
+    return f"__dq{seq}__", f"__ds{seq}__"
+
+
+def _dequant_leaf(q_flat: np.ndarray, s_flat: np.ndarray,
+                  numel: int) -> np.ndarray:
+    """(int8 [nb*block], f32 [nb]) -> flat f32 [numel]. Plain numpy so
+    the publisher's error-feedback reference and every ingesting
+    replica reconstruct BIT-IDENTICAL values."""
+    nb = int(s_flat.shape[0])
+    d = q_flat.reshape(nb, -1).astype(np.float32) * \
+        s_flat.reshape(nb, 1).astype(np.float32)
+    return d.reshape(-1)[:numel]
+
+
+def chunk_weight_deltas(flat: Dict[str, np.ndarray],
+                        base: Dict[str, np.ndarray], version: int,
+                        base_version: int, quant: str = "int8",
+                        block: int = DEFAULT_DELTA_BLOCK,
+                        bucket_bytes: int = DEFAULT_BUCKET_BYTES
+                        ) -> Tuple[List[bytes], Dict[str, np.ndarray]]:
+    """Serialize ``flat - base`` into a DELTA payload
+    ``[header, chunk...]``.
+
+    ``base`` is the receivers' reconstruction of ``base_version`` (the
+    publisher's error-feedback reference — it tracks the fleet exactly,
+    so the residual the quantizer introduced at version k is folded
+    into the k+1 delta automatically). Returns ``(payloads, recon)``
+    where ``recon`` is the bit-exact fleet state after this payload is
+    applied — the caller's next error-feedback reference."""
+    if quant not in DELTA_QUANT_MODES:
+        raise ValueError(
+            f"delta quant mode must be one of {DELTA_QUANT_MODES} "
+            f"(got {quant!r})")
+    if set(flat) != set(base):
+        raise ValueError(
+            "delta publication leaf set changed vs the base version; "
+            "publisher and base must share one model structure")
+    import jax.numpy as jnp
+
+    from ....comm.quantized import _quantize_wire
+    chunks: List[bytes] = []
+    crcs: List[int] = []
+    chunk_leaves: List[List[str]] = []
+    delta_manifest: List[list] = []
+    leaf_meta: Dict[str, dict] = {}
+    recon: Dict[str, np.ndarray] = {}
+    param_count = 0
+    items = list(flat.items())
+    for seq, names in enumerate(plan_buckets(items, bucket_bytes)):
+        manifest: list = []
+        if quant == "off":
+            kv: Dict[str, np.ndarray] = {}
+            for n in names:
+                cur = np.ascontiguousarray(np.asarray(flat[n],
+                                                      np.float32))
+                leaf_meta[n] = {"shape": list(cur.shape)}
+                param_count += int(cur.size)
+                ref = np.asarray(base[n], np.float32)
+                if cur.shape != ref.shape:
+                    raise ValueError(
+                        f"delta leaf {n!r} shape {cur.shape} != base "
+                        f"shape {ref.shape}")
+                if np.array_equal(cur, ref):
+                    recon[n] = ref     # unchanged: receiver keeps base
+                else:
+                    kv[n] = cur
+                    # recon must not alias the caller's live array (it
+                    # becomes the next error-feedback base)
+                    recon[n] = np.array(cur, np.float32)
+                    manifest.append(n)
+        else:
+            qk, sk = _delta_keys(seq)
+            qs: List[np.ndarray] = []
+            ss: List[np.ndarray] = []
+            for n in names:
+                cur = np.asarray(flat[n], np.float32)
+                ref = np.asarray(base[n], np.float32)
+                if cur.shape != ref.shape:
+                    raise ValueError(
+                        f"delta leaf {n!r} shape {cur.shape} != base "
+                        f"shape {ref.shape}")
+                leaf_meta[n] = {"shape": list(cur.shape)}
+                numel = int(cur.size)
+                param_count += numel
+                d = np.ascontiguousarray(cur - ref).reshape(-1)
+                q, s = _quantize_wire(jnp.asarray(d),
+                                      max(1, min(int(block),
+                                                 max(numel, 1))),
+                                      "int8")
+                q = np.asarray(q, np.int8)
+                s = np.asarray(s, np.float32)
+                manifest.append({"name": n, "numel": numel,
+                                 "nb": int(q.shape[0]),
+                                 "block": int(q.shape[1])})
+                recon[n] = (ref.reshape(-1)
+                            + _dequant_leaf(q.reshape(-1),
+                                            s.reshape(-1), numel)
+                            ).astype(np.float32).reshape(cur.shape)
+                qs.append(q.reshape(-1))
+                ss.append(s.reshape(-1))
+            kv = {qk: (np.concatenate(qs) if qs
+                       else np.zeros(0, np.int8)),
+                  sk: (np.concatenate(ss) if ss
+                       else np.zeros(0, np.float32))}
+        crc = _chunk_crc(kv)
+        crcs.append(crc)
+        chunk_leaves.append(sorted(kv))
+        delta_manifest.append(manifest)
+        chunks.append(_npz_chunk(
+            {"kind": _CHUNK_KIND, "seq": seq, "crc32": crc,
+             "version": int(version)}, kv))
+    header = _npz_chunk(
+        {"kind": _HEADER_KIND, "version": int(version),
+         "payload_kind": "delta", "base_version": int(base_version),
+         "quant": quant, "n_chunks": len(chunks), "chunk_crcs": crcs,
+         "chunk_leaves": chunk_leaves,
+         "delta_manifest": delta_manifest, "leaf_meta": leaf_meta,
+         "param_count": param_count}, {})
+    return [header] + chunks, recon
+
+
+def is_delta_header(header: Dict) -> bool:
+    return header.get("payload_kind") == "delta"
+
+
+def is_delta_payload(payloads: Sequence[bytes]) -> bool:
+    return is_delta_header(parse_weights_header(payloads[0]))
+
+
+def delta_base_version(payloads: Sequence[bytes]) -> int:
+    header = parse_weights_header(payloads[0])
+    if not is_delta_header(header):
+        raise ValueError("not a delta payload (no base_version)")
+    return int(header["base_version"])
+
+
+def reconstruct_delta(header: Dict, staged: Dict[str, np.ndarray],
+                      base: Dict[str, np.ndarray]
+                      ) -> Dict[str, np.ndarray]:
+    """Rebuild the full ``{name: fp32 ndarray}`` map from a staged
+    delta payload and the receiver's retained base (``base_version``'s
+    fp32 leaves). Pure host math, deterministic — every replica
+    applying this payload over the same base holds identical bits."""
+    quant = header.get("quant", "int8")
+    out = dict(base)
+    missing = [n for n in header["leaf_meta"] if n not in base]
+    if missing:
+        raise ValueError(
+            f"delta payload names {len(missing)} leaves absent from "
+            f"the retained base (first: {missing[:3]})")
+    for seq, manifest in enumerate(header["delta_manifest"]):
+        if quant == "off":
+            for n in manifest:
+                out[n] = np.asarray(staged[n], np.float32)
+            continue
+        qk, sk = _delta_keys(seq)
+        q_flat = np.asarray(staged[qk])
+        s_flat = np.asarray(staged[sk], np.float32)
+        q_off = s_off = 0
+        for ent in manifest:
+            n, numel = ent["name"], int(ent["numel"])
+            nb, blk = int(ent["nb"]), int(ent["block"])
+            ref = np.asarray(base[n], np.float32)
+            if int(ref.size) != numel:
+                raise ValueError(
+                    f"delta leaf {n!r} numel {numel} != base "
+                    f"{int(ref.size)}")
+            q_seg = q_flat[q_off:q_off + nb * blk]
+            s_seg = s_flat[s_off:s_off + nb]
+            if q_seg.size != nb * blk or s_seg.size != nb:
+                raise ValueError(
+                    f"delta chunk {seq} truncated at leaf {n!r}")
+            out[n] = (ref.reshape(-1)
+                      + _dequant_leaf(q_seg, s_seg, numel)
+                      ).astype(np.float32).reshape(ref.shape)
+            q_off += nb * blk
+            s_off += nb
+    return out
 
 
 def parse_weights_header(buf: bytes) -> Dict:
@@ -272,14 +487,72 @@ def swap_engine_params(engine, flat: Dict[str, np.ndarray],
         new_leaves.append(arr)
     engine.params = jax.tree_util.tree_unflatten(treedef, new_leaves)
     engine.weight_version = int(version)
+    # retain the fp32 flat leaves as the DELTA BASE for the next push:
+    # a delta payload reconstructs against exactly these bits (the
+    # receiver-side half of the publisher's error-feedback reference).
+    # Host cost: one fp32 copy of the model per serving engine.
+    set_delta_base(engine, flat)
     engine.note_weight_swap(time.perf_counter() - t0)
 
 
+def set_delta_base(engine, flat: Dict[str, np.ndarray]) -> None:
+    """Record ``flat`` (fp32 host leaves) as the engine's delta base —
+    what ``commit_stager`` reconstructs the next delta payload
+    against. Called by every ingest path (swap + fresh build)."""
+    engine._weight_flat_base = {
+        n: np.asarray(a, np.float32) for n, a in flat.items()}
+
+
+def delta_base_of(engine):
+    """The engine's retained ``{name: fp32 ndarray}`` delta base, or
+    None when it never ingested a payload (boot-checkpoint engines
+    cannot take deltas — the router falls back to a full push)."""
+    return getattr(engine, "_weight_flat_base", None)
+
+
+def prepare_stager(engine, stager: WeightStager
+                   ) -> Dict[str, np.ndarray]:
+    """The host-side half of ingest: validate + (for deltas)
+    reconstruct the full flat leaf map, touching nothing live. Delta
+    payloads validate base version + retained base BEFORE any
+    reconstruction — a stale base fails typed with the live params
+    untouched. Runs off the serving loop thread (heavy host math);
+    the returned map goes to ``swap_engine_params`` between scheduler
+    steps."""
+    header = stager.header
+    if not is_delta_header(header):
+        return stager.leaves
+    base_version = int(header["base_version"])
+    live = int(getattr(engine, "weight_version", 0) or 0)
+    base = delta_base_of(engine)
+    if live != base_version:
+        raise ValueError(
+            f"delta payload base_version={base_version} does not "
+            f"match the live weight_version={live}; a full push is "
+            f"required")
+    if base is None:
+        raise ValueError(
+            "delta payload cannot apply: this engine retains no delta "
+            "base (it never ingested a weight payload); a full push "
+            "is required")
+    return reconstruct_delta(header, stager.leaves, base)
+
+
+def commit_stager(engine, stager: WeightStager) -> int:
+    """THE ingest choke point: every path that turns a complete stager
+    into live params (colocated ``apply_payload``, the serving loop's
+    ``WeightUpdate.commit``, the worker ``/weights`` handler above it)
+    lands here, so full and delta payloads behave identically
+    everywhere."""
+    flat = prepare_stager(engine, stager)
+    swap_engine_params(engine, flat, stager.version)
+    return int(stager.version)
+
+
 def apply_payload(engine, payloads: Sequence[bytes]) -> int:
-    """Stage + swap a complete payload into ``engine`` synchronously
-    (the colocated hybrid path; serving runtimes go through
-    :meth:`~.frontend.ServingEngine.begin_weight_update` so the swap
-    lands between scheduler steps). Returns the installed version."""
-    stager = stage_payload(payloads)
-    swap_engine_params(engine, stager.leaves, stager.version)
-    return stager.version
+    """Stage + swap a complete payload (full or delta) into ``engine``
+    synchronously (the colocated hybrid path; serving runtimes go
+    through :meth:`~.frontend.ServingEngine.begin_weight_update` so the
+    swap lands between scheduler steps). Returns the installed
+    version."""
+    return commit_stager(engine, stage_payload(payloads))

@@ -332,6 +332,36 @@ def _chunked_ce_loss(x, targets, mask, head, chunk: int, bias=None):
     return total, jnp.sum(mask)
 
 
+def _chunked_token_logprobs(x, targets, head, chunk: int):
+    """Per-token ``log softmax(x @ head)[target]`` [B, S] without
+    materializing [B, S, V] logits — the same sequence-chunked scan +
+    rematerialization as :func:`_chunked_ce_loss`, returning the
+    per-position values instead of their masked sum (the PPO ratio and
+    KL terms need each token's logprob, not an aggregate)."""
+    B, S, H = x.shape
+    chunk = min(chunk, S) if chunk and chunk > 0 else S
+    pad = (-S) % chunk
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+    n_chunks = x.shape[1] // chunk
+    xc = x.reshape(B, n_chunks, chunk, H).swapaxes(0, 1)
+    tc = targets.reshape(B, n_chunks, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def chunk_lp(x_c, t_c):
+        logits = (x_c @ head.astype(x_c.dtype)).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, t_c[..., None], axis=-1)[..., 0]
+        return tgt - lse
+
+    def body(carry, inputs):
+        return carry, chunk_lp(*inputs)
+
+    _, lps = jax.lax.scan(body, None, (xc, tc))
+    return lps.swapaxes(0, 1).reshape(B, -1)[:, :S]
+
+
 class TransformerLM:
     """Functional decoder-only LM implementing the engine model protocol."""
 
@@ -959,7 +989,15 @@ class TransformerLM:
         {input_ids [B,S], optional loss_mask}; objective="mlm" (BERT
         family): masked-LM loss on {input_ids, labels, loss_mask} with
         bidirectional attention, no shift. Under pipeline parallelism
-        input_ids is [M, B, S]."""
+        input_ids is [M, B, S].
+
+        A batch carrying ``ppo_old_logprobs`` routes to the clipped-PPO
+        objective (:meth:`_apply_ppo`) — the RLHF learner's loss. The
+        batch-dict STRUCTURE is part of the jit trace, so PPO batches
+        compile their own program per shape bucket and coexist with LM
+        batches in one engine without respecialization."""
+        if "ppo_old_logprobs" in batch:
+            return self._apply_ppo(params, batch)
         if self.topology is not None and self.topology.axis_size("pipe") > 1:
             assert self.cfg.is_causal, \
                 "pipeline parallelism supports objective='causal_lm' only"
@@ -991,6 +1029,66 @@ class TransformerLM:
             total, count = _chunked_ce_loss(x[:, :-1], ids[:, 1:], mask,
                                             head, self.cfg.loss_chunk)
         loss = total / jnp.maximum(count, 1.0)
+        if self.cfg.moe_num_experts > 0:
+            loss = loss + self.cfg.moe_aux_loss_coef * aux
+        return loss
+
+    def _apply_ppo(self, params, batch):
+        """Clipped-PPO loss with a reference-policy KL term (the RLHF
+        learner objective; rl/learner.py packs the batch).
+
+        Batch (all [B, S] aligned with ``input_ids``, plus
+        ``ppo_hparams`` [B, 2]):
+          * ``loss_mask`` — 1 at GENERATED token positions (the
+            rollout's sampled tokens; prompt + pad are 0),
+          * ``ppo_old_logprobs`` — the behavior policy's per-token
+            logprobs recorded AT ROLLOUT TIME (serving as both the
+            importance-ratio denominator and the reference policy of
+            the KL term — no second reference forward),
+          * ``ppo_advantages`` — host-computed GAE advantages
+            (rl/advantage.py),
+          * ``ppo_hparams`` — every row ``[clip_eps, kl_coef]``:
+            traced values, so tuning them never recompiles.
+
+        Per masked token t (predicted at position t-1 — the causal
+        shift):  ratio = exp(new_lp - old_lp),
+        pg = -min(ratio*adv, clip(ratio, 1±eps)*adv), and the k3 KL
+        estimator kl = exp(old-new) - 1 - (old-new) (unbiased,
+        non-negative). Loss is the masked mean of pg + kl_coef*kl —
+        same masked-mean discipline as the LM objective, so the
+        engine's fp16 loss scaling and gradient plumbing apply
+        verbatim."""
+        assert self.cfg.is_causal, \
+            "PPO batches require objective='causal_lm' (the rollout " \
+            "policy is a decoder)"
+        assert (self.topology is None
+                or self.topology.axis_size("pipe") == 1), \
+            "PPO learner batches are not supported under pipeline " \
+            "parallelism yet (the shifted per-token logprob gather " \
+            "needs the last stage's full sequence)"
+        ids = batch["input_ids"]
+        x, aux = self.forward_hidden(params, ids)
+        head = (params["embed"].T if self.cfg.tie_embeddings
+                else params["lm_head"])
+        new_lp = _chunked_token_logprobs(x[:, :-1], ids[:, 1:], head,
+                                         self.cfg.loss_chunk)
+        mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
+        old_lp = batch["ppo_old_logprobs"][:, 1:].astype(jnp.float32)
+        adv = batch["ppo_advantages"][:, 1:].astype(jnp.float32)
+        hp = batch["ppo_hparams"].astype(jnp.float32)
+        # every row carries the same (clip_eps, kl_coef); the mean is a
+        # plain reduction (no single-row gather across the dp shards)
+        clip_eps = jnp.mean(hp[:, 0])
+        kl_coef = jnp.mean(hp[:, 1])
+        ratio = jnp.exp(new_lp - old_lp)
+        surrogate = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1.0 - clip_eps, 1.0 + clip_eps) * adv)
+        log_ref_over_new = old_lp - new_lp
+        kl = jnp.exp(log_ref_over_new) - 1.0 - log_ref_over_new
+        per_token = -surrogate + kl_coef * kl
+        loss = (jnp.sum(per_token * mask)
+                / jnp.maximum(jnp.sum(mask), 1.0))
         if self.cfg.moe_num_experts > 0:
             loss = loss + self.cfg.moe_aux_loss_coef * aux
         return loss

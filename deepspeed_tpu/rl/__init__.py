@@ -1,0 +1,24 @@
+"""RLHF learner subsystem (ROADMAP item 3; docs/TRAINING.md § RLHF
+learner loop).
+
+The hybrid engine (runtime/hybrid_engine.py) closed the ACTOR half:
+train -> publish -> ``rollout()`` feeds a bounded :class:`RolloutQueue`
+with per-token policy logprobs. This package is the LEARNER half:
+
+* :mod:`~.advantage` — pure-numpy GAE advantages/returns (the host-side
+  reference math the tests pin the device loss against),
+* :mod:`~.learner` — :class:`PPOLearner`: drains queue minibatches,
+  computes GAE on host, packs the ragged rollout layout onto the ZeRO
+  training mesh (pow2 length buckets — one compile per bucket, zero
+  steady-state recompiles), and runs the clipped-PPO + reference-KL
+  loss through the engine's EXISTING jitted train step,
+* :mod:`~.loop` — :class:`ActorLearnerLoop`: rollout -> reward hook ->
+  learn -> publish-every-N with quantized weight-DELTA payloads
+  (serve/weights.py) and staleness telemetry.
+"""
+
+from .advantage import gae, whiten
+from .learner import PPOLearner
+from .loop import ActorLearnerLoop
+
+__all__ = ["gae", "whiten", "PPOLearner", "ActorLearnerLoop"]

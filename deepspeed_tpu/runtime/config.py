@@ -431,6 +431,17 @@ class HybridEngineConfig:
     # counted — an RLHF actor loop must never grow host memory
     # unboundedly behind a slow learner)
     rollout_queue_size: int = 64
+    # quantized weight-DELTA publication (serve/weights.py § delta
+    # payloads; docs/SERVING.md § Delta weight push): publish-every-N
+    # RLHF cadence ships current-base block-quantized int8 + fp32
+    # block scales (~4x fewer push bytes) with publisher-side error
+    # feedback across pushes. delta_publish=False disables base
+    # tracking (and its fp32 host copy of the model); delta_quant is
+    # "int8" or "off" (changed leaves at full fp32 — bitwise-exact
+    # reconstruction)
+    delta_publish: bool = True
+    delta_quant: str = "int8"
+    delta_block: int = 2048
     # overrides for the colocated serving engine the hybrid engine
     # builds (keys: "state_manager", "engine", "serving" — the worker
     # --spec layout); empty = geometry derived from the model config

@@ -160,6 +160,41 @@ def fuse_flat_leaves(flat: Dict[str, np.ndarray], scale: float = 1.0,
 # ---------------------------------------------------------------------------
 # Weight publication (training side of serve/weights.py)
 # ---------------------------------------------------------------------------
+class WeightPublication:
+    """One publication's payloads: ``full`` is the fp32 chunked payload
+    every ingest path accepts; ``delta`` (when the publisher tracked a
+    base) is the block-quantized int8 delta vs ``base_version`` — ~4x
+    fewer wire bytes for the same version. ``router.push_weights``
+    accepts this object directly and negotiates delta-vs-full per
+    replica."""
+
+    __slots__ = ("full", "delta", "version", "base_version")
+
+    def __init__(self, full: List[bytes], delta: Optional[List[bytes]],
+                 version: int, base_version: Optional[int]):
+        self.full = full
+        self.delta = delta
+        self.version = int(version)
+        self.base_version = (None if base_version is None
+                             else int(base_version))
+
+    @property
+    def full_bytes(self) -> int:
+        return sum(len(p) for p in self.full)
+
+    @property
+    def delta_bytes(self) -> Optional[int]:
+        return (None if self.delta is None
+                else sum(len(p) for p in self.delta))
+
+    @property
+    def wire_ratio(self) -> Optional[float]:
+        """full fp32 bytes / delta bytes — the delta's wire win."""
+        if self.delta is None:
+            return None
+        return self.full_bytes / max(self.delta_bytes, 1)
+
+
 class WeightPublisher:
     """Versioned snapshots of a training engine's live params.
 
@@ -172,11 +207,22 @@ class WeightPublisher:
     """
 
     def __init__(self, source, bucket_bytes: int = 16 << 20,
-                 lora_scale: float = 1.0):
+                 lora_scale: float = 1.0, track_deltas: bool = True,
+                 delta_quant: str = "int8", delta_block: int = 2048):
         self.source = source
         self.bucket_bytes = max(int(bucket_bytes), 1)
         self.lora_scale = float(lora_scale)
         self.version = 0
+        # error-feedback reference (EQuARX across-push discipline):
+        # the RECEIVERS' bit-exact reconstruction of the last tracked
+        # publication, so delta_{k+1} = current - ref_k folds the
+        # residual the k-th quantization introduced back onto the
+        # wire. Costs one fp32 host copy of the model while tracking.
+        self.track_deltas = bool(track_deltas)
+        self.delta_quant = str(delta_quant)
+        self.delta_block = int(delta_block)
+        self._delta_ref: Optional[Dict[str, np.ndarray]] = None
+        self._delta_ref_version: Optional[int] = None
         from ..telemetry import get_registry
         reg = get_registry()
         self._m_publishes = reg.counter(
@@ -192,6 +238,28 @@ class WeightPublisher:
         self._m_version = reg.gauge(
             "training_weight_version",
             "version of the newest published weight snapshot")
+        self._m_delta_publishes = reg.counter(
+            "weight_delta_publishes_total",
+            "publications that emitted a quantized delta payload")
+        self._m_delta_bytes = reg.counter(
+            "weight_delta_bytes_total",
+            "serialized delta-payload bytes published", unit="bytes")
+        self._m_delta_ratio = reg.gauge(
+            "weight_delta_wire_ratio",
+            "full fp32 payload bytes / delta payload bytes of the "
+            "newest delta publication (the wire win)")
+        self._m_delta_residual = reg.gauge(
+            "weight_delta_residual_norm",
+            "l2 norm of the publisher-side error-feedback residual "
+            "(live params minus the receivers' reconstruction) after "
+            "the newest delta publication")
+
+    @property
+    def delta_ref_version(self) -> Optional[int]:
+        """Version of the error-feedback reference — the
+        ``delta_base`` the next :meth:`publish` can delta against
+        (None until a tracked publication)."""
+        return self._delta_ref_version
 
     def _iter_buckets(self) -> Iterable[Dict[str, np.ndarray]]:
         src = self.source
@@ -215,11 +283,19 @@ class WeightPublisher:
         ``fuse_lora=True`` (or external ``adapters``) fuses adapters
         into their base weights on the gathered HOST leaves — the live
         training params are never modified, so there is nothing to
-        unfuse and the training executable cannot respecialize."""
+        unfuse and the training executable cannot respecialize.
+
+        Streams bucket-by-bucket without materializing the whole
+        model, so it cannot maintain the delta error-feedback
+        reference — snapshotting INVALIDATES it (the next
+        :meth:`publish` re-anchors with a full-tracking publication).
+        """
         from ..inference.v2.serve import weights as serve_weights
         from ..telemetry import recorder as flight
         t0 = time.perf_counter()
         self.version += 1
+        self._delta_ref = None
+        self._delta_ref_version = None
         scale = self.lora_scale if lora_scale is None else float(
             lora_scale)
         if fuse_lora or adapters:
@@ -251,24 +327,125 @@ class WeightPublisher:
                       dur_s=round(dt, 4))
         return payloads
 
+    def publish(self, delta_base: Optional[int] = None,
+                quant: Optional[str] = None,
+                block: Optional[int] = None, fuse_lora: bool = False,
+                lora_scale: Optional[float] = None,
+                adapters: Optional[Dict[str, Tuple[np.ndarray,
+                                                   np.ndarray]]] = None
+                ) -> WeightPublication:
+        """Delta-aware publication: one gather produces the full fp32
+        payload AND (when ``delta_base`` names the error-feedback
+        reference version) the block-quantized int8 delta against it.
+
+        The reference tracks the RECEIVERS' bit-exact reconstruction,
+        so the residual each quantization introduces is folded into
+        the next delta (EQuARX error feedback) — successive deltas
+        cannot drift. ``delta_base`` mismatching the reference fails
+        typed (the caller should publish full — ``delta_base=None`` —
+        to re-anchor). With ``track_deltas`` off this is a plain full
+        publication returning ``delta=None``."""
+        from ..inference.v2.serve import weights as serve_weights
+        from ..telemetry import recorder as flight
+        t0 = time.perf_counter()
+        scale = self.lora_scale if lora_scale is None else float(
+            lora_scale)
+        quant = self.delta_quant if quant is None else str(quant)
+        block = self.delta_block if block is None else int(block)
+        if delta_base is not None:
+            if not self.track_deltas:
+                raise ValueError(
+                    "delta_base given but this publisher has "
+                    "track_deltas disabled")
+            if self._delta_ref is None \
+                    or int(delta_base) != self._delta_ref_version:
+                raise ValueError(
+                    f"delta_base={int(delta_base)} does not match the "
+                    f"publisher's error-feedback reference version "
+                    f"{self._delta_ref_version}; publish full "
+                    f"(delta_base=None) to re-anchor")
+        flat: Dict[str, np.ndarray] = {}
+        for group in self._iter_buckets():
+            flat.update(group)
+        if fuse_lora or adapters:
+            flat = fuse_flat_leaves(flat, scale, adapters)
+        flat = {n: np.ascontiguousarray(np.asarray(v, np.float32))
+                for n, v in flat.items()}
+        self.version += 1
+        items = list(flat.items())
+        buckets = serve_weights.plan_buckets(items, self.bucket_bytes)
+        full = serve_weights.chunk_weight_leaves(
+            ({n: flat[n] for n in names} for names in buckets),
+            self.version)
+        delta = None
+        residual = None
+        if delta_base is not None:
+            delta, recon = serve_weights.chunk_weight_deltas(
+                flat, self._delta_ref, self.version, int(delta_base),
+                quant=quant, block=block,
+                bucket_bytes=self.bucket_bytes)
+            self._delta_ref = recon
+            self._delta_ref_version = self.version
+            residual = float(np.sqrt(sum(
+                float(np.sum((flat[n] - recon[n]).astype(np.float64)
+                             ** 2)) for n in flat)))
+            self._m_delta_publishes.inc()
+        elif self.track_deltas:
+            # full-tracking publish: receivers applying this payload
+            # hold exactly these bits — the next delta's base. The ref
+            # must OWN its bytes: gathered leaves can alias live host
+            # params, and a ref that drifts with them would diff to
+            # zero forever
+            self._delta_ref = {n: np.array(v, np.float32)
+                               for n, v in flat.items()}
+            self._delta_ref_version = self.version
+        pub = WeightPublication(full, delta, self.version,
+                                None if delta is None
+                                else int(delta_base))
+        dt = time.perf_counter() - t0
+        self._m_publishes.inc()
+        self._m_publish_time.observe(dt)
+        self._m_publish_bytes.inc(pub.full_bytes)
+        self._m_version.set(self.version)
+        if delta is not None:
+            self._m_delta_bytes.inc(pub.delta_bytes)
+            self._m_delta_ratio.set(pub.wire_ratio)
+            self._m_delta_residual.set(residual)
+        flight.record("weight_publish", version=self.version,
+                      bytes=pub.full_bytes, chunks=len(full) - 1,
+                      fused=bool(fuse_lora or adapters),
+                      delta_bytes=pub.delta_bytes,
+                      delta_base=pub.base_version,
+                      dur_s=round(dt, 4))
+        return pub
+
 
 # ---------------------------------------------------------------------------
 # Rollouts (serving -> training direction of the seam)
 # ---------------------------------------------------------------------------
 class RolloutSample:
-    """One generated rollout: the RLHF actor-loop unit."""
+    """One generated rollout: the RLHF actor-loop unit.
+
+    ``reward`` is filled by the actor loop's reward hook AFTER
+    generation (a scalar sequence reward, or a per-generated-token
+    list); ``done`` marks the episode finished at the sequence end
+    (GAE bootstraps a zero value past a done step). Queue and loop
+    share the same object, so a hook's mutation is visible to the
+    learner that pops it."""
 
     __slots__ = ("prompt", "tokens", "logprobs", "weight_version",
-                 "seed")
+                 "seed", "reward", "done")
 
     def __init__(self, prompt: List[int], tokens: List[int],
                  logprobs: List[float], weight_version: int,
-                 seed: Optional[int]):
+                 seed: Optional[int], reward=None, done: bool = True):
         self.prompt = prompt
         self.tokens = tokens
         self.logprobs = logprobs
         self.weight_version = weight_version
         self.seed = seed
+        self.reward = reward
+        self.done = bool(done)
 
 
 class RolloutQueue:
@@ -282,6 +459,7 @@ class RolloutQueue:
         self.maxlen = max(int(maxlen), 1)
         self._q: "collections.deque" = collections.deque()
         self._lock = threading.Lock()
+        self._depth = 0
         from ..telemetry import get_registry
         reg = get_registry()
         self._m_depth = reg.gauge(
@@ -292,13 +470,27 @@ class RolloutQueue:
             "rollouts dropped oldest-first because the bounded queue "
             "was full (the learner fell behind the actor)")
 
+    def _set_depth(self, n: int) -> None:
+        # the gauge path: every mutation already publishes the depth
+        # here, so `depth` below reads it lock-free
+        self._depth = n
+        self._m_depth.set(n)
+
+    @property
+    def depth(self) -> int:
+        """Lock-free depth (the last value the gauge path published).
+        The learner's backpressure check polls this from the train
+        thread without contending the push/pop lock; ``len(queue)``
+        remains the locked exact read."""
+        return self._depth
+
     def push(self, sample: RolloutSample) -> None:
         with self._lock:
             self._q.append(sample)
             while len(self._q) > self.maxlen:
                 self._q.popleft()
                 self._m_dropped.inc()
-            self._m_depth.set(len(self._q))
+            self._set_depth(len(self._q))
 
     def pop(self, n: int = 1) -> List[RolloutSample]:
         """Up to ``n`` oldest samples (the next training micro-batch)."""
@@ -306,7 +498,7 @@ class RolloutQueue:
         with self._lock:
             while self._q and len(out) < n:
                 out.append(self._q.popleft())
-            self._m_depth.set(len(self._q))
+            self._set_depth(len(self._q))
         return out
 
     def __len__(self) -> int:
@@ -340,7 +532,10 @@ class DeepSpeedHybridEngine(DeepSpeedTpuEngine):
         self.lora_adapters: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
         self.publisher = WeightPublisher(
             self, bucket_bytes=hy.publish_bucket_bytes,
-            lora_scale=lora_scale)
+            lora_scale=lora_scale,
+            track_deltas=hy.delta_publish,
+            delta_quant=hy.delta_quant,
+            delta_block=hy.delta_block)
         self.rollout_queue = RolloutQueue(hy.rollout_queue_size)
         self._serving_model = serving_model
         self._serving = None
@@ -415,6 +610,9 @@ class DeepSpeedHybridEngine(DeepSpeedTpuEngine):
                 **spec["engine"]),
             params=host_tree)
         engine.weight_version = stager.version
+        # the freshly-built colocated engine retains its payload's fp32
+        # leaves as the delta base, same as a swap would
+        serve_weights.set_delta_base(engine, stager.leaves)
         return engine
 
     def _ensure_current(self) -> None:
@@ -466,6 +664,35 @@ class DeepSpeedHybridEngine(DeepSpeedTpuEngine):
             serve_weights.apply_payload(self._serving, payloads)
         return payloads
 
+    def publish_delta(self, fuse_lora: Optional[bool] = None,
+                      quant: Optional[str] = None,
+                      block: Optional[int] = None
+                      ) -> WeightPublication:
+        """Delta-aware publication (the RLHF publish-every-N path):
+        one gather emits the full payload AND — once a tracked base
+        exists — the block-quantized int8 delta against it. The
+        colocated serving engine ingests the DELTA when available, so
+        its weights stay bit-identical to every fleet replica
+        following the delta chain; the returned
+        :class:`WeightPublication` goes to ``router.push_weights``
+        which negotiates delta-vs-full per replica."""
+        from ..inference.v2.serve import weights as serve_weights
+        if fuse_lora is None:
+            fuse_lora = self.has_lora()
+        pub = self.publisher.publish(
+            delta_base=self.publisher.delta_ref_version,
+            quant=quant, block=block, fuse_lora=fuse_lora,
+            adapters=(self.lora_adapters or None) if fuse_lora
+            else None)
+        self._published_at = (self.global_steps, self.micro_steps)
+        if self._serving is None:
+            self._serving = self._build_serving(pub.full)
+        else:
+            serve_weights.apply_payload(
+                self._serving,
+                pub.delta if pub.delta is not None else pub.full)
+        return pub
+
     # -- generation (reference hybrid_engine.generate :174) -------------
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,
@@ -510,7 +737,8 @@ class DeepSpeedHybridEngine(DeepSpeedTpuEngine):
                 top_p: float = 1.0, top_k: int = 0,
                 seed: Optional[int] = 0,
                 eos_token_id: Optional[int] = None,
-                enqueue: bool = True) -> List[RolloutSample]:
+                enqueue: bool = True,
+                allow_stale: bool = False) -> List[RolloutSample]:
         """Generate rollouts and feed the bounded training queue.
 
         Tokens come from the serving engine's ``put()`` logits sampled
@@ -519,9 +747,16 @@ class DeepSpeedHybridEngine(DeepSpeedTpuEngine):
         draw discipline, so a rollout's stream is bit-identical to the
         same request served through the async runtime (parity-pinned).
         Per-token logprobs are the policy log-softmax of each sampled
-        token, computed from the same logits that sampled it."""
+        token, computed from the same logits that sampled it.
+
+        ``allow_stale=True`` skips the publish-on-demand republish and
+        acts on the last PUBLISHED weights even if train steps ran
+        since — the actor-learner loop's publish-every-N cadence
+        (samples carry ``weight_version`` so the learner's staleness
+        telemetry measures the gap)."""
         from ..inference.v2.sampling import host_sample
-        self._ensure_current()
+        if not (allow_stale and self._serving is not None):
+            self._ensure_current()
         eng = self._serving
         samples: List[RolloutSample] = []
         for row_i, prompt in enumerate(prompts):

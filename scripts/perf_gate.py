@@ -871,6 +871,70 @@ def collect(seq_len: int = 64, new_tokens: int = 16,
         metrics["rollout_queue_ns_per_item"] = (
             (_time.perf_counter() - t0) / n * 1e9)
 
+        # -- RLHF actor-learner loop + delta publication (ISSUE 17) --------
+        # rollout -> GAE/PPO learner step -> publish-every-N: after the
+        # warm-up iterations compile the single pow2 bucket, further
+        # learner steps AND the delta hot-swap must not retrace anything
+        # (learner_step_steady_recompiles); the int8 delta payload must
+        # stay >= 3.5x smaller on the wire than the fp32 full payload
+        # (weight_delta_push_wire_ratio); and the loop's publish cadence
+        # must leave the acting policy fresh at the cycle boundary
+        # (rl_loop_publish_staleness_steps — the gauge resets to 0 on
+        # every publish)
+        def _rl_gate():
+            import numpy as _np
+
+            import deepspeed_tpu as _ds
+            from deepspeed_tpu.rl import ActorLearnerLoop
+            out = {}
+            tcfg = TransformerConfig(
+                vocab_size=64, hidden_size=32, intermediate_size=64,
+                num_layers=2, num_heads=4, max_seq_len=64,
+                remat=False, use_flash=False)
+            hyb, _, _, _ = _ds.initialize(
+                model=TransformerLM(tcfg),
+                config={"train_micro_batch_size_per_gpu": 2,
+                        "gradient_accumulation_steps": 1,
+                        "optimizer": {"type": "adamw",
+                                      "params": {"lr": 1e-2}},
+                        "bf16": {"enabled": True},
+                        "zero_optimization": {"stage": 2},
+                        "hybrid_engine": {"enabled": True,
+                                          "max_out_tokens": 64},
+                        "steps_per_print": 10**9})
+            hyb.publish_delta()    # anchor: full payload + EF ref
+
+            def prompts_fn(i):
+                rng = _np.random.default_rng(100 + i)
+                return [rng.integers(1, 64, size=6).tolist()
+                        for _ in range(2)]
+
+            def reward_fn(samples):
+                return [len(set(s.tokens)) / max(len(s.tokens), 1)
+                        for s in samples]
+
+            rl_loop = ActorLearnerLoop(
+                hyb, reward_fn, prompts_fn, publish_every=2,
+                rollout_kwargs=dict(max_new_tokens=8,
+                                    temperature=1.0, seed=5),
+                min_bucket=16)
+            rl_loop.run(2)          # warm: bucket compile + hot-swap
+            st0 = fam_total("xla_steady_state_recompiles_total")
+            watchdog.mark_steady(True)
+            try:
+                rl_pubs = rl_loop.run(2)
+            finally:
+                watchdog.mark_steady(False)
+            out["learner_step_steady_recompiles"] = (
+                fam_total("xla_steady_state_recompiles_total") - st0)
+            out["weight_delta_push_wire_ratio"] = float(
+                rl_pubs[-1].wire_ratio)
+            out["rl_loop_publish_staleness_steps"] = fam_total(
+                "rl_loop_publish_staleness_steps")
+            return out
+
+        metrics.update(_rl_gate())
+
         # -- flight-recorder record() cost ---------------------------------
         bench_rec = FlightRecorder()
         prev_bench = set_recorder(bench_rec)
@@ -1065,7 +1129,9 @@ def make_baseline(metrics: Dict[str, float]) -> Dict[str, Any]:
                     "tiered_offload_update_programs",
                     "reconnect_steady_recompiles",
                     "breaker_false_positive_failovers",
-                    "online_adapt_steady_recompiles"):
+                    "online_adapt_steady_recompiles",
+                    "hot_swap_steady_recompiles",
+                    "learner_step_steady_recompiles"):
             spec[name] = {"value": value, "direction": "max",
                           "abs_tol": 0.0}
         elif name == "autotune_offline_improved_signals":
@@ -1107,6 +1173,20 @@ def make_baseline(metrics: Dict[str, float]) -> Dict[str, Any]:
             # letting the decode loop step — direction "min" so a
             # blocking regression (stalled windows) fails the gate
             spec[name] = {"value": value, "direction": "min",
+                          "abs_tol": 0.0}
+        elif name == "weight_delta_push_wire_ratio":
+            # the delta-publication wire win: the int8 delta payload
+            # must stay >= 3.5x below the fp32 full payload (direction
+            # "min" with the slack eating exactly the headroom above
+            # 3.5 — same pin shape as train_quant_reduce_wire_ratio)
+            spec[name] = {"value": value, "direction": "min",
+                          "abs_tol": round(max(value - 3.5, 0.0), 6)}
+        elif name == "rl_loop_publish_staleness_steps":
+            # structural cadence pin: the actor-learner loop publishes
+            # at the cycle boundary, so the staleness gauge must read 0
+            # when the gate samples it — any residual lag means the
+            # publish-every-N discipline broke (abs-tol pinned)
+            spec[name] = {"value": value, "direction": "max",
                           "abs_tol": 0.0}
         elif name == "train_quant_reduce_wire_ratio":
             # the wire-compression pin: quantized ring bytes must stay
@@ -1152,7 +1232,8 @@ def make_baseline(metrics: Dict[str, float]) -> Dict[str, Any]:
             # sites, not the machine — small absolute slack only
             spec[name] = {"value": value, "direction": "max",
                           "abs_tol": 2.0}
-        elif name in ("recorder_ns_per_event", "trace_ns_per_span"):
+        elif name in ("recorder_ns_per_event", "trace_ns_per_span",
+                      "rollout_queue_ns_per_item"):
             # wall-clock-ish: wide absolute tolerance so scheduler
             # jitter never flaps the gate, but an order-of-magnitude
             # regression (per-event snapshotting, lock convoy) fails

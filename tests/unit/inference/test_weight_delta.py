@@ -1,0 +1,280 @@
+"""Delta weight publication (serve/weights.py chunk_weight_deltas,
+WeightPublisher.publish, router delta negotiation).
+
+Pinned contracts (ISSUE 17 acceptance):
+  * CHAIN AGREEMENT — every receiver that follows the same delta chain
+    reconstructs BIT-IDENTICAL params (base + dequant(delta) is plain
+    host numpy on both sides), and stays quant-error-close to the
+    publisher's live weights with the error-feedback residual BOUNDED
+    across pushes (EQuARX across-push discipline, arXiv:2506.17615).
+  * EXACTNESS — quant="off" deltas ship changed leaves at full fp32:
+    receivers land EXACTLY on the publisher's weights.
+  * WIRE WIN — an int8 delta payload is >= 3.5x smaller on the wire
+    than the fp32 full payload (the reason deltas exist).
+  * TYPED FAILURE — a corrupt delta chunk and a stale/absent base both
+    fail typed BEFORE any live param mutates; the router falls back to
+    the full payload and still converges the fleet.
+  * DISAGGREGATED — blue/green push over a disaggregated fleet stays
+    rejected typed for delta payloads too (regression: the unwrap of a
+    WeightPublication must not bypass the guard).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+from deepspeed_tpu.inference.v2.serve import (PrefillReplica, Replica,
+                                              ReplicaRouter,
+                                              RouterConfig,
+                                              ServingConfig, weights)
+from deepspeed_tpu.runtime.hybrid_engine import (WeightPublication,
+                                                 WeightPublisher)
+from deepspeed_tpu.telemetry import get_registry
+
+
+@pytest.fixture(scope="module")
+def model_and_params(tiny_model_256):
+    return tiny_model_256
+
+
+def _engine(model, params):
+    return InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_tracked_sequences=8, max_seq_len=256, num_blocks=65,
+                block_size=16, max_ragged_batch_size=512),
+            dtype="float32", prefill_bucket=16), params=params)
+
+
+def _np_tree(params):
+    """fp32 numpy copy whose leaves can be mutated in place — the
+    'live training params' a publisher keeps re-reading."""
+    return jax.tree.map(lambda x: np.array(x, np.float32), params)
+
+
+def _drift(tree, seed, scale=1e-3):
+    rng = np.random.default_rng(seed)
+    for leaf in jax.tree.leaves(tree):
+        leaf += rng.normal(0.0, scale, leaf.shape).astype(np.float32)
+
+
+def _flat(engine_or_tree):
+    tree = getattr(engine_or_tree, "params", engine_or_tree)
+    items, _ = weights.flatten_params(tree)
+    return {n: weights.fetch_leaf(a) for n, a in items}
+
+
+def _gauge(name):
+    fam = get_registry().get(name)
+    assert fam is not None, name
+    return max(s.value for _, s in fam.series())
+
+
+# ---------------------------------------------------------------------------
+# chain agreement + bounded error feedback (int8)
+# ---------------------------------------------------------------------------
+def test_int8_delta_chain_bit_identical_receivers(model_and_params):
+    model, params = model_and_params
+    src = _np_tree(params)
+    pub = WeightPublisher(src)
+    anchor = pub.publish()               # v1: full, anchors the EF ref
+    assert isinstance(anchor, WeightPublication)
+    assert anchor.delta is None and anchor.base_version is None
+
+    eng_a = _engine(model, params)
+    eng_b = _engine(model, params)
+    for e in (eng_a, eng_b):
+        assert weights.apply_payload(e, anchor.full) == 1
+
+    residuals = []
+    for k in range(3):
+        _drift(src, seed=10 + k)
+        p = pub.publish(delta_base=pub.delta_ref_version)
+        assert p.base_version == k + 1 and p.version == k + 2
+        assert p.delta is not None
+        for e in (eng_a, eng_b):
+            assert weights.apply_payload(e, p.delta) == p.version
+        residuals.append(_gauge("weight_delta_residual_norm"))
+
+    fa, fb, truth = _flat(eng_a), _flat(eng_b), _flat(src)
+    for n in truth:
+        # every chain receiver holds the SAME bits
+        assert np.array_equal(fa[n], fb[n]), n
+        # ... and those bits are quant-error-close to the live weights
+        np.testing.assert_allclose(fa[n], truth[n], atol=2e-4,
+                                   err_msg=n)
+    # error feedback keeps the publisher-receiver residual bounded:
+    # three pushes later it has not drifted upward
+    assert residuals[-1] <= max(3.0 * residuals[0], 1e-6), residuals
+    assert eng_a.weight_version == 4
+    # the swap re-anchored the receiver's base for the NEXT delta
+    assert weights.delta_base_of(eng_a) is not None
+
+
+def test_quant_off_delta_is_exact(model_and_params):
+    model, params = model_and_params
+    src = _np_tree(params)
+    pub = WeightPublisher(src, delta_quant="off")
+    anchor = pub.publish()
+    eng = _engine(model, params)
+    weights.apply_payload(eng, anchor.full)
+    for k in range(2):
+        _drift(src, seed=20 + k)
+        p = pub.publish(delta_base=pub.delta_ref_version)
+        weights.apply_payload(eng, p.delta)
+    truth = _flat(src)
+    got = _flat(eng)
+    for n in truth:
+        assert np.array_equal(got[n], truth[n]), \
+            f"quant='off' delta must land exactly: {n}"
+
+
+def test_int8_delta_wire_ratio_floor(model_and_params):
+    _, params = model_and_params
+    src = _np_tree(params)
+    pub = WeightPublisher(src)
+    pub.publish()
+    _drift(src, seed=30)
+    p = pub.publish(delta_base=pub.delta_ref_version)
+    assert p.delta_bytes * 3.5 <= p.full_bytes, \
+        (p.delta_bytes, p.full_bytes)
+    assert p.wire_ratio >= 3.5
+    assert _gauge("weight_delta_wire_ratio") >= 3.5
+
+
+# ---------------------------------------------------------------------------
+# typed failure: corruption and stale/absent base
+# ---------------------------------------------------------------------------
+def test_corrupt_delta_chunk_fails_typed_params_untouched(
+        model_and_params):
+    model, params = model_and_params
+    src = _np_tree(params)
+    pub = WeightPublisher(src)
+    anchor = pub.publish()
+    eng = _engine(model, params)
+    weights.apply_payload(eng, anchor.full)
+    before = _flat(eng)
+    _drift(src, seed=40)
+    p = pub.publish(delta_base=pub.delta_ref_version)
+    bad = list(p.delta)
+    body = bytearray(bad[1])
+    body[len(body) // 2] ^= 0xFF
+    bad[1] = bytes(body)
+    with pytest.raises(ValueError,
+                       match="crc32|integrity|load|failed"):
+        weights.apply_payload(eng, bad)
+    after = _flat(eng)
+    assert eng.weight_version == 1
+    for n in before:
+        assert np.array_equal(before[n], after[n]), \
+            f"corrupt delta mutated live param {n}"
+    # the intact payload still applies afterwards
+    assert weights.apply_payload(eng, p.delta) == p.version
+
+
+def test_stale_or_absent_base_fails_typed(model_and_params):
+    model, params = model_and_params
+    src = _np_tree(params)
+    pub = WeightPublisher(src)
+    pub.publish()                                   # v1
+    _drift(src, seed=50)
+    pub.publish(delta_base=1)                       # v2 (skip it)
+    _drift(src, seed=51)
+    p3 = pub.publish(delta_base=2)                  # v3, base v2
+
+    eng = _engine(model, params)
+    weights.apply_payload(eng, pub.publish().full)  # v4 full... too new
+    with pytest.raises(ValueError, match="full push is required"):
+        weights.apply_payload(eng, p3.delta)
+
+    fresh = _engine(model, params)                  # v0, no base held
+    delta0, _ = weights.chunk_weight_deltas(
+        _flat(src), _flat(src), version=1, base_version=0)
+    with pytest.raises(ValueError, match="retains no delta base"):
+        weights.apply_payload(fresh, delta0)
+
+    # the publisher refuses to delta against a base it is not tracking
+    with pytest.raises(ValueError, match="re-anchor"):
+        pub.publish(delta_base=1)
+
+
+# ---------------------------------------------------------------------------
+# router: per-replica negotiation + fallback to full
+# ---------------------------------------------------------------------------
+def test_router_delta_negotiation_and_full_fallback(model_and_params):
+    model, params = model_and_params
+    src = _np_tree(params)
+    pub = WeightPublisher(src)
+    anchor = pub.publish()                          # v1
+
+    async def run():
+        cfg = ServingConfig(token_budget=64, chunk=16)
+        ra = Replica("da", _engine(model, params), cfg)
+        rb = Replica("db", _engine(model, params), cfg)
+        router = ReplicaRouter([ra, rb],
+                               RouterConfig(monitor_interval_s=0.0))
+        await router.start()
+        try:
+            await router.push_weights(anchor.full)  # fleet at v1
+            # rb advertises v1 but lost its reconstruction base (e.g.
+            # restarted from a checkpoint): its delta push must fail
+            # typed and fall back to the full payload
+            rb.engine._weight_flat_base = None
+            _drift(src, seed=60)
+            p2 = pub.publish(delta_base=pub.delta_ref_version)
+            reg = get_registry()
+            d0 = reg.family_total("router_weight_delta_pushes_total")
+            f0 = reg.family_total(
+                "router_weight_delta_fallbacks_total")
+            version = await router.push_weights(p2)  # a publication
+            d1 = reg.family_total("router_weight_delta_pushes_total")
+            f1 = reg.family_total(
+                "router_weight_delta_fallbacks_total")
+            return (version, d1 - d0, f1 - f0,
+                    [ra.weight_version, rb.weight_version],
+                    _flat(ra.engine), _flat(rb.engine))
+        finally:
+            await router.stop()
+
+    version, deltas, fallbacks, versions, fa, fb = asyncio.run(run())
+    assert version == 2 and versions == [2, 2], \
+        "fleet must converge despite the fallback"
+    assert deltas == 1, "only the base-matched replica takes the delta"
+    assert fallbacks == 1, "the base-less replica falls back to full"
+    truth = _flat(src)
+    for n in truth:
+        # fallback receiver took the exact fp32 full payload ...
+        assert np.array_equal(fb[n], truth[n]), n
+        # ... the delta receiver is quant-close to the same weights
+        np.testing.assert_allclose(fa[n], truth[n], atol=2e-4,
+                                   err_msg=n)
+
+
+def test_disaggregated_fleet_rejects_delta_push(model_and_params):
+    """Satellite regression: the WeightPublication unwrap must not
+    route a delta around the disaggregated guard."""
+    model, params = model_and_params
+    src = _np_tree(params)
+    pub = WeightPublisher(src)
+    pub.publish()
+    _drift(src, seed=70)
+    p2 = pub.publish(delta_base=pub.delta_ref_version)
+
+    cfg = ServingConfig(token_budget=64, chunk=16)
+    router = ReplicaRouter(
+        [Replica("dg0", _engine(model, params), cfg)],
+        RouterConfig(disaggregated=True),
+        prefill_replicas=[PrefillReplica("dgp",
+                                         _engine(model, params))])
+    with pytest.raises(NotImplementedError, match="disaggregated"):
+        asyncio.run(router.push_weights(p2))        # publication form
+    with pytest.raises(NotImplementedError, match="disaggregated"):
+        asyncio.run(router.push_weights(p2.full, delta=p2.delta))
+    with pytest.raises(NotImplementedError, match="disaggregated"):
+        asyncio.run(router.push_weights(p2.delta))  # bare delta

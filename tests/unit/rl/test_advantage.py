@@ -1,0 +1,114 @@
+"""GAE reference math (rl/advantage.py) pinned against an independent
+hand-rolled implementation.
+
+The learner packs these exact host-computed values onto the training
+mesh, so this module is the ground truth the device-side PPO tests
+chain from: here GAE is re-derived with the O(T^2) forward-sum
+definition (A_t = sum_l (gamma*lam)^l * delta_{t+l}, truncated at
+episode boundaries) rather than the recursive backward pass the
+implementation uses — two independent derivations must agree.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.rl import gae, whiten
+
+
+def _forward_sum_gae(r, v, nonterminal, gamma, lam):
+    """Textbook definition, written forward: for each t accumulate
+    discounted td-errors until the episode ends."""
+    T = len(r)
+    adv = np.zeros(T, np.float64)
+    for t in range(T):
+        coef = 1.0
+        for l in range(t, T):
+            delta = r[l] + gamma * nonterminal[l] * v[l + 1] - v[l]
+            adv[t] += coef * delta
+            if nonterminal[l] == 0.0:
+                break
+            coef *= gamma * lam
+    return adv
+
+
+def test_gae_matches_forward_sum_reference():
+    rng = np.random.default_rng(0)
+    for trial in range(5):
+        T = int(rng.integers(3, 20))
+        r = rng.normal(size=T).astype(np.float32)
+        v = rng.normal(size=T + 1).astype(np.float32)
+        d = (rng.random(T) < 0.3).astype(np.float32)
+        d[-1] = 1.0
+        gamma, lam = 0.97, 0.9
+        adv, ret = gae(r, values=v, dones=d, gamma=gamma, lam=lam)
+        ref = _forward_sum_gae(r, v, 1.0 - d, gamma, lam)
+        np.testing.assert_allclose(adv, ref, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(ret, adv + v[:T], rtol=1e-6)
+
+
+def test_gae_without_values_is_discounted_reward_to_go():
+    rng = np.random.default_rng(1)
+    r = rng.normal(size=7).astype(np.float32)
+    gamma, lam = 0.99, 0.95
+    adv, ret = gae(r, gamma=gamma, lam=lam)
+    for t in range(7):
+        ref = sum((gamma * lam) ** (l - t) * r[l] for l in range(t, 7))
+        assert adv[t] == pytest.approx(ref, rel=1e-5)
+    # no critic: returns degenerate to advantages
+    np.testing.assert_array_equal(adv, ret)
+
+
+def test_gae_done_truncates_credit():
+    """A done at position k must make advantages before it independent
+    of everything after it (no credit flows across the boundary)."""
+    r = np.array([0.5, -0.2, 1.0, 9.0, -9.0], np.float32)
+    d = np.array([0, 0, 1, 0, 1], np.float32)
+    adv_full, _ = gae(r, dones=d)
+    adv_head, _ = gae(r[:3], dones=d[:3])
+    np.testing.assert_allclose(adv_full[:3], adv_head, rtol=1e-6)
+
+
+def test_gae_value_length_contracts():
+    r = np.ones(4, np.float32)
+    # [T] values: zero bootstrap appended
+    a_t, _ = gae(r, values=np.ones(4, np.float32), dones=np.zeros(4))
+    # [T+1] values: explicit bootstrap changes the last delta
+    a_t1, _ = gae(r, values=np.array([1, 1, 1, 1, 5], np.float32),
+                  dones=np.zeros(4))
+    assert a_t[-1] != a_t1[-1]
+    with pytest.raises(ValueError, match="length T or T\\+1"):
+        gae(r, values=np.ones(6, np.float32))
+    with pytest.raises(ValueError, match="dones must be length"):
+        gae(r, dones=np.zeros(3))
+
+
+def test_gae_empty_sequence():
+    adv, ret = gae(np.zeros(0, np.float32))
+    assert adv.shape == (0,) and ret.shape == (0,)
+
+
+def test_whiten_masked_moments():
+    rng = np.random.default_rng(2)
+    x = rng.normal(3.0, 2.0, size=(4, 8)).astype(np.float32)
+    m = (rng.random((4, 8)) < 0.6).astype(np.float32)
+    assert m.sum() > 2
+    w = whiten(x, m)
+    # masked moments normalized, unmasked positions zeroed
+    n = m.sum()
+    assert (w * m).sum() / n == pytest.approx(0.0, abs=1e-6)
+    assert np.sqrt(((w * m) ** 2).sum() / n) == pytest.approx(
+        1.0, abs=1e-4)
+    assert np.all(w[m == 0] == 0.0)
+
+
+def test_whiten_degenerate_masks():
+    x = np.array([5.0, 7.0], np.float32)
+    # one masked element: centered only (std undefined)
+    one = whiten(x, np.array([1.0, 0.0]))
+    np.testing.assert_allclose(one, [0.0, 0.0])
+    # empty mask: all zeros, no div-by-zero
+    np.testing.assert_array_equal(whiten(x, np.zeros(2)),
+                                  np.zeros(2, np.float32))
+    # no mask: plain whitening
+    w = whiten(x)
+    assert w[0] < 0 < w[1] and np.mean(w) == pytest.approx(0, abs=1e-6)

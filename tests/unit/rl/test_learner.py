@@ -1,0 +1,283 @@
+"""PPOLearner contracts: ragged->mesh packing, the device PPO loss
+pinned against a pure-numpy reference, and the rollout queue's
+lock-free depth under thread churn.
+
+The packing tests run against a FAKE engine (just the geometry attrs
+the learner reads) — no jax, so the layout contracts stay cheap. The
+loss-pin test runs the real model forward once and re-derives the
+entire objective (logprob gather, ratio/clip surrogate, k3 KL, masked
+mean) in dense numpy from the hidden states: the chunked device path
+and the O(B*S*V) reference must agree.
+"""
+
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.rl import PPOLearner, gae, whiten
+from deepspeed_tpu.rl.learner import _token_rewards
+from deepspeed_tpu.runtime.hybrid_engine import (RolloutQueue,
+                                                 RolloutSample)
+
+
+def _fake_engine(gas=2, micro=2, dp=1, max_seq_len=64, version=3):
+    return SimpleNamespace(
+        gas=gas, micro_batch_size=micro,
+        ds_config=SimpleNamespace(dp_world_size=dp),
+        model=SimpleNamespace(cfg=SimpleNamespace(
+            max_seq_len=max_seq_len)),
+        weight_version=version)
+
+
+def _sample(prompt, tokens, logprobs=None, version=3, reward=None,
+            done=True):
+    if logprobs is None:
+        logprobs = [-0.5] * len(tokens)
+    return RolloutSample(prompt=list(prompt), tokens=list(tokens),
+                         logprobs=list(logprobs),
+                         weight_version=version, seed=0,
+                         reward=reward, done=done)
+
+
+# ---------------------------------------------------------------------------
+# packing: ragged rollout layout -> fixed mesh layout
+# ---------------------------------------------------------------------------
+def test_pack_layout_and_reference_advantages():
+    eng = _fake_engine(gas=2, micro=2)      # rows = 4
+    learner = PPOLearner(eng, queue=RolloutQueue(4), gamma=0.9,
+                         lam=0.8, whiten_advantages=False)
+    assert learner.rows == 4
+    samples = [
+        _sample([5, 6, 7], [8, 9], logprobs=[-0.1, -0.2], reward=1.5),
+        _sample([4], [3, 2, 1], logprobs=[-1.0, -2.0, -3.0],
+                reward=[0.1, 0.2, 0.3]),
+    ]
+    batch, stats = learner.pack(samples)
+    S = batch["input_ids"].shape[1]
+    assert batch["input_ids"].shape == (4, S)
+    assert S == 8                            # max_len 5 -> min_bucket 8
+    # row 0: prompt then tokens, mask only over generated positions
+    np.testing.assert_array_equal(batch["input_ids"][0, :5],
+                                  [5, 6, 7, 8, 9])
+    np.testing.assert_array_equal(batch["loss_mask"][0],
+                                  [0, 0, 0, 1, 1, 0, 0, 0])
+    np.testing.assert_allclose(batch["ppo_old_logprobs"][0, 3:5],
+                               [-0.1, -0.2])
+    # rows without samples are all-pad
+    assert not batch["input_ids"][2:].any()
+    assert not batch["loss_mask"][2:].any()
+    # advantages match the host GAE reference exactly (whitening off)
+    a0, _ = gae(np.array([0, 1.5], np.float32),
+                dones=np.array([0, 1], np.float32), gamma=0.9, lam=0.8)
+    a1, _ = gae(np.array([0.1, 0.2, 0.3], np.float32),
+                dones=np.array([0, 0, 1], np.float32), gamma=0.9,
+                lam=0.8)
+    np.testing.assert_allclose(batch["ppo_advantages"][0, 3:5], a0)
+    np.testing.assert_allclose(batch["ppo_advantages"][1, 1:4], a1)
+    # traced hparams tiled on every row
+    np.testing.assert_allclose(
+        batch["ppo_hparams"],
+        np.tile([learner.clip_eps, learner.kl_coef], (4, 1)))
+    assert stats["samples"] == 2 and stats["tokens"] == 5
+    assert stats["seq_bucket"] == 8
+    assert stats["pad_fraction"] == pytest.approx(1 - 9 / 32)
+    assert stats["staleness_mean"] == 0.0
+
+
+def test_pack_pow2_buckets_and_cap():
+    eng = _fake_engine(gas=1, micro=1, max_seq_len=32)
+    learner = PPOLearner(eng, queue=RolloutQueue(4))
+    assert learner.pack([_sample([1] * 9, [2] * 8)])[1]["seq_bucket"] \
+        == 32                               # 17 -> 32
+    assert learner.pack([_sample([1] * 20, [2] * 12)])[1][
+        "seq_bucket"] == 32                 # exactly the cap
+    with pytest.raises(ValueError, match="exceeds the model's"):
+        learner.pack([_sample([1] * 30, [2] * 4)])
+
+
+def test_pack_whitening_and_staleness():
+    eng = _fake_engine(gas=1, micro=2, version=5)
+    learner = PPOLearner(eng, queue=RolloutQueue(4), gamma=0.9,
+                         lam=0.8, whiten_advantages=True)
+    samples = [_sample([1, 2], [3, 4, 5], reward=2.0, version=3),
+               _sample([6], [7, 8], reward=-1.0, version=5)]
+    batch, stats = learner.pack(samples)
+    off = PPOLearner(eng, queue=RolloutQueue(4), gamma=0.9, lam=0.8,
+                     whiten_advantages=False)
+    raw, _ = off.pack(samples)
+    np.testing.assert_allclose(
+        batch["ppo_advantages"],
+        whiten(raw["ppo_advantages"], raw["loss_mask"]), rtol=1e-6)
+    assert stats["staleness_mean"] == pytest.approx(1.0)  # lags 2, 0
+    assert stats["staleness_max"] == 2
+
+
+def test_pack_contract_errors():
+    eng = _fake_engine(gas=1, micro=1)      # rows = 1
+    learner = PPOLearner(eng, queue=RolloutQueue(4))
+    with pytest.raises(ValueError, match="at least one"):
+        learner.pack([])
+    with pytest.raises(ValueError, match="mesh rows"):
+        learner.pack([_sample([1], [2]), _sample([1], [2])])
+    bad = _sample([1], [2, 3], logprobs=[-0.5])
+    with pytest.raises(ValueError, match="logprobs"):
+        learner.pack([bad])
+
+
+def test_token_rewards_shapes():
+    s = _sample([1], [2, 3, 4], reward=2.5)
+    np.testing.assert_allclose(_token_rewards(s), [0, 0, 2.5])
+    s.reward = [1.0, 2.0, 3.0]
+    np.testing.assert_allclose(_token_rewards(s), [1, 2, 3])
+    s.reward = None
+    np.testing.assert_allclose(_token_rewards(s), [0, 0, 0])
+    s.reward = [1.0]
+    with pytest.raises(ValueError, match="per-token reward length"):
+        _token_rewards(s)
+
+
+def test_step_backpressure_and_drain():
+    """step() declines below min_samples (lock-free depth read) and
+    pops at most `rows` samples once the floor is met."""
+    calls = []
+
+    class _Eng:
+        gas, micro_batch_size = 1, 2
+        ds_config = SimpleNamespace(dp_world_size=1)
+        model = SimpleNamespace(cfg=SimpleNamespace(max_seq_len=64))
+        weight_version = 1
+
+        def train_batch(self, batch=None):
+            calls.append(batch)
+            return 0.25
+
+    q = RolloutQueue(8)
+    learner = PPOLearner(_Eng(), queue=q, min_samples=2)
+    q.push(_sample([1], [2], version=1))
+    assert learner.step() is None and not calls     # depth 1 < 2
+    assert q.depth == 1                              # nothing popped
+    q.push(_sample([3], [4], version=1))
+    q.push(_sample([5], [6], version=1))
+    out = learner.step()
+    assert out is not None and out["loss"] == 0.25
+    assert out["samples"] == 2                       # rows=2 cap
+    assert q.depth == 1 and learner.steps == 1
+    assert calls[0]["input_ids"].shape[0] == 2
+
+
+# ---------------------------------------------------------------------------
+# device PPO loss vs dense numpy reference
+# ---------------------------------------------------------------------------
+def test_ppo_loss_matches_dense_numpy_reference():
+    """model.apply on a ppo_* batch must equal the textbook objective
+    computed densely in numpy from the same hidden states: full
+    [B,S,V] log-softmax gather (vs the device's chunked scan), then
+    ratio/clip/k3-KL/masked-mean in float64."""
+    import jax
+    from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                  TransformerLM)
+    cfg = TransformerConfig(vocab_size=64, hidden_size=32,
+                            intermediate_size=64, num_layers=2,
+                            num_heads=4, max_seq_len=64, remat=False,
+                            use_flash=False, loss_chunk=8)
+    model = TransformerLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    eng = _fake_engine(gas=1, micro=2, max_seq_len=64)
+    learner = PPOLearner(eng, queue=RolloutQueue(4), gamma=0.95,
+                         lam=0.9, clip_eps=0.15, kl_coef=0.3)
+    rng = np.random.default_rng(4)
+    samples = [
+        _sample(rng.integers(1, 64, 5).tolist(),
+                rng.integers(1, 64, 7).tolist(),
+                logprobs=(-rng.random(7) * 3).tolist(), reward=1.0),
+        _sample(rng.integers(1, 64, 3).tolist(),
+                rng.integers(1, 64, 4).tolist(),
+                logprobs=(-rng.random(4) * 3).tolist(),
+                reward=[0.2, -0.1, 0.0, 0.7]),
+    ]
+    batch, _ = learner.pack(samples)
+    loss_dev = float(model.apply(params, batch))
+
+    # dense reference: full-vocab log-softmax in float64
+    x, _ = model.forward_hidden(params, batch["input_ids"])
+    x = np.asarray(x, np.float64)
+    head = np.asarray(params["embed"], np.float64).T \
+        if cfg.tie_embeddings else np.asarray(params["lm_head"],
+                                              np.float64)
+    logits = x[:, :-1] @ head                       # [B, S-1, V]
+    lse = np.log(np.exp(
+        logits - logits.max(-1, keepdims=True)).sum(-1)) \
+        + logits.max(-1)
+    tgt = np.take_along_axis(
+        logits, batch["input_ids"][:, 1:, None], axis=-1)[..., 0]
+    new_lp = tgt - lse
+    mask = batch["loss_mask"][:, 1:].astype(np.float64)
+    old_lp = batch["ppo_old_logprobs"][:, 1:].astype(np.float64)
+    adv = batch["ppo_advantages"][:, 1:].astype(np.float64)
+    ratio = np.exp(new_lp - old_lp)
+    surrogate = np.minimum(
+        ratio * adv, np.clip(ratio, 0.85, 1.15) * adv)
+    d = old_lp - new_lp
+    kl = np.exp(d) - 1.0 - d
+    ref = ((-surrogate + 0.3 * kl) * mask).sum() / max(mask.sum(), 1)
+    assert loss_dev == pytest.approx(ref, rel=2e-4), \
+        "chunked device PPO loss diverged from the dense numpy " \
+        "reference"
+    # identical policies: ratio==1 and KL==0 => loss is -mean(adv)
+    batch2 = dict(batch)
+    batch2["ppo_old_logprobs"] = np.zeros_like(batch["loss_mask"])
+    batch2["ppo_old_logprobs"][:, 1:] = new_lp.astype(np.float32)
+    loss_same = float(model.apply(params, batch2))
+    assert loss_same == pytest.approx(
+        -(adv * mask).sum() / mask.sum(), rel=1e-3, abs=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# satellite: lock-free queue depth under thread churn
+# ---------------------------------------------------------------------------
+def test_rollout_queue_depth_threaded_stress():
+    """Producers push while a consumer pops: `depth` must stay a valid
+    recently-published value (never negative, never above maxlen) with
+    zero locking on the read side, and converge to the exact locked
+    length when the churn stops."""
+    q = RolloutQueue(maxlen=10_000)
+    producers, per = 4, 250
+    errors = []
+
+    def produce(k):
+        for i in range(per):
+            q.push(_sample([k], [i % 7], version=0))
+
+    def consume():
+        got = 0
+        while got < 600:
+            got += len(q.pop(3))
+
+    def watch():
+        for _ in range(2000):
+            d = q.depth                      # lock-free read
+            if not (0 <= d <= q.maxlen):
+                errors.append(d)
+
+    threads = ([threading.Thread(target=produce, args=(k,))
+                for k in range(producers)]
+               + [threading.Thread(target=consume),
+                  threading.Thread(target=watch)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, f"depth out of range under churn: {errors[:3]}"
+    assert q.depth == len(q) == producers * per - 600
+    # the gauge path IS the depth feed: the published metric agrees
+    from deepspeed_tpu.telemetry import get_registry
+    fam = get_registry().get("hybrid_rollout_queue_depth")
+    assert fam is not None
+    assert any(s.value == q.depth for _, s in fam.series())
+    # drain to empty: depth follows
+    while q.pop(128):
+        pass
+    assert q.depth == 0 and len(q) == 0
