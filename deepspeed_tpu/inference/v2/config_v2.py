@@ -96,6 +96,21 @@ class RaggedInferenceEngineConfig:
     #   "off"  — keep the stitched prefill->continue->decode dispatch
     #            (the rollback knob; parity-tested against "on")
     ragged_attention: str = "auto"
+    # multi-tenant batched LoRA serving (0 = off): hot adapter slots in
+    # the stacked device bank. Slot 0 is reserved for the base model
+    # (all-zero delta — bit-exact no-op), so the bank holds
+    # max_lora_adapters live fine-tunes at slots 1..max. Per-row adapter
+    # indices ride the per-token descriptor layout and the deltas are
+    # gathered inside the jitted step (paged_model._lora_delta); the
+    # bank is allocated at engine init so hot-deploying an adapter is a
+    # same-shape slot update — no recompile.
+    max_lora_adapters: int = 0
+    lora_rank: int = 8                       # rank of every bank slot
+    # speculative decoding source per request: "auto" routes between the
+    # host n-gram index and the in-window draft model via the
+    # hysteresis-armed accept-rate chooser (engine_v2.SpecChooser);
+    # "ngram" / "draft" pin the source
+    spec_mode: str = "auto"
     seed: int = 0
 
     def __post_init__(self):
@@ -107,6 +122,15 @@ class RaggedInferenceEngineConfig:
                           ("prefill_bucket", "serving.prefill_bucket")):
             tunables.check(name, getattr(self, key), label=key)
             tunables.observe(name, getattr(self, key), "config")
+        if self.spec_mode not in ("auto", "ngram", "draft"):
+            raise ValueError(
+                f"spec_mode must be 'auto', 'ngram' or 'draft', got "
+                f"{self.spec_mode!r}")
+        if self.max_lora_adapters < 0:
+            raise ValueError("max_lora_adapters must be >= 0")
+        if self.max_lora_adapters and self.lora_rank < 1:
+            raise ValueError("lora_rank must be >= 1 when the adapter "
+                             "bank is enabled")
 
     @classmethod
     def from_dict(cls, d: dict) -> "RaggedInferenceEngineConfig":

@@ -42,15 +42,91 @@ from ...telemetry import trace, watchdog
 from ...utils.bucketing import ceil_bucket, pow2_bucket
 from ...utils.logging import log_dist
 from .config_v2 import RaggedInferenceEngineConfig
-from .paged_model import (init_paged_kv_cache, paged_continue, paged_decode,
-                          paged_decode_window, paged_prefill,
-                          paged_ragged_step)
+from .paged_model import (init_lora_bank, init_paged_kv_cache,
+                          paged_continue, paged_decode, paged_decode_window,
+                          paged_prefill, paged_ragged_step,
+                          paged_spec_decode_window)
 from .ragged import batch as ragged_batch
 from .ragged.blocked_allocator import NULL_BLOCK
 from .ragged.ragged_manager import DSStateManager
 
 DTYPES = {"float32": jnp.float32, "float16": jnp.float16,
           "bfloat16": jnp.bfloat16}
+
+
+class DraftModelMismatchError(ValueError):
+    """A draft model cannot verify-share with the serving target:
+    greedy verification compares raw token ids, so the vocabularies
+    must be the SAME id space, and the draft writes its KV through the
+    target's block tables, so it must cover the same sequence range."""
+
+
+class SpecChooser:
+    """Routes each speculative request between the two draft sources —
+    the host n-gram index (``"ngram"``, prompt-lookup) and the in-window
+    draft model (``"draft"``) — by observed accept rate.
+
+    Hysteresis-armed like the online autotuner (autotuning/online.py's
+    armed/hold cycle): a switch commits only after the OTHER source's
+    accept-rate EMA beats the current one by ``margin`` for ``hold``
+    consecutive observations, so one noisy window never flips the
+    route. Cold start (no accept history for either source) routes by a
+    repetitiveness prior: histories whose trailing n-gram already
+    recurs draft well from their own text; everything else goes to the
+    draft model."""
+
+    def __init__(self, mode: str = "auto", alpha: float = 0.3,
+                 margin: float = 0.05, hold: int = 3):
+        self.mode = mode
+        self.alpha = float(alpha)
+        self.margin = float(margin)
+        self.hold = int(hold)
+        self.rate: Dict[str, Optional[float]] = {"ngram": None,
+                                                 "draft": None}
+        self.current = "draft" if mode == "draft" else "ngram"
+        self.switches = 0
+        self._armed: Optional[str] = None
+        self._streak = 0
+
+    def observe(self, mode: str, drafted: int, accepted: int) -> None:
+        """Fold one round's (drafted, accepted) counts into ``mode``'s
+        accept-rate EMA; may arm or commit a route switch."""
+        if drafted <= 0:
+            return
+        r = min(max(accepted / drafted, 0.0), 1.0)
+        prev = self.rate.get(mode)
+        self.rate[mode] = (r if prev is None
+                           else (1 - self.alpha) * prev + self.alpha * r)
+        self._maybe_switch()
+
+    def _maybe_switch(self) -> None:
+        if self.mode != "auto":
+            return
+        other = "draft" if self.current == "ngram" else "ngram"
+        ro, rc = self.rate[other], self.rate[self.current]
+        if ro is None or rc is None or ro <= rc + self.margin:
+            self._armed, self._streak = None, 0
+            return
+        if self._armed != other:
+            self._armed, self._streak = other, 1
+        else:
+            self._streak += 1
+        if self._streak >= self.hold:
+            self.current = other
+            self.switches += 1
+            self._armed, self._streak = None, 0
+
+    def choose(self, has_draft_model: bool, ngram_hit: bool) -> str:
+        """Route one incoming request. Pinned modes and a missing draft
+        model short-circuit; "auto" returns the hysteresis-settled
+        current source once any accept history exists."""
+        if self.mode == "ngram" or not has_draft_model:
+            return "ngram"
+        if self.mode == "draft":
+            return "draft"
+        if self.rate["ngram"] is None and self.rate["draft"] is None:
+            return "ngram" if ngram_hit else "draft"
+        return self.current
 
 
 class InferenceEngineV2:
@@ -164,6 +240,32 @@ class InferenceEngineV2:
         # /healthz so the router's blue/green rollout can converge a
         # fleet onto one version
         self.weight_version = 0
+        # multi-tenant batched LoRA (config_v2.max_lora_adapters): the
+        # stacked adapter bank lives on device next to the params; slot
+        # 0 holds the all-zero base delta, so rows without an adapter
+        # ride the same gathered program bit-exactly (+0.0). The bank is
+        # a jit ARGUMENT, not a closure constant, so loading an adapter
+        # is a same-shape slot update — no recompile.
+        self.lora_bank = None
+        self._adapter_slots: Dict[str, int] = {}
+        self._uid_adapter: Dict[int, str] = {}
+        if config.max_lora_adapters > 0:
+            self.lora_bank = init_lora_bank(
+                cfg, config.max_lora_adapters + 1, config.lora_rank,
+                self.dtype)
+        # draft-model speculation (load_draft_model): the draft shares
+        # the target's block tables against its OWN paged KV pool, so
+        # propose->verify->accept runs entirely inside one jitted window
+        # (paged_spec_decode_window); jits cached per (window, spec_k)
+        self.draft_model = None
+        self.draft_params = None
+        self.draft_cache = None
+        self._draft_cfg = None
+        self._draft_seen: Dict[int, int] = {}
+        self._spec_window_jits: Dict[tuple, object] = {}
+        self.spec_chooser = SpecChooser(config.spec_mode)
+        self._spec_mode_of: Dict[int, str] = {}
+        self._spec_switches_seen = 0
         self._init_telemetry()
         # Pallas kernels only at tp=1: a bare pallas_call is not
         # GSPMD-partitionable, so sharded-param (tp>1) serving keeps the
@@ -179,30 +281,40 @@ class InferenceEngineV2:
                       and cfg.positional != "alibi")  # kernels carry no
         # alibi bias; the jnp paths add the softmax-invariant row
         topo = self.topology if ep > 1 else None
+        # load_draft_model builds jits after __init__; it reuses the
+        # same kernel gate and topology the serving programs resolved
+        self._use_kernel = use_kernel
+        self._topo = topo
         # every compile point below is watchdog-wrapped: the power-of-two
         # bucketing is SUPPOSED to make steady-state serving compile-free,
         # and the watchdog is what proves it (telemetry/watchdog.py)
+        # every decode-family jit takes trailing (lb, aid): the LoRA
+        # bank and per-row adapter slots. Both are None when the bank is
+        # disabled (an empty pytree — same compiled programs as before),
+        # and they TRAIL the existing argument lists so every
+        # donate_argnums index stays put
         self._decode_jit = watchdog.watch("decode", jax.jit(
-            lambda p, t, pos, bt, c, a: paged_decode(
+            lambda p, t, pos, bt, c, a, lb, aid: paged_decode(
                 cfg, p, t, pos, bt, c, a, sm.block_size,
-                use_kernel=use_kernel, topo=topo),
+                use_kernel=use_kernel, topo=topo, lora=lb,
+                adapter_ids=aid),
             donate_argnums=(4,)))
 
-        def _decode_tok(p, t, pos, bt, c, a):
+        def _decode_tok(p, t, pos, bt, c, a, lb, aid):
             # greedy variant for the generate() hot loop: argmax on device
             # so the per-token host transfer is [N] int32, not [N, vocab]
             # (the reference's sampler also runs device-side)
             logits, c = paged_decode(cfg, p, t, pos, bt, c, a,
                                      sm.block_size,
                                      use_kernel=use_kernel,
-                                     topo=topo)
+                                     topo=topo, lora=lb, adapter_ids=aid)
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), c
 
         self._decode_tok_jit = watchdog.watch(
             "decode_greedy", jax.jit(_decode_tok, donate_argnums=(4,)))
 
         def _decode_sample(p, t, pos, bt, c, a, rng, seeds, gidx, temp,
-                           topp, topk):
+                           topp, topk, lb, aid):
             # sampling variant (FastGen temperature/top-p/top-k): the
             # sampler runs device-side too, still an [N] int32 transfer.
             # Per-ROW keys (stable row seed + generated-token index) so
@@ -211,7 +323,7 @@ class InferenceEngineV2:
             logits, c = paged_decode(cfg, p, t, pos, bt, c, a,
                                      sm.block_size,
                                      use_kernel=use_kernel,
-                                     topo=topo)
+                                     topo=topo, lora=lb, adapter_ids=aid)
             keys = fold_in_rows(rng, seeds, gidx)
             return sample_tokens_rowwise(logits, keys, temp, topp,
                                          topk), c
@@ -238,18 +350,20 @@ class InferenceEngineV2:
 
         def _build_fused_pair(K: int):
             greedy = watchdog.watch("decode_window_greedy", jax.jit(
-                lambda p, t, pos, bt, c, sl, eos, _K=K: paged_decode_window(
+                lambda p, t, pos, bt, c, sl, eos, lb, aid, _K=K:
+                paged_decode_window(
                     cfg, p, t, pos, bt, c, sl, eos, sm.block_size,
                     _K, use_kernel=use_kernel,
-                    topo=topo),
+                    topo=topo, lora=lb, adapter_ids=aid),
                 donate_argnums=(4,)))
             sample = watchdog.watch("decode_window_sample", jax.jit(
                 lambda p, t, pos, bt, c, sl, eos, rng, seeds, g0, temp, \
-                topp, topk, _K=K: paged_decode_window(
+                topp, topk, lb, aid, _K=K: paged_decode_window(
                     cfg, p, t, pos, bt, c, sl, eos, sm.block_size,
                     _K, rng=rng, row_seeds=seeds, gen_idx0=g0,
                     temp=temp, topp=topp, topk=topk,
-                    use_kernel=use_kernel, topo=topo),
+                    use_kernel=use_kernel, topo=topo, lora=lb,
+                    adapter_ids=aid),
                 donate_argnums=(4,)))
             return greedy, sample
 
@@ -261,13 +375,15 @@ class InferenceEngineV2:
         self._fused_greedy_jit, self._fused_sample_jit = \
             self._fused_pair(self.decode_window)
         self._prefill_jit = watchdog.watch("prefill", jax.jit(
-            lambda p, ids, n, c, b, o: paged_prefill(
+            lambda p, ids, n, c, b, o, lb, aid: paged_prefill(
                 cfg, p, ids, n, c, b, o,
-                use_kernel=use_kernel, topo=topo),
+                use_kernel=use_kernel, topo=topo, lora=lb,
+                adapter_ids=aid),
             donate_argnums=(3,)))
         self._continue_jit = watchdog.watch("continue", jax.jit(
-            lambda p, ids, s, n, c, b, o, t: paged_continue(
-                cfg, p, ids, s, n, c, b, o, t, sm.block_size, topo=topo),
+            lambda p, ids, s, n, c, b, o, t, lb, aid: paged_continue(
+                cfg, p, ids, s, n, c, b, o, t, sm.block_size, topo=topo,
+                lora=lb, adapter_ids=aid),
             donate_argnums=(4,)))
         # ragged unified step (ROADMAP item 1; kernels/ragged_attention.py
         # + ragged/batch.py): every mixed prefill+decode composition runs
@@ -281,10 +397,11 @@ class InferenceEngineV2:
         self.ragged_enabled = self._resolve_ragged_mode(
             config.ragged_attention)
         self._ragged_jit = watchdog.watch("ragged_step", jax.jit(
-            lambda p, ids, rows, pos, ln, wb, wo, bt, li, c:
+            lambda p, ids, rows, pos, ln, wb, wo, bt, li, c, lb, aid:
             paged_ragged_step(
                 cfg, p, ids, rows, pos, ln, wb, wo, bt, li, c,
-                sm.block_size, use_kernel=use_kernel, topo=topo),
+                sm.block_size, use_kernel=use_kernel, topo=topo,
+                lora=lb, adapter_ids=aid),
             donate_argnums=(9,)))
         # speculative verification: greedy ids for a static window of
         # fed positions from one fused continuation pass (prompt-lookup
@@ -295,9 +412,11 @@ class InferenceEngineV2:
             if window not in self._continue_spec_jits:
                 self._continue_spec_jits[window] = watchdog.watch(
                     f"spec_verify_w{window}", jax.jit(
-                        lambda p, ids, s, n, c, b, o, t: paged_continue(
+                        lambda p, ids, s, n, c, b, o, t, lb, aid:
+                        paged_continue(
                             cfg, p, ids, s, n, c, b, o, t, sm.block_size,
-                            topo=topo, greedy_window=window),
+                            topo=topo, greedy_window=window, lora=lb,
+                            adapter_ids=aid),
                         donate_argnums=(4,)))
             return self._continue_spec_jits[window]
 
@@ -366,6 +485,29 @@ class InferenceEngineV2:
         self._m_spec_miss_rounds = reg.counter(
             "inference_spec_miss_rounds_total",
             "speculative rounds whose whole draft was rejected")
+        self._m_spec_window_rounds = reg.counter(
+            "inference_spec_window_rounds_total",
+            "draft-model propose->verify->accept rounds run inside "
+            "fused speculative decode windows (per-row, summed on "
+            "device)")
+        self._m_spec_mode_requests = reg.counter(
+            "inference_spec_mode_requests_total",
+            "speculative requests routed per speculation source",
+            labelnames=("mode",))
+        self._m_spec_switches = reg.counter(
+            "inference_spec_chooser_switches_total",
+            "speculation-source switches committed by the hysteresis "
+            "chooser")
+        self._m_spec_rate = reg.gauge(
+            "inference_spec_accept_rate",
+            "EMA accept rate (accepted/drafted) per speculation source",
+            labelnames=("mode",))
+        self._m_adapter_loads = reg.counter(
+            "inference_lora_adapter_loads_total",
+            "LoRA adapters (re)loaded into device bank slots")
+        self._m_adapters_live = reg.gauge(
+            "inference_lora_adapters_live",
+            "adapter names currently resident in the device bank")
         self._m_window_size = reg.gauge(
             "inference_decode_window_size",
             "configured fused decode window K (1 = per-token decode)")
@@ -489,6 +631,136 @@ class InferenceEngineV2:
         self.config.ragged_attention = mode
 
     # ------------------------------------------------------------------
+    # Multi-tenant batched LoRA (config_v2.max_lora_adapters)
+    # ------------------------------------------------------------------
+    def load_adapter(self, name: str, adapters: Dict[str, tuple],
+                     scale: float = 1.0) -> int:
+        """Install a LoRA adapter into a device bank slot (hot-deploy:
+        a same-shape ``.at[:, slot].set`` — no recompile, serving
+        continues through the same programs).
+
+        ``adapters`` is the hybrid engine's external-adapter payload
+        convention (``runtime/hybrid_engine.py fuse_flat_leaves``):
+        ``{"layers/wq": (a, b), "layers/wv": (a, b)}`` with a [L, h, r]
+        and b [L, r, out]. ``scale`` folds into b at load time so the
+        gathered per-row delta matches the fused-weight definition
+        ``_fused_w``: w + scale * (a @ b). Ranks below the bank rank
+        zero-pad (extra rank contributes exactly 0); larger ranks are a
+        typed error. Re-loading a known name updates its slot in place
+        (hot redeploy of a freshly trained adapter). Returns the slot."""
+        if self.lora_bank is None:
+            raise ValueError(
+                "adapter bank disabled: set max_lora_adapters > 0 in "
+                "RaggedInferenceEngineConfig")
+        from ...models.transformer import lora_target_leaves
+        cfg = self.model.cfg
+        targets = lora_target_leaves(cfg)
+        if set(adapters) != set(targets):
+            raise ValueError(
+                f"adapter {name!r} leaves {sorted(adapters)} != serving "
+                f"targets {sorted(targets)} (q/v projections only)")
+        R = self.config.lora_rank
+        L = cfg.num_layers
+        staged = {}
+        for leaf, keys in (("layers/wq", ("qa", "qb")),
+                           ("layers/wv", ("va", "vb"))):
+            a, b = adapters[leaf]
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32)
+            in_dim, out_dim = targets[leaf]
+            if (a.ndim != 3 or b.ndim != 3 or a.shape[0] != L
+                    or b.shape[0] != L or a.shape[1] != in_dim
+                    or b.shape[2] != out_dim or a.shape[2] != b.shape[1]):
+                raise ValueError(
+                    f"adapter {name!r} leaf {leaf}: got a{a.shape} "
+                    f"b{b.shape}, want a[{L},{in_dim},r] "
+                    f"b[{L},r,{out_dim}]")
+            r = a.shape[2]
+            if r > R:
+                raise ValueError(
+                    f"adapter {name!r} rank {r} exceeds bank rank {R} "
+                    f"(config_v2.lora_rank)")
+            if r < R:   # zero-pad: the extra rank contributes exactly 0
+                a = np.concatenate(
+                    [a, np.zeros((L, in_dim, R - r), a.dtype)], axis=2)
+                b = np.concatenate(
+                    [b, np.zeros((L, R - r, out_dim), b.dtype)], axis=1)
+            staged[keys] = (a, b * float(scale))
+        slot = self._adapter_slots.get(name)
+        if slot is None:
+            used = set(self._adapter_slots.values())
+            slot = next(
+                (s for s in range(1, self.config.max_lora_adapters + 1)
+                 if s not in used), None)
+            if slot is None:
+                raise RuntimeError(
+                    f"adapter bank full "
+                    f"({self.config.max_lora_adapters} slots); "
+                    f"unload_adapter() one or raise max_lora_adapters")
+        bank = self.lora_bank
+        for (ka, kb), (a, b) in staged.items():
+            bank[ka] = bank[ka].at[:, slot].set(jnp.asarray(a, self.dtype))
+            bank[kb] = bank[kb].at[:, slot].set(jnp.asarray(b, self.dtype))
+        self.lora_bank = bank
+        self._adapter_slots[name] = slot
+        self._m_adapter_loads.inc()
+        self._m_adapters_live.set(len(self._adapter_slots))
+        flight.record("adapter_load", name=str(name), slot=int(slot))
+        return slot
+
+    def unload_adapter(self, name: str) -> None:
+        """Zero the adapter's slot (back to the base no-op delta) and
+        free it for reuse; uids still routed to the name fall back to
+        the base model."""
+        slot = self._adapter_slots.pop(name, None)
+        if slot is None:
+            return
+        bank = self.lora_bank
+        for k in bank:
+            bank[k] = bank[k].at[:, slot].set(
+                jnp.zeros(bank[k].shape[2:], bank[k].dtype))
+        self.lora_bank = bank
+        self._uid_adapter = {u: n for u, n in self._uid_adapter.items()
+                             if n != name}
+        self._m_adapters_live.set(len(self._adapter_slots))
+
+    def assign_adapter(self, uid: int, name: Optional[str]) -> int:
+        """Route ``uid``'s tokens through a loaded adapter's bank slot
+        (None/"" clears to the base slot 0). Typed failure at SUBMIT
+        time when the adapter is unknown — not mid-batch on device."""
+        uid = int(uid)
+        if not name:
+            self._uid_adapter.pop(uid, None)
+            return 0
+        if self.lora_bank is None:
+            raise ValueError(
+                f"adapter {name!r} requested but the bank is disabled "
+                f"(max_lora_adapters=0)")
+        slot = self._adapter_slots.get(name)
+        if slot is None:
+            raise KeyError(
+                f"unknown adapter {name!r}: load_adapter() it first "
+                f"(loaded: {sorted(self._adapter_slots)})")
+        self._uid_adapter[uid] = str(name)
+        seq = self.state_manager.seqs.get(uid)
+        if seq is not None:
+            seq.adapter = str(name)
+            seq.adapter_slot = int(slot)
+        return slot
+
+    def adapter_of(self, uid: int) -> Optional[str]:
+        """The adapter NAME serving ``uid`` (None = base). Names — not
+        engine-local slot ints — are the identity prefix digests and
+        router affinity key on, so they agree across replicas."""
+        return self._uid_adapter.get(int(uid))
+
+    def _adapter_slot_of(self, uid: int) -> int:
+        name = self._uid_adapter.get(int(uid))
+        if name is None:
+            return 0
+        return self._adapter_slots.get(name, 0)
+
+    # ------------------------------------------------------------------
     # Schedulability (reference engine_v2.py:135 query / :161 can_schedule)
     # ------------------------------------------------------------------
     def query(self, uid: int) -> Dict[str, int]:
@@ -546,11 +818,15 @@ class InferenceEngineV2:
         table = np.full(C, NULL_BLOCK, np.int32)
         valid = positions < n
         table[valid] = np.asarray(seq.blocks, np.int32)[block_idx[valid]]
+        lb = self.lora_bank
+        aid = (jnp.asarray(self._adapter_slot_of(uid), jnp.int32)
+               if lb is not None else None)
         with trace.span("prefill", uid=int(uid), tokens=int(n),
                         **self._trace_attr(uid)):
             logits, self.kv_cache = self._prefill_jit(
                 self.params, jnp.asarray(ids), jnp.asarray(n),
-                self.kv_cache, jnp.asarray(table), jnp.asarray(offs))
+                self.kv_cache, jnp.asarray(table), jnp.asarray(offs),
+                lb, aid)
         flight.record("prefill", uid=int(uid), tokens=int(n))
         seq.seen_tokens = n
         if sm.config.enable_prefix_caching:
@@ -583,12 +859,15 @@ class InferenceEngineV2:
         full_table = sm.block_table_for(uid)
         jit_fn = (self._spec_jit(all_logits) if all_logits
                   else self._continue_jit)
+        lb = self.lora_bank
+        aid = (jnp.asarray(self._adapter_slot_of(uid), jnp.int32)
+               if lb is not None else None)
         with trace.span("continue", uid=int(uid), tokens=int(n),
                         spec=bool(all_logits), **self._trace_attr(uid)):
             logits, self.kv_cache = jit_fn(
                 self.params, jnp.asarray(ids), jnp.asarray(start),
                 jnp.asarray(n), self.kv_cache, jnp.asarray(table),
-                jnp.asarray(offs), jnp.asarray(full_table))
+                jnp.asarray(offs), jnp.asarray(full_table), lb, aid)
         seq.seen_tokens = start + n
         if sm.config.enable_prefix_caching:
             seq.token_log.extend(map(int, tokens))
@@ -702,6 +981,8 @@ class InferenceEngineV2:
             emitted = self._speculative_step(uid, row[-1], draft)
             self._m_spec_drafted.inc(len(draft))
             self._m_spec_accepted.inc(len(emitted) - 1)
+            self.spec_chooser.observe("ngram", len(draft),
+                                      len(emitted) - 1)
             if len(emitted) == 1:
                 self._m_spec_miss_rounds.inc()
                 self._spec_miss_streak[uid] = \
@@ -722,6 +1003,243 @@ class InferenceEngineV2:
         if plain_uids:
             cur.update(self._decode_batch_greedy(
                 plain_uids, [outs[row_of[u]][-1] for u in plain_uids]))
+        self._observe_spec_rates()
+        return cur
+
+    # -- draft-model speculation (in-window propose->verify->accept) ----
+    def load_draft_model(self, model, params=None) -> None:
+        """Attach a small draft model for in-window speculative
+        decoding. The draft shares the TARGET's block tables against its
+        own paged KV pool (same num_blocks x block_size geometry), so
+        the fused spec window (``paged_spec_decode_window``) needs no
+        extra table plumbing and rollback stays free. Raises the typed
+        :class:`DraftModelMismatchError` when the draft cannot
+        verify-share with the target. ``params`` defaults to a fresh
+        init (tests); production passes the trained draft weights."""
+        dcfg = model.cfg
+        cfg = self.model.cfg
+        if dcfg.vocab_size != cfg.vocab_size:
+            raise DraftModelMismatchError(
+                f"draft vocab_size {dcfg.vocab_size} != target "
+                f"{cfg.vocab_size}: greedy verification compares raw "
+                f"token ids, so the vocabularies must be the same id "
+                f"space")
+        sm = self.state_manager
+        if dcfg.max_seq_len < sm.config.max_seq_len:
+            raise DraftModelMismatchError(
+                f"draft max_seq_len {dcfg.max_seq_len} < serving "
+                f"max_seq_len {sm.config.max_seq_len}: the draft must "
+                f"decode at every position the target serves")
+        self.draft_model = model
+        self._draft_cfg = dcfg
+        if params is not None:
+            self.draft_params = jax.jit(lambda p: jax.tree.map(
+                lambda x: jnp.asarray(x, self.dtype), p))(params)
+        else:
+            self.draft_params = jax.jit(lambda p: jax.tree.map(
+                lambda x: x.astype(self.dtype), model.init_params(p)))(
+                jax.random.PRNGKey(self.config.seed + 1))
+        self.draft_cache = init_paged_kv_cache(
+            dcfg, sm.config.num_blocks, sm.block_size, self.dtype)
+        self._draft_seen.clear()
+        self._spec_window_jits.clear()
+        # draft catch-up: one fused continuation over the DRAFT pool,
+        # replaying history the target built through non-draft paths
+        # (prefill, plain decode, n-gram rounds) before a uid's first
+        # spec window
+        bs = self.block_size
+        self._draft_continue_jit = watchdog.watch(
+            "draft_catchup", jax.jit(
+                lambda p, ids, s, n, c, b, o, t: paged_continue(
+                    dcfg, p, ids, s, n, c, b, o, t, bs, topo=None),
+                donate_argnums=(4,)))
+        try:
+            ds_memory.record_buffer(
+                "draft_params", ds_memory.tree_bytes(self.draft_params))
+            ds_memory.record_buffer(
+                "draft_kv_pool", ds_memory.tree_bytes(self.draft_cache))
+        except Exception:   # accounting must never block serving
+            pass
+        log_dist(
+            f"draft model attached: layers={dcfg.num_layers} "
+            f"hidden={dcfg.hidden_size} (target hidden="
+            f"{cfg.hidden_size})", ranks=[0])
+
+    def _spec_window_jit(self, window: int, spec_k: int):
+        """Per-(window, spec_k) fused speculative window program — like
+        the per-K plain-window cache, both constants are baked into the
+        compiled loop, so per-request draft lengths ride a bounded jit
+        cache instead of growing it. One watchdog name for all sizes."""
+        key = (int(window), int(spec_k))
+        if key not in self._spec_window_jits:
+            cfg = self.model.cfg
+            dcfg = self._draft_cfg
+            bs = self.block_size
+            uk, topo = self._use_kernel, self._topo
+            self._spec_window_jits[key] = watchdog.watch(
+                "spec_decode_window", jax.jit(
+                    lambda p, dp, t, pos, bt, c, dc, sl, eos, lb, aid,
+                    _K=window, _k=spec_k: paged_spec_decode_window(
+                        cfg, dcfg, p, dp, t, pos, bt, c, dc, sl, eos,
+                        bs, _K, _k, use_kernel=uk, topo=topo,
+                        lora=lb, adapter_ids=aid),
+                    donate_argnums=(5, 6)))
+        return self._spec_window_jits[key]
+
+    def _draft_catchup(self, uid: int, row: List[int]) -> None:
+        """Bring the draft KV pool level with the target's cache for
+        ``uid``: feed the fed-token suffix the draft has not seen
+        (``row[:seen_tokens]`` is exactly the fed history — the last
+        emitted token is never fed, the loop invariant). No-op when the
+        draft is already level (consecutive spec windows)."""
+        sm = self.state_manager
+        seq = sm.seqs[uid]
+        seen = seq.seen_tokens
+        d0 = self._draft_seen.get(uid, 0)
+        if d0 >= seen:
+            return
+        toks = np.asarray(row[d0:seen], np.int64)
+        n = len(toks)
+        C = self._bucket(n)
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :n] = toks
+        positions = d0 + np.arange(C)
+        block_idx = positions // self.block_size
+        offs = positions % self.block_size
+        table = np.full(C, NULL_BLOCK, np.int32)
+        valid = np.arange(C) < n
+        seq_blocks = np.asarray(seq.blocks, np.int32)
+        table[valid] = seq_blocks[block_idx[valid]]
+        full_table = sm.block_table_for(uid)
+        with trace.span("draft_catchup", uid=int(uid), tokens=int(n),
+                        **self._trace_attr(uid)):
+            _, self.draft_cache = self._draft_continue_jit(
+                self.draft_params, jnp.asarray(ids), jnp.asarray(d0),
+                jnp.asarray(n), self.draft_cache, jnp.asarray(table),
+                jnp.asarray(offs), jnp.asarray(full_table))
+        self._draft_seen[uid] = seen
+
+    def _observe_spec_rates(self) -> None:
+        """Publish the chooser's per-source accept-rate EMAs and any
+        newly committed route switches."""
+        for mode in ("ngram", "draft"):
+            r = self.spec_chooser.rate.get(mode)
+            if r is not None:
+                self._m_spec_rate.labels(mode=mode).set(r)
+        d = self.spec_chooser.switches - self._spec_switches_seen
+        if d > 0:
+            self._m_spec_switches.inc(d)
+            self._spec_switches_seen = self.spec_chooser.switches
+
+    def _spec_window_round(self, step_uids, outs, row_of, prompt_lens,
+                           live, max_new_tokens, eos_token_id,
+                           spec_k) -> Dict[int, int]:
+        """One fused draft-model speculative window per batch:
+        propose(k) -> target-verify -> accept-prefix loops ON DEVICE
+        (``paged_spec_decode_window``) — speculation adds zero host
+        round-trips on top of the window's single [N, K] transfer.
+        Rows without the sequence room / KV blocks the widened
+        pre-allocation contract needs (``steps_left + spec_k`` writes)
+        fall back to the plain batched greedy step."""
+        sm = self.state_manager
+        K = max(self.decode_window, spec_k + 1)
+        spec_uids: List[int] = []
+        plain_uids: List[int] = []
+        sl: List[int] = []
+        for uid in step_uids:
+            row = outs[row_of[uid]]
+            remaining = max_new_tokens - (len(row) - prompt_lens[uid])
+            room = (sm.config.max_seq_len - sm.seqs[uid].seen_tokens
+                    - spec_k)
+            s = min(K, remaining, room)
+            if s < 1 or not self.can_schedule([uid], [s + spec_k]):
+                plain_uids.append(uid)
+                continue
+            spec_uids.append(uid)
+            sl.append(s)
+        cur: Dict[int, int] = {}
+        if plain_uids:
+            cur.update(self._decode_batch_greedy(
+                plain_uids, [outs[row_of[u]][-1] for u in plain_uids]))
+        if not spec_uids:
+            return cur
+        for uid in spec_uids:
+            self._draft_catchup(uid, outs[row_of[uid]])
+        tokens = [outs[row_of[u]][-1] for u in spec_uids]
+        t0 = time.perf_counter()
+        with trace.span("spec_decode_window", batch=len(spec_uids),
+                        window=K, spec_k=spec_k,
+                        uids=[int(u) for u in spec_uids],
+                        **self._trace_attrs(spec_uids)):
+            # widened pre-allocation contract: row i may write KV at
+            # positions pos..pos+sl[i]+spec_k-1 (the final round's
+            # unaccepted tail), so those blocks exist BEFORE dispatch
+            N, toks, pos, tables = self._assemble_decode_rows(
+                spec_uids, tokens, [s + spec_k for s in sl])
+            eos = np.full(N, -1, np.int32)
+            eos[:len(spec_uids)] = (
+                -1 if eos_token_id is None else int(eos_token_id))
+            lb = self.lora_bank
+            aid = (self._pad_i32(N, [self._adapter_slot_of(u)
+                                     for u in spec_uids])
+                   if lb is not None else None)
+            out, stats, self.kv_cache, self.draft_cache = \
+                self._spec_window_jit(K, spec_k)(
+                    self.params, self.draft_params, jnp.asarray(toks),
+                    jnp.asarray(pos), jnp.asarray(tables),
+                    self.kv_cache, self.draft_cache,
+                    self._pad_i32(N, sl), jnp.asarray(eos), lb, aid)
+            out = np.asarray(out)   # one transfer for the whole window
+            stats = np.asarray(stats)
+        self._m_host_syncs.inc()
+        dt = time.perf_counter() - t0
+        drafted, accepted, miss, rounds = (int(x) for x in stats)
+        self._m_spec_drafted.inc(drafted)
+        self._m_spec_accepted.inc(accepted)
+        self._m_spec_miss_rounds.inc(miss)
+        self._m_spec_window_rounds.inc(rounds)
+        self.spec_chooser.observe("draft", drafted, accepted)
+        self._observe_spec_rates()
+        log_tokens = sm.config.enable_prefix_caching
+        total = 0
+        for i, uid in enumerate(spec_uids):
+            out_row = out[i]
+            e = int((out_row >= 0).sum())   # emissions are a prefix
+            toks_out = [int(t) for t in out_row[:e]]
+            seq = sm.seqs[uid]
+            seq.seen_tokens += e
+            # accepted draft tokens ARE the canonical stream, so the
+            # draft cache is level with the target after the window
+            self._draft_seen[uid] = seq.seen_tokens
+            if log_tokens:
+                seq.token_log.extend([int(tokens[i])] + toks_out[:-1])
+            total += e
+            row = outs[row_of[uid]]
+            finished = False
+            # all but the last emit are fed/cached already; the host
+            # re-applies the eos/budget cuts (defensively — the device
+            # enforced them too), same fold-back as the plain window
+            for tok in toks_out[:-1]:
+                row.append(tok)
+                if ((eos_token_id is not None and tok == eos_token_id)
+                        or len(row) - prompt_lens[uid] >= max_new_tokens):
+                    finished = True
+                    break
+            if finished or not toks_out:
+                live.discard(uid)
+            else:
+                cur[uid] = toks_out[-1]
+        self._m_decode_steps.inc()
+        self._m_decode_tokens.inc(total)
+        self._m_decode_time.observe(dt)
+        self._m_fused_time.observe(dt)
+        if dt > 0:
+            self._m_decode_tput.set(total / dt)
+        flight.record("spec_decode_window", batch=len(spec_uids),
+                      tokens=total, window=K, spec_k=spec_k,
+                      drafted=drafted, accepted=accepted,
+                      dur_s=round(dt, 5))
+        self._update_pool_telemetry()
         return cur
 
     # next power-of-two >= count, capped (one compiled program per
@@ -787,8 +1305,13 @@ class InferenceEngineV2:
                         **self._trace_attrs(uids)):
             toks, pos, tables, active = self._build_decode_inputs(uids,
                                                                   tokens)
+            lb = self.lora_bank
+            aid = (self._pad_i32(active.shape[0],
+                                 [self._adapter_slot_of(u) for u in uids])
+                   if lb is not None else None)
             vals, self.kv_cache = jit_fn(
-                self.params, toks, pos, tables, self.kv_cache, active)
+                self.params, toks, pos, tables, self.kv_cache, active,
+                lb, aid)
             vals = np.asarray(vals)  # blocks: the pass completes here
         self._m_host_syncs.inc()
         dt = time.perf_counter() - t0
@@ -846,8 +1369,9 @@ class InferenceEngineV2:
             temperature, top_p, top_k)
         return self._decode_common(
             uids, tokens,
-            lambda p, t, pos, bt, c, a: self._decode_sample_jit(
-                p, t, pos, bt, c, a, rng, seeds, g0, temp, topp, topk),
+            lambda p, t, pos, bt, c, a, lb, aid: self._decode_sample_jit(
+                p, t, pos, bt, c, a, rng, seeds, g0, temp, topp, topk,
+                lb, aid),
             lambda v, i: int(v[i]))
 
     # -- fused multi-token decode window --------------------------------
@@ -872,9 +1396,13 @@ class InferenceEngineV2:
                 uids, tokens, steps_left)
             eos = np.full(N, -1, np.int32)
             eos[:len(uids)] = eos_ids
+            lb = self.lora_bank
+            aid = (self._pad_i32(N, [self._adapter_slot_of(u)
+                                     for u in uids])
+                   if lb is not None else None)
             out, self.kv_cache = run(
                 jnp.asarray(toks), jnp.asarray(pos), jnp.asarray(tables),
-                self._pad_i32(N, steps_left), jnp.asarray(eos))
+                self._pad_i32(N, steps_left), jnp.asarray(eos), lb, aid)
             out = np.asarray(out)   # ONE transfer for the whole window
         self._m_host_syncs.inc()
         dt = time.perf_counter() - t0
@@ -909,8 +1437,8 @@ class InferenceEngineV2:
                               eos_ids: List[int]) -> Dict[int, List[int]]:
         return self._decode_window_common(
             uids, tokens, steps_left, eos_ids,
-            lambda t, pos, bt, sl, eos: self._fused_greedy_jit(
-                self.params, t, pos, bt, self.kv_cache, sl, eos))
+            lambda t, pos, bt, sl, eos, lb, aid: self._fused_greedy_jit(
+                self.params, t, pos, bt, self.kv_cache, sl, eos, lb, aid))
 
     def _decode_window_sample(self, uids: List[int], tokens: List[int],
                               steps_left: List[int], eos_ids: List[int],
@@ -923,9 +1451,9 @@ class InferenceEngineV2:
             temperature, top_p, top_k)
         return self._decode_window_common(
             uids, tokens, steps_left, eos_ids,
-            lambda t, pos, bt, sl, eos: self._fused_sample_jit(
+            lambda t, pos, bt, sl, eos, lb, aid: self._fused_sample_jit(
                 self.params, t, pos, bt, self.kv_cache, sl, eos, rng,
-                seeds, g0, temp, topp, topk))
+                seeds, g0, temp, topp, topk, lb, aid))
 
     def _window_steps_left(self, step_uids: List[int],
                            remaining: List[int]) -> List[int]:
@@ -978,8 +1506,13 @@ class InferenceEngineV2:
         for i, (uid, toks) in enumerate(entries):
             if not sm.known_seq(uid) and len(toks) > 1:
                 # prefix caching: shared full blocks shorten the row to
-                # its unseen suffix (same as the stitched put())
-                _, n_reused = sm.match_prefix(uid, toks)
+                # its unseen suffix (same as the stitched put()).
+                # Adapter-keyed: a LoRA row's v-projection KV differs
+                # from the base model's, so prefixes only share within
+                # one adapter identity (the NAME — stable across
+                # replicas, unlike engine-local slot ints)
+                _, n_reused = sm.match_prefix(
+                    uid, toks, adapter=self._uid_adapter.get(int(uid)))
                 if n_reused:
                     entries[i] = (uid, toks[n_reused:])
         # classify rows BEFORE packing mutates allocation state: a
@@ -988,6 +1521,14 @@ class InferenceEngineV2:
             1 for uid, toks in entries
             if len(toks) == 1 and sm.known_seq(uid)
             and sm.seqs[uid].seen_tokens > 0)
+        if self.lora_bank is not None:
+            # stamp each row's adapter identity into its descriptor so
+            # the packer carries the per-row bank slots in the ragged
+            # layout (and flush-time prefix registration keys on it)
+            for uid, _ in entries:
+                seq = sm.get_or_create_sequence(uid)
+                seq.adapter = self._uid_adapter.get(int(uid))
+                seq.adapter_slot = self._adapter_slot_of(uid)
         t0 = time.perf_counter()
         rb = ragged_batch.pack(entries, sm)
         with trace.span("ragged_step", rows=len(entries),
@@ -1000,7 +1541,10 @@ class InferenceEngineV2:
                 jnp.asarray(rb.lengths), jnp.asarray(rb.write_blocks),
                 jnp.asarray(rb.write_offsets),
                 jnp.asarray(rb.block_tables),
-                jnp.asarray(rb.last_index), self.kv_cache)
+                jnp.asarray(rb.last_index), self.kv_cache,
+                self.lora_bank,
+                (jnp.asarray(rb.adapter_slots)
+                 if self.lora_bank is not None else None))
             logits = np.asarray(logits)  # blocks: the pass completes here
         dt = time.perf_counter() - t0
         log_tokens = sm.config.enable_prefix_caching
@@ -1051,7 +1595,9 @@ class InferenceEngineV2:
             if not sm.known_seq(uid) and len(toks) > 1:
                 # prefix caching: shared full blocks make this uid a
                 # KNOWN sequence whose suffix continues below
-                _, n_reused = sm.match_prefix(uid, toks)
+                # (adapter-keyed — see step_ragged)
+                _, n_reused = sm.match_prefix(
+                    uid, toks, adapter=self._uid_adapter.get(int(uid)))
                 if n_reused:
                     toks = toks[n_reused:]
                     entries[i] = (uid, toks)
@@ -1128,6 +1674,9 @@ class InferenceEngineV2:
         self._spec_miss_streak.pop(uid, None)
         self._draft_index.pop(uid, None)
         self._uid_traces.pop(int(uid), None)
+        self._uid_adapter.pop(int(uid), None)
+        self._spec_mode_of.pop(int(uid), None)
+        self._draft_seen.pop(int(uid), None)
         self.state_manager.flush_sequence(uid)
         self._update_pool_telemetry()
 
@@ -1161,22 +1710,30 @@ class InferenceEngineV2:
         params = jax.tree.map(sds, self.params)
         cache = jax.tree.map(sds, self.kv_cache)
         toks, pos, tables = i32(N), i32(N), i32(N, MB)
+        # the LoRA bank rides every hot-path program as trailing (bank,
+        # adapter-ids) args; None keeps the pre-bank program signatures
+        lb = (jax.tree.map(sds, self.lora_bank)
+              if self.lora_bank is not None else None)
+        aidN = i32(N) if self.lora_bank is not None else None
+        aid0 = (jax.ShapeDtypeStruct((), jnp.int32)
+                if self.lora_bank is not None else None)
         programs: Dict[str, dict] = {}
         compiled = self._decode_tok_jit.lower(
             params, toks, pos, tables, cache,
-            jax.ShapeDtypeStruct((N,), jnp.bool_)).compile()
+            jax.ShapeDtypeStruct((N,), jnp.bool_), lb, aidN).compile()
         programs["decode_greedy"] = ds_memory.record_memory_analysis(
             "decode_greedy", compiled)
         if self.decode_window > 1:
             compiled = self._fused_greedy_jit.lower(
-                params, toks, pos, tables, cache, i32(N), i32(N)).compile()
+                params, toks, pos, tables, cache, i32(N), i32(N),
+                lb, aidN).compile()
             programs["decode_window_greedy"] = \
                 ds_memory.record_memory_analysis("decode_window_greedy",
                                                  compiled)
         C = self._bucket(self.config.prefill_bucket)
         compiled = self._prefill_jit.lower(
             params, i32(1, C), jax.ShapeDtypeStruct((), jnp.int32), cache,
-            i32(C), i32(C)).compile()
+            i32(C), i32(C), lb, aid0).compile()
         programs["prefill"] = ds_memory.record_memory_analysis(
             "prefill", compiled)
         if self.ragged_enabled:
@@ -1190,7 +1747,7 @@ class InferenceEngineV2:
                              sm.config.max_ragged_batch_size)
             compiled = self._ragged_jit.lower(
                 params, i32(TB), i32(TB), i32(TB), i32(TB), i32(TB),
-                i32(TB), i32(N, MB), i32(N), cache).compile()
+                i32(TB), i32(N, MB), i32(N), cache, lb, aidN).compile()
             programs["ragged_step"] = dict(
                 ds_memory.record_memory_analysis("ragged_step", compiled),
                 token_bucket=TB, row_bucket=N)
@@ -1202,14 +1759,20 @@ class InferenceEngineV2:
                  eos_token_id: Optional[int] = None,
                  temperature: float = 0.0, top_p: float = 1.0,
                  top_k: int = 0, seed: int = 0, speculative: bool = False,
-                 spec_k: int = 4, spec_ngram: int = 3) -> List[np.ndarray]:
+                 spec_k: int = 4, spec_ngram: int = 3,
+                 spec_mode: Optional[str] = None,
+                 adapter=None) -> List[np.ndarray]:
         """Greedy by default; temperature > 0 samples with nucleus top_p
         (FastGen's sampling surface), deterministic for a given seed.
-        ``speculative`` turns on prompt-lookup decoding (greedy only):
-        each sequence drafts spec_k tokens from its own history's last
-        matching spec_ngram-gram and verifies them in ONE fused
-        continuation pass — output is IDENTICAL to plain greedy, steps
-        shrink when the text repeats itself (quotes, code, JSON)."""
+        ``speculative`` turns on speculative decoding (greedy only):
+        per request the chooser routes between prompt-lookup drafting
+        (spec_ngram-gram history match + one fused verify pass) and the
+        draft MODEL in-window path (propose->verify->accept inside one
+        jitted program) when one is loaded — output is IDENTICAL to
+        plain greedy either way. ``spec_mode`` overrides the configured
+        chooser mode for this call ("auto"/"ngram"/"draft"). ``adapter``
+        routes rows through a loaded LoRA adapter: a str applies to all
+        rows, a sequence gives one name (or None) per row."""
         uids = list(uids) if uids is not None else list(range(len(prompts)))
         outs: List[List[int]] = [list(map(int, p)) for p in prompts]
         row_of = {uid: i for i, uid in enumerate(uids)}
@@ -1222,6 +1785,39 @@ class InferenceEngineV2:
         # leak into this one
         self._spec_miss_streak.clear()
         self._draft_index.clear()
+        if adapter is not None:
+            names = ([adapter] * len(uids) if isinstance(adapter, str)
+                     else list(adapter))
+            if len(names) != len(uids):
+                raise ValueError(
+                    f"adapter list length {len(names)} != batch size "
+                    f"{len(uids)}")
+            for uid, name in zip(uids, names):
+                self.assign_adapter(uid, name)
+        if speculative:
+            if spec_mode not in (None, "auto", "ngram", "draft"):
+                raise ValueError(f"spec_mode must be auto|ngram|draft, "
+                                 f"got {spec_mode!r}")
+            if spec_mode == "draft" and self.draft_model is None:
+                raise ValueError("spec_mode='draft' requires a draft "
+                                 "model: call load_draft_model() first")
+            from .ngram_index import NGramIndex
+            for uid in uids:
+                # the request's routing decision is made ONCE, up front:
+                # the n-gram index over the prompt is the chooser's
+                # cheap repetitiveness prior, the per-mode accept-rate
+                # EMAs its learned history
+                idx = self._draft_index[uid] = NGramIndex(
+                    spec_ngram, self._SPEC_SCAN_WINDOW)
+                idx.sync(outs[row_of[uid]])
+                if spec_mode in ("ngram", "draft"):
+                    mode = spec_mode
+                else:
+                    mode = self.spec_chooser.choose(
+                        self.draft_model is not None,
+                        idx.has_candidate(spec_ngram))
+                self._spec_mode_of[int(uid)] = mode
+                self._m_spec_mode_requests.labels(mode=mode).inc()
         base_rng = jax.random.PRNGKey(seed) if sampling else None
         t_start = time.perf_counter()
         # prompts go through put() (prefill); the continuation loop then
@@ -1286,9 +1882,24 @@ class InferenceEngineV2:
                 gen_count = [len(outs[row_of[u]]) - prompt_lens[u]
                              for u in step_uids]
                 if speculative:
-                    cur = self._speculative_round(
-                        step_uids, outs, row_of, prompt_lens, live,
-                        max_new_tokens, eos_token_id, spec_k, spec_ngram)
+                    # per-request routing: draft-model rows take the
+                    # fused in-window path, the rest keep prompt-lookup
+                    draft_set = {u for u in step_uids
+                                 if self._spec_mode_of.get(int(u))
+                                 == "draft"}
+                    cur = {}
+                    if draft_set:
+                        cur.update(self._spec_window_round(
+                            [u for u in step_uids if u in draft_set],
+                            outs, row_of, prompt_lens, live,
+                            max_new_tokens, eos_token_id, spec_k))
+                    ngram_uids = [u for u in step_uids
+                                  if u not in draft_set]
+                    if ngram_uids:
+                        cur.update(self._speculative_round(
+                            ngram_uids, outs, row_of, prompt_lens, live,
+                            max_new_tokens, eos_token_id, spec_k,
+                            spec_ngram))
                     continue
                 if window > 1:
                     sl = self._window_steps_left(

@@ -66,6 +66,13 @@ class NGramIndex:
                     if len(occ) > 2:
                         del occ[0]
 
+    def has_candidate(self, ngram: int) -> bool:
+        """Whether :meth:`draft` would find a candidate right now (any
+        matching trailing n-gram inside the window) — the speculation
+        chooser's cheap repetitiveness prior before either source has
+        accept-rate history for a request."""
+        return bool(self.draft(1, ngram))
+
     def draft(self, k: int, ngram: int) -> List[int]:
         """The k tokens that followed the most recent earlier occurrence
         of the trailing n-gram (n = ngram..2, longest first), with both

@@ -126,6 +126,48 @@ def _kv_read(kc, ksc, l, table, dtype):
             * ksc[l][table][..., None, :, None]).astype(dtype)
 
 
+def _lora_delta(a, b, hn, aid):
+    """Per-row LoRA delta ``(hn @ a[aid]) @ b[aid]`` gathered from a
+    stacked adapter bank. ``a`` [S, h, r] and ``b`` [S, r, o] hold one
+    layer's A/B factors for every hot slot (the adapter scale is folded
+    into ``b`` at load time, so this matches the training-side fused
+    semantics ``W + scale * (a @ b)`` bit-for-bit under fp32); ``hn``
+    [T, h]; ``aid`` int32 [T] per row, or a scalar for single-sequence
+    chunks (prefill/continue), which skips the gather entirely. Slot 0
+    is all-zeros — base-model rows add an exact +0.0."""
+    aid = jnp.asarray(aid)
+    if aid.ndim == 0:
+        t = hn @ a[aid].astype(hn.dtype)
+        return t @ b[aid].astype(hn.dtype)
+    t = jnp.einsum("ti,tir->tr", hn, a[aid].astype(hn.dtype))
+    return jnp.einsum("tr,tro->to", t, b[aid].astype(hn.dtype))
+
+
+def _lora_qv(ll, hn, aid, q, v):
+    """Add one layer's per-row LoRA deltas to the FLAT q/v projections
+    (classic LoRA targets the q and v projections); ``ll`` is the scan-
+    sliced bank layer {"qa","qb","va","vb"} or None (bank disabled)."""
+    if ll is None:
+        return q, v
+    return (q + _lora_delta(ll["qa"], ll["qb"], hn, aid),
+            v + _lora_delta(ll["va"], ll["vb"], hn, aid))
+
+
+def init_lora_bank(cfg: TransformerConfig, slots: int, rank: int,
+                   dtype) -> Dict[str, jnp.ndarray]:
+    """All-zero stacked adapter bank: ``slots`` INCLUDES the reserved
+    base slot 0. Allocated once at engine init so every jitted program's
+    signature is stable from boot — hot-deploying an adapter is a same-
+    shape ``.at[:, slot].set`` update, never a recompile."""
+    h, r = cfg.hidden_size, int(rank)
+    L = cfg.num_layers
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    return {"qa": jnp.zeros((L, slots, h, r), dtype),
+            "qb": jnp.zeros((L, slots, r, nh * hd), dtype),
+            "va": jnp.zeros((L, slots, h, r), dtype),
+            "vb": jnp.zeros((L, slots, r, nkv * hd), dtype)}
+
+
 def _norm(cfg, x, w, b=None):
     from ...ops.norms import layer_norm, rms_norm
 
@@ -277,7 +319,8 @@ def _logits(cfg, params, x):
 def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
                   prompt_len: jnp.ndarray, cache: Dict[str, jnp.ndarray],
                   block_ids: jnp.ndarray, offsets: jnp.ndarray,
-                  use_kernel: bool = True, topo=None
+                  use_kernel: bool = True, topo=None,
+                  lora=None, adapter_ids=None
                   ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """ids [1, C] (padded prompt); prompt_len scalar; block_ids/offsets [C]
     map chunk position -> (cache block, slot) with padding -> null block.
@@ -312,10 +355,12 @@ def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
 
     def layer_fn(carry, inputs):
         x, kc, vc, ksc, vsc = carry
-        lp, l = inputs
+        lp, l = inputs[0], inputs[1]
+        ll = inputs[2] if lora is not None else None
         lp = _deq_layer(lp)
         hn = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
         q, k, v = qkv_proj(lp, hn)
+        q, v = _lora_qv(ll, hn, adapter_ids, q, v)
         q = q.reshape(C, nh, hd)
         k = k.reshape(C, nkv, hd)
         v = v.reshape(C, nkv, hd)
@@ -359,7 +404,8 @@ def paged_prefill(cfg: TransformerConfig, params, ids: jnp.ndarray,
     (x, kc, vc, ksc, vsc), _ = jax.lax.scan(
         layer_fn, (x, cache["k"], cache["v"],
                    cache.get("ks"), cache.get("vs")),
-        (params["layers"], jnp.arange(cfg.num_layers)))
+        (params["layers"], jnp.arange(cfg.num_layers))
+        + ((lora,) if lora is not None else ()))
     x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
     last = jnp.take(x, prompt_len - 1, axis=0)                  # [H]
     return _logits(cfg, params, last), _cache_dict(kc, vc, ksc, vsc)
@@ -373,7 +419,8 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
                    cache: Dict[str, jnp.ndarray], block_ids: jnp.ndarray,
                    offsets: jnp.ndarray, block_table: jnp.ndarray,
                    block_size: int, topo=None,
-                   greedy_window: int = 0
+                   greedy_window: int = 0,
+                   lora=None, adapter_ids=None
                    ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Multi-token continuation of ONE existing sequence in a single pass
     (the reference's chunked prefill over ragged atoms,
@@ -407,10 +454,12 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
 
     def layer_fn(carry, inputs):
         x, kc, vc, ksc, vsc = carry
-        lp, l = inputs
+        lp, l = inputs[0], inputs[1]
+        ll = inputs[2] if lora is not None else None
         lp = _deq_layer(lp)
         hn = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
         q, k, v = qkv_proj(lp, hn)
+        q, v = _lora_qv(ll, hn, adapter_ids, q, v)
         q = q.reshape(C, nh, hd)
         k = k.reshape(C, nkv, hd)
         v = v.reshape(C, nkv, hd)
@@ -448,15 +497,16 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
     (x, kc, vc, ksc, vsc), _ = jax.lax.scan(
         layer_fn, (x, cache["k"], cache["v"],
                    cache.get("ks"), cache.get("vs")),
-        (params["layers"], jnp.arange(cfg.num_layers)))
+        (params["layers"], jnp.arange(cfg.num_layers))
+        + ((lora,) if lora is not None else ()))
     x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
     if greedy_window:
         # speculative verification: greedy token ids for the first
         # ``greedy_window`` fed positions — the projection runs on the
         # sliced window (not the padded bucket) and only [window] int32
         # crosses to host, keeping the decode loop's transfer discipline
-        ids_out = jnp.argmax(_logits(cfg, params, x[:greedy_window]),
-                             axis=-1).astype(jnp.int32)
+        from .sampling import greedy_tokens
+        ids_out = greedy_tokens(_logits(cfg, params, x[:greedy_window]))
         return ids_out, _cache_dict(kc, vc, ksc, vsc)
     last = jnp.take(x, n_new - 1, axis=0)
     return _logits(cfg, params, last), _cache_dict(kc, vc, ksc, vsc)
@@ -468,7 +518,8 @@ def paged_continue(cfg: TransformerConfig, params, ids: jnp.ndarray,
 def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
                  pos: jnp.ndarray, block_tables: jnp.ndarray,
                  cache: Dict[str, jnp.ndarray], active: jnp.ndarray,
-                 block_size: int, use_kernel: bool = True, topo=None
+                 block_size: int, use_kernel: bool = True, topo=None,
+                 lora=None, adapter_ids=None
                  ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """toks/pos/active [N]; block_tables [N, MB]. One token per sequence;
     returns ([N, V] logits, cache). Inactive rows write to the null block
@@ -495,10 +546,12 @@ def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
 
     def layer_fn(carry, inputs):
         x, kc, vc, ksc, vsc = carry
-        lp, l = inputs
+        lp, l = inputs[0], inputs[1]
+        ll = inputs[2] if lora is not None else None
         lp = _deq_layer(lp)
         hn = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
         q, k, v = qkv_proj(lp, hn)
+        q, v = _lora_qv(ll, hn, adapter_ids, q, v)
         q = q.reshape(N, nh, hd)
         k = k.reshape(N, nkv, hd)
         v = v.reshape(N, nkv, hd)
@@ -544,7 +597,8 @@ def paged_decode(cfg: TransformerConfig, params, toks: jnp.ndarray,
     (x, kc, vc, ksc, vsc), _ = jax.lax.scan(
         layer_fn, (x, cache["k"], cache["v"],
                    cache.get("ks"), cache.get("vs")),
-        (params["layers"], jnp.arange(cfg.num_layers)))
+        (params["layers"], jnp.arange(cfg.num_layers))
+        + ((lora,) if lora is not None else ()))
     x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
     return _logits(cfg, params, x), _cache_dict(kc, vc, ksc, vsc)
 
@@ -558,7 +612,8 @@ def paged_ragged_step(cfg: TransformerConfig, params, ids: jnp.ndarray,
                       write_offsets: jnp.ndarray,
                       block_tables: jnp.ndarray, last_index: jnp.ndarray,
                       cache: Dict[str, jnp.ndarray], block_size: int,
-                      use_kernel: bool = True, topo=None
+                      use_kernel: bool = True, topo=None,
+                      lora=None, adapter_ids=None
                       ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """One compiled program for a MIXED batch (the Ragged Paged
     Attention layout, kernels/ragged_attention.py): prefill chunks,
@@ -593,13 +648,20 @@ def paged_ragged_step(cfg: TransformerConfig, params, ids: jnp.ndarray,
     cos, sin = _rope_at(cfg, pos)                                # [T, half]
     ctx_pos = jnp.arange(ctx)
     attn_mask = ctx_pos[None, :] < lengths[:, None]              # [T, ctx]
+    # multi-tenant LoRA: ``adapter_ids`` arrives PER ROW [RB] (the
+    # descriptor layout carries one adapter per sequence); gather it to
+    # per token here so the bank lookup inside the scanned layer body is
+    # a plain [T] indexed read — padding rows carry slot 0 (base)
+    tok_aid = adapter_ids[row_ids] if lora is not None else None
 
     def layer_fn(carry, inputs):
         x, kc, vc, ksc, vsc = carry
-        lp, l = inputs
+        lp, l = inputs[0], inputs[1]
+        ll = inputs[2] if lora is not None else None
         lp = _deq_layer(lp)
         hn = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
         q, k, v = qkv_proj(lp, hn)
+        q, v = _lora_qv(ll, hn, tok_aid, q, v)
         q = q.reshape(T, nh, hd)
         k = k.reshape(T, nkv, hd)
         v = v.reshape(T, nkv, hd)
@@ -650,7 +712,8 @@ def paged_ragged_step(cfg: TransformerConfig, params, ids: jnp.ndarray,
     (x, kc, vc, ksc, vsc), _ = jax.lax.scan(
         layer_fn, (x, cache["k"], cache["v"],
                    cache.get("ks"), cache.get("vs")),
-        (params["layers"], jnp.arange(cfg.num_layers)))
+        (params["layers"], jnp.arange(cfg.num_layers))
+        + ((lora,) if lora is not None else ()))
     x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
     last = x[last_index]                                         # [RB, H]
     return _logits(cfg, params, last), _cache_dict(kc, vc, ksc, vsc)
@@ -668,7 +731,8 @@ def paged_decode_window(cfg: TransformerConfig, params, toks: jnp.ndarray,
                         gen_idx0: jnp.ndarray = None,
                         temp: jnp.ndarray = None, topp: jnp.ndarray = None,
                         topk: jnp.ndarray = None,
-                        use_kernel: bool = True, topo=None
+                        use_kernel: bool = True, topo=None,
+                        lora=None, adapter_ids=None
                         ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Up to ``window`` decode steps entirely on device — the answer to
     the dispatch-bound per-token loop (one Python round-trip + [N] int32
@@ -709,13 +773,15 @@ def paged_decode_window(cfg: TransformerConfig, params, toks: jnp.ndarray,
         s, toks, pos, active, out, cache = state
         logits, cache = paged_decode(cfg, params, toks, pos, block_tables,
                                      cache, active, block_size,
-                                     use_kernel=use_kernel, topo=topo)
+                                     use_kernel=use_kernel, topo=topo,
+                                     lora=lora, adapter_ids=adapter_ids)
         if sampled:
             from .sampling import fold_in_rows, sample_tokens_rowwise
             keys = fold_in_rows(rng, row_seeds, gen_idx0 + s)
             nxt = sample_tokens_rowwise(logits, keys, temp, topp, topk)
         else:
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            from .sampling import greedy_tokens
+            nxt = greedy_tokens(logits)
         out = out.at[:, s].set(jnp.where(active, nxt, -1))
         pos = jnp.where(active, pos + 1, pos)
         toks = jnp.where(active, nxt, toks)
@@ -730,3 +796,235 @@ def paged_decode_window(cfg: TransformerConfig, params, toks: jnp.ndarray,
              jnp.full((N, window), -1, jnp.int32), cache)
     _, _, _, _, out, cache = jax.lax.while_loop(cond, body, state)
     return out, cache
+
+
+# ---------------------------------------------------------------------------
+# Speculative decode window (draft-model propose -> target verify, on device)
+# ---------------------------------------------------------------------------
+def _paged_verify(cfg: TransformerConfig, params, fed: jnp.ndarray,
+                  pos0: jnp.ndarray, block_tables: jnp.ndarray,
+                  cache: Dict[str, jnp.ndarray], active: jnp.ndarray,
+                  block_size: int, use_kernel: bool = True, topo=None,
+                  lora=None, adapter_ids=None
+                  ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Multi-query target forward for in-window speculation: score the
+    ``S = spec_k + 1`` fed tokens of every row in ONE pass. ``fed``
+    [N, S] (fed[:, 0] is the row's pending token, fed[:, 1:] the draft's
+    proposals); ``pos0`` [N] is fed[:, 0]'s cache position. The fed
+    tokens' K/V scatter into each row's blocks at pos0..pos0+S-1
+    (inactive rows -> null block), then every fed token attends over its
+    row's table up to its own position — the same masked-softmax math as
+    :func:`paged_continue`'s verify (pinned bit-identical to the decode
+    loop), batched over rows. Returns (greedy ids [N, S] int32, cache):
+    ids[:, j] is the target's next token AFTER seeing fed[:, :j+1], which
+    is exactly what the plain loop would emit at that step — the accept
+    rule compares ids[:, :S-1] against fed[:, 1:]."""
+    N, S = fed.shape
+    MB = block_tables.shape[1]
+    ctx = MB * block_size
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    params = _deq_nonlayer(params)
+    x = params["embed"][fed]                                    # [N, S, H]
+    if cfg.embed_scale != 1.0:
+        x = x * jnp.asarray(cfg.embed_scale, x.dtype)
+    x = _embed_ln(cfg, params, x)
+    posm = pos0[:, None] + jnp.arange(S)[None, :]               # [N, S]
+    if cfg.positional == "learned":
+        x = x + params["pos_embed"][jnp.clip(posm, 0, cfg.max_seq_len - 1)]
+    cos, sin = _rope_at(cfg, posm)                              # [N, S, half]
+    blkm = jnp.take_along_axis(block_tables, posm // block_size, axis=1)
+    blkm = jnp.where(active[:, None], blkm, 0).reshape(N * S)
+    offm = (posm % block_size).reshape(N * S)
+    ctx_pos = jnp.arange(ctx)
+    # each fed token sees cache positions up to and including itself
+    mask = ctx_pos[None, None, :] <= posm[:, :, None]           # [N, S, ctx]
+    row_ids = jnp.repeat(jnp.arange(N, dtype=jnp.int32), S)     # [N*S]
+    lengths = jnp.where(active[:, None], posm + 1, 0).reshape(N * S)
+
+    def layer_fn(carry, inputs):
+        x, kc, vc, ksc, vsc = carry
+        lp, l = inputs[0], inputs[1]
+        ll = inputs[2] if lora is not None else None
+        lp = _deq_layer(lp)
+        hn = _norm(cfg, x, lp["attn_norm"], lp.get("attn_norm_b"))
+        q, k, v = qkv_proj(lp, hn)
+        if ll is not None:
+            # bank gather broadcast over the S fed positions of each row
+            q = q + _lora_delta(ll["qa"], ll["qb"],
+                                hn.reshape(N * S, -1),
+                                jnp.repeat(adapter_ids, S)).reshape(q.shape)
+            v = v + _lora_delta(ll["va"], ll["vb"],
+                                hn.reshape(N * S, -1),
+                                jnp.repeat(adapter_ids, S)).reshape(v.shape)
+        q = q.reshape(N, S, nh, hd)
+        k = k.reshape(N, S, nkv, hd)
+        v = v.reshape(N, S, nkv, hd)
+        if cfg.positional == "rope":
+            q = _rotate(q, cos[..., None, :], sin[..., None, :])
+            k = _rotate(k, cos[..., None, :], sin[..., None, :])
+        kc, ksc = _kv_write(kc, ksc, l, blkm, offm,
+                            k.reshape(N * S, nkv, hd))
+        vc, vsc = _kv_write(vc, vsc, l, blkm, offm,
+                            v.reshape(N * S, nkv, hd))
+        if use_kernel:
+            from .kernels.ragged_attention import ragged_attention
+            o = ragged_attention(
+                q.reshape(N * S, nh, hd), kc[l], vc[l], row_ids, lengths,
+                block_tables,
+                k_scale=None if ksc is None else ksc[l],
+                v_scale=None if vsc is None else vsc[l]
+            ).reshape(N, S, nh * hd)
+        else:
+            kpages = _kv_read(kc, ksc, l, block_tables,
+                              x.dtype).reshape(N, ctx, nkv, hd)
+            vpages = _kv_read(vc, vsc, l, block_tables,
+                              x.dtype).reshape(N, ctx, nkv, hd)
+            if nkv != nh:
+                kpages = jnp.repeat(kpages, nh // nkv, axis=2)
+                vpages = jnp.repeat(vpages, nh // nkv, axis=2)
+            scores = jnp.einsum("nshd,nchd->nhsc", q,
+                                kpages).astype(jnp.float32)
+            scores = scores / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+            if cfg.positional == "alibi":
+                scores = scores + _alibi_row(cfg, ctx_pos)[None]
+            scores = jnp.where(mask[:, None], scores, NEG_INF)
+            probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+            o = jnp.einsum("nhsc,nchd->nshd", probs,
+                           vpages).reshape(N, S, nh * hd)
+        if cfg.parallel_residual:
+            hn2 = (_norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+                   if cfg.parallel_norms else hn)
+            x = x + out_proj(lp, o) + _mlp(cfg, lp, hn2, topo)
+            return (x, kc, vc, ksc, vsc), None
+        x = x + out_proj(lp, o)
+        hn = _norm(cfg, x, lp["mlp_norm"], lp.get("mlp_norm_b"))
+        x = x + _mlp(cfg, lp, hn, topo)
+        return (x, kc, vc, ksc, vsc), None
+
+    (x, kc, vc, ksc, vsc), _ = jax.lax.scan(
+        layer_fn, (x, cache["k"], cache["v"],
+                   cache.get("ks"), cache.get("vs")),
+        (params["layers"], jnp.arange(cfg.num_layers))
+        + ((lora,) if lora is not None else ()))
+    x = _norm(cfg, x, params["final_norm"], params.get("final_norm_b"))
+    from .sampling import greedy_tokens
+    return greedy_tokens(_logits(cfg, params, x)), \
+        _cache_dict(kc, vc, ksc, vsc)
+
+
+def paged_spec_decode_window(cfg: TransformerConfig, dcfg: TransformerConfig,
+                             params, dparams, toks: jnp.ndarray,
+                             pos: jnp.ndarray, block_tables: jnp.ndarray,
+                             cache: Dict[str, jnp.ndarray],
+                             dcache: Dict[str, jnp.ndarray],
+                             steps_left: jnp.ndarray, eos_ids: jnp.ndarray,
+                             block_size: int, window: int, spec_k: int,
+                             use_kernel: bool = True, topo=None,
+                             lora=None, adapter_ids=None):
+    """Draft-model speculative decoding fused into the jitted decode
+    window: every ``lax.while_loop`` round runs propose(k) -> target-
+    verify -> accept-prefix entirely on device, so speculation adds ZERO
+    host round-trips on top of the fused window's one [N, window] token
+    transfer. Greedy-only (the engine rejects sampling + speculation).
+
+    Per round, for every running row (active and window not yet full):
+
+      1. the DRAFT model proposes ``spec_k`` greedy tokens with
+         ``spec_k + 1`` sequential single-token decodes over its OWN KV
+         pool sharing the target's block tables (same paged layout, so
+         block advancement is the same position arithmetic). The extra
+         (k+1)-th feed writes the last proposal's draft K/V so an all-
+         accept round leaves no hole in the draft cache; rejected
+         positions hold stale K/V that position masking never attends
+         and the next round overwrites — rollback is free, exactly like
+         the host n-gram path.
+      2. the TARGET verifies all ``spec_k + 1`` fed tokens in ONE
+         multi-query pass (:func:`_paged_verify`) — K/V written, greedy
+         ids returned.
+      3. accept the longest matching prefix: ``m = accepted + 1``
+         emissions (the +1 is the target's own next token — correction
+         on a miss, bonus on an all-accept), truncated by the row's
+         remaining window/steps budget and by an emitted EOS.
+
+    ``spec_k`` is a compile-time constant (the draft loop is unrolled),
+    bucketed by the engine like the window itself — per-request draft
+    lengths ride the steady jit cache instead of growing it.
+
+    The host's pre-allocation contract widens: the window can write up
+    to ``steps_left[i] + spec_k`` tokens from ``pos[i]`` (the last
+    round's rejected tail), so the caller pre-allocates blocks AND
+    leaves ``spec_k`` tokens of sequence room beyond the step budget.
+
+    Returns (tokens [N, window] int32, -1 padded — emissions form a
+    prefix of each row; stats [4] int32 = (drafted, accepted,
+    miss_rounds, row_rounds); target cache; draft cache).
+    """
+    N = toks.shape[0]
+    S = spec_k + 1
+    sidx = jnp.arange(S)
+    rows = jnp.arange(N)
+
+    def body(state):
+        oi, toks, pos, active, out, cache, dcache, st = state
+        run = active & (oi < window)
+        # -- 1. draft proposes (unrolled: spec_k is static) -------------
+        t, p = toks, pos
+        seq = [toks]
+        for j in range(S):
+            dlogits, dcache = paged_decode(
+                dcfg, dparams, t, p, block_tables, dcache, run,
+                block_size, use_kernel=use_kernel, topo=topo)
+            if j < spec_k:
+                from .sampling import greedy_tokens
+                t = greedy_tokens(dlogits)
+                seq.append(t)
+                p = p + 1
+        fed = jnp.stack(seq, axis=1)                         # [N, S]
+        # -- 2. target verifies every fed token in one pass -------------
+        ids_v, cache = _paged_verify(
+            cfg, params, fed, pos, block_tables, cache, run, block_size,
+            use_kernel=use_kernel, topo=topo, lora=lora,
+            adapter_ids=adapter_ids)
+        # -- 3. accept the matching prefix + the target's own token -----
+        matches = ids_v[:, :spec_k] == fed[:, 1:]            # [N, k]
+        acc = jnp.sum(jnp.cumprod(matches.astype(jnp.int32), axis=1),
+                      axis=1)                                # [N]
+        m = jnp.minimum(acc + 1, jnp.minimum(window - oi, steps_left - oi))
+        m = jnp.where(run, jnp.maximum(m, 0), 0)
+        # an emitted EOS truncates the acceptance and retires the row
+        # (emitted, never fed back — the plain loop's invariant)
+        within = sidx[None, :] < m[:, None]
+        is_eos = within & (ids_v == eos_ids[:, None])
+        any_eos = jnp.any(is_eos, axis=1)
+        m = jnp.where(any_eos, jnp.argmax(is_eos, axis=1) + 1, m)
+        # -- emit: out[i, oi+j] = ids_v[i, j] for j < m (unrolled; cols
+        # past the row's slice land out of bounds and drop) ------------
+        for j in range(S):
+            col = jnp.where(run & (j < m), oi + j, window)
+            out = out.at[rows, col].set(ids_v[:, j], mode="drop")
+        # -- advance ----------------------------------------------------
+        m_safe = jnp.maximum(m, 1)
+        last = jnp.take_along_axis(ids_v, (m_safe - 1)[:, None],
+                                   axis=1)[:, 0]
+        toks = jnp.where(run, last, toks)
+        pos = jnp.where(run, pos + m, pos)
+        oi = oi + m
+        active = jnp.where(run, (~any_eos) & (oi < steps_left), active)
+        drafted, accepted, miss, rounds = st
+        st = (drafted + jnp.sum(jnp.where(run, spec_k, 0)),
+              accepted + jnp.sum(jnp.maximum(m - 1, 0)),
+              miss + jnp.sum((run & (acc == 0)).astype(jnp.int32)),
+              rounds + jnp.sum(run.astype(jnp.int32)))
+        return oi, toks, pos, active, out, cache, dcache, st
+
+    def cond(state):
+        oi, _, _, active, *_ = state
+        return jnp.any(active & (oi < window))
+
+    zero = jnp.asarray(0, jnp.int32)
+    state = (jnp.zeros(N, jnp.int32), toks, pos, steps_left > 0,
+             jnp.full((N, window), -1, jnp.int32), cache, dcache,
+             (zero, zero, zero, zero))
+    oi, _, _, _, out, cache, dcache, st = jax.lax.while_loop(
+        cond, body, state)
+    return out, jnp.stack(st), cache, dcache

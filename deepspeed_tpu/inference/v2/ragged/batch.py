@@ -52,6 +52,7 @@ class RaggedBatch:
     write_offsets: np.ndarray     # [TB] int32 slot within the block
     block_tables: np.ndarray      # [RB, MBw] int32 (null-padded)
     last_index: np.ndarray        # [RB] int32 flat idx of row's last token
+    adapter_slots: np.ndarray     # [RB] int32 LoRA bank slot (0 = base)
 
     @property
     def total_tokens(self) -> int:
@@ -92,6 +93,7 @@ def pack(entries: Sequence[Tuple[int, np.ndarray]], state_manager
     write_offsets = np.zeros(TB, np.int32)
     tables = np.full((RB, sm.max_blocks_per_seq), NULL_BLOCK, np.int32)
     last_index = np.zeros(RB, np.int32)
+    adapter_slots = np.zeros(RB, np.int32)
 
     cursor = 0
     used_pages = 1
@@ -112,6 +114,7 @@ def pack(entries: Sequence[Tuple[int, np.ndarray]], state_manager
         write_offsets[sl] = pos % bs
         tables[r, :len(seq.blocks)] = seq_blocks
         last_index[r] = cursor + n - 1
+        adapter_slots[r] = getattr(seq, "adapter_slot", 0)
         used_pages = max(used_pages, len(seq.blocks))
         cursor += n
         uids.append(int(uid))
@@ -126,4 +129,4 @@ def pack(entries: Sequence[Tuple[int, np.ndarray]], state_manager
                        positions=positions, lengths=lengths,
                        write_blocks=write_blocks,
                        write_offsets=write_offsets, block_tables=tables,
-                       last_index=last_index)
+                       last_index=last_index, adapter_slots=adapter_slots)

@@ -37,7 +37,22 @@ def _chain(digest: bytes, tokens) -> bytes:
         digest + np.asarray(tokens, np.int32).tobytes()).digest()
 
 
-def prefix_digest(tokens, block_size: int) -> List[bytes]:
+def _digest_seed(adapter: Optional[str]) -> bytes:
+    """Chain seed for a (possibly adapter-scoped) digest walk. A LoRA
+    adapter changes the KV a prefix produces (q/v projections differ),
+    so the same token prefix under different adapters must NEVER share
+    blocks — the adapter NAME is folded into the seed, which scopes the
+    whole chain without touching per-block hashing. Base-model chains
+    (adapter None/"") keep the bare seed, byte-identical to the
+    pre-adapter digests (router affinity keys stay stable)."""
+    if not adapter:
+        return _DIGEST_SEED
+    return hashlib.sha1(
+        _DIGEST_SEED + adapter.encode("utf-8")).digest()
+
+
+def prefix_digest(tokens, block_size: int,
+                  adapter: Optional[str] = None) -> List[bytes]:
     """Chain-hash digests of the FULL block-aligned prefixes of
     ``tokens``: digest ``i`` covers ``tokens[:(i + 1) * block_size]``.
 
@@ -46,11 +61,11 @@ def prefix_digest(tokens, block_size: int) -> List[bytes]:
     the serving router (serve/router.py): the router hashes an incoming
     prompt with the replica's block size and routes to the replica that
     last served the longest matching digest — without ever reaching
-    into manager state. Digests depend only on token content and block
-    size (sha1 over int32 bytes), so two processes with the same config
-    compute identical lists."""
+    into manager state. Digests depend only on token content, block
+    size and the adapter scope (sha1 over int32 bytes), so two
+    processes with the same config compute identical lists."""
     toks = np.asarray(tokens, np.int64)
-    digest = _DIGEST_SEED
+    digest = _digest_seed(adapter)
     out: List[bytes] = []
     for n in range(0, (len(toks) // block_size) * block_size, block_size):
         digest = _chain(digest, toks[n:n + block_size])
@@ -104,19 +119,23 @@ class DSStateManager:
     # -- prefix caching -----------------------------------------------------
     _chain = staticmethod(_chain)
 
-    def match_prefix(self, uid: int,
-                     tokens: np.ndarray) -> Tuple[List[int], int]:
+    def match_prefix(self, uid: int, tokens: np.ndarray,
+                     adapter: Optional[str] = None
+                     ) -> Tuple[List[int], int]:
         """Longest retained block-aligned prefix of ``tokens`` (capped one
-        token short so the model still produces last-token logits).
-        Registers ``uid`` with the shared blocks; returns (blocks,
-        n_reused_tokens) — (…, 0) when nothing matches."""
+        token short so the model still produces last-token logits),
+        scoped to ``adapter`` — an adapter-scoped chain can only hit
+        blocks registered under the SAME adapter name (base-model
+        lookups only hit base blocks). Registers ``uid`` with the
+        shared blocks; returns (blocks, n_reused_tokens) — (…, 0) when
+        nothing matches."""
         if not self.config.enable_prefix_caching or uid in self.seqs:
             return [], 0
         self._m_lookups.inc()
         bs = self.block_size
         usable = ((len(tokens) - 1) // bs) * bs
         blocks: List[int] = []
-        digest = _DIGEST_SEED
+        digest = _digest_seed(adapter)
         n = 0
         # incremental chain (same rule as prefix_digest, which callers
         # use for the full list): the lookup stops hashing at the first
@@ -151,6 +170,7 @@ class DSStateManager:
         seq.blocks = list(blocks)
         seq.seen_tokens = n
         seq.token_log = list(map(int, tokens[:n]))
+        seq.adapter = adapter or None
         self._m_hits.inc()
         self._m_reused_tokens.inc(n)
         return blocks, n
@@ -158,10 +178,13 @@ class DSStateManager:
     def _register_prefix(self, seq: DSSequenceDescriptor) -> None:
         """Index the sequence's full blocks at flush so the NEXT arrival
         with the same prefix reuses them (the index holds its own block
-        references — retained blocks survive the flush)."""
+        references — retained blocks survive the flush). Registration
+        uses the sequence's adapter scope, so adapter-served blocks are
+        only ever matched by same-adapter arrivals."""
         bs = self.block_size
         full = min(len(seq.token_log) // bs, len(seq.blocks))
-        digests = prefix_digest(seq.token_log[:full * bs], bs)
+        digests = prefix_digest(seq.token_log[:full * bs], bs,
+                                adapter=getattr(seq, "adapter", None))
         for i, digest in enumerate(digests):
             if digest not in self._prefix:
                 self._prefix[digest] = int(seq.blocks[i])

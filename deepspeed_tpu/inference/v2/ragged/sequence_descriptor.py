@@ -6,7 +6,7 @@ cache blocks it owns.
 """
 
 from dataclasses import dataclass, field
-from typing import List
+from typing import List, Optional
 
 
 @dataclass
@@ -18,6 +18,12 @@ class DSSequenceDescriptor:
     # token content in cache order — what prefix caching indexes at flush
     # (appended by the engine's prefill/continue/decode paths)
     token_log: List[int] = field(default_factory=list)
+    # multi-tenant LoRA identity: the adapter NAME keys prefix-cache
+    # digests (stable across replicas), the engine-local bank SLOT rides
+    # the ragged batch so the kernel gathers the right delta per row.
+    # Base-model sequences keep (None, 0) — slot 0 is the zero adapter.
+    adapter: Optional[str] = None
+    adapter_slot: int = 0
 
     def blocks_needed(self, new_tokens: int, block_size: int) -> int:
         total = self.seen_tokens + new_tokens

@@ -80,6 +80,14 @@ def sample_tokens(logits: jnp.ndarray, rng, temperature: jnp.ndarray,
     return _unsort_pick(logits, order, pick, temperature)
 
 
+def greedy_tokens(logits: jnp.ndarray) -> jnp.ndarray:
+    """Argmax next-token pick as int32 — the one definition of "greedy"
+    shared by the decode hot loops and the in-window speculative verify,
+    so the accept rule compares tokens produced by the same reduction
+    order (the bit-identical-speculation contract leans on this)."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
 def fold_in_rows(rng, row_seeds: jnp.ndarray,
                  gen_idx: jnp.ndarray) -> jnp.ndarray:
     """[N] per-row PRNG keys: fold the row's stable seed then its

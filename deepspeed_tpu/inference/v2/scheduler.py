@@ -71,6 +71,10 @@ class _Request:
     # flight events carry it so the stitched fleet timeline follows the
     # request across router dispatch / prefill / handoff / decode hops
     trace_id: Optional[str] = None
+    # multi-tenant LoRA: adapter NAME serving this request (None = base
+    # model); scopes prefix-cache matches and rides the engine's
+    # per-row slot gather
+    adapter: Optional[str] = None
 
     def trace_attr(self) -> Dict[str, str]:
         return ({"trace_id": self.trace_id}
@@ -158,7 +162,8 @@ class DynamicSplitFuseScheduler:
                temperature: float = 0.0, top_p: float = 1.0,
                top_k: int = 0, seed: Optional[int] = None,
                on_token: Optional[Callable[[int, int, bool], None]]
-               = None, trace_ctx=None) -> None:
+               = None, trace_ctx=None,
+               adapter: Optional[str] = None) -> None:
         """temperature/top_p/seed are PER REQUEST (the MII SamplingParams
         surface): mixed greedy and sampled requests compose into the same
         steps; a SEEDED request's tokens are deterministic (independent
@@ -168,7 +173,9 @@ class DynamicSplitFuseScheduler:
         ``trace_ctx`` (a :class:`~...telemetry.context.TraceContext`)
         correlates the request's lifeline spans — and, via
         ``engine.bind_trace``, the engine's batch spans — with its
-        distributed trace."""
+        distributed trace. ``adapter`` names a loaded LoRA adapter to
+        serve this request through (KeyError if unknown; None = base
+        model)."""
         if uid in self._all:
             # results()/metrics() are keyed by uid: admitting a second
             # request under a live key would silently cross their
@@ -198,7 +205,12 @@ class DynamicSplitFuseScheduler:
                        eos_token_id, self.clock(),
                        temperature=temperature, top_p=top_p, top_k=top_k,
                        rng=np.random.default_rng(seed), on_token=on_token,
-                       t_submit_pc=time.perf_counter())
+                       t_submit_pc=time.perf_counter(), adapter=adapter)
+        if adapter:
+            # resolve the name to a bank slot NOW (KeyError surfaces at
+            # submit, not mid-batch) and route every engine pass for
+            # this uid through it
+            self.engine.assign_adapter(uid, adapter)
         self._bind_trace(req, trace_ctx)
         self._all[uid] = req
         self._queue.append(req)
@@ -436,7 +448,8 @@ class DynamicSplitFuseScheduler:
                 # put() only ever sees one chunk (<= self.chunk tokens),
                 # which would cap reuse at a chunk's worth
                 _, n_reused = sm.match_prefix(
-                    req.uid, np.asarray(req.prompt, np.int64))
+                    req.uid, np.asarray(req.prompt, np.int64),
+                    adapter=req.adapter)
                 if n_reused:
                     # match_prefix registered the uid in sm.seqs, so
                     # tracked_sequences() already counts it — no

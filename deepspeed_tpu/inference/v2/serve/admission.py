@@ -117,6 +117,18 @@ class AdmissionController:
             return max(float(entry.weight), 1e-6)
         return max(self.config.tenant_weights.get(entry.tenant, 1.0), 1e-6)
 
+    @staticmethod
+    def _lane(entry) -> str:
+        """Fairness-queue key: tenant, sub-divided by LoRA adapter. A
+        tenant hammering one adapter then cannot starve its OWN other
+        adapters either — each (tenant, adapter) pair drains in
+        virtual-finish-time order like a tenant of its own (weights
+        still come from the tenant, via ``_weight``). ``|`` cannot
+        appear ambiguously: it is appended only when an adapter is
+        set."""
+        adapter = getattr(entry, "adapter", None)
+        return f"{entry.tenant}|{adapter}" if adapter else entry.tenant
+
     def _reject(self, reason: str, message: str):
         self._m_rejected.labels(reason=reason).inc()
         flight.record("shed", reason=reason, depth=self._depth,
@@ -145,7 +157,7 @@ class AdmissionController:
                     "token_budget",
                     f"queued token budget exceeded ({self._tokens} "
                     f"queued + {cost} requested > {budget}); shed")
-            t = entry.tenant
+            t = self._lane(entry)
             q = self._queues.setdefault(t, deque())
             if not q:
                 self._head_finish[t] = (max(self._vtime,
@@ -156,6 +168,7 @@ class AdmissionController:
             self._tokens += cost
             self._m_admitted.inc()
             flight.record("admit", uid=entry.uid, tenant=entry.tenant,
+                          adapter=getattr(entry, "adapter", None),
                           cost_tokens=cost, depth=self._depth)
             self._update_gauges()
 

@@ -109,6 +109,9 @@ class _Entry:
     on_token: object = None
     on_end: object = None
     state: str = "pending"
+    # LoRA adapter NAME this request is served through (None = base):
+    # rides into scheduler.submit and the admission fairness key
+    adapter: Optional[str] = None
     # distributed TraceContext (telemetry/context.py), captured on the
     # asyncio side: the serving-loop thread does not share the asyncio
     # contextvar context, so the entry carries it across that boundary
@@ -282,7 +285,8 @@ class ServingEngine:
                      top_k: int = 0, seed: Optional[int] = None,
                      tenant: str = "default",
                      weight: Optional[float] = None,
-                     deadline_s: Optional[float] = None) -> TokenStream:
+                     deadline_s: Optional[float] = None,
+                     adapter: Optional[str] = None) -> TokenStream:
         """Admit a request and return its token stream.
 
         Raises :class:`~.admission.OverloadedError` when the runtime is
@@ -290,7 +294,10 @@ class ServingEngine:
         draining) — callers retry with backoff or surface 429.
         ``deadline_s`` is a wall-clock budget from now; overdue requests
         are cancelled wherever they are and the stream raises
-        :class:`DeadlineExceeded`."""
+        :class:`DeadlineExceeded`. ``adapter`` names a loaded LoRA
+        adapter to serve the request through (None = base model); it
+        scopes admission fairness within the tenant and the engine's
+        per-row adapter gather."""
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         uid = next(self._uids)
@@ -309,7 +316,7 @@ class ServingEngine:
             deadline_t=(self.clock() + deadline_s
                         if deadline_s is not None else None),
             on_token=stream._push_token, on_end=stream._push_end,
-            trace_ctx=ctx)
+            trace_ctx=ctx, adapter=adapter)
         self.admission.try_admit(entry)     # raises OverloadedError
         self._loop_runner.register(entry)
         return stream
@@ -614,9 +621,9 @@ class WeightUpdate:
             raise
 
         def swap() -> int:
-            serve_weights.swap_engine_params(engine, flat,
-                                             stager.version)
-            return int(stager.version)
+            # full/delta -> donated-buffer param swap; adapter ->
+            # bank-slot load_adapter (weights.install_stager routes)
+            return serve_weights.install_stager(engine, stager, flat)
         try:
             version = await loop.run_on_loop(swap)
         finally:

@@ -387,7 +387,8 @@ class ServingLoop:
                     temperature=entry.temperature, top_p=entry.top_p,
                     top_k=entry.top_k, seed=entry.seed,
                     on_token=self._make_on_token(entry),
-                    trace_ctx=getattr(entry, "trace_ctx", None))
+                    trace_ctx=getattr(entry, "trace_ctx", None),
+                    adapter=getattr(entry, "adapter", None))
             except Exception as e:   # e.g. prompt exceeds max_seq_len
                 self._end(entry, "error", f"{type(e).__name__}: {e}")
                 continue

@@ -323,6 +323,10 @@ class ReplicaRouter:
         # rotation, streams finishing on their original version)
         self.target_weight_version: Optional[int] = None
         self._weight_payloads: Optional[List[bytes]] = None
+        # adapter payloads cached by NAME for scale-up sync (a newcomer
+        # must hold every live adapter before it can take adapter
+        # traffic); latest push per name wins (hot redeploy)
+        self._adapter_payloads: Dict[str, List[bytes]] = {}
         self._updating: set = set()
         self._uids = itertools.count(1)
         self._requests: Dict[int, _RoutedRequest] = {}
@@ -553,6 +557,19 @@ class ReplicaRouter:
                 except Exception:
                     pass
                 raise
+        # newcomers also sync every live ADAPTER before taking traffic
+        # (bank-slot installs; weight_version untouched)
+        if self.config.sync_weights_on_add and self._adapter_payloads:
+            try:
+                for pl in self._adapter_payloads.values():
+                    await self._push_to_replica(
+                        replica, pl, sum(len(p) for p in pl))
+            except BaseException:
+                try:
+                    await replica.stop()
+                except Exception:
+                    pass
+                raise
         self.replicas.append(replica)
         self._by_name[replica.name] = replica
         self._rebuild_ring()
@@ -728,11 +745,15 @@ class ReplicaRouter:
         while len(self._affinity) > self.config.affinity_max_entries:
             self._affinity.popitem(last=False)
 
-    def pick_replica(self, prompt: Sequence[int]) -> tuple:
+    def pick_replica(self, prompt: Sequence[int],
+                     adapter: Optional[str] = None) -> tuple:
         """Placement decision only (no dispatch): returns
         ``(replica_name, digests, via)`` where ``via`` is 'affinity' |
-        'hash' | 'round_robin'. Exposed for the perf gate's dispatch-
-        overhead probe."""
+        'hash' | 'round_robin'. ``adapter`` scopes the placement key the
+        same way it scopes the replica-side prefix cache (the digests
+        ARE the replica's cache keys): the same prompt under different
+        adapters lands wherever each adapter's KV actually lives.
+        Exposed for the perf gate's dispatch-overhead probe."""
         routable = self._routable()
         if not routable:
             return None, [], "none"
@@ -740,7 +761,7 @@ class ReplicaRouter:
         digests: List[bytes] = []
         if self.config.placement == "affinity":
             digests = prefix_digest(np.asarray(list(prompt), np.int64),
-                                    self.block_size)
+                                    self.block_size, adapter=adapter)
             # longest matching digest wins: the deepest shared prefix
             for d in reversed(digests):
                 name = self._affinity.get(d)
@@ -750,6 +771,8 @@ class ReplicaRouter:
             name = routable[next(self._rr) % len(routable)].name
             return name, digests, "round_robin"
         key = np.asarray(list(prompt), np.int64).tobytes()
+        if adapter:
+            key = adapter.encode("utf-8") + b"\x00" + key
         return self._ring.pick(key, names), digests, "hash"
 
     def _candidates(self, first: str) -> List[Replica]:
@@ -798,7 +821,8 @@ class ReplicaRouter:
 
     def _pick_for(self, rec: _RoutedRequest):
         t0 = time.perf_counter()
-        name, digests, via = self.pick_replica(rec.prompt)
+        name, digests, via = self.pick_replica(
+            rec.prompt, adapter=rec.kw.get("adapter"))
         self._m_dispatch.observe(time.perf_counter() - t0)
         if name is None:
             self._m_shed.inc()
@@ -1071,6 +1095,11 @@ class ReplicaRouter:
             if delta is None:
                 delta = payloads.delta
             payloads = payloads.full
+        if serve_weights.is_adapter_payload(payloads):
+            # an ADAPTER rode the publish path: same per-replica push,
+            # but it installs into a bank slot and leaves the fleet
+            # weight-version target untouched
+            return await self.push_adapter(payloads)
         if self.config.disaggregated:
             raise NotImplementedError(
                 "blue/green weight push over disaggregated fleets is "
@@ -1147,6 +1176,52 @@ class ReplicaRouter:
             raise RequestFailed(
                 f"weight push to version {version} did not converge: "
                 f"replicas {still_stale} still stale ({detail})")
+        return version
+
+    async def push_adapter(self, payloads: Sequence[bytes]) -> int:
+        """Hot-deploy a LoRA adapter fleet-wide over the SAME
+        per-replica push path as blue/green weights (quiesce ->
+        ``POST /weights`` / staged in-process update -> ingest), but
+        WITHOUT moving the fleet weight-version target: the payload
+        installs into a bank slot (``engine.load_adapter``) on each
+        replica and ``weight_version`` stays put, so convergence is
+        judged by per-replica push success rather than advertised
+        version. The payload is cached by adapter NAME so later
+        ``add_replica`` scale-ups join holding every live adapter.
+        Returns the adapter payload version."""
+        from . import weights as serve_weights
+        if self._stopped:
+            raise RuntimeError("router is stopped")
+        header = serve_weights.parse_weights_header(payloads[0])
+        if not serve_weights.is_adapter_header(header):
+            raise ValueError(
+                "push_adapter requires an adapter payload "
+                "(payload_kind='adapter'); use push_weights for "
+                "full/delta payloads")
+        name = str(header["adapter_name"])
+        version = int(header["version"])
+        payloads = list(payloads)
+        nbytes = serve_weights.payload_bytes(payloads)
+        t0 = time.perf_counter()
+        failures: List[str] = []
+        for replica in list(self.replicas):
+            if replica.state != "up":
+                continue
+            try:
+                await self._push_to_replica(replica, payloads, nbytes)
+            except Exception as e:
+                self._m_weight_push_failures.inc()
+                failures.append(
+                    f"{replica.name}: {type(e).__name__}: {e}")
+        self._adapter_payloads[name] = payloads
+        trace.record("router_adapter_push", t0,
+                     time.perf_counter() - t0, lane=_ROUTER_LANE,
+                     adapter=name, version=version,
+                     payload_bytes=nbytes, failures=len(failures))
+        if failures:
+            raise RequestFailed(
+                f"adapter {name!r} push did not converge: "
+                + "; ".join(failures))
         return version
 
     async def _push_to_replica(self, replica, payloads: List[bytes],

@@ -337,6 +337,92 @@ def reconstruct_delta(header: Dict, staged: Dict[str, np.ndarray],
     return out
 
 
+# ---------------------------------------------------------------------------
+# Adapter payloads (multi-tenant LoRA hot-deploy)
+# ---------------------------------------------------------------------------
+# A freshly trained LoRA adapter rides the SAME publish path as full /
+# delta weight payloads (router.push_weights -> POST /weights ->
+# begin_weight_update), so it inherits the chunk CRCs, the retransmit
+# idempotence, the fleet blue/green drain and the fault plane for free.
+# The header carries ``payload_kind="adapter"`` + the adapter NAME (the
+# cross-replica identity the router and prefix cache key on) and the
+# scale; each low-rank pair travels as two leaves keyed ``path + "::a"``
+# / ``path + "::b"``. Ingest routes to ``engine.load_adapter`` — a
+# same-shape bank slot write, no param swap, no recompile — instead of
+# ``swap_engine_params``; ``weight_version`` and the retained delta
+# base are untouched (the base model did not change).
+
+_ADAPTER_A = "::a"
+_ADAPTER_B = "::b"
+
+
+def chunk_adapter_payload(name: str, adapters: Dict[str, tuple],
+                          version: int,
+                          scale: float = 1.0) -> List[bytes]:
+    """Serialize one LoRA adapter (``{"layers/wq": (a, b), ...}`` —
+    the hybrid-engine external-adapter convention) into the weights
+    wire ``[header, chunk]``. Adapters are tiny relative to the model,
+    so one chunk always suffices."""
+    if not str(name):
+        raise ValueError("adapter payload requires a non-empty name")
+    flat: Dict[str, np.ndarray] = {}
+    for path in sorted(adapters):
+        a, b = adapters[path]
+        flat[path + _ADAPTER_A] = np.ascontiguousarray(
+            np.asarray(a, np.float32))
+        flat[path + _ADAPTER_B] = np.ascontiguousarray(
+            np.asarray(b, np.float32))
+    crc = _chunk_crc(flat)
+    leaf_meta = {n: {"shape": list(v.shape)} for n, v in flat.items()}
+    chunk = _npz_chunk(
+        {"kind": _CHUNK_KIND, "seq": 0, "crc32": crc,
+         "version": int(version)}, flat)
+    header = _npz_chunk(
+        {"kind": _HEADER_KIND, "version": int(version),
+         "payload_kind": "adapter", "adapter_name": str(name),
+         "adapter_scale": float(scale), "n_chunks": 1,
+         "chunk_crcs": [crc], "chunk_leaves": [sorted(flat)],
+         "leaf_meta": leaf_meta,
+         "param_count": sum(int(v.size) for v in flat.values())}, {})
+    return [header, chunk]
+
+
+def is_adapter_header(header: Dict) -> bool:
+    return header.get("payload_kind") == "adapter"
+
+
+def is_adapter_payload(payloads: Sequence[bytes]) -> bool:
+    return is_adapter_header(parse_weights_header(payloads[0]))
+
+
+def adapters_from_flat(flat: Dict[str, np.ndarray]
+                       ) -> Dict[str, tuple]:
+    """Regroup staged ``path::a`` / ``path::b`` leaves into the
+    ``{path: (a, b)}`` map ``engine.load_adapter`` takes. Typed failure
+    on an unpaired or unrecognized leaf."""
+    adapters: Dict[str, tuple] = {}
+    for n in sorted(flat):
+        if n.endswith(_ADAPTER_A):
+            path = n[:-len(_ADAPTER_A)]
+            bk = path + _ADAPTER_B
+            if bk not in flat:
+                raise ValueError(
+                    f"adapter payload leaf {n!r} has no matching "
+                    f"{bk!r} (a/b pairs must travel together)")
+            adapters[path] = (flat[n], flat[bk])
+        elif not n.endswith(_ADAPTER_B):
+            raise ValueError(
+                f"adapter payload leaf {n!r} is neither "
+                f"'{_ADAPTER_A}' nor '{_ADAPTER_B}' suffixed")
+    for n in flat:
+        if n.endswith(_ADAPTER_B) \
+                and n[:-len(_ADAPTER_B)] not in adapters:
+            raise ValueError(
+                f"adapter payload leaf {n!r} has no matching "
+                f"'{_ADAPTER_A}' half")
+    return adapters
+
+
 def parse_weights_header(buf: bytes) -> Dict:
     d = parse_chunk(buf)["descriptor"]
     if d.get("kind") != _HEADER_KIND:
@@ -520,6 +606,12 @@ def prepare_stager(engine, stager: WeightStager
     the returned map goes to ``swap_engine_params`` between scheduler
     steps."""
     header = stager.header
+    if is_adapter_header(header):
+        # validate pairing off-loop so a malformed payload fails typed
+        # BEFORE the loop-thread install; the regrouped map is rebuilt
+        # (cheap — adapters are tiny) by install_stager
+        adapters_from_flat(stager.leaves)
+        return stager.leaves
     if not is_delta_header(header):
         return stager.leaves
     base_version = int(header["base_version"])
@@ -538,15 +630,34 @@ def prepare_stager(engine, stager: WeightStager
     return reconstruct_delta(header, stager.leaves, base)
 
 
+def install_stager(engine, stager: WeightStager,
+                   flat: Dict[str, np.ndarray]) -> int:
+    """The loop-thread half of ingest: install the prepared leaves into
+    the engine. Full/delta payloads run the donated-buffer param swap;
+    ADAPTER payloads route to ``engine.load_adapter`` (a bank-slot
+    write — ``weight_version`` and the retained delta base stay put,
+    the base model did not change). Both the colocated
+    ``commit_stager`` and the serving loop's ``WeightUpdate.commit``
+    land here, so every payload kind behaves identically on every
+    ingest path."""
+    if is_adapter_header(stager.header):
+        header = stager.header
+        engine.load_adapter(
+            str(header["adapter_name"]), adapters_from_flat(flat),
+            scale=float(header.get("adapter_scale", 1.0)))
+        return int(stager.version)
+    swap_engine_params(engine, flat, stager.version)
+    return int(stager.version)
+
+
 def commit_stager(engine, stager: WeightStager) -> int:
     """THE ingest choke point: every path that turns a complete stager
     into live params (colocated ``apply_payload``, the serving loop's
     ``WeightUpdate.commit``, the worker ``/weights`` handler above it)
-    lands here, so full and delta payloads behave identically
+    lands here, so full, delta and adapter payloads behave identically
     everywhere."""
     flat = prepare_stager(engine, stager)
-    swap_engine_params(engine, flat, stager.version)
-    return int(stager.version)
+    return install_stager(engine, stager, flat)
 
 
 def apply_payload(engine, payloads: Sequence[bytes]) -> int:

@@ -295,6 +295,18 @@ def out_proj(lp, o):
     return x
 
 
+def lora_target_leaves(cfg: TransformerConfig):
+    """Flat leaf paths multi-tenant serving LoRA may target (classic
+    LoRA: the q and v projections) mapped to their layer-stacked
+    (fan_in, fan_out) dims — the one validation surface shared by
+    ``InferenceEngineV2.load_adapter`` and the adapter publication
+    path, and the same flat-leaf key space the hybrid engine's external
+    adapters fuse into (``runtime/hybrid_engine.fuse_flat_leaves``)."""
+    h, hd = cfg.hidden_size, cfg.head_dim
+    return {"layers/wq": (h, cfg.num_heads * hd),
+            "layers/wv": (h, cfg.kv_heads * hd)}
+
+
 def _chunked_ce_loss(x, targets, mask, head, chunk: int, bias=None):
     """Cross-entropy without materializing [B, S, V] logits: scan over
     sequence chunks, each chunk's logits+logsumexp rematerialized in the

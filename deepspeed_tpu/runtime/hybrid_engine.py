@@ -693,6 +693,40 @@ class DeepSpeedHybridEngine(DeepSpeedTpuEngine):
                 pub.delta if pub.delta is not None else pub.full)
         return pub
 
+    def publish_adapter(self, name: str, adapters=None,
+                        scale: Optional[float] = None) -> List[bytes]:
+        """Package a freshly trained LoRA adapter as a versioned
+        ADAPTER payload (serve/weights.chunk_adapter_payload) riding
+        the same publish path as full weights: install it into the
+        colocated serving engine's bank (when one is enabled) and
+        return the payload for fleet distribution —
+        ``router.push_weights`` routes it to ``push_adapter``
+        automatically. ``adapters`` defaults to the attached external
+        adapters (``self.lora_adapters``, the
+        ``{path: (lora_a, lora_b)}`` convention ``fuse_flat_leaves``
+        and ``engine.load_adapter`` share); ``scale`` defaults to the
+        engine's ``lora_scale``. Unlike ``publish()`` this does NOT
+        fuse into the base weights — the adapter stays a bank-slot
+        delta, servable next to other tenants' adapters."""
+        from ..inference.v2.serve import weights as serve_weights
+        if adapters is None:
+            adapters = dict(self.lora_adapters)
+        if not adapters:
+            raise ValueError(
+                "no adapter leaves to publish: pass adapters= or "
+                "attach external adapters first")
+        if scale is None:
+            scale = self.lora_scale
+        self.publisher.version += 1
+        payloads = serve_weights.chunk_adapter_payload(
+            name, adapters, self.publisher.version,
+            scale=float(scale))
+        if (self._serving is not None
+                and getattr(self._serving, "lora_bank", None)
+                is not None):
+            serve_weights.apply_payload(self._serving, payloads)
+        return payloads
+
     # -- generation (reference hybrid_engine.generate :174) -------------
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0,

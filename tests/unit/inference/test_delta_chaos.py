@@ -1,0 +1,215 @@
+"""Composition: quantized delta publication x remote fleet x chaos
+fault plane (ISSUE 18 satellite — the delta wire rides ``POST /weights``
+but no test ran delta pushes through injected faults before this one).
+
+The invariant: under scripted transport faults on the ``/weights``
+lane, a fleet rollout either CONVERGES with every replica holding the
+publisher's exact reconstruction (transport failures retry — staging is
+idempotent, the worker aborts partial stagers), or the faulted payload
+fails TYPED (corruption dies at the CRC) and the router falls back to
+the full payload — the fleet still converges, live params never hold
+garbage. Adapter payloads ride the same faulted wire into bank slots.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.config_v2 import DSStateManagerConfig
+from deepspeed_tpu.inference.v2.serve import (FaultPlane, FaultSpec,
+                                              RemoteReplica,
+                                              ReplicaRouter,
+                                              ReplicaWorker,
+                                              RouterConfig,
+                                              ServingConfig, weights)
+from deepspeed_tpu.models.transformer import lora_target_leaves
+from deepspeed_tpu.runtime.hybrid_engine import WeightPublisher
+from deepspeed_tpu.telemetry import get_registry
+
+
+@pytest.fixture(scope="module")
+def model_and_params(tiny_model_256):
+    return tiny_model_256
+
+
+def _engine(model, params, **kw):
+    return InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            state_manager=DSStateManagerConfig(
+                max_tracked_sequences=8, max_seq_len=256, num_blocks=65,
+                block_size=16, max_ragged_batch_size=512),
+            dtype="float32", prefill_bucket=16, **kw), params=params)
+
+
+def _np_tree(params):
+    return jax.tree.map(lambda x: np.array(x, np.float32), params)
+
+
+def _drift(tree, seed, scale=1e-3):
+    rng = np.random.default_rng(seed)
+    for leaf in jax.tree.leaves(tree):
+        leaf += rng.normal(0.0, scale, leaf.shape).astype(np.float32)
+
+
+def _flat(engine_or_tree):
+    tree = getattr(engine_or_tree, "params", engine_or_tree)
+    items, _ = weights.flatten_params(tree)
+    return {n: weights.fetch_leaf(a) for n, a in items}
+
+
+async def _worker(model, params, name, plane, **ekw):
+    worker = ReplicaWorker(_engine(model, params, **ekw),
+                           ServingConfig(token_budget=64, chunk=16),
+                           name=name)
+    host, port = await worker.start()
+    replica = RemoteReplica(name, host, port, faults=plane,
+                            probe_interval_s=0.0,
+                            reconnect_backoff_s=0.01)
+    return worker, replica
+
+
+def test_delta_push_through_faults_converges_or_falls_back(
+        model_and_params):
+    """One scenario, three phases over a remote two-replica fleet:
+
+    1. clean full anchor push (v1) — the delta base on every replica;
+    2. delta push (quant='off': reconstruction is bit-exact) with a
+       mid-transfer connection kill on ``/weights`` — the transport
+       retry converges the fleet to the publisher's EXACT weights;
+    3. delta push whose frames are CORRUPTED on the wire — the CRC
+       rejects typed, the router's per-replica fallback re-sends the
+       FULL payload, and the fleet still converges exactly.
+    """
+    model, params = model_and_params
+    src = _np_tree(params)
+    pub = WeightPublisher(src, delta_quant="off")
+    anchor = pub.publish()                              # v1
+    fam = get_registry().family_total
+
+    async def run():
+        planes = {n: FaultPlane() for n in ("dc0", "dc1")}
+        w0, r0 = await _worker(model, params, "dc0", planes["dc0"])
+        w1, r1 = await _worker(model, params, "dc1", planes["dc1"])
+        router = ReplicaRouter([r0, r1],
+                               RouterConfig(monitor_interval_s=0.0))
+        await router.start()
+        try:
+            # phase 1: clean anchor
+            assert await router.push_weights(anchor.full) == 1
+            for w in (w0, w1):
+                assert w.replica.engine.weight_version == 1
+                assert weights.delta_base_of(w.replica.engine) \
+                    is not None
+
+            # phase 2: delta push through a mid-transfer reset
+            _drift(src, seed=10)
+            p2 = pub.publish(delta_base=pub.delta_ref_version)   # v2
+            assert p2.delta is not None
+            retr0 = fam("remote_call_retries_total")
+            d0 = fam("router_weight_delta_pushes_total")
+            planes["dc0"].script(
+                FaultSpec(kind="reset", op="write", target="/weights",
+                          skip=1, times=1))
+            assert await router.push_weights(p2) == 2
+            assert fam("remote_call_retries_total") - retr0 >= 1, \
+                "the killed transfer must have retried"
+            assert fam("router_weight_delta_pushes_total") - d0 == 2
+            truth2 = _flat(src)
+            for w in (w0, w1):
+                got = _flat(w.replica.engine)
+                for n in truth2:
+                    assert np.array_equal(got[n], truth2[n]), \
+                        f"{w.replica.name}:{n} drifted through the " \
+                        f"faulted delta push"
+
+            # phase 3: corrupted delta frames -> typed CRC rejection ->
+            # per-replica fallback to the full payload
+            planes["dc0"].clear()
+            _drift(src, seed=11)
+            p3 = pub.publish(delta_base=pub.delta_ref_version)   # v3
+            f0 = fam("router_weight_delta_fallbacks_total")
+            # corrupt EVERY delta attempt on dc1 (retries included);
+            # the full-payload fallback then gets a clean wire
+            planes["dc1"].script(
+                FaultSpec(kind="corrupt", op="write",
+                          target="/weights", skip=1, times=3))
+            assert await router.push_weights(p3) == 3
+            assert fam("router_weight_delta_fallbacks_total") - f0 \
+                >= 1, "the corrupted delta must fall back to full"
+            truth3 = _flat(src)
+            for w in (w0, w1):
+                got = _flat(w.replica.engine)
+                for n in truth3:
+                    assert np.array_equal(got[n], truth3[n]), \
+                        f"{w.replica.name}:{n} not exact after the " \
+                        f"fallback"
+                assert w.replica.engine.weight_version == 3
+        finally:
+            await router.stop()
+            await w0.stop()
+            await w1.stop()
+
+    asyncio.run(run())
+
+
+def test_adapter_payload_rides_faulted_weights_wire(model_and_params):
+    """A LoRA adapter hot-deploy shares the ``/weights`` lane: a
+    mid-transfer reset retries to success (bank installed on every
+    replica, base weights untouched), and corrupted frames reject
+    typed without installing anything."""
+    model, params = model_and_params
+    cfg = model.cfg
+    tg = lora_target_leaves(cfg)
+    rng = np.random.default_rng(3)
+    adapters = {p: (rng.normal(size=(cfg.num_layers, i, 4))
+                    .astype(np.float32) * 0.5,
+                    rng.normal(size=(cfg.num_layers, 4, o))
+                    .astype(np.float32) * 0.5)
+                for p, (i, o) in tg.items()}
+    payload = weights.chunk_adapter_payload("wire-ada", adapters, 5)
+
+    async def run():
+        planes = {n: FaultPlane() for n in ("ac0", "ac1")}
+        w0, r0 = await _worker(model, params, "ac0", planes["ac0"],
+                               max_lora_adapters=2, lora_rank=4)
+        w1, r1 = await _worker(model, params, "ac1", planes["ac1"],
+                               max_lora_adapters=2, lora_rank=4)
+        router = ReplicaRouter([r0, r1],
+                               RouterConfig(monitor_interval_s=0.0))
+        await router.start()
+        try:
+            planes["ac0"].script(
+                FaultSpec(kind="reset", op="write", target="/weights",
+                          skip=1, times=1))
+            # push_weights routes adapter payloads to push_adapter
+            assert await router.push_weights(payload) == 5
+            for w in (w0, w1):
+                eng = w.replica.engine
+                assert eng._adapter_slots == {"wire-ada": 1}, \
+                    w.replica.name
+                # base weights and version untouched by the adapter
+                assert int(getattr(eng, "weight_version", 0) or 0) == 0
+
+            # corruption: typed, nothing installed
+            bad = weights.chunk_adapter_payload("bad-ada", adapters, 6)
+            planes["ac0"].script(
+                FaultSpec(kind="corrupt", op="write",
+                          target="/weights", skip=1, times=3))
+            with pytest.raises(Exception):
+                await router.push_adapter(bad)
+            assert "bad-ada" not in w0.replica.engine._adapter_slots
+            # the fleet still serves clean adapter pushes afterwards
+            planes["ac0"].clear()
+            assert await router.push_adapter(bad) == 6
+            assert "bad-ada" in w0.replica.engine._adapter_slots
+        finally:
+            await router.stop()
+            await w0.stop()
+            await w1.stop()
+
+    asyncio.run(run())

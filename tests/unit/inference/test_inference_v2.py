@@ -490,9 +490,9 @@ def test_decode_table_sliced_to_used_pages():
     widths = []
     inner = eng._decode_tok_jit  # generate()'s greedy hot loop
 
-    def spy(p, t, pos, bt, c, a):
+    def spy(p, t, pos, bt, c, a, *lora):
         widths.append(bt.shape[1])
-        return inner(p, t, pos, bt, c, a)
+        return inner(p, t, pos, bt, c, a, *lora)
 
     eng._decode_tok_jit = spy
     out = eng.generate([list(range(4, 14))], max_new_tokens=30)[0]

@@ -77,6 +77,44 @@ def test_zero_tolerance_counters_fail_on_any_increase(perf_gate,
     assert len(fails) == 1 and "steady_state_recompiles" in fails[0]
 
 
+def test_spec_and_lora_pins_are_hand_tuned(perf_gate, baseline):
+    """ISSUE 18 acceptance rides the committed baseline: zero-tolerance
+    recompile pin, accept rate pinned from below, the draft-vs-ngram
+    margin's slack eating exactly the headroom above 0, and the LoRA
+    window overhead pinned from above — and ``make_baseline`` must
+    PRESERVE that hand-tuning on ``--update`` (the same treatment as
+    ``hot_swap_steady_recompiles``)."""
+    m = baseline["metrics"]
+    assert m["spec_steady_recompiles"] == {
+        "value": 0, "direction": "max", "abs_tol": 0.0}
+    assert m["spec_accept_rate"]["direction"] == "min"
+    margin = m["spec_accept_margin"]
+    assert margin["direction"] == "min"
+    # draft may erode toward n-gram but never below it
+    assert abs(margin["value"] - margin["abs_tol"]) < 1e-4
+    assert m["multi_lora_batch_overhead"]["direction"] == "max"
+
+    # --update re-derives the same policy from fresh values
+    spec = perf_gate.make_baseline({
+        "spec_steady_recompiles": 0.0,
+        "spec_accept_rate": 0.71,
+        "spec_accept_margin": 0.42,
+        "multi_lora_batch_overhead": 0.02,
+    })["metrics"]
+    assert spec["spec_steady_recompiles"] == {
+        "value": 0.0, "direction": "max", "abs_tol": 0.0}
+    assert spec["spec_accept_rate"] == {
+        "value": 0.71, "direction": "min", "abs_tol": 0.05}
+    assert spec["spec_accept_margin"] == {
+        "value": 0.42, "direction": "min", "abs_tol": 0.42}
+    # a draft path already losing to n-gram gets no grace
+    assert perf_gate.make_baseline(
+        {"spec_accept_margin": -0.1})["metrics"][
+            "spec_accept_margin"]["abs_tol"] == 0.0
+    assert spec["multi_lora_batch_overhead"] == {
+        "value": 0.02, "direction": "max", "abs_tol": 0.05}
+
+
 # -- end-to-end: collect on this host, gate against the committed baseline --
 # slow tier: the full collect() duplicates what scripts/perf_gate.py
 # runs standalone (~67s) — the CLI/compare units below stay tier-1

@@ -221,6 +221,13 @@ def test_concurrent_lane_writers_wraparound_keeps_traces_unbroken():
         t.join()
     assert not errors, errors
 
+    # the lifeline pin probes a QUIESCED writer on the well-wrapped
+    # ring: while writers race, a GIL burst can land >capacity appends
+    # between two hops of one in-flight trace and legitimately split
+    # it mid-record — the racing phase above pins well-formedness
+    # under contention, not per-trace retention
+    _emit_traced_hops(9_999_999, f"{9_999_999:032x}", 1e6)
+
     spans = trace.export()
     assert len(spans) == 256
     # newest completed trace in the window has its whole hop set
