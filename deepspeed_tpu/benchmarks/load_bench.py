@@ -42,6 +42,21 @@ resets). The report adds the chaos accounting: ``invariant_ok`` (every
 submitted request completed or failed typed — the robustness
 invariant), faults injected by kind, mid-stream reconnects, retries,
 and suspect/death verdicts.
+
+``--city N`` is the city-scale serving simulation (ISSUE 19
+acceptance): N multi-turn conversation SESSIONS — diurnally modulated
+Poisson session starts, long-tail (lognormal) idle gaps between
+turns, each turn's prompt the full conversation history — driven
+through a routed, SPILL-ENABLED fleet with the autoscaler and
+(optionally) the chaos plane composed on top. Idle conversations
+spill out of HBM between turns; the next turn restores over
+recompute via the router's bloom-summary spill placement. The report
+carries the robustness invariant (every TURN completed or failed
+typed), a bit-identical sweep (a sample of completed sessions
+replayed turn-by-turn on a fault-free reference engine — greedy AND
+seeded sampling), restore-vs-recompute fractions, and
+capacity-per-host-byte (conversation tokens kept servable per MiB of
+KV pool + spill tier).
 """
 
 import argparse
@@ -404,6 +419,317 @@ def run_chaos_open_loop(engines, arrivals, prompts, new_tokens, budget,
     return asyncio.run(drive())
 
 
+def make_city_workload(sessions, max_turns, rate_rps, seed,
+                       first_len=40, turn_len=10,
+                       diurnal_amplitude=0.8, day_s=None,
+                       idle_mean_s=0.2, idle_sigma=1.2,
+                       sampled_every=5):
+    """City-scale conversation schedule: ``sessions`` session specs,
+    each ``{"start_s", "turns", "idles", "kw"}``.
+
+    * session starts are a diurnally modulated Poisson process
+      (thinning over ``rate * (1 + A*sin(2*pi*t/day))`` — the arrival
+      rate breathes like a city's day instead of staying flat),
+    * per-session turn counts are 1 + Poisson (most sessions short, a
+      tail of long conversations),
+    * idle gaps between turns are lognormal — the LONG-TAIL pauses
+      that push an idle conversation's KV out of the pool and into
+      the spill tier before the user comes back,
+    * every ``sampled_every``-th session uses fixed-seed sampling
+      instead of greedy, so the bit-identical sweep covers both.
+    """
+    rng = np.random.default_rng(seed)
+    horizon = max(sessions / max(rate_rps, 1e-9), 1e-6)
+    day = day_s if day_s else horizon
+    peak = rate_rps * (1.0 + diurnal_amplitude)
+    starts, t = [], 0.0
+    while len(starts) < sessions:
+        t += rng.exponential(1.0 / peak)
+        lam = rate_rps * (1.0 + diurnal_amplitude
+                          * np.sin(2.0 * np.pi * t / day))
+        if rng.random() * peak <= max(lam, 0.0):
+            starts.append(t)
+    specs = []
+    for i, start in enumerate(starts):
+        n_turns = int(min(1 + rng.poisson(1.2), max_turns))
+        turns = [list(map(int, rng.integers(
+            1, 127, first_len if k == 0 else turn_len)))
+            for k in range(n_turns)]
+        idles = [float(rng.lognormal(np.log(idle_mean_s), idle_sigma))
+                 for _ in range(n_turns)]
+        kw = (dict(temperature=0.8, top_p=0.9, seed=1000 + i)
+              if sampled_every and i % sampled_every == sampled_every - 1
+              else dict(temperature=0.0))
+        specs.append({"start_s": float(start), "turns": turns,
+                      "idles": idles, "kw": kw})
+    return specs
+
+
+def run_city_open_loop(engines, workload, reply_tokens, budget, chunk,
+                       max_pending, max_queued_tokens=None,
+                       deadline_s=None, placement="affinity",
+                       engine_factory=None, autoscale_max=0,
+                       chaos_seed=None, reset_p=0.1, latency_p=0.15,
+                       latency_s=0.02, reference_engine=None,
+                       parity_sample=4, max_history=0):
+    """The full composition: multi-turn conversations through a routed
+    spill-enabled fleet with the autoscaler and (optionally) the chaos
+    plane stacked on top. One invariant sweep — every submitted TURN
+    either completes or fails with a typed reason, and a sample of
+    completed sessions replays bit-identical on a fault-free
+    ``reference_engine`` — reported with restore-vs-recompute
+    fractions and capacity-per-host-byte."""
+    import asyncio
+
+    from ..inference.v2.serve import (AdmissionConfig, DeadlineExceeded,
+                                      OverloadedError, RequestFailed,
+                                      RouterConfig, ServingConfig)
+    from ..telemetry import get_registry
+    from ..telemetry import memory as ds_memory
+
+    fam = get_registry().family_total
+    _COUNTERS = ("kv_restore_blocks_total", "kv_spill_blocks_total",
+                 "kv_spill_adopted_blocks_total",
+                 "inference_prefix_reused_tokens_total",
+                 "router_spill_placement_hits_total",
+                 "router_spill_placement_false_positives_total",
+                 "router_spill_placement_restored_blocks_total",
+                 "router_session_resurrections_total",
+                 "router_resurrected_requests_total",
+                 "router_autoscale_up_total", "router_requeued_total",
+                 "remote_stream_reconnects_total",
+                 "router_dead_replicas_total")
+    base = {name: fam(name) for name in _COUNTERS}
+    spawned_engines = []
+
+    def serving_config():
+        return ServingConfig(
+            token_budget=budget, chunk=chunk,
+            admission=AdmissionConfig(
+                max_pending=max_pending,
+                max_queued_tokens=max_queued_tokens))
+
+    outcomes = {"submitted_turns": 0, "completed_turns": 0,
+                "rejected": 0, "expired": 0, "errors": 0}
+    transcripts = {}
+    prompt_tokens = [0]
+    history_tokens = [0]
+
+    async def drive():
+        from ..inference.v2.serve import ReplicaRouter
+        workers, planes, replicas = [], [], []
+        if chaos_seed is not None:
+            from ..inference.v2.serve import (FaultPlane, FaultSpec,
+                                              RemoteReplica,
+                                              ReplicaWorker)
+            for i, eng in enumerate(engines):
+                w = ReplicaWorker(eng, serving_config(),
+                                  name=f"city{i}")
+                host, port = await w.start()
+                plane = FaultPlane([
+                    FaultSpec(kind="latency", op="connect",
+                              target="/generate", delay_s=latency_s,
+                              probability=latency_p, times=None),
+                    FaultSpec(kind="reset", op="read",
+                              target="/generate", skip=2,
+                              probability=reset_p, times=None),
+                ], seed=chaos_seed + i)
+                workers.append(w)
+                planes.append(plane)
+                replicas.append(RemoteReplica(
+                    f"city{i}", host, port, faults=plane,
+                    probe_interval_s=0.05, reconnect_backoff_s=0.01))
+        else:
+            from ..inference.v2.serve import build_replicas
+            replicas = build_replicas(engines, serving_config())
+        router = ReplicaRouter(replicas,
+                               RouterConfig(placement=placement))
+        await router.start()
+        scaler = None
+        if autoscale_max > len(engines) and engine_factory is not None:
+            from ..inference.v2.serve import (Autoscaler,
+                                              AutoscalerConfig)
+            if chaos_seed is not None:
+                from ..inference.v2.serve import (RemoteReplica,
+                                                  ReplicaWorker)
+
+                async def spawn(name):
+                    eng = engine_factory()
+                    spawned_engines.append(eng)
+                    w = ReplicaWorker(eng, serving_config(), name=name)
+                    host, port = await w.start()
+                    workers.append(w)
+                    return RemoteReplica(
+                        name, host, port, probe_interval_s=0.05,
+                        reconnect_backoff_s=0.01)
+            else:
+                from ..inference.v2.serve import Replica
+
+                async def spawn(name):
+                    eng = engine_factory()
+                    spawned_engines.append(eng)
+                    return Replica(name, eng, serving_config())
+
+            scaler = Autoscaler(
+                router, spawn,
+                AutoscalerConfig(min_replicas=len(engines),
+                                 max_replicas=autoscale_max,
+                                 scale_up_after_ticks=1,
+                                 interval_s=0.2, cooldown_s=0.5)).start()
+
+        t0 = time.perf_counter()
+
+        async def session(i, spec):
+            await asyncio.sleep(max(
+                0.0, t0 + spec["start_s"] - time.perf_counter()))
+            history, turns_done = [], []
+            for k, user in enumerate(spec["turns"]):
+                prompt = history + user
+                if max_history and len(prompt) + reply_tokens \
+                        > max_history:
+                    break
+                outcomes["submitted_turns"] += 1
+                prompt_tokens[0] += len(prompt)
+                try:
+                    stream = await router.submit(
+                        prompt, reply_tokens, deadline_s=deadline_s,
+                        **spec["kw"])
+                    toks = await stream.drain()
+                except OverloadedError:
+                    outcomes["rejected"] += 1
+                    break
+                except DeadlineExceeded:
+                    outcomes["expired"] += 1
+                    break
+                except RequestFailed:
+                    outcomes["errors"] += 1
+                    break
+                outcomes["completed_turns"] += 1
+                turns_done.append((list(prompt), list(toks)))
+                history = prompt + list(map(int, toks))
+                history_tokens[0] += len(user) + len(toks)
+                await asyncio.sleep(min(spec["idles"][k], 30.0))
+            if turns_done and len(turns_done) == len(spec["turns"]):
+                transcripts[i] = (turns_done, spec["kw"])
+
+        await asyncio.gather(*[session(i, s)
+                               for i, s in enumerate(workload)])
+        if scaler is not None:
+            await scaler.stop()
+        await router.stop(drain=True)
+        for w in workers:
+            await w.stop()
+        makespan = time.perf_counter() - t0
+        injected = {}
+        for plane in planes:
+            for kind, n in plane.injected.items():
+                injected[kind] = injected.get(kind, 0) + n
+        return makespan, injected
+
+    makespan, injected = asyncio.run(drive())
+    delta = {name: fam(name) - base[name] for name in _COUNTERS}
+
+    # bit-identical sweep: replay a sample of fully completed sessions
+    # turn-by-turn on a fault-free SERVING engine over the reference —
+    # same greedy / fixed-seed sampling kw — and compare every
+    # generated token. The replay must go through the serving surface:
+    # a SEEDED request's tokens come from the scheduler's per-request
+    # host rng, a different (equally deterministic) stream than
+    # ``generate()``'s jitted sampler.
+    parity_checked = parity_mismatches = 0
+    if reference_engine is not None and transcripts:
+        from ..inference.v2.serve import ServingEngine
+
+        async def replay():
+            checked = mismatches = 0
+            serving = ServingEngine(reference_engine, serving_config())
+            await serving.start()
+            for i in sorted(transcripts)[:max(parity_sample, 0)]:
+                turns_done, kw = transcripts[i]
+                ok = True
+                for prompt, toks in turns_done:
+                    s = await serving.submit(
+                        prompt, len(toks) or reply_tokens, **kw)
+                    if list(map(int, await s.drain())) != \
+                            list(map(int, toks)):
+                        ok = False
+                checked += 1
+                mismatches += 0 if ok else 1
+            await serving.stop()
+            return checked, mismatches
+
+        parity_checked, parity_mismatches = asyncio.run(replay())
+
+    block_size = getattr(
+        engines[0].state_manager.config, "block_size", 1)
+    all_engines = list(engines) + spawned_engines
+    kv_bytes = spill_bytes = 0
+    for eng in all_engines:
+        try:
+            kv_bytes += int(ds_memory.tree_bytes(eng.kv_cache))
+        except Exception:
+            pass
+        tier = getattr(eng, "spill", None)
+        if tier is not None:
+            st = tier.stats()
+            spill_bytes += st.get("host_bytes", 0) \
+                + st.get("disk_bytes", 0)
+
+    restored_tokens = delta["kv_restore_blocks_total"] * block_size
+    reused = delta["inference_prefix_reused_tokens_total"]
+    submitted_prompt = max(prompt_tokens[0], 1)
+    accounted = (outcomes["completed_turns"] + outcomes["rejected"]
+                 + outcomes["expired"] + outcomes["errors"])
+    host_mib = max((kv_bytes + spill_bytes) / (1 << 20), 1e-9)
+    return {
+        "sessions": len(workload),
+        "placement": placement,
+        "chaos_seed": chaos_seed,
+        **outcomes,
+        "makespan_s": round(makespan, 3),
+        # the robustness invariant: every submitted TURN completed or
+        # ended with a typed verdict — nothing hung, nothing vanished
+        "invariant_ok": accounted == outcomes["submitted_turns"],
+        # the bit-identical sweep verdict over the replayed sample
+        "parity_sessions_checked": parity_checked,
+        "parity_mismatches": parity_mismatches,
+        "bit_identical_ok": parity_mismatches == 0,
+        "faults_injected": injected,
+        # restore-over-recompute accounting: of all submitted prompt
+        # tokens, how many were served from reuse (hot + restored),
+        # how many the spill tier RESTORED specifically, and how many
+        # had to be recomputed
+        "prompt_tokens": prompt_tokens[0],
+        "reuse_fraction": round(reused / submitted_prompt, 4),
+        "restore_fraction": round(
+            restored_tokens / submitted_prompt, 4),
+        "recompute_fraction": round(
+            max(submitted_prompt - reused, 0) / submitted_prompt, 4),
+        # capacity per host byte: conversation tokens kept servable
+        # per MiB of KV pool + spill-tier footprint across the fleet
+        "conversation_tokens": history_tokens[0],
+        "kv_pool_bytes": kv_bytes,
+        "spill_resident_bytes": spill_bytes,
+        "capacity_tok_per_mib": round(history_tokens[0] / host_mib, 2),
+        "spill_placement_hits":
+            delta["router_spill_placement_hits_total"],
+        "spill_placement_false_positives":
+            delta["router_spill_placement_false_positives_total"],
+        "spill_restored_blocks":
+            delta["router_spill_placement_restored_blocks_total"],
+        "session_resurrections":
+            delta["router_session_resurrections_total"],
+        "resurrected_requests":
+            delta["router_resurrected_requests_total"],
+        "adopted_blocks": delta["kv_spill_adopted_blocks_total"],
+        "replicas_died": delta["router_dead_replicas_total"],
+        "requeued": delta["router_requeued_total"],
+        "autoscale_up": delta["router_autoscale_up_total"],
+        "stream_reconnects": delta["remote_stream_reconnects_total"],
+        "final_replicas": len(engines) + len(spawned_engines),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ds_tpu_load_bench")
     p.add_argument("--requests", type=int, default=48)
@@ -440,6 +766,24 @@ def main(argv=None) -> int:
                         "robustness invariant (invariant_ok), fault/"
                         "reconnect/retry counts and per-outcome "
                         "accounting")
+    p.add_argument("--city", type=int, default=0, metavar="SESSIONS",
+                   help="city-scale simulation: SESSIONS multi-turn "
+                        "conversations (diurnal Poisson starts, "
+                        "long-tail idle gaps) through a routed "
+                        "spill-enabled fleet of --router N replicas "
+                        "with the autoscaler (--autoscale) and chaos "
+                        "plane (--chaos) composed; reports the "
+                        "invariant sweep, bit-identical sample, "
+                        "restore-vs-recompute fractions and "
+                        "capacity-per-host-byte")
+    p.add_argument("--city-turns", type=int, default=4,
+                   help="city mode: max turns per session")
+    p.add_argument("--city-rate", type=float, default=4.0,
+                   help="city mode: mean session starts per second "
+                        "(diurnally modulated)")
+    p.add_argument("--city-blocks", type=int, default=48,
+                   help="city mode: KV pool blocks per replica (small "
+                        "enough that idle conversations spill)")
     p.add_argument("--chaos-reset-p", type=float, default=0.15,
                    help="chaos mode: per-read probability of an "
                         "injected mid-stream connection reset")
@@ -478,6 +822,56 @@ def main(argv=None) -> int:
                               "num_blocks": 4096,
                               "enable_prefix_caching": prefix_caching},
         }, params=params)
+
+    if args.city > 0:
+        import tempfile
+
+        spill_dir = tempfile.mkdtemp(prefix="ds_tpu_city_spill_")
+        n_replicas = max(args.router, 2)
+
+        def city_engine():
+            return InferenceEngineV2(model, {
+                "dtype": "bfloat16",
+                "prefill_bucket": 16,
+                "state_manager": {
+                    "max_tracked_sequences": 16,
+                    "max_ragged_batch_size": 1024,
+                    "max_seq_len": 512,
+                    "num_blocks": args.city_blocks,
+                    "block_size": 16,
+                    "enable_prefix_caching": True,
+                    "enable_kv_spill": True,
+                    "kv_spill_dir": spill_dir},
+            }, params=params)
+
+        engines = [city_engine() for _ in range(n_replicas)]
+        reference = InferenceEngineV2(model, {
+            "dtype": "bfloat16", "prefill_bucket": 16,
+            "state_manager": {
+                "max_tracked_sequences": 16,
+                "max_ragged_batch_size": 1024, "max_seq_len": 512,
+                "num_blocks": 2048, "block_size": 16,
+                "enable_prefix_caching": True},
+        }, params=params)
+        workload = make_city_workload(
+            args.city, args.city_turns, args.city_rate, seed=0)
+        report = run_city_open_loop(
+            engines, workload, reply_tokens=args.new, budget=args.budget,
+            chunk=args.chunk, max_pending=args.max_pending,
+            max_queued_tokens=args.max_queued_tokens or None,
+            deadline_s=args.deadline or None, placement=args.placement,
+            engine_factory=city_engine, autoscale_max=args.autoscale,
+            chaos_seed=args.chaos, reset_p=args.chaos_reset_p,
+            latency_s=args.chaos_latency_s, reference_engine=reference,
+            max_history=512 - args.new)
+        print(json.dumps({
+            "metric": "serving_city_open_loop",
+            "backend": jax.default_backend(),
+            "replicas": n_replicas, "turn_cap": args.city_turns,
+            "rate_rps": args.city_rate, "new_tokens": args.new,
+            **report,
+        }))
+        return 0
 
     if args.router > 0 and args.chaos is not None:
         engines = [fresh_engine() for _ in range(args.router)]

@@ -33,6 +33,14 @@ class DSStateManagerConfig:
     kv_spill_host_bytes: int = 64 << 20      # host-tier LRU budget
     kv_spill_dir: Optional[str] = None       # optional disk tier
     kv_spill_disk_bytes: int = 256 << 20     # disk-tier LRU budget
+    # disk-tier namespace under kv_spill_dir: every tier writes its
+    # entries into its OWN subdirectory, so replicas sharing a scratch
+    # directory never clobber each other. None (default) derives a
+    # unique per-instance namespace; an explicit name must be unique
+    # per directory (a claimed collision raises typed at engine
+    # construction) and is what a fleet orchestrator pins so the
+    # router's session resurrection can name the namespace to adopt.
+    kv_spill_namespace: Optional[str] = None
 
     def __post_init__(self):
         if self.enable_kv_spill and not self.enable_prefix_caching:
@@ -40,6 +48,12 @@ class DSStateManagerConfig:
                 "enable_kv_spill requires enable_prefix_caching: spilled "
                 "blocks are keyed by the prefix chain digests the index "
                 "computes")
+        if self.kv_spill_namespace is not None:
+            ns = self.kv_spill_namespace
+            if not ns or "/" in ns or "\\" in ns or ns in (".", ".."):
+                raise ValueError(
+                    f"kv_spill_namespace must be a single path "
+                    f"component (got {ns!r})")
         if self.enable_kv_spill:
             # spill budgets are registered tunables: bad values fail
             # naming the registry entry and its documented range

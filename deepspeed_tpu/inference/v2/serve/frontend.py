@@ -463,7 +463,20 @@ class ServingEngine:
             # blue/green rollout signal (serve/weights.py): the router
             # converges the fleet onto one target version off this field
             "weight_version": self.weight_version,
+            # spill-aware placement signal (ragged/spill.py): the bloom
+            # summary of this replica's spilled digests rides every
+            # heartbeat, so the router can place a returning
+            # conversation where its cold KV actually lives
+            "kv_spill": self.spill_summary_doc(),
         }
+
+    def spill_summary_doc(self) -> Optional[dict]:
+        """Serialized spill-tier digest summary, or None when the
+        engine runs without a spill tier."""
+        spill = getattr(self.scheduler.engine, "spill", None)
+        if spill is None:
+            return None
+        return spill.digest_summary().to_doc()
 
 
 class ChunkedHandoff:

@@ -422,6 +422,8 @@ class RemoteReplica:
         self._reachable = False
         self._last_probe = -1.0
         self._last_metrics: Optional[str] = None
+        # seq-keyed decode cache for the /healthz kv_spill summary
+        self._spill_summary = None
         self.block_size: Optional[int] = None
         self.max_seq_len: Optional[int] = None
         # probe classification consumed by the router's breaker: one
@@ -569,6 +571,58 @@ class RemoteReplica:
         until the first probe answers."""
         v = self._health.get("weight_version")
         return int(v) if v is not None else None
+
+    # -- spill-aware placement (ragged/spill.py; router placement) ------
+    def spill_summary(self):
+        """Decoded :class:`~..ragged.spill.SpillSummary` from the
+        worker's last-advertised /healthz document (staleness bounded
+        by the probe interval — refresh piggybacks on the router's
+        ``check_replicas`` poll). None until the worker advertises
+        one. The decode caches by the summary's ``seq``, so repeated
+        placement checks between probes cost a dict lookup."""
+        doc = self._health.get("kv_spill")
+        if not isinstance(doc, dict):
+            self._spill_summary = None
+            return None
+        cached = self._spill_summary
+        if cached is not None and cached.seq == doc.get("seq"):
+            return cached
+        from ..ragged.spill import SpillSummary
+        self._spill_summary = SpillSummary.from_doc(doc)
+        return self._spill_summary
+
+    def spill_namespace(self):
+        doc = self._health.get("kv_spill")
+        return doc.get("namespace") if isinstance(doc, dict) else None
+
+    def spill_probe(self, digests):
+        """No exact digest check over the wire — the router falls back
+        to the bloom's claim (a false positive silently recomputes on
+        the worker)."""
+        return None
+
+    async def adopt_spill(self, namespace: str) -> int:
+        """Tell the worker to adopt a dead peer's disk-tier spill
+        namespace (``POST /spill/adopt``; shared-filesystem
+        kv_spill_dir). Returns entries adopted — 0 on any transport
+        or worker-side failure (resurrection degrades to a recompute,
+        never an error)."""
+        try:
+            code, obj = await self.retry.call(
+                lambda t: self._json("POST", "/spill/adopt",
+                                     body={"namespace": namespace},
+                                     timeout=t),
+                call="spill_adopt", deadline_s=self.probe_timeout_s)
+        except _CONN_ERRORS:
+            return 0
+        if code != 200 or not isinstance(obj, dict):
+            return 0
+        if isinstance(obj.get("kv_spill"), dict):
+            # the worker returns its post-adoption summary: fold it
+            # into the cached health so placement sees the adopted
+            # digests before the next probe
+            self._health["kv_spill"] = obj["kv_spill"]
+        return int(obj.get("adopted", 0))
 
     # -- live weight push (blue/green rollout; serve/weights.py) --------
     async def push_weights(self, payloads: List[bytes]) -> int:

@@ -90,12 +90,21 @@ class Replica:
     def reap(self) -> None:
         """Dead-replica cleanup (the router declared this replica
         dead): empty the admission queue so a later recovery cannot
-        also run the re-enqueued work, tell the loop to halt, and stop
-        the watchdog thread."""
+        also run the re-enqueued work, tell the loop to halt, stop the
+        watchdog thread, and close the spill tier so the dead replica
+        leaks no host RAM or disk scratch (the router adopts the disk
+        namespace into a survivor BEFORE reaping, so resurrection sees
+        the files first)."""
         try:
             self.serving.admission.reclaim_pending()
             self.serving.loop_runner.request_stop()
             self.serving.diagnostics.close()
+        except Exception:
+            pass
+        try:
+            spill = getattr(self.engine, "spill", None)
+            if spill is not None:
+                spill.close()
         except Exception:
             pass
 
@@ -134,6 +143,43 @@ class Replica:
     def health(self) -> dict:
         return {"name": self.name, "state": self.state,
                 **self.serving.health()}
+
+    # -- spill-aware placement (ragged/spill.py; router placement) ------
+    def spill_summary(self):
+        """Live :class:`~..ragged.spill.SpillSummary` of this replica's
+        spilled digests (None without a spill tier). In-process
+        replicas answer from the tier directly — always fresh; the
+        remote counterpart decodes its cached /healthz document."""
+        spill = getattr(self.engine, "spill", None)
+        return spill.digest_summary() if spill is not None else None
+
+    def spill_namespace(self) -> Optional[str]:
+        """Disk-tier namespace under the shared kv_spill_dir (None
+        without a disk tier) — what a survivor adopts when this
+        replica dies."""
+        spill = getattr(self.engine, "spill", None)
+        if spill is None or not spill.root_dir:
+            return None
+        return spill.namespace
+
+    def spill_probe(self, digests) -> Optional[int]:
+        """EXACT count of ``digests`` present in this replica's spill
+        tier — the router's bloom-false-positive detector. Remote
+        replicas return None (only the bloom is visible without a
+        round trip)."""
+        spill = getattr(self.engine, "spill", None)
+        if spill is None:
+            return None
+        return sum(1 for d in digests if spill.has(d))
+
+    async def adopt_spill(self, namespace: str) -> int:
+        """Adopt a dead peer's disk-tier spill namespace into this
+        replica's tier (session resurrection). Returns entries
+        adopted; 0 without a spill tier."""
+        spill = getattr(self.engine, "spill", None)
+        if spill is None:
+            return 0
+        return await asyncio.to_thread(spill.adopt_namespace, namespace)
 
     @property
     def block_size(self) -> int:

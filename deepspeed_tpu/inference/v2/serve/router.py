@@ -106,6 +106,19 @@ class RouterConfig:
     # affinity index, NOT correctness — evicted digests just fall back
     # to the hash ring
     affinity_max_entries: int = 8192
+    # spill-aware placement: when no replica holds a request's prefix
+    # HOT (affinity miss at every depth), prefer a replica whose
+    # advertised spill-tier bloom summary claims the prefix digests —
+    # restoring spilled KV beats recomputing it. A bloom false positive
+    # degrades silently to recompute on the chosen replica (counted,
+    # never a typed failure). Only consulted under placement='affinity'.
+    spill_placement: bool = True
+    # session resurrection: when a replica dies, a least-loaded survivor
+    # adopts the dead replica's disk spill namespace (shared
+    # kv_spill_dir) BEFORE the reap sweeps it, so re-enqueued requests
+    # whose prefixes were spilled restore on the failover target instead
+    # of recomputing from token zero. No shared directory -> no-op.
+    resurrection: bool = True
     # dead-replica detection: loop stuck mid-step longer than this (as
     # reported by the stall-watchdog heartbeat) or a dead loop thread
     heartbeat_timeout_s: float = 10.0
@@ -369,6 +382,32 @@ class ReplicaRouter:
             "router_affinity_fallback_total",
             "requests placed by the consistent-hash ring / round robin "
             "(no affinity match)")
+        # spill-aware placement + session resurrection (ragged/spill.py
+        # bloom summaries advertised over /healthz)
+        self._m_spill_hits = reg.counter(
+            "router_spill_placement_hits_total",
+            "requests placed onto a replica whose spill-tier bloom "
+            "summary claims the prompt's prefix digests (restore "
+            "preferred over recompute)")
+        self._m_spill_fp = reg.counter(
+            "router_spill_placement_false_positives_total",
+            "spill placements where none of the bloom-claimed digests "
+            "actually existed in the tier (the replica silently "
+            "recomputes; exact check, in-process replicas only)")
+        self._m_spill_restored = reg.counter(
+            "router_spill_placement_restored_blocks_total",
+            "KV blocks a spill placement expects to restore instead of "
+            "recompute (exact for in-process replicas, bloom-claimed "
+            "for remote)")
+        self._m_resurrections = reg.counter(
+            "router_session_resurrections_total",
+            "dead replicas whose disk spill namespace a survivor "
+            "adopted (shared kv_spill_dir)")
+        self._m_resurrected = reg.counter(
+            "router_resurrected_requests_total",
+            "re-enqueued requests whose prefix digests survived into "
+            "the adopter's spill tier (restore instead of full "
+            "recompute on the failover target)")
         self._m_reroutes = reg.counter(
             "router_reroutes_total",
             "requests re-routed off an overloaded replica",
@@ -749,11 +788,15 @@ class ReplicaRouter:
                      adapter: Optional[str] = None) -> tuple:
         """Placement decision only (no dispatch): returns
         ``(replica_name, digests, via)`` where ``via`` is 'affinity' |
-        'hash' | 'round_robin'. ``adapter`` scopes the placement key the
-        same way it scopes the replica-side prefix cache (the digests
-        ARE the replica's cache keys): the same prompt under different
-        adapters lands wherever each adapter's KV actually lives.
-        Exposed for the perf gate's dispatch-overhead probe."""
+        'spill' | 'hash' | 'round_robin'. ``adapter`` scopes the
+        placement key the same way it scopes the replica-side prefix
+        cache (the digests ARE the replica's cache keys): the same
+        prompt under different adapters lands wherever each adapter's
+        KV actually lives. 'spill' means no replica holds the prefix
+        HOT at that depth but one's advertised spill-tier bloom claims
+        it — restoring spilled KV beats recomputing it (a bloom false
+        positive silently recomputes). Exposed for the perf gate's
+        dispatch-overhead probe."""
         routable = self._routable()
         if not routable:
             return None, [], "none"
@@ -762,11 +805,27 @@ class ReplicaRouter:
         if self.config.placement == "affinity":
             digests = prefix_digest(np.asarray(list(prompt), np.int64),
                                     self.block_size, adapter=adapter)
-            # longest matching digest wins: the deepest shared prefix
+            summaries = []
+            if self.config.spill_placement and digests:
+                for r in routable:
+                    fn = getattr(r, "spill_summary", None)
+                    s = fn() if fn is not None else None
+                    if s is not None and s.entries:
+                        summaries.append((r, s))
+            # longest matching digest wins: the deepest shared prefix.
+            # At equal depth hot KV (affinity) beats spilled KV (the
+            # restore costs a host->device scatter the hot block
+            # doesn't); a DEEPER spill claim beats a shallower affinity
+            # entry because the walk is deepest-first over depths.
             for d in reversed(digests):
                 name = self._affinity.get(d)
                 if name is not None and name in names:
                     return name, digests, "affinity"
+                if summaries:
+                    claimants = [r for (r, s) in summaries if s.claims(d)]
+                    if claimants:
+                        best = min(claimants, key=lambda r: r.load())
+                        return best.name, digests, "spill"
         if self.config.placement == "round_robin":
             name = routable[next(self._rr) % len(routable)].name
             return name, digests, "round_robin"
@@ -832,9 +891,36 @@ class ReplicaRouter:
                 retry_after_s=self._soonest_backoff())
         if via == "affinity":
             self._m_aff_hits.inc()
+        elif via == "spill":
+            self._m_aff_miss.inc()
+            self._note_spill_placement(name, digests)
         else:
             self._m_aff_miss.inc()
         return name, digests
+
+    def _note_spill_placement(self, name: str, digests) -> None:
+        """Account a via='spill' placement: count the hit, the blocks
+        it expects to restore, and — where an exact check is possible —
+        a bloom false positive (placement gained nothing; the replica
+        recomputes silently, which is the designed degradation)."""
+        self._m_spill_hits.inc()
+        replica = self._by_name.get(name)
+        if replica is None:
+            return
+        summary = replica.spill_summary()
+        claimed = ([d for d in digests if summary.claims(d)]
+                   if summary is not None else [])
+        if not claimed:
+            return
+        probe = replica.spill_probe(claimed)
+        if probe is None:
+            # remote replica: no exact digest check over the wire —
+            # count the bloom-claimed blocks (documented-optimistic)
+            self._m_spill_restored.inc(len(claimed))
+        elif probe == 0:
+            self._m_spill_fp.inc()
+        else:
+            self._m_spill_restored.inc(probe)
 
     def _soonest_backoff(self) -> Optional[float]:
         now = self.clock()
@@ -1422,10 +1508,18 @@ class ReplicaRouter:
                 self._unsuspect(r.name)
         for replica in died:
             t0 = time.perf_counter()
-            requeued = failed = 0
+            requeued = failed = resurrected = 0
             replica.state = "dead"
             self._m_state.labels(replica=replica.name).set(-1)
             self._m_dead.inc()
+            # session resurrection: a survivor adopts the dead
+            # replica's disk spill namespace BEFORE the reap below
+            # closes the tier — the adoption moves the files out via
+            # atomic rename, so the reap's own-namespace sweep finds
+            # nothing to destroy
+            adopter = None
+            if self.config.resurrection:
+                adopter = await self._adopt_spill_from(replica)
             # empty the dead replica's admission queue so a later
             # recovery cannot also run the re-enqueued work, tell its
             # loop to halt (if the thread ever unwedges it cancels
@@ -1444,6 +1538,10 @@ class ReplicaRouter:
                     # elsewhere (prompts are idempotent)
                     self._m_requeued.inc()
                     requeued += 1
+                    if adopter is not None and \
+                            self._resurrects(rec, adopter):
+                        self._m_resurrected.inc()
+                        resurrected += 1
                     try:
                         await self._dispatch(rec)
                     except (OverloadedError, RequestFailed) as e:
@@ -1459,8 +1557,56 @@ class ReplicaRouter:
             trace.record("router_failover", t0,
                          time.perf_counter() - t0, lane=_ROUTER_LANE,
                          replica=replica.name, requeued=requeued,
-                         failed_mid_stream=failed)
+                         failed_mid_stream=failed,
+                         resurrected=resurrected)
         return [r.name for r in died]
+
+    async def _adopt_spill_from(self, dead) -> Optional[Replica]:
+        """Find the dead replica's disk spill namespace and have the
+        least-loaded routable survivor adopt it. Returns the adopter
+        (None when the dead replica had no disk tier, no survivor has
+        one, or the namespace was empty) — every failure mode degrades
+        to plain recompute, never a typed error."""
+        try:
+            fn = getattr(dead, "spill_namespace", None)
+            ns = fn() if fn is not None else None
+        except Exception:
+            return None
+        if not ns:
+            return None
+        for r in sorted(self._routable(), key=lambda r: r.load()):
+            try:
+                adopted = await r.adopt_spill(ns)
+            except Exception:
+                adopted = 0
+            if adopted:
+                self._m_resurrections.inc()
+                return r
+            # 0 = this survivor has no disk tier (or the source is
+            # already gone): try the next one — adoption is an atomic
+            # rename, so at most one survivor can win
+        return None
+
+    def _resurrects(self, rec: _RoutedRequest, adopter) -> bool:
+        """True when the re-enqueued request's prefix digests survive
+        in the adopter's spill tier (recompute avoided). Exact probe
+        in-process; bloom-claimed for remote adopters."""
+        try:
+            digests = prefix_digest(
+                np.asarray(rec.prompt, np.int64), self.block_size,
+                adapter=rec.kw.get("adapter"))
+        except Exception:
+            return False
+        if not digests:
+            return False
+        summary = adopter.spill_summary()
+        if summary is None:
+            return False
+        claimed = [d for d in digests if summary.claims(d)]
+        if not claimed:
+            return False
+        probe = adopter.spill_probe(claimed)
+        return bool(claimed) if probe is None else probe > 0
 
     # -- introspection (the ServingAPI surface) -------------------------
     def health(self) -> dict:

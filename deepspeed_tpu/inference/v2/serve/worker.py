@@ -179,7 +179,37 @@ class WorkerAPI(ServingAPI):
                             "perf_now": time.perf_counter(),
                             "wall_now": time.time()})
             return True
+        if method == "POST" and target == "/spill/adopt":
+            await self._spill_adopt(body, writer)
+            return True
         return False
+
+    async def _spill_adopt(self, body: bytes, writer) -> None:
+        """Adopt a dead peer's disk-tier spill namespace (router session
+        resurrection over a shared ``kv_spill_dir``). Answers with the
+        adopted-entry count and the post-adoption /healthz summary so
+        the caller's placement view updates without waiting a probe."""
+        try:
+            obj = json.loads(body.decode("utf-8")) if body else {}
+            ns = obj["namespace"]
+            if not isinstance(ns, str) or not ns:
+                raise ValueError("namespace must be a non-empty string")
+        except (ValueError, KeyError, UnicodeDecodeError) as e:
+            _json_response(writer, "400 Bad Request",
+                           {"error": "bad_request",
+                            "detail": f"{type(e).__name__}: {e}"})
+            return
+        try:
+            adopted = await self.replica.adopt_spill(ns)
+        except Exception as e:  # adoption failure degrades to recompute
+            _json_response(writer, "200 OK",
+                           {"adopted": 0, "name": self.replica.name,
+                            "detail": f"{type(e).__name__}: {e}"})
+            return
+        doc = self.replica.serving.spill_summary_doc()
+        _json_response(writer, "200 OK",
+                       {"adopted": adopted, "name": self.replica.name,
+                        "kv_spill": doc})
 
     async def _stop_replica(self) -> None:
         try:

@@ -14,11 +14,17 @@ with per-token policy logprobs. This package is the LEARNER half:
   loss through the engine's EXISTING jitted train step,
 * :mod:`~.loop` — :class:`ActorLearnerLoop`: rollout -> reward hook ->
   learn -> publish-every-N with quantized weight-DELTA payloads
-  (serve/weights.py) and staleness telemetry.
+  (serve/weights.py) and staleness telemetry,
+* :mod:`~.value` — :class:`CriticValueHead`: host-side fitted value
+  baseline (ridge regression over per-token features) for the
+  learner's ``value_fn`` hook — GAE against fitted values instead of
+  the reward-to-go degenerate case.
 """
 
 from .advantage import gae, whiten
 from .learner import PPOLearner
 from .loop import ActorLearnerLoop
+from .value import CriticValueHead
 
-__all__ = ["gae", "whiten", "PPOLearner", "ActorLearnerLoop"]
+__all__ = ["gae", "whiten", "PPOLearner", "ActorLearnerLoop",
+           "CriticValueHead"]

@@ -43,14 +43,21 @@ class ActorLearnerLoop:
     a per-token list per sample); ``prompts_fn(iteration) -> prompts``
     supplies each round's prompt batch. ``learner`` takes a prebuilt
     :class:`PPOLearner`; otherwise one is built from
-    ``**learner_kwargs``. ``rollout_kwargs`` are forwarded to
-    ``engine.rollout`` (max_new_tokens, temperature, seed, ...).
+    ``**learner_kwargs``. ``critic`` plugs a
+    :class:`~.value.CriticValueHead` (or anything with
+    ``observe(samples)`` + ``__call__(sample) -> [T] values``): the
+    loop feeds it each round's rewarded samples BEFORE the learner
+    step and — unless ``learner_kwargs`` pins its own ``value_fn`` —
+    installs it as the learner's value hook, so GAE runs against
+    fitted values instead of the reward-to-go degenerate case.
+    ``rollout_kwargs`` are forwarded to ``engine.rollout``
+    (max_new_tokens, temperature, seed, ...).
     """
 
     def __init__(self, engine, reward_fn: RewardFn,
                  prompts_fn: PromptsFn, publish_every: int = 4,
                  learner: Optional[PPOLearner] = None,
-                 rollout_kwargs: Optional[dict] = None,
+                 critic=None, rollout_kwargs: Optional[dict] = None,
                  **learner_kwargs):
         if publish_every < 1:
             raise ValueError(
@@ -59,6 +66,9 @@ class ActorLearnerLoop:
         self.reward_fn = reward_fn
         self.prompts_fn = prompts_fn
         self.publish_every = int(publish_every)
+        self.critic = critic
+        if critic is not None and learner is None:
+            learner_kwargs.setdefault("value_fn", critic)
         self.learner = learner if learner is not None \
             else PPOLearner(engine, **learner_kwargs)
         self.rollout_kwargs = dict(rollout_kwargs or {})
@@ -100,6 +110,10 @@ class ActorLearnerLoop:
         samples = self.engine.rollout(prompts, allow_stale=True,
                                       **self.rollout_kwargs)
         self._apply_rewards(samples)
+        if self.critic is not None:
+            # fit BEFORE the learner step: this round's advantages use
+            # a head that has seen this round's returns
+            self.critic.observe(samples)
         result = self.learner.step()
         if result is not None:
             self._steps_since_publish += 1
